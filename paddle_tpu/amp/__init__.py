@@ -1,0 +1,15 @@
+"""Automatic mixed precision.
+
+Reference parity: ``python/paddle/amp/`` — ``auto_cast`` (O1 white/black
+lists, O2 pure-fp16) and ``GradScaler`` over dynamic loss scaling
+(``python/paddle/fluid/dygraph/amp/loss_scaler.py:44``).
+
+TPU-native stance: bfloat16 is the native half type (MXU) and needs NO loss
+scaling — ``auto_cast`` defaults to bf16 and GradScaler becomes a pass-through
+unless fp16 is requested explicitly. The dynamic-scale machinery
+(found_inf detection, scale growth/backoff — reference
+``check_finite_and_unscale_op.cu`` / ``update_loss_scaling_op.cu``) is
+implemented functionally so it jits into the train step.
+"""
+from .auto_cast import amp_guard, auto_cast, autocast_call, decorate, is_autocast_enabled  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401

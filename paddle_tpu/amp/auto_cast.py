@@ -1,0 +1,101 @@
+"""Autocast context (reference ``python/paddle/amp/auto_cast.py``).
+
+O1: matmul/conv-class ops run in low precision (white list), numerically
+sensitive ops stay f32 (black list) — implemented by casting *inputs* at the
+layer boundary via a thread-local autocast state consulted by the compute
+layers. O2: cast the whole model to bf16 (``decorate``).
+
+On TPU the low dtype defaults to bfloat16; float16 is honored if asked.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+
+# ops that benefit from low precision (MXU-bound) — the O1 white list
+WHITE_OPS = {"matmul", "linear", "conv", "einsum", "attention"}
+# numerically sensitive — always f32 accumulation (the O1 black list)
+BLACK_OPS = {"softmax", "log_softmax", "layer_norm", "batch_norm", "reduce",
+             "cross_entropy", "exp", "log", "norm"}
+
+
+class _AutocastState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_state = _AutocastState()
+
+
+def is_autocast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_autocast_dtype():
+    return _state.dtype
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+
+
+amp_guard = auto_cast  # legacy alias (fluid.dygraph.amp.amp_guard)
+
+
+def autocast_call(op_kind: str, *tensors):
+    """Cast tensors per the active autocast policy; used by compute layers.
+
+    Returns tensors cast to the autocast dtype when ``op_kind`` is
+    white-listed, f32 when black-listed, unchanged otherwise.
+    """
+    if not _state.enabled:
+        return tensors
+    if op_kind in WHITE_OPS:
+        tgt = _state.dtype
+    elif op_kind in BLACK_OPS:
+        tgt = jnp.float32
+    else:
+        return tensors
+    out = tuple(t.astype(tgt) if t is not None and hasattr(t, "astype")
+                and jnp.issubdtype(jnp.asarray(t).dtype, jnp.floating) else t
+                for t in tensors)
+    return out
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype=None):
+    """O2 ("pure" low precision): cast model floating params to ``dtype``;
+    optimizers should enable multi_precision (f32 master weights) — done here
+    when the optimizer supports it (reference ``amp.decorate``)."""
+    d = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.to(d)
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for opt in opt_list:
+            if master_weight is not False:
+                opt.multi_precision = True
+        if models is None:
+            return opt_list[0] if opt_single else opt_list
+        return (model_list[0] if single else model_list,
+                opt_list[0] if opt_single else opt_list)
+    return model_list[0] if single else model_list

@@ -1,0 +1,112 @@
+"""Dynamic loss scaling (reference: ``python/paddle/amp/grad_scaler.py:26``
+over ``AmpScaler`` ``loss_scaler.py:44``; device kernels
+``check_finite_and_unscale_op.cu`` and ``update_loss_scaling_op.cu``).
+
+Functional core: ``scale_state`` is a small pytree carried through the jitted
+step; ``unscale_and_update`` checks grads for inf/nan, skips the step on
+overflow, and grows/backs off the scale — all inside the compiled program
+(no host sync, unlike the reference's found_inf readback).
+
+bf16 training does not need this; it exists for fp16 parity.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_scale_state(init_loss_scaling=2.0 ** 15, incr_ratio=2.0, decr_ratio=0.5,
+                     incr_every_n_steps=1000, decr_every_n_nan_or_inf=2):
+    return {
+        "scale": jnp.asarray(init_loss_scaling, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+        "bad_steps": jnp.zeros((), jnp.int32),
+        "incr_ratio": incr_ratio,
+        "decr_ratio": decr_ratio,
+        "incr_every_n_steps": incr_every_n_steps,
+        "decr_every_n_nan_or_inf": decr_every_n_nan_or_inf,
+    }
+
+
+def scale_loss(loss, state):
+    return loss * state["scale"]
+
+
+def unscale_and_check(grads, state):
+    """Returns (unscaled_grads, found_inf)."""
+    inv = 1.0 / state["scale"]
+    unscaled = jax.tree.map(lambda g: None if g is None else g * inv, grads,
+                            is_leaf=lambda x: x is None)
+    leaves = [g for g in jax.tree.leaves(unscaled) if g is not None]
+    found = jnp.zeros((), jnp.bool_)
+    for g in leaves:
+        found = found | ~jnp.all(jnp.isfinite(g))
+    return unscaled, found
+
+
+def update_scale(state, found_inf):
+    """Grow/backoff schedule, traced (reference update_loss_scaling)."""
+    good = jnp.where(found_inf, 0, state["good_steps"] + 1)
+    bad = jnp.where(found_inf, state["bad_steps"] + 1, 0)
+    grow = good >= state["incr_every_n_steps"]
+    shrink = bad >= state["decr_every_n_nan_or_inf"]
+    scale = state["scale"]
+    scale = jnp.where(grow, scale * state["incr_ratio"], scale)
+    scale = jnp.where(shrink, jnp.maximum(scale * state["decr_ratio"], 1.0), scale)
+    return {**state,
+            "scale": scale,
+            "good_steps": jnp.where(grow, 0, good),
+            "bad_steps": jnp.where(shrink, 0, bad)}
+
+
+class GradScaler:
+    """Paddle-shaped wrapper. In a jitted TrainStep, prefer the functional
+    helpers; this class packages them for the eager/hapi path and provides
+    ``minimize``-style semantics."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 use_dynamic_loss_scaling=True):
+        self.enable = enable
+        self.use_dynamic = use_dynamic_loss_scaling
+        self.state = init_scale_state(init_loss_scaling, incr_ratio, decr_ratio,
+                                      incr_every_n_steps, decr_every_n_nan_or_inf)
+
+    def scale(self, loss):
+        if not self.enable:
+            return loss
+        return scale_loss(loss, self.state)
+
+    def unscale_(self, grads):
+        if not self.enable:
+            return grads, jnp.zeros((), jnp.bool_)
+        return unscale_and_check(grads, self.state)
+
+    def step(self, optimizer, params, grads):
+        """Unscale, skip-on-overflow, update scale. Returns (params, opt_state_updated?)"""
+        if not self.enable:
+            return optimizer.step(params, grads)
+        unscaled, found = unscale_and_check(grads, self.state)
+        new_params = optimizer.step(params, unscaled)
+        # roll back if overflow: keep old params
+        rolled = jax.tree.map(lambda old, new: jnp.where(found, old, new), params, new_params)
+        if self.use_dynamic:
+            self.state = update_scale(self.state, found)
+        return rolled
+
+    def is_enable(self):
+        return self.enable
+
+    def get_loss_scaling(self):
+        return float(self.state["scale"])
+
+    def state_dict(self):
+        return dict(self.state)
+
+    def set_state_dict(self, sd):
+        self.state.update(sd)
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,135 @@
+"""Collective communication API.
+
+Reference parity: ``python/paddle/distributed/communication/`` (all_reduce /
+all_gather / alltoall / reduce_scatter / broadcast / send / recv over
+ProcessGroupNCCL) and the 160-file ``c_*`` op zoo
+(``paddle/fluid/operators/collective/``). TPU-native: a "group" is a mesh
+axis name; collectives are ``jax.lax`` primitives that XLA lowers onto
+ICI/DCN. Two usage modes:
+
+1. **Inside shard_map** (explicit SPMD — the PP/MoE/ring paths): these
+   functions are the direct analogue of the ``c_*`` ops.
+2. **Under plain pjit/GSPMD**: you rarely call these at all — sharding
+   annotations make XLA insert the collectives (the whole point, see
+   SURVEY §7 design stance).
+
+``ReduceOp`` and function signatures mirror paddle for porting ease.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis(group):
+    """Accept an axis name, tuple of names, or None (-> 'dp')."""
+    if group is None:
+        return "dp"
+    return group
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None):
+    axis = _axis(group)
+    if op == ReduceOp.SUM:
+        return lax.psum(tensor, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(tensor, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(tensor), axis))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_gather(tensor, group=None, axis=0):
+    """Gather shards along ``axis`` (reference ``c_allgather``)."""
+    return lax.all_gather(tensor, _axis(group), axis=axis, tiled=True)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, scatter_axis=0):
+    axis = _axis(group)
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError("reduce_scatter supports sum/avg")
+    out = lax.psum_scatter(tensor, axis, scatter_dimension=scatter_axis, tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / lax.psum(jnp.ones((), out.dtype), axis)
+    return out
+
+
+def broadcast(tensor, src=0, group=None):
+    """Select rank ``src``'s value on every rank (reference ``c_broadcast``)."""
+    axis = _axis(group)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, axis)
+
+
+def alltoall(tensor, group=None, split_axis=0, concat_axis=0):
+    """reference ``alltoall`` / MoE ``global_scatter`` building block."""
+    axis = _axis(group)
+    n = lax.axis_size(axis)
+    return lax.all_to_all(tensor, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(tensor, perm, group=None):
+    """Point-to-point ring shift — the PP/ring-attention primitive
+    (replaces the reference's batch_isend_irecv NCCL P2P)."""
+    return lax.ppermute(tensor, _axis(group), perm=perm)
+
+
+def shift_right(tensor, group=None):
+    axis = _axis(group)
+    n = lax.axis_size(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(tensor, axis, perm=perm)
+
+
+def shift_left(tensor, group=None):
+    axis = _axis(group)
+    n = lax.axis_size(axis)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    return lax.ppermute(tensor, axis, perm=perm)
+
+
+def axis_index(group=None):
+    return lax.axis_index(_axis(group))
+
+
+def axis_size_of(group=None):
+    return lax.axis_size(_axis(group))
+
+
+# ----------------------------------------------------------------- eager API
+def eager_all_reduce(tensor, op=ReduceOp.SUM, group=None, mesh=None):
+    """Paddle-style eager collective over a mesh axis: runs a tiny shard_map
+    program. For testing/metric aggregation, not hot paths."""
+    from jax.experimental.shard_map import shard_map
+    from .mesh import require_mesh, P
+
+    m = mesh or require_mesh()
+    axis = _axis(group)
+    spec = P(axis)
+    n = m.shape[axis]
+
+    def body(x):
+        return all_reduce(x, op=op, group=axis)
+
+    reshaped = jnp.asarray(tensor)[None].repeat(n, axis=0) if False else jnp.asarray(tensor)
+    # tensor is host-global; replicate then reduce is identity — instead treat
+    # leading dim as the axis shard dim
+    f = shard_map(body, mesh=m, in_specs=(spec,), out_specs=spec)
+    return f(reshaped)

@@ -1,0 +1,75 @@
+"""Process bootstrap & environment.
+
+Reference parity: ``python/paddle/distributed/parallel.py:98``
+(``init_parallel_env`` — env-var rank discovery, TCPStore rendezvous at
+``parallel.py:268``, NCCL comm init). TPU-native: JAX's distributed
+coordination service *is* the TCPStore+comm-init bundle — one call wires every
+host into a global runtime where ``jax.devices()`` spans the whole slice.
+NCCL-ring bootstrap ops (``c_gen_nccl_id``/``c_comm_init``) have no analogue:
+the mesh exists as soon as the runtime is up.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> None:
+    """Initialize multi-host execution. Single-process (one host, N chips)
+    needs no initialization — SPMD covers all local devices. Multi-host reads
+    either explicit args or the env contract:
+
+    - ``PADDLE_MASTER`` / ``MASTER_ADDR:MASTER_PORT`` -> coordinator
+    - ``PADDLE_TRAINERS_NUM`` / ``WORLD_SIZE``        -> process count
+    - ``PADDLE_TRAINER_ID`` / ``RANK``                -> process id
+    """
+    global _initialized
+    if _initialized:
+        return
+    coord = coordinator_address or os.environ.get("PADDLE_MASTER")
+    if coord is None and os.environ.get("MASTER_ADDR"):
+        coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', '8701')}"
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                os.environ.get("WORLD_SIZE", "1")))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    if coord is not None and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+
+
+def get_rank() -> int:
+    """Process (host) index — the unit of data loading and checkpoint IO."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def local_device_count() -> int:
+    return len(jax.local_devices())
+
+
+def is_initialized() -> bool:
+    return _initialized or jax.process_count() > 1
+
+
+def barrier(group=None):
+    """Host barrier (reference: GlooWrapper barrier, ``gloo_wrapper.h:139``)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -1,0 +1,1 @@
+from . import dtype, io, jit, random  # noqa: F401

@@ -1,0 +1,100 @@
+"""Dtype registry and helpers.
+
+Reference parity: paddle exposes string/VarType dtypes
+(``paddle/phi/common/data_type.h``); here dtypes are plain
+``jnp.dtype`` objects with paddle-style string aliases. TPU-first choices:
+bfloat16 is the preferred half precision (MXU native), float64 is discouraged
+(TPU emulates it) but supported for CPU testing.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical dtype table: paddle name -> jnp dtype
+_DTYPE_ALIASES = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+    # fp8 for quantized serving paths
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+float32 = jnp.float32
+float64 = jnp.float64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (string / np / jnp) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_ALIASES:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        return _DTYPE_ALIASES[dtype]
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Inverse of convert_dtype: jnp dtype -> paddle-style name."""
+    d = jnp.dtype(dtype)
+    for name, alias in _DTYPE_ALIASES.items():
+        if jnp.dtype(alias) == d:
+            return name
+    return d.name
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, np.floating):
+        raise ValueError("default dtype must be floating point")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, np.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, np.integer)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, np.complexfloating)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

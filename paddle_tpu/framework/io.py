@@ -1,0 +1,50 @@
+"""Serialization: ``paddle.save`` / ``paddle.load`` analogues.
+
+Reference: ``python/paddle/framework/io.py:637,879`` — pickled nested
+state_dicts. Same wire idea here: pytrees with jax arrays converted to numpy,
+pickled. Distributed/sharded checkpointing (orbax-backed, the ``dist_saver``
+analogue) lives in ``paddle_tpu.distributed.checkpoint``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_numpy_tree(obj: Any):
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree.map(conv, obj)
+
+
+def _to_jax_tree(obj: Any):
+    def conv(x):
+        if isinstance(x, np.ndarray):
+            return jnp.asarray(x)
+        return x
+
+    return jax.tree.map(conv, obj)
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return _to_jax_tree(obj)

@@ -1,0 +1,157 @@
+"""Compiled execution.
+
+The reference runs programs through ``InterpreterCore`` (instruction list +
+threadpool, ``paddle/fluid/framework/new_executor/interpretercore.cc``). On
+TPU the executor *is* XLA: a train/eval step is traced once, compiled, and
+cached keyed on shapes/shardings. This module packages that as:
+
+- :func:`jit` — paddle.jit.to_static analogue for plain functions/Layers.
+- :class:`TrainStep` — whole-step compilation: forward + loss + backward +
+  optimizer update in ONE XLA program with donated buffers (the analogue of
+  the reference's fused optimizer pass + executor pipeline).
+- :class:`EvalStep` — inference-only compiled step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import random as framework_random
+from ..nn.layer import Layer, buffer_state, functional_call, param_state
+
+
+def jit(fn=None, *, static_argnums=(), static_argnames=(), donate_argnums=()):
+    """``paddle.jit.to_static`` analogue. Accepts a function or a Layer.
+
+    For a Layer, returns a compiled callable closed over the layer's current
+    state (params become compile-time constants refreshed per call via
+    functional_call — use TrainStep for training).
+    """
+    if fn is None:
+        return functools.partial(jit, static_argnums=static_argnums,
+                                 static_argnames=static_argnames,
+                                 donate_argnums=donate_argnums)
+    if isinstance(fn, Layer):
+        layer = fn
+
+        params = param_state(layer)
+        buffers = buffer_state(layer)
+
+        @jax.jit
+        def _run(p, b, *args, **kwargs):
+            out, _ = functional_call(layer, p, b, *args, **kwargs)
+            return out
+
+        def wrapped(*args, **kwargs):
+            return _run(param_state(layer), buffer_state(layer), *args, **kwargs)
+
+        wrapped.__wrapped_layer__ = layer
+        return wrapped
+    return jax.jit(fn, static_argnums=static_argnums, static_argnames=static_argnames,
+                   donate_argnums=donate_argnums)
+
+
+class TrainStep:
+    """One-call training: ``loss = step(batch)``.
+
+    ``loss_fn(outputs, batch) -> scalar`` or pass ``model_loss=True`` when the
+    model's forward already returns the loss. The compiled program:
+    forward -> grad -> (optional grad transforms) -> optimizer update,
+    with params/buffers/opt_state donated (in-place buffer reuse in HBM).
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
+                 inputs_fn: Optional[Callable] = None,
+                 grad_transform: Optional[Callable] = None, donate: bool = True,
+                 rng_streams=("dropout", "rrelu", "gumbel", "default")):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        # which part of the batch feeds the model: default is batch[0] for
+        # (inputs, labels) tuples when a loss_fn is given, whole batch otherwise
+        if inputs_fn is None:
+            if loss_fn is not None:
+                inputs_fn = lambda b: b[0] if isinstance(b, (tuple, list)) else b  # noqa: E731
+            else:
+                inputs_fn = lambda b: b  # noqa: E731
+        self.inputs_fn = inputs_fn
+        self.grad_transform = grad_transform
+        self.params = param_state(model)
+        self.buffers = buffer_state(model)
+        self.opt_state = optimizer.init(self.params)
+        self._rng_streams = tuple(rng_streams)
+        self._base_key = framework_random.next_key()
+        self._count = 0
+        donate_argnums = (0, 1, 2) if donate else ()
+        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
+
+    def _make_rngs(self, key):
+        keys = jax.random.split(key, len(self._rng_streams))
+        return dict(zip(self._rng_streams, keys))
+
+    def _step(self, params, buffers, opt_state, batch, key):
+        rngs = self._make_rngs(key)
+
+        def compute_loss(p):
+            inputs = self.inputs_fn(batch)
+            if not isinstance(inputs, (tuple, list)):
+                inputs = (inputs,)
+            out, new_buf = functional_call(self.model, p, buffers, *inputs, rngs=rngs)
+            loss = out if self.loss_fn is None else self.loss_fn(out, batch)
+            return jnp.asarray(loss, jnp.float32), (new_buf, out)
+
+        (loss, (new_buffers, _)), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        if self.grad_transform is not None:
+            grads = self.grad_transform(grads)
+        new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        return loss, new_params, new_buffers, new_opt_state
+
+    def __call__(self, batch):
+        key = jax.random.fold_in(self._base_key, self._count)
+        self._count += 1
+        loss, self.params, self.buffers, self.opt_state = self._compiled(
+            self.params, self.buffers, self.opt_state, batch, key)
+        return loss
+
+    # ----------------------------------------------------------- state sync
+    def sync_to_model(self):
+        """Write the step's current params/buffers back into the Layer
+        (for checkpointing / eval through the eager path)."""
+        for name, v in self.params.items():
+            self.model._set_by_path(name, v)
+        for name, v in self.buffers.items():
+            self.model._set_by_path(name, v)
+        return self.model
+
+    def load_from_model(self):
+        self.params = param_state(self.model)
+        self.buffers = buffer_state(self.model)
+        return self
+
+    def state_dict(self):
+        return {"params": self.params, "buffers": self.buffers,
+                "opt_state": self.opt_state, "count": self._count}
+
+    def set_state_dict(self, sd):
+        self.params = sd["params"]
+        self.buffers = sd["buffers"]
+        self.opt_state = sd["opt_state"]
+        self._count = sd.get("count", 0)
+
+
+class EvalStep:
+    def __init__(self, model: Layer):
+        self.model = model
+
+        @jax.jit
+        def _run(params, buffers, *args):
+            out, _ = functional_call(model, params, buffers, *args)
+            return out
+
+        self._compiled = _run
+
+    def __call__(self, *args):
+        return self._compiled(param_state(self.model), buffer_state(self.model), *args)

@@ -1,0 +1,66 @@
+"""Global RNG state.
+
+The reference keeps per-device cuRAND generators behind ``paddle.seed``
+(``paddle/fluid/framework/generator.cc``). JAX randomness is functional, so the
+framework keeps one global :class:`Generator` that hands out fresh subkeys by
+splitting. Outside ``jit`` this gives paddle-style "stateful" randomness; code
+that runs under ``jit`` must thread keys explicitly (see
+``paddle_tpu.nn.layer.RNGContext`` which supplies named key streams to layers
+during a functional call, the analogue of the reference's
+``RNGStatesTracker``, ``python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:32``).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    """Splittable stateful PRNG wrapper around ``jax.random.key``."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def next_key(self):
+        """Return a fresh subkey; mutates internal state."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+_default_generator = Generator(0)
+
+
+def seed(value: int) -> Generator:
+    """Set the global seed (``paddle.seed`` analogue)."""
+    return _default_generator.manual_seed(value)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    """Fresh subkey from the global generator (eager-mode randomness)."""
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

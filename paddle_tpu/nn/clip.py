@@ -1,0 +1,76 @@
+"""Gradient clipping (reference: ``python/paddle/fluid/clip.py`` —
+ClipGradByValue / ClipGradByNorm / ClipGradByGlobalNorm).
+
+Each clip is a pure pytree->pytree function; the hybrid-parallel variant that
+sums norm contributions across mesh axes lives in
+``paddle_tpu.distributed.parallel.hybrid_optimizer``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree.map(
+            lambda g: None if g is None else jnp.clip(g, self.min, self.max), grads,
+            is_leaf=lambda x: x is None)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            if g is None:
+                return None
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return jax.tree.map(clip_one, grads, is_leaf=lambda x: x is None)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip across the whole gradient pytree."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = [g for g in jax.tree.leaves(grads) if g is not None]
+        if not leaves:
+            return grads
+        gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gnorm = jnp.sqrt(gnorm_sq)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return jax.tree.map(
+            lambda g: None if g is None else (g.astype(jnp.float32) * scale).astype(g.dtype),
+            grads, is_leaf=lambda x: x is None)
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2.0):
+    """Functional torch-style helper; returns (clipped, total_norm)."""
+    leaves = [g for g in jax.tree.leaves(grads) if g is not None]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in leaves])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    clipped = jax.tree.map(lambda g: None if g is None else (g * scale).astype(g.dtype),
+                           grads, is_leaf=lambda x: x is None)
+    return clipped, total

@@ -1,0 +1,952 @@
+"""Functional neural-net ops (reference: ``python/paddle/nn/functional/``).
+
+Each function is a pure jnp/lax composition — the conv/matmul ops hit the MXU
+via a single ``lax.conv_general_dilated``/``dot_general``; elementwise
+epilogues (bias, activation) are fused by XLA, which is why there is no
+``fused_*`` op zoo here (reference keeps 39k LoC of fused CUDA ops under
+``paddle/fluid/operators/fused/``).
+
+Layout: paddle defaults to NCHW; ``data_format`` is honored and NHWC is the
+TPU-friendly fast path.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+from .layer import take_rng_key
+
+# ------------------------------------------------------------- activations
+relu = jax.nn.relu
+relu6 = jax.nn.relu6
+sigmoid = jax.nn.sigmoid
+softplus_ = jax.nn.softplus
+silu = jax.nn.silu
+swish = jax.nn.silu
+elu = jax.nn.elu
+selu = jax.nn.selu
+celu = jax.nn.celu
+glu = jax.nn.glu
+
+
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    if w.size > 1:
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    x = jnp.asarray(x)
+    if training:
+        a = jax.random.uniform(take_rng_key("rrelu"), x.shape, dtype=x.dtype,
+                               minval=lower, maxval=upper)
+    else:
+        a = jnp.asarray((lower + upper) / 2.0, x.dtype)
+    return jnp.where(x >= 0, x, a * x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, jnp.zeros_like(x)))
+
+
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x, jnp.zeros_like(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * jnp.asarray(x) + offset, 0.0, 1.0)
+
+
+def hardswish(x, name=None):
+    x = jnp.asarray(x)
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+def softsign(x, name=None):
+    return jax.nn.soft_sign(x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1 :]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = jnp.asarray(x)
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = jnp.asarray(x)
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = jax.random.gumbel(take_rng_key("gumbel"), jnp.shape(x), dtype=jnp.asarray(x).dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.put_along_axis(
+            jnp.zeros_like(y), idx, jnp.ones([], y.dtype), axis=axis, inplace=False)
+        y = jax.lax.stop_gradient(onehot - y) + y  # straight-through
+    return y
+
+
+# ------------------------------------------------------------- linear / embedding
+def linear(x, weight, bias=None, name=None):
+    """paddle weight layout: [in_features, out_features]."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    del sparse  # XLA gather handles both densities
+    out = jnp.take(jnp.asarray(weight), jnp.asarray(x), axis=0)
+    if padding_idx is not None:
+        mask = (jnp.asarray(x) == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(jnp.asarray(x), num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = jnp.asarray(label)
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * jnp.asarray(prior_dist)
+    return (1 - epsilon) * label + epsilon / k
+
+
+# ------------------------------------------------------------- normalization
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = jnp.asarray(x)
+    nrm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = jnp.asarray(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(tuple(normalized_shape)), x.ndim))
+    # compute stats in f32 for bf16 inputs (TPU norm-stability idiom)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """Not in the reference (predates RMSNorm adoption); required for the
+    Llama family (BASELINE.md)."""
+    x = jnp.asarray(x)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Returns (out, new_running_mean, new_running_var).
+
+    Unlike the reference's in-place stat mutation (``batch_norm_kernel.cu``),
+    updated stats are returned functionally; ``nn.BatchNorm`` layers write
+    them back into their buffers.
+    """
+    x = jnp.asarray(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+        n = x.size // x.shape[ch_axis]
+        unbiased = var * n / max(n - 1, 1)
+        new_mean = momentum * running_mean + (1 - momentum) * mean.astype(running_mean.dtype)
+        new_var = momentum * running_var + (1 - momentum) * unbiased.astype(running_var.dtype)
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+
+    out = (x - mean.reshape(shape).astype(x.dtype)) * jax.lax.rsqrt(
+        var.reshape(shape).astype(jnp.float32) + epsilon
+    ).astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_mean, new_var
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    if data_format.startswith("NC"):
+        N, C = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        g = x.reshape(N, num_groups, C // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+        out = g.reshape(x.shape)
+        shape = [1, C] + [1] * len(spatial)
+    else:
+        N, C = x.shape[0], x.shape[-1]
+        spatial = x.shape[1:-1]
+        g = x.reshape(N, *spatial, num_groups, C // num_groups)
+        axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+        out = g.reshape(x.shape)
+        shape = [1] * (x.ndim - 1) + [C]
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=reduce_axes, keepdims=True)
+    var = jnp.var(x, axis=reduce_axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        out = out * weight.reshape(shape)
+        if bias is not None:
+            out = out + bias.reshape(shape)
+    return out
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    moved = jnp.moveaxis(sq, ch_axis, -1)
+    pad_lo = (size - 1) // 2
+    pad_hi = size - 1 - pad_lo
+    padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(pad_lo, pad_hi)])
+    windows = jnp.stack([jnp.roll(padded, -i, axis=-1)[..., : moved.shape[-1]] for i in range(size)], axis=0)
+    summed = jnp.sum(windows, axis=0)
+    summed = jnp.moveaxis(summed, -1, ch_axis)
+    return x / jnp.power(k + alpha * summed, beta)
+
+
+# ------------------------------------------------------------- dropout
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = jnp.asarray(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    key = take_rng_key("dropout")
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = tuple(x.shape[i] if i in axes else 1 for i in range(x.ndim))
+    else:
+        mask_shape = x.shape
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = jnp.asarray(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = take_rng_key("dropout")
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / math.sqrt((1.0 - p) * (1.0 + p * alpha_p**2)))
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, jnp.full_like(x, alpha_p)) + b
+
+
+# ------------------------------------------------------------- conv / pool
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_dim_numbers(ndim, channel_last):
+    if ndim == 3:
+        return ("NCL", "OIL", "NCL") if not channel_last else ("NLC", "OIL", "NLC")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _conv_padding(padding, n_spatial, kernel, stride, dilation):
+    """paddle padding: int | list | 'SAME' | 'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n_spatial
+    padding = list(padding)
+    if len(padding) == n_spatial and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n_spatial:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n_spatial)]
+    return [tuple(p) for p in padding]
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, n_spatial, channel_last):
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    stride = _pair(stride, n_spatial)
+    dilation = _pair(dilation, n_spatial)
+    kernel = w.shape[2:]
+    pad = _conv_padding(padding, n_spatial, kernel, stride, dilation)
+    lhs_spec, rhs_spec, out_spec = _conv_dim_numbers(x.ndim, channel_last)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        b_shape = [1] * out.ndim
+        b_shape[out.ndim - 1 if channel_last else 1] = -1
+        out = out + jnp.asarray(bias, out.dtype).reshape(b_shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1, data_format == "NLC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2, data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format == "NDHWC")
+
+
+def _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                      groups, n_spatial, channel_last):
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    stride = _pair(stride, n_spatial)
+    dilation = _pair(dilation, n_spatial)
+    kernel = w.shape[2:]
+    pad = _conv_padding(padding, n_spatial, kernel, stride, dilation)
+    opad = _pair(output_padding, n_spatial)
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    lhs_spec, rhs_spec, out_spec = _conv_dim_numbers(x.ndim, channel_last)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, (w.shape[1] * groups, w.shape[0] // groups) + tuple(kernel),
+        (lhs_spec, rhs_spec, out_spec))
+    if isinstance(pad, str):
+        trans_pad = pad
+    else:
+        trans_pad = [
+            (dilation[i] * (kernel[i] - 1) - pad[i][0],
+             dilation[i] * (kernel[i] - 1) - pad[i][1] + opad[i])
+            for i in range(n_spatial)
+        ]
+    # gradient-of-conv formulation: dilate the input by stride
+    w_t = jnp.swapaxes(w, 0, 1)  # -> [out_c/groups, in_c, *k]
+    if groups > 1:
+        # regroup: [g, out_c/g, in_c/g, *k] with flipped spatial
+        w_g = w.reshape(groups, w.shape[0] // groups, *w.shape[1:])
+        w_g = jnp.swapaxes(w_g, 1, 2)  # g, out/g, in/g, *k
+        w_t = w_g.reshape(w.shape[1] * groups, w.shape[0] // groups, *kernel)
+    w_t = jnp.flip(w_t, axis=tuple(range(2, w_t.ndim)))
+    out = jax.lax.conv_general_dilated(
+        x, w_t.astype(x.dtype), window_strides=(1,) * n_spatial, padding=trans_pad,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        b_shape = [1] * out.ndim
+        b_shape[out.ndim - 1 if channel_last else 1] = -1
+        out = out + jnp.asarray(bias, out.dtype).reshape(b_shape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCL", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding,
+                             dilation, groups, 1, data_format == "NLC")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW", output_size=None, name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding,
+                             dilation, groups, 2, data_format == "NHWC")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCDHW", output_size=None, name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding,
+                             dilation, groups, 3, data_format == "NDHWC")
+
+
+def _pool(x, kernel_size, stride, padding, n_spatial, channel_last, reducer, init, ceil_mode=False):
+    x = jnp.asarray(x)
+    kernel_size = _pair(kernel_size, n_spatial)
+    stride = _pair(stride if stride is not None else kernel_size, n_spatial)
+    pad = _conv_padding(padding, n_spatial, kernel_size, stride, (1,) * n_spatial)
+    if channel_last:
+        dims = (1,) + tuple(kernel_size) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = [(0, 0)] + (list(pad) if not isinstance(pad, str) else pad) + [(0, 0)]
+    else:
+        dims = (1, 1) + tuple(kernel_size)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + (list(pad) if not isinstance(pad, str) else pad)
+    if isinstance(pad, str):
+        pads = pad
+    elif ceil_mode:
+        # extend high padding so the last partial window is included
+        spatial_axes = range(1, 1 + n_spatial) if channel_last else range(2, 2 + n_spatial)
+        pads = list(pads)
+        for i, ax in enumerate(spatial_axes):
+            size = x.shape[ax] + pads[ax][0] + pads[ax][1]
+            rem = (size - kernel_size[i]) % stride[i]
+            if rem != 0:
+                pads[ax] = (pads[ax][0], pads[ax][1] + stride[i] - rem)
+    return jax.lax.reduce_window(x, init, reducer, dims, strides, pads)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                jax.lax.max, -jnp.inf if jnp.issubdtype(jnp.asarray(x).dtype, np.floating)
+                else jnp.iinfo(jnp.asarray(x).dtype).min, ceil_mode)
+    if return_mask:
+        raise NotImplementedError("return_mask is not supported on the TPU backend yet")
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    x4 = jnp.expand_dims(jnp.asarray(x), -1)
+    k = _pair(kernel_size, 1) + (1,)
+    s = None if stride is None else _pair(stride, 1) + (1,)
+    p = _pair(padding, 1) + (0,) if not isinstance(padding, str) else padding
+    out = max_pool2d(x4, k, s, p, ceil_mode=ceil_mode)
+    return jnp.squeeze(out, -1)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 jax.lax.max, -jnp.inf, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    summed = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                   jax.lax.add, 0.0 if jnp.issubdtype(x.dtype, np.floating) else 0, ceil_mode)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive:
+        ones = jnp.ones_like(x)
+        counts = _pool(ones, kernel_size, stride, padding, 2, data_format == "NHWC",
+                       jax.lax.add, 0.0, ceil_mode)
+        return summed / counts
+    k = _pair(kernel_size, 2)
+    return summed / (k[0] * k[1])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, name=None):
+    x4 = jnp.expand_dims(jnp.asarray(x), -1)
+    k = _pair(kernel_size, 1) + (1,)
+    s = None if stride is None else _pair(stride, 1) + (1,)
+    p = _pair(padding, 1) + (0,) if not isinstance(padding, str) else padding
+    out = avg_pool2d(x4, k, s, p, ceil_mode=ceil_mode, exclusive=exclusive)
+    return jnp.squeeze(out, -1)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    x = jnp.asarray(x)
+    summed = _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                   jax.lax.add, 0.0, ceil_mode)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive:
+        counts = _pool(jnp.ones_like(x), kernel_size, stride, padding, 3,
+                       data_format == "NDHWC", jax.lax.add, 0.0, ceil_mode)
+        return summed / counts
+    k = _pair(kernel_size, 3)
+    return summed / (k[0] * k[1] * k[2])
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    out_h, out_w = _pair(output_size, 2)
+    if data_format == "NCHW":
+        H, W = x.shape[2], x.shape[3]
+    else:
+        H, W = x.shape[1], x.shape[2]
+    if out_h is None:
+        out_h = H
+    if out_w is None:
+        out_w = W
+    if H % out_h == 0 and W % out_w == 0:
+        kh, kw = H // out_h, W // out_w
+        return avg_pool2d(x, (kh, kw), (kh, kw), 0, data_format=data_format)
+    # general adaptive: per-output-cell variable windows via mean over gathers
+    def pool_axis(arr, axis, out_size):
+        size = arr.shape[axis]
+        starts = (np.arange(out_size) * size) // out_size
+        ends = ((np.arange(out_size) + 1) * size + out_size - 1) // out_size
+        segs = [jnp.mean(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis), axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)]
+        return jnp.concatenate(segs, axis=axis)
+
+    h_ax, w_ax = (2, 3) if data_format == "NCHW" else (1, 2)
+    return pool_axis(pool_axis(x, h_ax, out_h), w_ax, out_w)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = jnp.asarray(x)
+    out_h, out_w = _pair(output_size, 2)
+    H, W = x.shape[2], x.shape[3]
+    if H % out_h == 0 and W % out_w == 0:
+        kh, kw = H // out_h, W // out_w
+        return max_pool2d(x, (kh, kw), (kh, kw), 0)
+
+    def pool_axis(arr, axis, out_size):
+        size = arr.shape[axis]
+        starts = (np.arange(out_size) * size) // out_size
+        ends = ((np.arange(out_size) + 1) * size + out_size - 1) // out_size
+        segs = [jnp.max(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis), axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)]
+        return jnp.concatenate(segs, axis=axis)
+
+    return pool_axis(pool_axis(x, 2, out_h), 3, out_w)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x4 = jnp.expand_dims(jnp.asarray(x), -1)
+    out = adaptive_avg_pool2d(x4, (output_size, 1))
+    return jnp.squeeze(out, -1)
+
+
+# ------------------------------------------------------------- vision
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    channel_last = not data_format.startswith("NC")
+    n_spatial = x.ndim - 2
+    if channel_last:
+        spatial = x.shape[1:-1]
+    else:
+        spatial = x.shape[2:]
+    if size is None:
+        sf = _pair(scale_factor, n_spatial)
+        size = tuple(int(s * f) for s, f in zip(spatial, sf))
+    else:
+        size = tuple(int(s) for s in _pair(size, n_spatial))
+    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+              "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if channel_last:
+        new_shape = (x.shape[0],) + size + (x.shape[-1],)
+    else:
+        new_shape = x.shape[:2] + size
+    if method == "nearest":
+        return jax.image.resize(x, new_shape, method="nearest")
+    if align_corners:
+        # jax.image.resize has no align_corners; emulate with explicit gather
+        idx = []
+        for i, (in_s, out_s) in enumerate(zip(spatial, size)):
+            if out_s == 1:
+                pos = jnp.zeros((1,), jnp.float32)
+            else:
+                pos = jnp.linspace(0.0, in_s - 1.0, out_s)
+            idx.append(pos)
+        return _separable_linear_resize(x, idx, channel_last)
+    return jax.image.resize(x, new_shape, method=method)
+
+
+def _separable_linear_resize(x, positions, channel_last):
+    n_spatial = len(positions)
+    first_spatial_axis = 1 if channel_last else 2
+    out = x
+    for i, pos in enumerate(positions):
+        axis = first_spatial_axis + i
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, x.shape[axis] - 1 if False else out.shape[axis] - 1)
+        w = (pos - lo).astype(out.dtype)
+        lo = jnp.clip(lo, 0, out.shape[axis] - 1)
+        a = jnp.take(out, lo, axis=axis)
+        b = jnp.take(out, hi, axis=axis)
+        shape = [1] * out.ndim
+        shape[axis] = -1
+        out = a * (1 - w.reshape(shape)) + b * w.reshape(shape)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    r = upscale_factor
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        x = x.reshape(N, C // (r * r), r, r, H, W)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(N, C // (r * r), H * r, W * r)
+    N, H, W, C = x.shape
+    x = x.reshape(N, H, W, r, r, C // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(N, H * r, W * r, C // (r * r))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = jnp.asarray(x)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+    N, C, H, W = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)], rhs_dilation=(dh, dw),
+        dimension_numbers=jax.lax.conv_dimension_numbers(x.shape, (1, 1, kh, kw), ("NCHW", "OIHW", "NCHW")),
+    )
+    return patches.reshape(N, C * kh * kw, -1)
+
+
+# ------------------------------------------------------------- losses
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _reduce_loss(jnp.square(jnp.asarray(input) - jnp.asarray(label)), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _reduce_loss(jnp.abs(jnp.asarray(input) - jnp.asarray(label)), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    d = jnp.asarray(input) - jnp.asarray(label)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    """Softmax cross entropy. TP-sharded variant lives in
+    ``paddle_tpu.distributed.parallel.mp_layers.parallel_cross_entropy``."""
+    logits = jnp.asarray(input)
+    label = jnp.asarray(label)
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+    if soft_label or (label.ndim == logits.ndim and label.shape == logits.shape):
+        target = label.astype(logp.dtype)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            target = (1 - label_smoothing) * target + label_smoothing / k
+        loss = -jnp.sum(target * logp, axis=axis)
+        return _reduce_loss(loss, reduction)
+    # hard labels (class indices); paddle allows a trailing 1 dim
+    if label.ndim == logits.ndim and label.shape[axis] == 1:
+        label = jnp.squeeze(label, axis=axis)
+    valid = label != ignore_index
+    safe_label = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(logp, safe_label[..., None].astype(jnp.int32), axis=axis)[..., 0]
+    if label_smoothing > 0:
+        k = logits.shape[axis]
+        smooth_term = jnp.mean(logp, axis=axis)
+        picked = (1 - label_smoothing) * picked + label_smoothing * smooth_term
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight), safe_label)
+        loss = loss * w
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, w, 0.0))
+            return jnp.sum(jnp.where(valid, loss, 0.0)) / jnp.maximum(denom, 1e-12)
+    loss = jnp.where(valid, loss, jnp.zeros_like(loss))
+    if reduction == "mean":
+        n_valid = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / n_valid
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)[..., None]
+    if return_softmax:
+        return loss, jax.nn.softmax(jnp.asarray(logits), axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    logp = jnp.asarray(input)
+    label = jnp.asarray(label)
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight), safe)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(jnp.where(valid, loss, 0.0)) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    loss = jnp.where(valid, loss, jnp.zeros_like(loss))
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    p = jnp.clip(jnp.asarray(input), 1e-12, 1.0 - 1e-7)
+    label = jnp.asarray(label)
+    loss = -(label * jnp.log(p) + (1 - label) * jnp.log1p(-p))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    z = jnp.asarray(logit)
+    label = jnp.asarray(label)
+    # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+    base = jnp.maximum(z, 0) - z * label + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    if pos_weight is not None:
+        pw = jnp.asarray(pos_weight)
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        base = -(pw * label * log_sig + (1 - label) * log_sig_neg)
+    loss = base
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    logp = jnp.asarray(input)
+    target = jnp.asarray(label)
+    loss = target * (jnp.log(jnp.clip(target, 1e-12, None)) - logp)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / loss.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    loss = jnp.maximum(0.0, -jnp.asarray(label) * (jnp.asarray(input) - jnp.asarray(other)) + margin)
+    return _reduce_loss(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = jnp.asarray(x1), jnp.asarray(x2)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    cos = cosine_similarity(input1, input2, axis=-1)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce_loss(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, eps=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    a, pos, neg = jnp.asarray(input), jnp.asarray(positive), jnp.asarray(negative)
+    d_pos = jnp.linalg.norm(a - pos + eps, ord=p, axis=-1)
+    d_neg = jnp.linalg.norm(a - neg + eps, ord=p, axis=-1)
+    if swap:
+        d_neg = jnp.minimum(d_neg, jnp.linalg.norm(pos - neg + eps, ord=p, axis=-1))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce_loss(loss, reduction)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(jnp.asarray(input) - jnp.asarray(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    z = jnp.asarray(logit)
+    y = jnp.asarray(label)
+    p = jax.nn.sigmoid(z)
+    ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce_loss(loss, reduction)
+
+
+# ------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """[B, L, H, D] layout (paddle convention). Dispatches to the Pallas
+    flash-attention kernel on TPU for long sequences; falls back to the XLA
+    composition otherwise (XLA fuses the softmax chain well up to ~2k seq).
+    """
+    q, k, v = jnp.asarray(query), jnp.asarray(key), jnp.asarray(value)
+    from ..kernels import flash_attention as _fa
+
+    if _fa.should_use_flash(q, k, attn_mask, dropout_p):
+        return _fa.flash_attention_blhd(q, k, v, causal=is_causal)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    # -> [B, H, L, D]
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if is_causal:
+        Lq, Lk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
+        scores = jnp.where(causal, scores, jnp.asarray(-jnp.inf, scores.dtype))
+    if attn_mask is not None:
+        m = jnp.asarray(attn_mask)
+        if m.dtype == jnp.bool_:
+            scores = jnp.where(m, scores, jnp.asarray(-jnp.inf, scores.dtype))
+        else:
+            scores = scores + m.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, p=dropout_p, training=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+# ------------------------------------------------------------- sequence utils
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        raise ValueError("maxlen must be static under jit; pass it explicitly")
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lengths[..., None]
+    return mask.astype(convert_dtype(dtype))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    NT, C, H, W = x.shape
+    x = x.reshape(NT // seg_num, seg_num, C, H, W)
+    fold = int(C * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold], jnp.zeros_like(x[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]), x[:, :-1, fold:2 * fold]], axis=1)
+    mid = x[:, :, 2 * fold:]
+    out = jnp.concatenate([left, right, mid], axis=2)
+    return out.reshape(NT, C, H, W)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ..ops.manipulation import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)

@@ -1,0 +1,191 @@
+"""Parameter initializers.
+
+Reference parity: ``python/paddle/nn/initializer/`` (Constant, Normal,
+TruncatedNormal, Uniform, Xavier*, Kaiming*, Assign, Orthogonal, Dirac).
+Each initializer is a callable ``(key, shape, dtype) -> jax.Array``; keys come
+from the global generator at layer-construction time.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle linear weights are [in, out]
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c, *spatial] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.normal(key, shape, dtype=dtype) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype):
+        # truncation at 2 sigma, matching the reference's
+        # truncated_gaussian_random kernel
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=dtype) * self.std + self.mean
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype=dtype, minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, key, shape, dtype):
+        fin, fout = _fans(shape)
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        std = self.gain * math.sqrt(2.0 / (fin + fout))
+        return jax.random.normal(key, shape, dtype=dtype) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, key, shape, dtype):
+        fin, fout = _fans(shape)
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        limit = self.gain * math.sqrt(6.0 / (fin + fout))
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, key, shape, dtype):
+        fin, _ = _fans(shape)
+        fin = self.fan_in or fin
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2)) if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        std = gain / math.sqrt(fin)
+        return jax.random.normal(key, shape, dtype=dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, key, shape, dtype):
+        fin, _ = _fans(shape)
+        fin = self.fan_in or fin
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2)) if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        limit = gain * math.sqrt(3.0 / fin)
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        out = jnp.asarray(self.value, dtype=dtype)
+        if tuple(out.shape) != tuple(shape):
+            out = out.reshape(shape)
+        return out
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype):
+        return jax.nn.initializers.orthogonal(scale=self.gain)(key, shape, dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, key, shape, dtype):
+        # identity-preserving conv kernel [out_c, in_c, *spatial]
+        out = np.zeros(shape, dtype=np.float32)
+        out_c, in_c = shape[0], shape[1]
+        spatial_center = tuple(s // 2 for s in shape[2:])
+        per_group = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per_group, in_c)):
+                out[(g * per_group + i, i) + spatial_center] = 1.0
+        return jnp.asarray(out, dtype=dtype)
+
+
+def _resolve_initializer(attr, default_initializer):
+    """Accept a ParamAttr-ish object, an Initializer, or None."""
+    if default_initializer is not None:
+        return default_initializer
+    if attr is None or attr is False:
+        return None
+    if isinstance(attr, Initializer):
+        return attr
+    init = getattr(attr, "initializer", None)
+    if isinstance(init, Initializer):
+        return init
+    return None
+
+
+# paddle also exposes functional-style aliases
+constant = Constant
+normal = Normal
+uniform = Uniform
+xavier_normal = XavierNormal
+xavier_uniform = XavierUniform
+kaiming_normal = KaimingNormal
+kaiming_uniform = KaimingUniform
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a**2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

@@ -1,0 +1,211 @@
+"""Recurrent layers (reference: ``python/paddle/nn/layer/rnn.py``).
+
+TPU-native: the time loop is a single ``lax.scan`` — one compiled kernel per
+layer/direction instead of the reference's per-step cuDNN calls. Input layout
+[batch, time, size] when ``time_major=False`` (paddle default).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..initializer import Uniform
+from ..layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_size, hidden_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, hidden_size), dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], self.hidden_size, inputs.dtype)
+        pre = inputs @ self.weight_ih.T + self.bias_ih + states @ self.weight_hh.T + self.bias_hh
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h = act(pre)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = self.get_initial_states(inputs.shape[0], self.hidden_size, inputs.dtype)
+            states = (z, z)
+        h, c = states
+        gates = inputs @ self.weight_ih.T + self.bias_ih + h @ self.weight_hh.T + self.bias_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], self.hidden_size, inputs.dtype)
+        h = states
+        gi = inputs @ self.weight_ih.T + self.bias_ih
+        gh = h @ self.weight_hh.T + self.bias_hh
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        c = jnp.tanh(ic + r * hc)
+        h_new = (1.0 - z) * c + z * h
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Wraps a cell into a scanned sequence layer."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = jnp.asarray(inputs)
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)  # -> [T, B, C]
+        if self.is_reverse:
+            x = jnp.flip(x, axis=0)
+        if initial_states is None:
+            if isinstance(self.cell, LSTMCell):
+                z = jnp.zeros((x.shape[1], self.cell.hidden_size), x.dtype)
+                initial_states = (z, z)
+            else:
+                initial_states = jnp.zeros((x.shape[1], self.cell.hidden_size), x.dtype)
+
+        cell = self.cell
+
+        def step(state, xt):
+            out, new_state = cell(xt, state)
+            return new_state, out
+
+        final_state, outputs = jax.lax.scan(step, initial_states, x)
+        if self.is_reverse:
+            outputs = jnp.flip(outputs, axis=0)
+        if not self.time_major:
+            outputs = jnp.swapaxes(outputs, 0, 1)
+        return outputs, final_state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (None, None) if initial_states is None else initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__()
+        self.mode = mode
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell, "RNN_TANH": SimpleRNNCell,
+                    "RNN_RELU": SimpleRNNCell}[mode]
+
+        from .containers import LayerList
+
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * self.num_directions
+            extra = {"activation": "relu"} if mode == "RNN_RELU" else {}
+            if bidirect:
+                self.rnns.append(BiRNN(cell_cls(in_size, hidden_size, **extra),
+                                       cell_cls(in_size, hidden_size, **extra), time_major))
+            else:
+                self.rnns.append(RNN(cell_cls(in_size, hidden_size, **extra),
+                                     time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st = None if initial_states is None else jax.tree.map(
+                lambda t: t[i], initial_states)
+            out, fs = rnn(out, st)
+            final_states.append(fs)
+            if self.dropout > 0 and i < self.num_layers - 1 and self.training:
+                from .. import functional as F
+
+                out = F.dropout(out, self.dropout, training=True)
+        stacked = jax.tree.map(lambda *ts: jnp.stack(ts), *final_states)
+        return out, stacked
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)

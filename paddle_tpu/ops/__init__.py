@@ -1,0 +1,15 @@
+"""Tensor op library.
+
+The reference's PHI kernel library (255k LoC of per-backend CUDA/CPU kernels,
+``paddle/phi/kernels/``) collapses on TPU into thin jnp/lax wrappers: XLA owns
+codegen, fusion, and layout. Pallas kernels live in ``paddle_tpu.kernels`` for
+the few ops where the compiler needs help (attention, embedding all2all).
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403

@@ -1,0 +1,136 @@
+"""Tensor creation ops (reference: ``python/paddle/tensor/creation.py``).
+
+Tensors are plain ``jax.Array``; creation ops are thin jnp wrappers with
+paddle-compatible signatures. ``stop_gradient`` is a no-op marker kept for API
+compatibility — gradient flow in this framework is decided by which pytree
+leaves are differentiated, not per-tensor flags (use ``jax.lax.stop_gradient``
+for in-graph cuts).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype, get_default_dtype
+
+
+def _maybe_default_float(dtype):
+    return get_default_dtype() if dtype is None else convert_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """``paddle.to_tensor`` analogue: anything array-like -> jax.Array."""
+    del place, stop_gradient
+    dtype = convert_dtype(dtype)
+    if dtype is None and isinstance(data, (list, tuple, int, float)):
+        # match paddle: python floats default to the default float dtype
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            dtype = get_default_dtype()
+    return jnp.asarray(data, dtype=dtype)
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int64
+        else:
+            dtype = get_default_dtype()
+    return jnp.full(tuple(shape), fill_value, dtype=convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_maybe_default_float(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(tuple(shape), dtype=_maybe_default_float(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_maybe_default_float(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, num, base=base, dtype=convert_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_maybe_default_float(dtype))
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        out = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(jnp.asarray(x), k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def assign(x, output=None):
+    del output
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+def complex(real, imag):
+    return jax.lax.complex(jnp.asarray(real), jnp.asarray(imag))
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col if col is not None else row)
+    return jnp.stack([r, c])

@@ -1,0 +1,178 @@
+"""Linear algebra ops (reference: ``python/paddle/tensor/linalg.py``).
+
+Matmuls are the MXU path; everything here maps to a single XLA HLO
+(dot_general / triangular_solve / cholesky / ...). ``matmul`` keeps paddle's
+transpose_x/transpose_y flags so layers can avoid materializing transposes —
+XLA folds them into dot_general dimension numbers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if transpose_x:
+        if x.ndim == 1:
+            pass
+        else:
+            x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        if y.ndim == 1:
+            pass
+        else:
+            y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return jnp.matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    return jnp.sum(x * y, axis=-1)
+
+
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(jnp.asarray(x) - jnp.asarray(y), p=p)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (list, tuple)) else None,
+                               axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                               keepdims=keepdim)
+    if p == float("inf") or p == float("-inf") or isinstance(p, (int, float)):
+        if axis is None:
+            x = x.reshape(-1)
+            axis = 0
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis)
+        return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+    raise ValueError(f"unsupported norm order {p}")
+
+
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    x = jnp.asarray(x)
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((jnp.asarray(y), not upper), jnp.asarray(x))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return jax.scipy.linalg.solve_triangular(
+        jnp.asarray(x), jnp.asarray(y), lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x, name=None):
+    sign, logabsdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabsdet])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False, name=None):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    x = jnp.asarray(input).reshape(-1)
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    # under jit, length must be static: use minlength as the bound
+    import numpy as np
+
+    x_np = np.asarray(x)
+    return jnp.asarray(np.bincount(x_np, weights=None if weights is None else np.asarray(weights),
+                                   minlength=minlength))
+
+
+def einsum(equation, *operands):
+    """Reference implements its own einsum planner (``einsum.py``, 1,082 LoC);
+    XLA's dot_general lowering makes jnp.einsum optimal on TPU directly."""
+    return jnp.einsum(equation, *operands)

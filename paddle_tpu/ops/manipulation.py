@@ -1,0 +1,362 @@
+"""Shape / layout manipulation ops.
+
+Reference parity: ``python/paddle/tensor/manipulation.py`` (4.8k LoC).
+All shape arguments must be static under ``jit`` — XLA compiles per shape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+
+
+def reshape(x, shape, name=None):
+    return jnp.reshape(x, tuple(shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = jnp.asarray(x)
+    nd = x.ndim
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def transpose(x, perm, name=None):
+    return jnp.transpose(x, tuple(perm))
+
+
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def t(x, name=None):
+    x = jnp.asarray(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports tensors with ndim <= 2")
+    return x.T
+
+
+def concat(x, axis=0, name=None):
+    return jnp.concatenate([jnp.asarray(t) for t in x], axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return jnp.stack([jnp.asarray(t) for t in x], axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = jnp.asarray(x)
+    n = x.shape[axis] if num is None else num
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = jnp.asarray(x)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    # sections list, possibly containing one -1
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return jnp.array_split(jnp.asarray(x), chunks, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    # paddle ignores non-unit axes in squeeze
+    axes = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    x = jnp.asarray(x)
+    for a in sorted(a % (x.ndim + 1) for a in axis):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def expand(x, shape, name=None):
+    x = jnp.asarray(x)
+    shape = list(shape)
+    # paddle allows -1 meaning "keep this dim"
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - offset]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(jnp.asarray(x), jnp.asarray(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def tile(x, repeat_times, name=None):
+    return jnp.tile(jnp.asarray(x), tuple(repeat_times))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(jnp.asarray(x), repeats, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def cast(x, dtype):
+    return jnp.asarray(x).astype(convert_dtype(dtype))
+
+
+import builtins as _builtins
+
+slice_builtin = _builtins.slice
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    x = jnp.asarray(x)
+    idx = [slice_builtin(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice_builtin(s, e)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = jnp.asarray(x)
+    idx = [slice_builtin(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice_builtin(s, e, st)
+    return x[tuple(idx)]
+
+
+def gather(x, index, axis=0, name=None):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index), axis=axis)
+
+
+def gather_nd(x, index, name=None):
+    x, index = jnp.asarray(x), jnp.asarray(index)
+    # index: [..., k] indexes first k dims of x
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = jnp.asarray(x), jnp.asarray(index), jnp.asarray(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index = jnp.asarray(x), jnp.asarray(index)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = jnp.zeros(tuple(shape), dtype=jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    arr, indices = jnp.asarray(arr), jnp.asarray(indices)
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis, inplace=False)
+    dims = list(range(arr.ndim))
+    ix = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    ix[axis] = indices
+    if reduce == "add":
+        return arr.at[tuple(ix)].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return arr.at[tuple(ix)].multiply(values)
+    raise ValueError(f"unknown reduce: {reduce}")
+
+
+def take_along_axis(arr, indices, axis):
+    return jnp.take_along_axis(jnp.asarray(arr), jnp.asarray(indices), axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index), axis=axis)
+
+
+def index_sample(x, index):
+    x, index = jnp.asarray(x), jnp.asarray(index)
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def masked_select(x, mask, name=None):
+    # NOTE: output shape is data-dependent; not jittable (same caveat as
+    # reference dynamic-shape ops on XLA). Use where() under jit.
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.asarray(mask), jnp.asarray(value, x.dtype), x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent shape: eager-only (see masked_select note)
+    import numpy as np
+
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in idx)
+    return jnp.asarray(np.stack(idx, axis=1))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    import numpy as np
+
+    res = np.unique(
+        np.asarray(x),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    import numpy as np
+
+    x_np = np.asarray(x)
+    if axis is None:
+        x_np = x_np.reshape(-1)
+        keep = np.concatenate([[True], x_np[1:] != x_np[:-1]])
+    else:
+        diff = (x_np.take(range(1, x_np.shape[axis]), axis=axis)
+                != x_np.take(range(0, x_np.shape[axis] - 1), axis=axis))
+        keep = np.concatenate([[True], diff.any(axis=tuple(i for i in range(x_np.ndim) if i != axis))])
+        x_np = np.compress(keep, np.asarray(x), axis=axis)
+        out = [jnp.asarray(x_np)]
+        return out[0] if len(out) == 1 else tuple(out)
+    out = [jnp.asarray(x_np[keep])]
+    if return_inverse:
+        out.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.concatenate([idx, [len(x_np)]]))
+        out.append(jnp.asarray(counts))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def tolist(x):
+    return jnp.asarray(x).tolist()
+
+
+def numel(x, name=None):
+    return jnp.asarray(jnp.asarray(x).size)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    """TP vocab-shard index remap (reference: ``c_embedding``'s index logic)."""
+    x = jnp.asarray(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+def as_real(x, name=None):
+    x = jnp.asarray(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x, name=None):
+    x = jnp.asarray(x)
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def real(x, name=None):
+    return jnp.real(x)
+
+
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """paddle.nn.functional.pad semantics: ``pad`` is per-dim (low, high) pairs.
+
+    For len(pad) == 2*ndim the order is [d0_lo, d0_hi, d1_lo, ...]. For the
+    common conv case (len 4 with 4D input), pads the spatial dims of
+    ``data_format``.
+    """
+    x = jnp.asarray(x)
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # spatial padding: reversed per-dim pairs over trailing spatial dims
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * x.ndim
+        if data_format.endswith("C"):  # NHWC / NLC / NDHWC
+            spatial_axes = list(range(1, 1 + n_spatial))
+        else:  # NCHW / NCL / NCDHW
+            spatial_axes = list(range(x.ndim - n_spatial, x.ndim))
+        # paddle lists pads innermost-last: [left, right, top, bottom] pairs
+        for i, ax in enumerate(reversed(spatial_axes)):
+            width[ax] = (pad[2 * i], pad[2 * i + 1])
+    jnp_mode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if jnp_mode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=jnp_mode)

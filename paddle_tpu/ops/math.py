@@ -1,0 +1,411 @@
+"""Elementwise and reduction math ops.
+
+Reference parity: ``python/paddle/tensor/math.py`` (5.3k LoC of per-op
+dygraph/static dual paths). Here every op is a pure jnp function — XLA fuses
+elementwise chains into single TPU kernels, so there is no fused-op registry
+to maintain. Paddle semantics kept: ``axis``/``keepdim`` argument names,
+None-axis full reduction, broadcast rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------- arithmetic
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y, name=None):
+    return jnp.true_divide(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle name
+    return jnp.power(x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = jnp.asarray(x)
+    s = jnp.asarray(scale, x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    out = x * s + b if bias_after_scale else (x + b) * s
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+def abs(x, name=None):  # noqa: A001
+    return jnp.abs(x)
+
+
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+def reciprocal(x, name=None):
+    return jnp.reciprocal(x)
+
+
+def square(x, name=None):
+    return jnp.square(x)
+
+
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+def expm1(x, name=None):
+    return jnp.expm1(x)
+
+
+def log(x, name=None):
+    return jnp.log(x)
+
+
+def log2(x, name=None):
+    return jnp.log2(x)
+
+
+def log10(x, name=None):
+    return jnp.log10(x)
+
+
+def log1p(x, name=None):
+    return jnp.log1p(x)
+
+
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+def round(x, name=None):  # noqa: A001
+    return jnp.round(x)
+
+
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+# ---------------------------------------------------------------- trig
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+def tan(x, name=None):
+    return jnp.tan(x)
+
+
+def asin(x, name=None):
+    return jnp.arcsin(x)
+
+
+def acos(x, name=None):
+    return jnp.arccos(x)
+
+
+def atan(x, name=None):
+    return jnp.arctan(x)
+
+
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+def sinh(x, name=None):
+    return jnp.sinh(x)
+
+
+def cosh(x, name=None):
+    return jnp.cosh(x)
+
+
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+# ---------------------------------------------------------------- special
+def erf(x, name=None):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+# ---------------------------------------------------------------- reductions
+def _norm_axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    from ..framework.dtype import convert_dtype
+
+    return jnp.sum(x, axis=_norm_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+
+    return jnp.prod(x, axis=_norm_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.amax(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.amin(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+
+    x = jnp.asarray(x)
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    return jnp.cumsum(x, axis=axis, dtype=convert_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+
+    return jnp.cumprod(x, axis=dim, dtype=convert_dtype(dtype))
+
+
+def _cum_extreme(x, axis, dtype, is_max):
+    from ..framework.dtype import convert_dtype
+
+    x = jnp.asarray(x)
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    axis = axis % x.ndim
+    idx = jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == axis else 1 for i in range(x.ndim)]
+    )
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv >= av) if is_max else (bv <= av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    values, ind = jax.lax.associative_scan(combine, (x, idx), axis=axis)
+    return values, ind.astype(convert_dtype(dtype))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, is_max=True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, is_max=False)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.dtype import convert_dtype
+
+    return jnp.nansum(x, axis=_norm_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+# ---------------------------------------------------------------- tests / misc
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def lerp(x, y, weight, name=None):
+    return x + jnp.asarray(weight, jnp.asarray(x).dtype) * (y - x)

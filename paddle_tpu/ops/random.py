@@ -1,0 +1,96 @@
+"""Random ops (reference: ``python/paddle/tensor/random.py``).
+
+Eager calls draw subkeys from the global :class:`~paddle_tpu.framework.random.Generator`
+(paddle-style statefulness). Every op also accepts ``key=`` for functional use
+under ``jit`` — the TPU-native path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.random import next_key
+
+
+def _key(key):
+    return next_key() if key is None else key
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, key=None, name=None):  # noqa: A002
+    dtype = get_default_dtype() if dtype is None else convert_dtype(dtype)
+    return jax.random.uniform(_key(key), tuple(shape), dtype=dtype, minval=min, maxval=max)
+
+
+def rand(shape, dtype=None, key=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0, key=key)
+
+
+def randn(shape, dtype=None, key=None, name=None):
+    dtype = get_default_dtype() if dtype is None else convert_dtype(dtype)
+    return jax.random.normal(_key(key), tuple(shape), dtype=dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, key=None, name=None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+    out = jax.random.normal(_key(key), tuple(shape), dtype=get_default_dtype())
+    return out * std + mean
+
+
+def standard_normal(shape, dtype=None, key=None, name=None):
+    return randn(shape, dtype=dtype, key=key)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), tuple(shape), low, high, dtype=convert_dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, key=None, name=None):
+    x = jnp.asarray(x)
+    dtype = x.dtype if dtype is None else convert_dtype(dtype)
+    return randint(low, high, x.shape, dtype=dtype, key=key)
+
+
+def randperm(n, dtype="int64", key=None, name=None):
+    return jax.random.permutation(_key(key), n).astype(convert_dtype(dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None, name=None):
+    x = jnp.asarray(x)
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    k = _key(key)
+    if replacement:
+        out = jax.random.categorical(k, logits, axis=-1, shape=(num_samples, *x.shape[:-1]))
+        return jnp.moveaxis(out, 0, -1).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(k, x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def bernoulli(x, key=None, name=None):
+    x = jnp.asarray(x)
+    return jax.random.bernoulli(_key(key), x, x.shape).astype(x.dtype)
+
+
+def poisson(x, key=None, name=None):
+    x = jnp.asarray(x)
+    return jax.random.poisson(_key(key), x, x.shape).astype(x.dtype)
+
+
+def exponential_(x, lam=1.0, key=None, name=None):
+    x = jnp.asarray(x)
+    return (jax.random.exponential(_key(key), x.shape, dtype=x.dtype) / lam).astype(x.dtype)
+
+
+def uniform_(x, min=-1.0, max=1.0, key=None, name=None):  # noqa: A002
+    x = jnp.asarray(x)
+    return jax.random.uniform(_key(key), x.shape, dtype=x.dtype, minval=min, maxval=max)
+
+
+def normal_(x, mean=0.0, std=1.0, key=None, name=None):
+    x = jnp.asarray(x)
+    return jax.random.normal(_key(key), x.shape, dtype=x.dtype) * std + mean

@@ -1,0 +1,115 @@
+"""Search / sort ops (reference: ``python/paddle/tensor/search.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    out = jnp.argsort(jnp.asarray(x), axis=axis, descending=descending)
+    return out.astype(jnp.int64)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return jnp.sort(jnp.asarray(x), axis=axis, descending=descending)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = jnp.asarray(x)
+    if axis is None:
+        axis = -1
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(jnp.int64), -1, axis)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    idx = jnp.take(sorted_idx, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    s = jnp.sort(moved, axis=-1)
+    n = s.shape[-1]
+    # count run lengths in the sorted array
+    eq = (s[..., :, None] == s[..., None, :])
+    counts = eq.sum(-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+    # paddle returns the *last* index of the mode value in the original array
+    match = moved == vals[..., None]
+    pos = jnp.arange(n)
+    idx = jnp.max(jnp.where(match, pos, -1), axis=-1).astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    ss, v = jnp.asarray(sorted_sequence), jnp.asarray(values)
+    if ss.ndim == 1:
+        out = jnp.searchsorted(ss, v, side=side)
+    else:
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_add(x, index, axis, value, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, 0)
+    v = jnp.moveaxis(jnp.asarray(value, x.dtype), axis, 0)
+    out = moved.at[jnp.asarray(index)].add(v)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = jnp.asarray(x)
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(jnp.asarray(value, x.dtype))
+    return x.at[idx].set(jnp.asarray(value, x.dtype))

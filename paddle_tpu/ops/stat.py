@@ -1,0 +1,34 @@
+"""Statistics ops (reference: ``python/paddle/tensor/stat.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _norm_axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.quantile(jnp.asarray(x), jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(jnp.asarray(x), jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim)

@@ -1,0 +1,302 @@
+"""LR schedulers (reference: ``python/paddle/optimizer/lr.py``, ~20 schedulers).
+
+Dual API: paddle-style stateful ``step()``/``get_lr()``, plus ``value_at(step)``
+which is pure and traceable — the jitted train step computes LR from the
+optimizer's step counter so schedules live inside the compiled program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.step()
+
+    # stateful API ---------------------------------------------------------
+    def step(self, epoch=None):
+        if epoch is None:
+            self.last_epoch += 1
+        else:
+            self.last_epoch = epoch
+        self.last_lr = float(self.value_at(self.last_epoch))
+        return self.last_lr
+
+    def get_lr(self):
+        return self.last_lr
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state.get("last_epoch", self.last_epoch)
+        self.last_lr = state.get("last_lr", self.last_lr)
+
+    # pure API -------------------------------------------------------------
+    def value_at(self, step):
+        raise NotImplementedError
+
+
+class ConstantLR(LRScheduler):
+    def value_at(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1, verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr / (1 + self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(step, 1.0) / self.decay_steps)
+            decay_steps = self.decay_steps * jnp.maximum(div, 1.0)
+        else:
+            decay_steps = self.decay_steps
+            step = jnp.minimum(step, self.decay_steps)
+        frac = (1 - step / decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr, last_epoch=-1, verbose=False):
+        self.lr_after = learning_rate  # float or LRScheduler
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(end_lr, last_epoch, verbose)
+
+    def value_at(self, step):
+        step_f = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            step_f / max(self.warmup_steps, 1), 1.0)
+        if isinstance(self.lr_after, LRScheduler):
+            after = self.lr_after.value_at(jnp.maximum(step_f - self.warmup_steps, 0.0))
+        else:
+            after = jnp.asarray(self.lr_after, jnp.float32)
+        return jnp.where(step_f < self.warmup_steps, warm, after)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        out = jnp.asarray(self.values[-1], jnp.float32)
+        for b, v in zip(reversed(self.boundaries), reversed(self.values[:-1])):
+            out = jnp.where(step < b, jnp.asarray(v, jnp.float32), out)
+        return out
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1, verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        cos = jnp.cos(math.pi * jnp.minimum(step, self.T_max) / self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1, verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / self.step_size)
+        return self.base_lr * self.gamma ** k
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1, verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        k = sum(jnp.where(step >= m, 1.0, 0.0) for m in self.milestones)
+        return self.base_lr * self.gamma ** k
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class MultiplicativeDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        # product form is inherently sequential; supported for python ints only
+        lr = self.base_lr
+        for i in range(1, int(step) + 1):
+            lr *= self.lr_lambda(i)
+        return jnp.asarray(lr, jnp.float32)
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=0.0001, phase_pct=0.3, anneal_strategy="cos",
+                 three_phase=False, last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        up_steps = self.phase_pct * self.total_steps
+        down_steps = self.total_steps - up_steps
+
+        def cos_interp(a, b, frac):
+            return b + (a - b) * (1 + jnp.cos(math.pi * frac)) / 2
+
+        frac_up = jnp.clip(step / jnp.maximum(up_steps, 1.0), 0.0, 1.0)
+        frac_down = jnp.clip((step - up_steps) / jnp.maximum(down_steps, 1.0), 0.0, 1.0)
+        up = cos_interp(self.initial_lr, self.max_lr, 1 - frac_up)
+        down = cos_interp(self.max_lr, self.end_lr, 1 - frac_down)
+        return jnp.where(step < up_steps, up, down)
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate, max_learning_rate, step_size_up,
+                 step_size_down=None, mode="triangular", gamma=1.0, last_epoch=-1, verbose=False):
+        self.base_lr_c = base_learning_rate
+        self.max_lr = max_learning_rate
+        self.step_size_up = step_size_up
+        self.step_size_down = step_size_down or step_size_up
+        self.mode = mode
+        self.gamma = gamma
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        cycle_len = self.step_size_up + self.step_size_down
+        cycle = jnp.floor(step / cycle_len)
+        pos = step - cycle * cycle_len
+        up_frac = jnp.clip(pos / self.step_size_up, 0.0, 1.0)
+        down_frac = jnp.clip((pos - self.step_size_up) / self.step_size_down, 0.0, 1.0)
+        scale = jnp.where(pos < self.step_size_up, up_frac, 1.0 - down_frac)
+        amp = self.max_lr - self.base_lr_c
+        if self.mode == "triangular2":
+            amp = amp / (2.0 ** cycle)
+        elif self.mode == "exp_range":
+            amp = amp * self.gamma ** step
+        return self.base_lr_c + amp * scale
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven; inherently host-side (not traceable)."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,
+                 epsilon=1e-8, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.num_bad_epochs = 0
+        self.cooldown_counter = 0
+        self.base_lr = learning_rate
+        self.last_lr = learning_rate
+        self.last_epoch = 0
+
+    def value_at(self, step):
+        return jnp.asarray(self.last_lr, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            return self.last_lr
+        current = float(metrics)
+        if self.best is None:
+            self.best = current
+        better = (current < self.best - self._thr()) if self.mode == "min" else (
+            current > self.best + self._thr())
+        if better:
+            self.best = current
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad_epochs = 0
+        if self.num_bad_epochs > self.patience:
+            self.last_lr = max(self.last_lr * self.factor, self.min_lr)
+            self.cooldown_counter = self.cooldown
+            self.num_bad_epochs = 0
+        return self.last_lr
+
+    def _thr(self):
+        if self.threshold_mode == "rel":
+            return abs(self.best) * self.threshold if self.best is not None else 0.0
+        return self.threshold

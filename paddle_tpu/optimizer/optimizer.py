@@ -1,0 +1,434 @@
+"""Optimizer base.
+
+Reference parity: ``python/paddle/optimizer/optimizer.py`` (param groups, LR
+schedulers, grad clip, master weights). TPU-native design: every optimizer is
+a pure ``init(params) -> state`` / ``update(grads, state, params) -> (params,
+state)`` pair so the whole step jits into one XLA program with donated
+buffers; the stateful ``step()``-style API used by the eager/`hapi` path is a
+thin shell over it.
+
+Master weights ("multi_precision" in the reference,
+``python/paddle/optimizer/optimizer.py`` master-weight path): when params are
+bf16, ``init`` keeps an f32 copy and ``update`` applies the step in f32,
+casting back — same semantics, expressed functionally.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .lr import LRScheduler
+
+
+def _tree_map(fn, *trees, is_leaf=None):
+    return jax.tree.map(fn, *trees, is_leaf=is_leaf)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._learning_rate = learning_rate
+        self._parameters = parameters
+        self.weight_decay = 0.0 if weight_decay is None else weight_decay
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        # stateful-API storage (eager/hapi path)
+        self._state = None
+        self._accumulated_grads = None
+
+    # ------------------------------------------------------------ LR
+    def get_lr(self, step=None):
+        """Scalar LR; traceable when ``step`` is a tracer."""
+        if isinstance(self._learning_rate, LRScheduler):
+            if step is None:
+                return self._learning_rate.get_lr()
+            return self._learning_rate.value_at(step)
+        return self._learning_rate
+
+    def set_lr(self, value):
+        self._learning_rate = value
+
+    # ------------------------------------------------------------ functional
+    def init(self, params) -> Dict[str, Any]:
+        state = {"step": jnp.zeros((), jnp.int32)}
+        state.update(self._init_slots(params))
+        if self.multi_precision:
+            state["master_weights"] = _tree_map(
+                lambda p: p.astype(jnp.float32) if p.dtype in (jnp.bfloat16, jnp.float16) else p,
+                params)
+        return state
+
+    def update(self, grads, state, params):
+        """Apply one optimization step. Returns (new_params, new_state)."""
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step = state["step"] + 1
+        lr = self.get_lr(step)
+        work_params = state.get("master_weights", params)
+        grads32 = _tree_map(lambda g: g.astype(jnp.float32) if g is not None else None, grads)
+        new_work, new_slots = self._apply(grads32, {**state, "step": step}, work_params, lr)
+        new_state = {**new_slots, "step": step}
+        if self.multi_precision and "master_weights" in state:
+            new_state["master_weights"] = new_work
+            new_params = _tree_map(lambda p, m: m.astype(p.dtype), params, new_work)
+        else:
+            new_params = _tree_map(lambda p, w: w.astype(p.dtype), params, new_work)
+        return new_params, new_state
+
+    # subclass hooks -------------------------------------------------------
+    def _init_slots(self, params) -> Dict[str, Any]:
+        return {}
+
+    def _apply(self, grads, state, params, lr):
+        raise NotImplementedError
+
+    def _decayed_grad(self, g, p):
+        """L2-style decay folded into the gradient (paddle's default
+        ``weight_decay`` semantics for non-AdamW optimizers)."""
+        if self.weight_decay:
+            return g + self.weight_decay * p.astype(g.dtype)
+        return g
+
+    # ------------------------------------------------------------ stateful API
+    def bind(self, params):
+        """Attach parameter pytree for the stateful step() API."""
+        self._parameters = params
+        self._state = self.init(params)
+        return self
+
+    def step(self, params=None, grads=None):
+        """Stateful step over bound params (eager path). Returns new params."""
+        if params is None:
+            params = self._parameters
+        if grads is None:
+            grads = self._accumulated_grads
+        if self._state is None:
+            self._state = self.init(params)
+        new_params, self._state = self.update(grads, self._state, params)
+        self._parameters = new_params
+        self._accumulated_grads = None
+        return new_params
+
+    def clear_grad(self, set_to_zero=True):
+        self._accumulated_grads = None
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self):
+        out = {"state": self._state}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._state = state_dict.get("state", self._state)
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    """reference: ``python/paddle/optimizer/sgd.py``"""
+
+    def _apply(self, grads, state, params, lr):
+        new_params = _tree_map(
+            lambda p, g: p if g is None else p - lr * self._decayed_grad(g, p),
+            params, grads)
+        return new_params, {}
+
+
+class Momentum(Optimizer):
+    """reference: ``python/paddle/optimizer/momentum.py``"""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_slots(self, params):
+        return {"velocity": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)}
+
+    def _apply(self, grads, state, params, lr):
+        def upd(p, g, v):
+            if g is None:
+                return p, v
+            g = self._decayed_grad(g, p)
+            v_new = self.momentum * v + g
+            if self.use_nesterov:
+                step_dir = g + self.momentum * v_new
+            else:
+                step_dir = v_new
+            return p - lr * step_dir, v_new
+
+        flat = _tree_map(upd, params, grads, state["velocity"])
+        new_params = _tree_map(lambda pv: pv[0], flat, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = _tree_map(lambda pv: pv[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"velocity": new_v}
+
+
+class Adam(Optimizer):
+    """reference: ``python/paddle/optimizer/adam.py`` (incl. the fused
+    multi-tensor path — unnecessary here: the whole update is one XLA fusion).
+    """
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def _init_slots(self, params):
+        return {
+            "moment1": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            "moment2": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        }
+
+    def _decay_term(self, p, lr):
+        # plain Adam: decay folded into grad (L2); AdamW overrides
+        return None
+
+    def _apply(self, grads, state, params, lr):
+        step = state["step"]
+        b1c = 1.0 - self.beta1 ** step.astype(jnp.float32)
+        b2c = 1.0 - self.beta2 ** step.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            if g is None:
+                return p, m, v
+            g = g.astype(jnp.float32)
+            if not isinstance(self, AdamW):
+                g = self._decayed_grad(g, p)
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            m_hat = m_new / b1c
+            v_hat = v_new / b2c
+            delta = lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+            if isinstance(self, AdamW) and self.weight_decay:
+                delta = delta + lr * self.weight_decay * p.astype(jnp.float32)
+            return p - delta.astype(p.dtype), m_new, v_new
+
+        triples = _tree_map(upd, params, grads, state["moment1"], state["moment2"])
+        is_leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+        return (
+            _tree_map(lambda t: t[0], triples, is_leaf=is_leaf),
+            {
+                "moment1": _tree_map(lambda t: t[1], triples, is_leaf=is_leaf),
+                "moment2": _tree_map(lambda t: t[2], triples, is_leaf=is_leaf),
+            },
+        )
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: ``python/paddle/optimizer/adamw.py``).
+    Supports ``apply_decay_param_fun`` to exempt bias/norm params."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, grad_clip=None,
+                 apply_decay_param_fun=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision)
+        self.apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply(self, grads, state, params, lr):
+        if self.apply_decay_param_fun is None:
+            return super()._apply(grads, state, params, lr)
+        # per-name decay masking: params is a flat dict path->array
+        decay_mask = {k: self.apply_decay_param_fun(k) for k in params}
+        saved = self.weight_decay
+        step = state["step"]
+        b1c = 1.0 - self.beta1 ** step.astype(jnp.float32)
+        b2c = 1.0 - self.beta2 ** step.astype(jnp.float32)
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            p, g = params[k], grads[k]
+            m, v = state["moment1"][k], state["moment2"][k]
+            if g is None:
+                new_p[k], new_m[k], new_v[k] = p, m, v
+                continue
+            g = g.astype(jnp.float32)
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            delta = lr * (m_new / b1c) / (jnp.sqrt(v_new / b2c) + self.epsilon)
+            if decay_mask[k] and saved:
+                delta = delta + lr * saved * p.astype(jnp.float32)
+            new_p[k] = p - delta.astype(p.dtype)
+            new_m[k], new_v[k] = m_new, v_new
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _init_slots(self, params):
+        return {"moment": _tree_map(
+            lambda p: jnp.full_like(p, self.initial_accumulator_value, dtype=jnp.float32), params)}
+
+    def _apply(self, grads, state, params, lr):
+        def upd(p, g, acc):
+            if g is None:
+                return p, acc
+            g = self._decayed_grad(g.astype(jnp.float32), p)
+            acc_new = acc + jnp.square(g)
+            return p - (lr * g / (jnp.sqrt(acc_new) + self.epsilon)).astype(p.dtype), acc_new
+
+        pairs = _tree_map(upd, params, grads, state["moment"])
+        is_leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+        return (_tree_map(lambda t: t[0], pairs, is_leaf=is_leaf),
+                {"moment": _tree_map(lambda t: t[1], pairs, is_leaf=is_leaf)})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.rho = rho
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.centered = centered
+
+    def _init_slots(self, params):
+        slots = {
+            "mean_square": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            "momentum_buf": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        }
+        if self.centered:
+            slots["mean_grad"] = _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return slots
+
+    def _apply(self, grads, state, params, lr):
+        new_ms, new_mom, new_mg, new_p = {}, {}, {}, {}
+        for k in params:
+            p, g = params[k], grads[k]
+            if g is None:
+                new_p[k], new_ms[k], new_mom[k] = p, state["mean_square"][k], state["momentum_buf"][k]
+                if self.centered:
+                    new_mg[k] = state["mean_grad"][k]
+                continue
+            g = self._decayed_grad(g.astype(jnp.float32), p)
+            ms = self.rho * state["mean_square"][k] + (1 - self.rho) * jnp.square(g)
+            if self.centered:
+                mg = self.rho * state["mean_grad"][k] + (1 - self.rho) * g
+                denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+                new_mg[k] = mg
+            else:
+                denom = jnp.sqrt(ms + self.epsilon)
+            mom = self.momentum * state["momentum_buf"][k] + lr * g / denom
+            new_p[k] = p - mom.astype(p.dtype)
+            new_ms[k], new_mom[k] = ms, mom
+        slots = {"mean_square": new_ms, "momentum_buf": new_mom}
+        if self.centered:
+            slots["mean_grad"] = new_mg
+        return new_p, slots
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.epsilon = epsilon
+        self.rho = rho
+
+    def _init_slots(self, params):
+        return {
+            "avg_squared_grad": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            "avg_squared_update": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        }
+
+    def _apply(self, grads, state, params, lr):
+        new_p, new_g2, new_u2 = {}, {}, {}
+        for k in params:
+            p, g = params[k], grads[k]
+            if g is None:
+                new_p[k] = p
+                new_g2[k] = state["avg_squared_grad"][k]
+                new_u2[k] = state["avg_squared_update"][k]
+                continue
+            g = self._decayed_grad(g.astype(jnp.float32), p)
+            g2 = self.rho * state["avg_squared_grad"][k] + (1 - self.rho) * jnp.square(g)
+            u2_prev = state["avg_squared_update"][k]
+            update = jnp.sqrt(u2_prev + self.epsilon) / jnp.sqrt(g2 + self.epsilon) * g
+            u2 = self.rho * u2_prev + (1 - self.rho) * jnp.square(update)
+            new_p[k] = p - (lr * update).astype(p.dtype)
+            new_g2[k], new_u2[k] = g2, u2
+        return new_p, {"avg_squared_grad": new_g2, "avg_squared_update": new_u2}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        return {
+            "moment": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            "inf_norm": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        }
+
+    def _apply(self, grads, state, params, lr):
+        step = state["step"]
+        b1c = 1.0 - self.beta1 ** step.astype(jnp.float32)
+        new_p, new_m, new_u = {}, {}, {}
+        for k in params:
+            p, g = params[k], grads[k]
+            if g is None:
+                new_p[k], new_m[k], new_u[k] = p, state["moment"][k], state["inf_norm"][k]
+                continue
+            g = self._decayed_grad(g.astype(jnp.float32), p)
+            m = self.beta1 * state["moment"][k] + (1 - self.beta1) * g
+            u = jnp.maximum(self.beta2 * state["inf_norm"][k], jnp.abs(g))
+            new_p[k] = p - (lr / b1c * m / (u + self.epsilon)).astype(p.dtype)
+            new_m[k], new_u[k] = m, u
+        return new_p, {"moment": new_m, "inf_norm": new_u}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive large-batch optimizer
+    (reference: ``python/paddle/optimizer/lamb.py``)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_from_weight_decay_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, params):
+        return {
+            "moment1": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            "moment2": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        }
+
+    def _apply(self, grads, state, params, lr):
+        step = state["step"].astype(jnp.float32)
+        b1c = 1.0 - self.beta1 ** step
+        b2c = 1.0 - self.beta2 ** step
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            p, g = params[k], grads[k]
+            if g is None:
+                new_p[k], new_m[k], new_v[k] = p, state["moment1"][k], state["moment2"][k]
+                continue
+            g = g.astype(jnp.float32)
+            m = self.beta1 * state["moment1"][k] + (1 - self.beta1) * g
+            v = self.beta2 * state["moment2"][k] + (1 - self.beta2) * jnp.square(g)
+            r = (m / b1c) / (jnp.sqrt(v / b2c) + self.epsilon)
+            decay = self.weight_decay
+            if self.exclude_from_weight_decay_fn is not None and self.exclude_from_weight_decay_fn(k):
+                decay = 0.0
+            p32 = p.astype(jnp.float32)
+            r = r + decay * p32
+            w_norm = jnp.linalg.norm(p32)
+            r_norm = jnp.linalg.norm(r)
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            new_p[k] = p - (lr * trust * r).astype(p.dtype)
+            new_m[k], new_v[k] = m, v
+        return new_p, {"moment1": new_m, "moment2": new_v}

@@ -1,0 +1,40 @@
+"""Test config: force CPU backend with 8 virtual devices.
+
+This is the reference's "distributed tests without a cluster" mechanism
+rebuilt for XLA (SURVEY §4: fake_cpu_device / subprocess clusters ->
+host-platform simulated mesh).
+
+Note: the TPU-tunnel site customization pins ``jax_platforms`` via config (not
+just env), so we override the config value and reset backends before any
+device query.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge  # noqa: E402
+
+if xla_bridge.backends_are_initialized():
+    xla_bridge._clear_backends()
+
+assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,134 @@
+"""OpTest harness — the analogue of the reference's
+``python/paddle/fluid/tests/unittests/op_test.py:333`` (numpy-reference
+output checking + numeric-vs-analytic gradient checking with per-dtype
+tolerances), rebuilt for a functional framework: an "op" here is any pure
+function of jax arrays.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_TOLS = {
+    np.dtype(np.float32): dict(rtol=1e-5, atol=1e-6),
+    np.dtype(np.float64): dict(rtol=1e-7, atol=1e-8),
+    np.dtype(np.float16): dict(rtol=1e-2, atol=1e-3),
+    np.dtype("bfloat16") if "bfloat16" in np.sctypeDict else None: None,
+}
+
+
+def _tols(dtype, rtol=None, atol=None):
+    d = jnp.dtype(dtype)
+    if d == jnp.bfloat16:
+        base = dict(rtol=2e-2, atol=2e-2)
+    elif d == jnp.float16:
+        base = dict(rtol=1e-2, atol=1e-3)
+    elif d == jnp.float64:
+        base = dict(rtol=1e-7, atol=1e-8)
+    else:
+        base = dict(rtol=1e-5, atol=1e-6)
+    if rtol is not None:
+        base["rtol"] = rtol
+    if atol is not None:
+        base["atol"] = atol
+    return base
+
+
+def check_output(fn: Callable, args: Sequence, expect, rtol=None, atol=None, jit_check=True):
+    """Run ``fn`` eagerly and (optionally) under jit; compare to numpy ref."""
+    out = fn(*args)
+    _assert_close(out, expect, rtol, atol, "eager")
+    if jit_check:
+        out_jit = jax.jit(fn)(*args)
+        _assert_close(out_jit, expect, rtol, atol, "jit")
+
+
+def _assert_close(got, expect, rtol, atol, tag):
+    got_leaves = jax.tree.leaves(got)
+    exp_leaves = jax.tree.leaves(expect)
+    assert len(got_leaves) == len(exp_leaves), f"[{tag}] structure mismatch"
+    for g, e in zip(got_leaves, exp_leaves):
+        g = np.asarray(g, dtype=np.float64) if jnp.issubdtype(jnp.asarray(g).dtype, np.floating) else np.asarray(g)
+        e = np.asarray(e)
+        tols = _tols(jnp.asarray(got_leaves[0]).dtype, rtol, atol)
+        np.testing.assert_allclose(g, e.astype(g.dtype) if g.dtype != e.dtype else e,
+                                   rtol=tols["rtol"], atol=tols["atol"], err_msg=f"[{tag}]")
+
+
+def check_grad(fn: Callable, args: Sequence, arg_idx: int = 0, eps: float = 1e-3,
+               rtol: float = 5e-2, atol: float = 1e-3, reduce_fn=None):
+    """Compare analytic grad (jax.grad) vs central finite differences for
+    float32/float64 inputs — the reference's ``check_grad`` contract."""
+    args = [jnp.asarray(a) for a in args]
+
+    if reduce_fn is None:
+        reduce_fn = lambda out: jnp.sum(jnp.asarray(out))  # noqa: E731
+
+    def scalar_fn(x):
+        new_args = list(args)
+        new_args[arg_idx] = x
+        return reduce_fn(fn(*new_args))
+
+    x0 = args[arg_idx].astype(jnp.float64) if args[arg_idx].dtype == jnp.float64 else args[arg_idx]
+    analytic = np.asarray(jax.grad(scalar_fn)(x0), dtype=np.float64)
+
+    x_np = np.asarray(x0, dtype=np.float64)
+    numeric = np.zeros_like(x_np)
+    flat = x_np.reshape(-1)
+    num_flat = numeric.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = float(scalar_fn(jnp.asarray(x_np.reshape(x_np.shape), x0.dtype)))
+        flat[i] = orig - eps
+        fm = float(scalar_fn(jnp.asarray(x_np.reshape(x_np.shape), x0.dtype)))
+        flat[i] = orig
+        num_flat[i] = (fp - fm) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+class OpTest:
+    """Subclass-style harness:
+
+    class TestAdd(OpTest):
+        def setup(self):
+            self.fn = paddle_tpu.add
+            self.inputs = (np.random.rand(3, 4), np.random.rand(3, 4))
+            self.ref = lambda x, y: x + y
+
+    gives output checks across dtypes + grad checks for free via
+    ``run_output_checks`` / ``run_grad_checks``.
+    """
+
+    fn: Callable
+    inputs: tuple
+    ref: Callable
+    dtypes = ("float32",)
+    grad_args: Optional[Sequence[int]] = (0,)
+
+    def setup(self):
+        raise NotImplementedError
+
+    def run_output_checks(self, rtol=None, atol=None):
+        self.setup()
+        for dt in self.dtypes:
+            args = [jnp.asarray(np.asarray(a), dtype=jnp.dtype(dt))
+                    if np.issubdtype(np.asarray(a).dtype, np.floating) else jnp.asarray(a)
+                    for a in self.inputs]
+            np_args = [np.asarray(a, dtype=np.float64)
+                       if np.issubdtype(np.asarray(a).dtype, np.floating) else np.asarray(a)
+                       for a in self.inputs]
+            expect = self.ref(*np_args)
+            check_output(self.fn, args, expect, rtol=rtol, atol=atol)
+
+    def run_grad_checks(self, **kw):
+        self.setup()
+        if not self.grad_args:
+            return
+        args = [jnp.asarray(np.asarray(a), dtype=jnp.float32) if np.issubdtype(np.asarray(a).dtype, np.floating)
+                else jnp.asarray(a) for a in self.inputs]
+        for idx in self.grad_args:
+            check_grad(self.fn, args, arg_idx=idx, **kw)

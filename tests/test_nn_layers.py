@@ -1,0 +1,278 @@
+"""Layer system + nn layers tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import buffer_state, functional_call, param_state
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    m = M()
+    names = dict(m.named_parameters())
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    assert names["fc1.weight"].shape == (4, 8)
+    assert len(m.parameters()) == 4
+    assert len(m.sublayers()) == 2
+    out = m(pt.randn([3, 4]))
+    assert out.shape == (3, 2)
+
+
+def test_state_dict_roundtrip():
+    m = nn.Linear(3, 5)
+    sd = m.state_dict()
+    m2 = nn.Linear(3, 5)
+    m2.set_state_dict(sd)
+    x = pt.randn([2, 3])
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), rtol=1e-6)
+
+
+def test_save_load(tmp_path):
+    m = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    path = str(tmp_path / "model.pdparams")
+    pt.save(m.state_dict(), path)
+    loaded = pt.load(path)
+    m2 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    m2.set_state_dict(loaded)
+    x = pt.randn([2, 3])
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), rtol=1e-6)
+
+
+def test_functional_call_capture_buffers():
+    bn = nn.BatchNorm2D(3)
+    x = pt.randn([4, 3, 8, 8])
+    params = param_state(bn)
+    buffers = buffer_state(bn)
+    out, new_buffers = functional_call(bn, params, buffers, x)
+    assert out.shape == x.shape
+    # running stats changed
+    assert not np.allclose(np.asarray(new_buffers["_mean"]), np.asarray(buffers["_mean"]))
+    # original layer state untouched
+    np.testing.assert_array_equal(np.asarray(bn._mean), np.asarray(buffers["_mean"]))
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm1D(4, data_format="NCL")
+    x = pt.randn([8, 4, 6]) * 3 + 1
+    bn.train()
+    y = bn(x)
+    assert y.shape == x.shape
+    # train-mode output normalized per channel
+    arr = np.asarray(y)
+    assert abs(arr.mean()) < 0.1
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layernorm_matches_numpy():
+    ln = nn.LayerNorm(16)
+    x = np.random.randn(4, 16).astype(np.float32)
+    out = np.asarray(ln(x))
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_shape_and_value():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = pt.randn([2, 3, 16, 16])
+    out = conv(x)
+    assert out.shape == (2, 8, 8, 8)
+    # compare against explicit correlation for one output element
+    import jax.numpy as jnp
+
+    w = conv.weight
+    b = conv.bias
+    xp = np.pad(np.asarray(x), ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref = (xp[0, :, 0:3, 0:3] * np.asarray(w)[0]).sum() + np.asarray(b)[0]
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_groups_depthwise():
+    conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+    x = pt.randn([1, 4, 8, 8])
+    assert conv(x).shape == (1, 4, 8, 8)
+
+
+def test_conv_transpose():
+    convt = nn.Conv2DTranspose(3, 5, 4, stride=2, padding=1)
+    x = pt.randn([2, 3, 8, 8])
+    out = convt(x)
+    assert out.shape == (2, 5, 16, 16)
+
+
+def test_pooling():
+    x = pt.randn([2, 3, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == (2, 3, 1, 1)
+    xnp = np.asarray(x)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveAvgPool2D(1)(x))[..., 0, 0], xnp.mean((2, 3)), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.MaxPool2D(2, 2)(x))[0, 0, 0, 0], xnp[0, 0, :2, :2].max(), rtol=1e-6)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = pt.ones([1000])
+    d.train()
+    y = np.asarray(d(x))
+    frac_zero = (y == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # upscale keeps expectation
+    assert abs(y.mean() - 1.0) < 0.2
+    d.eval()
+    np.testing.assert_array_equal(np.asarray(d(x)), np.asarray(x))
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = pt.to_tensor([[1, 2], [0, 3]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_array_equal(np.asarray(out)[1, 0], np.zeros(4, np.float32))
+
+
+def test_activations():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(F.relu(x)), np.maximum(x, 0))
+    np.testing.assert_allclose(np.asarray(F.hardswish(x)),
+                               x * np.clip(x + 3, 0, 6) / 6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(F.sigmoid(x)), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    sm = np.asarray(F.softmax(x))
+    np.testing.assert_allclose(sm, np.exp(x) / np.exp(x).sum(), rtol=1e-5)
+
+
+def test_losses():
+    logits = np.random.randn(8, 5).astype(np.float32)
+    labels = np.random.randint(0, 5, (8,))
+    loss = F.cross_entropy(logits, labels)
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(8), labels]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    # soft label
+    soft = p
+    loss2 = F.cross_entropy(logits, soft, soft_label=True)
+    ref2 = -(soft * np.log(p)).sum(-1).mean()
+    np.testing.assert_allclose(float(loss2), ref2, rtol=1e-4)
+
+    # ignore index
+    labels2 = labels.copy()
+    labels2[:4] = -100
+    loss3 = F.cross_entropy(logits, labels2, ignore_index=-100)
+    ref3 = -np.log(p[np.arange(4, 8), labels[4:]]).mean()
+    np.testing.assert_allclose(float(loss3), ref3, rtol=1e-5)
+
+    x = np.random.randn(6).astype(np.float32)
+    y = np.random.randn(6).astype(np.float32)
+    np.testing.assert_allclose(float(F.mse_loss(x, y)), ((x - y) ** 2).mean(), rtol=1e-6)
+    np.testing.assert_allclose(float(F.l1_loss(x, y)), np.abs(x - y).mean(), rtol=1e-6)
+
+
+def test_bce_with_logits():
+    z = np.random.randn(10).astype(np.float32)
+    y = (np.random.rand(10) > 0.5).astype(np.float32)
+    loss = F.binary_cross_entropy_with_logits(z, y)
+    p = 1 / (1 + np.exp(-z))
+    ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+
+def test_attention_matches_reference():
+    B, L, H, D = 2, 16, 4, 8
+    q = pt.randn([B, L, H, D])
+    k = pt.randn([B, L, H, D])
+    v = pt.randn([B, L, H, D])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=False)
+    assert out.shape == (B, L, H, D)
+    # causal: first position attends only to itself
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.flash_attention import reference_attention_bhld
+
+    ref = reference_attention_bhld(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                                   jnp.swapaxes(v, 1, 2), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.swapaxes(ref, 1, 2)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(32, 4)
+    x = pt.randn([2, 10, 32])
+    out = mha(x)
+    assert out.shape == (2, 10, 32)
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=32, nhead=4, dim_feedforward=64)
+    enc = nn.TransformerEncoder(layer, 2)
+    enc.eval()
+    x = pt.randn([2, 6, 32])
+    assert enc(x).shape == (2, 6, 32)
+
+
+def test_rnn_lstm_gru():
+    x = pt.randn([4, 7, 6])
+    lstm = nn.LSTM(6, 12, num_layers=2)
+    out, (h, c) = lstm(x)
+    assert out.shape == (4, 7, 12)
+    assert h.shape == (2, 4, 12) and c.shape == (2, 4, 12)
+    gru = nn.GRU(6, 12, direction="bidirect")
+    out2, _ = gru(x)
+    assert out2.shape == (4, 7, 24)
+    rnn = nn.SimpleRNN(6, 12)
+    out3, _ = rnn(x)
+    assert out3.shape == (4, 7, 12)
+
+
+def test_sequential_containers():
+    seq = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    assert len(seq) == 3
+    x = pt.randn([2, 3])
+    assert seq(x).shape == (2, 2)
+    ll = nn.LayerList([nn.Linear(3, 3) for _ in range(3)])
+    ll.append(nn.Linear(3, 3))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_hooks():
+    m = nn.Linear(3, 3)
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    m(pt.randn([1, 3]))
+    assert calls == [1]
+    h.remove()
+    m(pt.randn([1, 3]))
+    assert calls == [1]
+
+
+def test_initializers():
+    from paddle_tpu.nn.initializer import (
+        Constant, KaimingNormal, Normal, TruncatedNormal, Uniform, XavierUniform)
+    import jax
+
+    key = jax.random.key(0)
+    assert float(np.asarray(Constant(3.0)(key, (2, 2), np.float32)).sum()) == 12.0
+    w = np.asarray(Normal(0, 0.02)(key, (1000,), np.float32))
+    assert abs(w.std() - 0.02) < 0.005
+    w = np.asarray(Uniform(-1, 1)(key, (1000,), np.float32))
+    assert w.min() >= -1 and w.max() <= 1
+    w = np.asarray(TruncatedNormal(0, 1.0)(key, (1000,), np.float32))
+    assert np.abs(w).max() <= 2.0 + 1e-5
+    w = np.asarray(XavierUniform()(key, (100, 100), np.float32))
+    limit = np.sqrt(6 / 200)
+    assert np.abs(w).max() <= limit + 1e-6

@@ -1,0 +1,210 @@
+"""Math/creation/manipulation op tests (reference pattern:
+``test_*_op.py`` files under ``python/paddle/fluid/tests/unittests/``)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpTest, check_grad, check_output
+
+
+class TestAdd(OpTest):
+    def setup(self):
+        self.fn = pt.add
+        self.inputs = (np.random.rand(3, 4), np.random.rand(3, 4))
+        self.ref = np.add
+
+    def test_output(self):
+        self.run_output_checks()
+
+    def test_grad(self):
+        self.run_grad_checks()
+
+
+class TestMatmul(OpTest):
+    def setup(self):
+        self.fn = pt.matmul
+        self.inputs = (np.random.rand(4, 5), np.random.rand(5, 3))
+        self.ref = np.matmul
+        self.grad_args = (0, 1)
+
+    def test_output(self):
+        self.run_output_checks()
+
+    def test_grad(self):
+        self.run_grad_checks()
+
+
+def test_matmul_transpose_flags():
+    x = np.random.rand(5, 4).astype(np.float32)
+    y = np.random.rand(5, 3).astype(np.float32)
+    check_output(lambda a, b: pt.matmul(a, b, transpose_x=True), (x, y), x.T @ y)
+    x2 = np.random.rand(4, 5).astype(np.float32)
+    y2 = np.random.rand(3, 5).astype(np.float32)
+    check_output(lambda a, b: pt.matmul(a, b, transpose_y=True), (x2, y2), x2 @ y2.T)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("tanh", np.tanh),
+    ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("floor", np.floor),
+    ("ceil", np.ceil), ("square", np.square), ("sign", np.sign),
+])
+def test_unary_ops(op, npop):
+    x = np.random.rand(3, 5) + 0.5
+    check_output(getattr(pt, op), (x.astype(np.float32),), npop(x))
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("subtract", np.subtract), ("multiply", np.multiply), ("divide", np.divide),
+    ("maximum", np.maximum), ("minimum", np.minimum), ("pow", np.power),
+])
+def test_binary_ops(op, npop):
+    x = np.random.rand(3, 5) + 0.5
+    y = np.random.rand(3, 5) + 0.5
+    check_output(getattr(pt, op), (x.astype(np.float32), y.astype(np.float32)), npop(x, y))
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ((0, 1), False)])
+def test_reductions(axis, keepdim):
+    x = np.random.rand(3, 4, 5)
+    check_output(lambda a: pt.sum(a, axis=axis, keepdim=keepdim), (x.astype(np.float32),),
+                 np.sum(x, axis=axis, keepdims=keepdim))
+    check_output(lambda a: pt.mean(a, axis=axis, keepdim=keepdim), (x.astype(np.float32),),
+                 np.mean(x, axis=axis, keepdims=keepdim))
+    check_output(lambda a: pt.max(a, axis=axis, keepdim=keepdim), (x.astype(np.float32),),
+                 np.max(x, axis=axis, keepdims=keepdim))
+
+
+def test_cumsum_cumprod():
+    x = np.random.rand(3, 4).astype(np.float32)
+    check_output(lambda a: pt.cumsum(a, axis=1), (x,), np.cumsum(x, axis=1))
+    check_output(lambda a: pt.cumsum(a), (x,), np.cumsum(x))
+    check_output(lambda a: pt.cumprod(a, dim=0), (x,), np.cumprod(x, axis=0))
+
+
+def test_cummax():
+    x = np.random.rand(3, 6).astype(np.float32)
+    vals, idx = pt.cummax(x, axis=1)
+    np.testing.assert_allclose(np.asarray(vals), np.maximum.accumulate(x, axis=1), rtol=1e-6)
+
+
+def test_clip_lerp():
+    x = np.random.randn(3, 4).astype(np.float32)
+    check_output(lambda a: pt.clip(a, -0.5, 0.5), (x,), np.clip(x, -0.5, 0.5))
+    y = np.random.randn(3, 4).astype(np.float32)
+    check_output(lambda a, b: pt.lerp(a, b, 0.3), (x, y), x + 0.3 * (y - x))
+
+
+def test_creation():
+    np.testing.assert_array_equal(np.asarray(pt.zeros([2, 3])), np.zeros((2, 3), np.float32))
+    np.testing.assert_array_equal(np.asarray(pt.ones([2])), np.ones(2, np.float32))
+    np.testing.assert_array_equal(np.asarray(pt.full([2, 2], 7.0)), np.full((2, 2), 7.0, np.float32))
+    np.testing.assert_array_equal(np.asarray(pt.arange(1, 7, 2)), np.arange(1, 7, 2))
+    assert pt.eye(3).shape == (3, 3)
+    t = pt.tril(np.ones((3, 3)))
+    np.testing.assert_array_equal(np.asarray(t), np.tril(np.ones((3, 3))))
+
+
+def test_manipulation():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    assert pt.reshape(x, [6, 4]).shape == (6, 4)
+    assert pt.flatten(x, 1, 2).shape == (2, 12)
+    assert pt.transpose(x, [2, 0, 1]).shape == (4, 2, 3)
+    assert pt.unsqueeze(x, [0, 2]).shape == (1, 2, 1, 3, 4)
+    assert pt.squeeze(pt.unsqueeze(x, 0), 0).shape == (2, 3, 4)
+    parts = pt.split(x, [1, 2], axis=1)
+    assert parts[0].shape == (2, 1, 4) and parts[1].shape == (2, 2, 4)
+    parts = pt.split(x, [1, -1], axis=1)
+    assert parts[1].shape == (2, 2, 4)
+    c = pt.concat([x, x], axis=0)
+    assert c.shape == (4, 3, 4)
+    s = pt.stack([x, x], axis=1)
+    assert s.shape == (2, 2, 3, 4)
+    assert pt.tile(x, [1, 2, 1]).shape == (2, 6, 4)
+    assert pt.expand(np.ones((1, 3, 1)), [2, -1, 4]).shape == (2, 3, 4)
+
+
+def test_gather_scatter():
+    x = np.arange(20).reshape(4, 5).astype(np.float32)
+    idx = np.array([0, 2])
+    np.testing.assert_array_equal(np.asarray(pt.gather(x, idx, axis=0)), x[[0, 2]])
+    upd = np.ones((2, 5), np.float32) * 100
+    out = pt.scatter(x, idx, upd, overwrite=True)
+    assert np.asarray(out)[0, 0] == 100
+    out2 = pt.scatter(x, idx, upd, overwrite=False)
+    assert np.asarray(out2)[0, 0] == 100  # zeroed then accumulated
+    nd_idx = np.array([[0, 1], [2, 3]])
+    np.testing.assert_array_equal(np.asarray(pt.gather_nd(x, nd_idx)), x[[0, 2], [1, 3]])
+
+
+def test_where_topk_sort():
+    x = np.random.rand(4, 6).astype(np.float32)
+    y = np.zeros_like(x)
+    cond = x > 0.5
+    np.testing.assert_array_equal(np.asarray(pt.where(cond, x, y)), np.where(cond, x, y))
+    vals, idx = pt.topk(x, k=3, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(pt.sort(x, axis=1)), np.sort(x, axis=1))
+    np.testing.assert_array_equal(np.asarray(pt.argsort(x, axis=1)), np.argsort(x, axis=1, kind="stable"))
+
+
+def test_argmax_argmin():
+    x = np.random.rand(3, 7).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(pt.argmax(x, axis=1)), np.argmax(x, axis=1))
+    np.testing.assert_array_equal(np.asarray(pt.argmin(x)), np.argmin(x))
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    check_output(pt.inverse, (a,), np.linalg.inv(a.astype(np.float64)), rtol=1e-3, atol=1e-4)
+    check_output(pt.det, (a,), np.linalg.det(a.astype(np.float64)), rtol=1e-3, atol=1e-3)
+    sym = a @ a.T
+    w = pt.eigvalsh(sym)
+    np.testing.assert_allclose(np.sort(np.asarray(w)), np.sort(np.linalg.eigvalsh(sym.astype(np.float64))),
+                               rtol=1e-3)
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    check_output(pt.bmm, (x, y), np.matmul(x, y), rtol=1e-4, atol=1e-5)
+    check_output(lambda u, v: pt.einsum("bij,bjk->bik", u, v), (x, y), np.matmul(x, y),
+                 rtol=1e-4, atol=1e-5)
+
+
+def test_logic():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    y = np.array([1.0, 5.0, 2.0], np.float32)
+    np.testing.assert_array_equal(np.asarray(pt.equal(x, y)), x == y)
+    np.testing.assert_array_equal(np.asarray(pt.greater_than(x, y)), x > y)
+    assert bool(pt.allclose(x, x))
+    assert not bool(pt.allclose(x, y))
+
+
+def test_grad_through_ops():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    check_grad(lambda a: pt.log(pt.exp(a) + 1.0), [x])
+    check_grad(lambda a: pt.mean(pt.square(a)), [x])
+
+
+def test_random_ops_shapes():
+    pt.seed(7)
+    a = pt.randn([3, 4])
+    assert a.shape == (3, 4)
+    b = pt.uniform([10], min=2.0, max=3.0)
+    arr = np.asarray(b)
+    assert (arr >= 2.0).all() and (arr < 3.0).all()
+    c = pt.randint(0, 10, [100])
+    assert (np.asarray(c) < 10).all()
+    p = pt.randperm(16)
+    assert sorted(np.asarray(p).tolist()) == list(range(16))
+    # determinism under same seed
+    pt.seed(42)
+    r1 = np.asarray(pt.randn([4]))
+    pt.seed(42)
+    r2 = np.asarray(pt.randn([4]))
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_stat():
+    x = np.random.rand(3, 5)
+    check_output(lambda a: pt.var(a, axis=1), (x.astype(np.float32),), np.var(x, axis=1, ddof=1))
+    check_output(lambda a: pt.std(a), (x.astype(np.float32),), np.std(x, ddof=1))
+    check_output(lambda a: pt.median(a, axis=0), (x.astype(np.float32),), np.median(x, axis=0))
