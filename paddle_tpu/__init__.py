@@ -71,13 +71,22 @@ class ParamAttr:
 
 
 def set_device(device: str = "tpu"):
-    """``paddle.set_device`` analogue. JAX places on the default backend; this
-    validates the request and records intent."""
+    """``paddle.set_device`` analogue: actually switches the JAX platform
+    (e.g. ``set_device("cpu")`` for host-simulated meshes). Resets backends,
+    so call it before creating arrays. Platform plugins that pin
+    ``jax_platforms`` via config (TPU tunnels) are overridden too."""
     import jax
+    from jax._src import xla_bridge
 
     want = device.split(":")[0]
-    have = jax.default_backend()
-    return f"{have}:0"
+    if want in ("gpu", "cuda"):
+        raise ValueError("this build is TPU/CPU only (no CUDA symbols)")
+    # do not query the current backend first — initializing the wrong
+    # platform before the config flip can wedge plugin-pinned setups
+    jax.config.update("jax_platforms", want)
+    if xla_bridge.backends_are_initialized():
+        xla_bridge._clear_backends()
+    return f"{jax.default_backend()}:0"
 
 
 def get_device():

@@ -1,0 +1,29 @@
+"""paddle_tpu.distributed — SPMD distributed training over device meshes.
+
+Reference: ``python/paddle/distributed/`` (fleet, collective API, launch,
+auto_parallel). Design per SURVEY §7: GSPMD shardings replace hand-inserted
+collectives; shard_map + lax collectives replace the ``c_*`` op zoo for the
+explicitly-scheduled paths (pipeline, ring attention, MoE).
+"""
+from . import collective  # noqa: F401
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, alltoall, broadcast, ppermute,
+    reduce_scatter, shift_left, shift_right,
+)
+from .env import (  # noqa: F401
+    barrier, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .mesh import (  # noqa: F401
+    HybridCommunicateGroup, axis_size, get_mesh, init_mesh, mesh_scope,
+    require_mesh, set_mesh, sharding,
+)
+from .shard import (  # noqa: F401
+    DistributedTrainStep, buffer_specs, opt_state_specs, param_specs,
+    shard_params,
+)
+from .parallel import (  # noqa: F401
+    mp_layers, moe, pipeline, recompute as recompute_mod, sequence_parallel,
+)
+from .parallel.recompute import recompute  # noqa: F401

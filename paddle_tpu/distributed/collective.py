@@ -117,19 +117,16 @@ def axis_size_of(group=None):
 def eager_all_reduce(tensor, op=ReduceOp.SUM, group=None, mesh=None):
     """Paddle-style eager collective over a mesh axis: runs a tiny shard_map
     program. For testing/metric aggregation, not hot paths."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from .mesh import require_mesh, P
 
     m = mesh or require_mesh()
     axis = _axis(group)
     spec = P(axis)
-    n = m.shape[axis]
 
     def body(x):
         return all_reduce(x, op=op, group=axis)
 
-    reshaped = jnp.asarray(tensor)[None].repeat(n, axis=0) if False else jnp.asarray(tensor)
-    # tensor is host-global; replicate then reduce is identity — instead treat
-    # leading dim as the axis shard dim
+    # the tensor's leading dim is treated as the axis shard dim
     f = shard_map(body, mesh=m, in_specs=(spec,), out_specs=spec)
-    return f(reshaped)
+    return f(jnp.asarray(tensor))

@@ -1,0 +1,65 @@
+"""DistributedStrategy (reference: 233-field protobuf
+``paddle/fluid/framework/distributed_strategy.proto:305`` + python wrapper
+``fleet/base/distributed_strategy.py``).
+
+Kept fields are the ones with TPU meaning; NCCL/brpc plumbing knobs
+(fuse_grad_size_in_MB, nccl_comm_num, hierarchical_allreduce...) are obsolete
+under XLA and intentionally absent. Unknown attribute reads return None so
+ported configs don't crash.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # mesh topology (reference hybrid_configs)
+        self.hybrid_configs: Dict[str, int] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sp_degree": 1, "ep_degree": 1,
+        }
+        # ZeRO stage 0-3 (reference sharding_configs / group_sharded levels)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {"stage": 1}
+        # AMP
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {"level": "O1", "dtype": "bfloat16",
+                                            "init_loss_scaling": 2.0 ** 15}
+        # recompute
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": [], "policy": None}
+        # gradient merge / accumulation
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1, "avg": True}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1,
+                                                 "schedule_mode": "1F1B"}
+        # parameter server mode (reference a_sync / a_sync_configs)
+        self.a_sync = False
+        self.a_sync_configs: Dict[str, Any] = {"k_steps": 0, "geo": False}
+        # misc parity fields
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # no-op: XLA fuses
+        self.nccl_comm_num = 1  # no-op
+        self.lamb = False
+        self.lars = False
+        self.localsgd = False
+        self.dgc = False
+
+    @property
+    def sharding_stage(self) -> int:
+        if not self.sharding:
+            return 0
+        return int(self.sharding_configs.get("stage", 1))
+
+    def __getattr__(self, name):
+        # tolerate reads of reference-only knobs
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return None
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()}
+        return f"DistributedStrategy({fields})"

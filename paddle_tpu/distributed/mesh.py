@@ -56,9 +56,10 @@ def init_mesh(shape: Dict[str, int] = None, devices=None, **axes) -> Mesh:
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
         sizes[sizes.index(-1)] = n // known
-    if int(np.prod(sizes)) != n:
+    total = int(np.prod(sizes))
+    if total > n or n % total != 0:
         raise ValueError(f"mesh {dict(zip(names, sizes))} does not fit {n} devices")
-    mesh = Mesh(devices.reshape(sizes), tuple(names))
+    mesh = Mesh(devices.reshape(-1)[:total].reshape(sizes), tuple(names))
     _current_mesh[0] = mesh
     return mesh
 

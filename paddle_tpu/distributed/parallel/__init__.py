@@ -1,0 +1,1 @@
+from . import moe, mp_layers, pipeline, recompute, sequence_parallel  # noqa: F401

@@ -1,0 +1,177 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference parity: ``python/paddle/incubate/distributed/models/moe/`` —
+``MoELayer`` (``moe_layer.py:259``: gate -> MoEScatter(global_scatter all2all)
+-> experts -> MoEGather), gates ``gshard_gate.py``/``switch_gate.py``/
+``naive_gate.py``, and the ``global_scatter/global_gather`` CUDA all2all ops.
+
+TPU-native: dispatch/combine are einsums against one-hot capacity tensors
+(dense, static-shaped — the GShard formulation XLA was built for). Experts are
+a stacked weight tensor sharded over the "ep" mesh axis; under GSPMD the
+dispatch einsum lowers to the all_to_all the reference implements by hand.
+Capacity-dropped tokens pass through the residual, matching gshard semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.initializer import Constant, XavierUniform
+from ...nn.layer import Layer, take_rng_key
+from ..mesh import get_mesh, sharding
+
+
+# ------------------------------------------------------------------- gates
+def top2_gating(logits, capacity: int, noise_key=None, second_policy="random"):
+    """GShard top-2 gate with capacity + load-balancing aux loss.
+    Returns (combine [G,S,E,C], dispatch bool [G,S,E,C], aux_loss)."""
+    G, S, E = logits.shape
+    raw_probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(raw_probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    # aux loss (gshard): mean_prob * mean_assignment per expert
+    density = jnp.mean(mask1, axis=1)
+    density_proxy = jnp.mean(raw_probs, axis=1)
+    aux_loss = jnp.mean(density * density_proxy) * (E * E)
+
+    probs_wo1 = raw_probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    g1 = jnp.sum(raw_probs * mask1, axis=-1)
+    g2 = jnp.sum(raw_probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    # positions within expert capacity
+    pos1 = jnp.cumsum(mask1, axis=1) * mask1 - mask1
+    mask1 = mask1 * (pos1 < capacity)
+    pos1 = jnp.sum(pos1 * mask1, axis=-1)
+
+    count1 = jnp.sum(mask1, axis=1, keepdims=True)
+    pos2 = (jnp.cumsum(mask2, axis=1) - mask2 + count1) * mask2
+    mask2 = mask2 * (pos2 < capacity)
+    pos2 = jnp.sum(pos2 * mask2, axis=-1)
+
+    keep1 = jnp.sum(mask1, axis=-1)
+    keep2 = jnp.sum(mask2, axis=-1)
+    g1, g2 = g1 * keep1, g2 * keep2
+
+    c1 = jax.nn.one_hot(pos1.astype(jnp.int32), capacity, dtype=jnp.float32)
+    c2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity, dtype=jnp.float32)
+    e1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32) * keep1[..., None]
+    e2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32) * keep2[..., None]
+    combine = (g1[..., None, None] * e1[..., None] * c1[..., None, :]
+               + g2[..., None, None] * e2[..., None] * c2[..., None, :])
+    dispatch = combine > 0
+    return combine, dispatch, aux_loss
+
+
+def switch_gating(logits, capacity: int, noise_key=None, jitter_eps=0.01):
+    """Switch-Transformer top-1 gate."""
+    G, S, E = logits.shape
+    if noise_key is not None:
+        noise = jax.random.uniform(noise_key, logits.shape, minval=1 - jitter_eps,
+                                   maxval=1 + jitter_eps)
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+    density = jnp.mean(mask, axis=1)
+    density_proxy = jnp.mean(probs, axis=1)
+    aux_loss = jnp.mean(density * density_proxy) * (E * E)
+    g = jnp.sum(probs * mask, axis=-1)
+    pos = jnp.cumsum(mask, axis=1) * mask - mask
+    mask = mask * (pos < capacity)
+    pos = jnp.sum(pos * mask, axis=-1)
+    keep = jnp.sum(mask, axis=-1)
+    g = g * keep
+    c = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    e = jax.nn.one_hot(idx, E, dtype=jnp.float32) * keep[..., None]
+    combine = g[..., None, None] * e[..., None] * c[..., None, :]
+    return combine, combine > 0, aux_loss
+
+
+GATES = {"gshard": top2_gating, "top2": top2_gating, "switch": switch_gating,
+         "top1": switch_gating, "naive": switch_gating}
+
+
+class ExpertFFN(Layer):
+    """Stacked expert FFNs: weights [E, d, d_hidden] sharded over "ep"."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter((num_experts, d_model, d_hidden),
+                                        default_initializer=XavierUniform())
+        self.b1 = self.create_parameter((num_experts, 1, d_hidden), is_bias=True)
+        self.w2 = self.create_parameter((num_experts, d_hidden, d_model),
+                                        default_initializer=XavierUniform())
+        self.b2 = self.create_parameter((num_experts, 1, d_model), is_bias=True)
+        for n in ("w1", "b1", "w2", "b2"):
+            self.set_param_sharding(n, ("ep",) + (None,) * 2)
+        self._activation = activation
+
+    def forward(self, x):
+        # x: [E, C_total, d]
+        act = getattr(F, self._activation)
+        h = act(jnp.einsum("ecd,edh->ech", x, self.w1) + self.b1)
+        return jnp.einsum("ech,ehd->ecd", h, self.w2) + self.b2
+
+
+class MoELayer(Layer):
+    """GShard MoE layer (reference ``moe_layer.py:259``).
+
+    Input [B, L, d] -> gate -> dispatch einsum (GSPMD all2all over "ep") ->
+    experts -> combine einsum. ``aux_loss`` is stored on the layer after each
+    forward (add it to the training loss, as the reference's fleet loss hooks
+    do).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate: str = "gshard",
+                 capacity_factor: float = 1.25, eval_capacity_factor: float = 2.0,
+                 activation: str = "gelu", group=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.gate_name = gate
+        self.gate_weight = self.create_parameter(
+            (d_model, num_experts), default_initializer=XavierUniform())
+        self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
+        self.register_buffer("aux_loss", jnp.zeros((), jnp.float32), persistable=False)
+
+    def forward(self, x):
+        B, L, d = x.shape
+        S = B * L
+        E = self.num_experts
+        factor = self.capacity_factor if self.training else self.eval_capacity_factor
+        capacity = max(int(math.ceil(S / E * factor)), 4)
+
+        tokens = x.reshape(1, S, d)  # single gating group
+        logits = jnp.einsum("gsd,de->gse", tokens, self.gate_weight.astype(x.dtype))
+        noise_key = take_rng_key("gumbel") if self.training and self.gate_name in ("switch", "top1") else None
+        combine, dispatch, aux = GATES[self.gate_name](logits, capacity, noise_key)
+        self.aux_loss = aux
+
+        dtype = x.dtype
+        # dispatch: [G,S,E,C] x [G,S,d] -> [E, G*C, d]  (GSPMD: all2all to "ep")
+        expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dtype), tokens)
+        expert_in = expert_in.reshape(E, -1, d)
+        expert_in = self._constrain_ep(expert_in)
+        expert_out = self.experts(expert_in)
+        expert_out = self._constrain_ep(expert_out)
+        expert_out = expert_out.reshape(1, E, capacity, d)
+        out = jnp.einsum("gsec,gecd->gsd", combine.astype(dtype), expert_out)
+        return out.reshape(B, L, d)
+
+    def _constrain_ep(self, t):
+        mesh = get_mesh()
+        if mesh is None or "ep" not in mesh.shape:
+            return t
+        return jax.lax.with_sharding_constraint(t, sharding("ep", None, None, mesh=mesh))

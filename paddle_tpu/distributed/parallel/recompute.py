@@ -1,0 +1,88 @@
+"""Activation recompute (reference:
+``python/paddle/distributed/fleet/recompute/recompute.py`` —
+``RecomputeFunction:224`` PyLayer saving RNG state + inputs and replaying
+forward in backward; ``recompute_sequential:497``).
+
+TPU-native: ``jax.checkpoint`` (remat) is the same trade expressed to the
+compiler; RNG replay is automatic because layer randomness is functional
+(keys are inputs). Policies map to jax.checkpoint_policies.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+from ...nn.layer import Layer, buffer_state, functional_call, param_state
+
+POLICIES = {
+    None: None,
+    "full": None,  # recompute everything
+    "save_dots": jax.checkpoint_policies.checkpoint_dots,
+    "save_dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "save_nothing": jax.checkpoint_policies.nothing_saveable,
+    "save_anything": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function: Callable, *args, policy: Optional[str] = None,
+              preserve_rng_state: bool = True, use_reentrant: bool = True, **kwargs):
+    """``paddle.distributed.fleet.utils.recompute`` analogue."""
+    pol = POLICIES.get(policy, policy)
+    fn = jax.checkpoint(function, policy=pol) if pol is not None else jax.checkpoint(function)
+    return fn(*args, **kwargs)
+
+
+def recompute_wrap(function: Callable, policy: Optional[str] = None) -> Callable:
+    pol = POLICIES.get(policy, policy)
+    if pol is None:
+        return jax.checkpoint(function)
+    return jax.checkpoint(function, policy=pol)
+
+
+def recompute_sequential(ctx: dict, functions, *args):
+    """Segmented sequential recompute (reference ``recompute_sequential:497``):
+    splits a Sequential into ``segments`` chunks, rematerializing each."""
+    segments = int(ctx.get("segments", 1))
+    layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+    out = args
+    for start in range(0, len(layers), seg_size):
+        chunk = layers[start:start + seg_size]
+
+        def run_chunk(*xs, _chunk=tuple(chunk)):
+            y = xs
+            for f in _chunk:
+                y = f(*y) if isinstance(y, tuple) else f(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y[0] if len(y) == 1 else y
+
+        out = recompute(run_chunk, *(out if isinstance(out, tuple) else (out,)))
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out
+
+
+class RecomputeLayer(Layer):
+    """Wrap a sublayer so its forward is rematerialized in backward."""
+
+    def __init__(self, inner: Layer, policy: Optional[str] = None):
+        super().__init__()
+        self.inner = inner
+        self._policy = policy
+
+    def forward(self, *args, **kwargs):
+        inner = self.inner
+
+        def run(params, buffers, *xs):
+            out, new_buf = functional_call(inner, params, buffers, *xs)
+            return out, new_buf
+
+        pol = POLICIES.get(self._policy, self._policy)
+        wrapped = jax.checkpoint(run, policy=pol) if pol is not None else jax.checkpoint(run)
+        out, new_buf = wrapped(param_state(inner), buffer_state(inner), *args)
+        for k, v in new_buf.items():
+            inner._set_by_path(k, v)
+        return out

@@ -1,0 +1,132 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context support (SURVEY §2.3/§5: no
+sequence_parallel/ring_attention anywhere — sequence length is bounded by one
+device's memory). This module is the new capability the TPU build adds:
+
+- **Ring attention** (blockwise attention with K/V rotating around the "sp"
+  mesh axis via ``ppermute`` over ICI): sequence length scales linearly with
+  the axis size, communication overlaps with the blockwise compute, and the
+  online-softmax accumulation matches the Pallas flash kernel's inner loop.
+- **Ulysses-style all-to-all**: resharding [B, L/sp, H, D] -> [B, L, H/sp, D]
+  so each device runs full-sequence attention on a head subset; two
+  ``all_to_all`` ops around any attention implementation.
+
+Both run inside ``shard_map`` over the "sp" axis.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..mesh import require_mesh
+
+
+def _online_block(q, k, v, m_prev, l_prev, acc, scale, mask=None):
+    """One blockwise-attention accumulation step (f32 state)."""
+    s = jnp.einsum("blhd,bkhd->bhlk", q, k, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhlk,bkhd->bhld", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Runs on one shard: q,k,v are [B, L_local, H, D]; K/V blocks rotate
+    around the ring while each device accumulates its queries' output."""
+    B, Lq, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+    # mark the fresh accumulators as device-varying over the sp axis so the
+    # scan carry types line up (shard_map VMA rule)
+    _vary = lambda t: lax.pcast(t, (axis_name,), to="varying")  # noqa: E731
+    m0 = _vary(jnp.full((B, H, Lq), -1e30, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, Lq), jnp.float32))
+    acc0 = _vary(jnp.zeros((B, H, Lq, D), jnp.float32))
+
+    def body(carry, step):
+        k_blk, v_blk, m, l, acc = carry
+        # block currently held = originally owned by (my_idx - step) mod n
+        src = (my_idx - step) % n
+        if causal:
+            q_pos = my_idx * Lq + jnp.arange(Lq)
+            k_pos = src * Lq + jnp.arange(k_blk.shape[1])
+            mask = q_pos[None, None, :, None] >= k_pos[None, None, None, :]
+        else:
+            mask = None
+        m, l, acc = _online_block(qf, k_blk.astype(jnp.float32),
+                                  v_blk.astype(jnp.float32), m, l, acc, scale, mask)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, m, l, acc), None
+
+    (k_fin, v_fin, m, l, acc), _ = lax.scan(body, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    # [B,H,L,D] -> [B,L,H,D]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name: str = "sp", causal: bool = True):
+    """Global-view API: q,k,v are [B, L, H, D] sharded (or shardable) along L
+    over ``axis_name``. Returns same-sharded output."""
+    mesh = mesh or require_mesh()
+    if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        # degenerate: plain attention
+        from ...nn import functional as F
+
+        return F.scaled_dot_product_attention(q, k, v, is_causal=causal, training=False)
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+# --------------------------------------------------------- Ulysses all2all
+def _ulysses_local(q, k, v, axis_name: str, causal: bool):
+    n = lax.axis_size(axis_name)
+
+    def seq_to_head(x):
+        # [B, L/n, H, D] -> [B, L, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    from ...nn import functional as F
+
+    out = F.scaled_dot_product_attention(qh, kh, vh, is_causal=causal, training=False)
+    return head_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis_name: str = "sp", causal: bool = True):
+    """DeepSpeed-Ulysses-style sequence parallelism: all_to_all heads<->seq.
+    Requires num_heads % sp == 0."""
+    mesh = mesh or require_mesh()
+    if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        from ...nn import functional as F
+
+        return F.scaled_dot_product_attention(q, k, v, is_causal=causal, training=False)
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,225 @@
+"""Sharding utilities + the distributed train step.
+
+This is the replacement for the reference's whole program-rewrite stack:
+``sharding_optimizer.py`` / ``tensor_parallel_optimizer.py`` meta-optimizers
+and the ``c_*`` collective insertion passes collapse into: (1) parameter
+PartitionSpecs declared by layers (or by policy here), (2) one ``jax.jit``
+with in/out shardings, (3) GSPMD.
+
+ZeRO mapping (reference ``group_sharded_parallel`` levels, SURVEY §2.3):
+- os   (stage 1): optimizer state sharded over "sdp"
+- os_g (stage 2): + gradient reduce-scatter (weight-update sharding)
+- p_g_os (stage 3): + parameters sharded over "sdp" (gathered on use)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer, buffer_state, functional_call, param_state
+from ..framework import random as framework_random
+from .mesh import get_mesh, require_mesh
+
+P = PartitionSpec
+
+
+def _filter_spec(spec: tuple, mesh) -> PartitionSpec:
+    """Drop axes absent from the mesh (so tp-annotated models run on a
+    dp-only mesh etc.)."""
+    out = []
+    for s in spec:
+        if s is None:
+            out.append(None)
+        elif isinstance(s, (tuple, list)):
+            kept = [a for a in s if a in mesh.shape]
+            out.append(tuple(kept) if kept else None)
+        else:
+            out.append(s if s in mesh.shape else None)
+    return PartitionSpec(*out)
+
+
+def param_specs(model: Layer, mesh=None, zero3_axis: Optional[str] = None,
+                min_zero3_size: int = 2 ** 16) -> Dict[str, PartitionSpec]:
+    """PartitionSpec per parameter path: layer-declared (TP) specs first,
+    then optional ZeRO-3 sharding of remaining large params over
+    ``zero3_axis`` (largest dim divisible by the axis size)."""
+    mesh = mesh or require_mesh()
+    declared = dict(model.named_param_shardings())
+    specs: Dict[str, PartitionSpec] = {}
+    for name, p in model.named_parameters():
+        if name in declared:
+            specs[name] = _filter_spec(declared[name], mesh)
+            continue
+        spec = [None] * p.ndim
+        if zero3_axis and zero3_axis in mesh.shape and p.size >= min_zero3_size:
+            ax_size = mesh.shape[zero3_axis]
+            # pick the largest divisible dim
+            cand = sorted(range(p.ndim), key=lambda i: -p.shape[i])
+            for i in cand:
+                if p.shape[i] % ax_size == 0:
+                    spec[i] = zero3_axis
+                    break
+        specs[name] = PartitionSpec(*spec)
+    return specs
+
+
+def buffer_specs(model: Layer, mesh=None) -> Dict[str, PartitionSpec]:
+    mesh = mesh or require_mesh()
+    return {name: PartitionSpec() for name, _ in model.named_buffers()}
+
+
+def shard_params(params: Dict[str, Any], specs: Dict[str, PartitionSpec], mesh=None):
+    """device_put each param to its NamedSharding (host->mesh scatter).
+    Goes through numpy so the result never aliases the input buffer (the
+    train step donates its params; the source Layer must stay valid)."""
+    mesh = mesh or require_mesh()
+    return {name: jax.device_put(np.asarray(p), NamedSharding(mesh, specs.get(name, PartitionSpec())))
+            for name, p in params.items()}
+
+
+def opt_state_specs(opt_state, params_specs: Dict[str, PartitionSpec],
+                    shard_axis: Optional[str] = None, mesh=None):
+    """Specs for optimizer state: moment slots inherit their parameter's
+    spec; with ``shard_axis`` (ZeRO-1/2 weight-update sharding, cf.
+    "Automatic Cross-Replica Sharding" in PAPERS.md) unsharded dims of the
+    slots are additionally sharded over that axis."""
+    mesh = mesh or require_mesh()
+
+    def spec_for(path_key, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return PartitionSpec()
+        base = params_specs.get(path_key)
+        if base is None:
+            return PartitionSpec()
+        spec = list(base) + [None] * (leaf.ndim - len(list(base)))
+        if shard_axis and shard_axis in mesh.shape:
+            used = set()
+            for s in spec:
+                if isinstance(s, (tuple, list)):
+                    used.update(s)
+                elif s is not None:
+                    used.add(s)
+            if shard_axis not in used:
+                ax = mesh.shape[shard_axis]
+                for i in range(leaf.ndim):
+                    if spec[i] is None and leaf.shape[i] % ax == 0 and leaf.shape[i] >= ax:
+                        spec[i] = shard_axis
+                        break
+        return PartitionSpec(*spec)
+
+    out = {}
+    for slot, val in opt_state.items():
+        if isinstance(val, dict) and slot != "step":
+            out[slot] = {k: spec_for(k, v) for k, v in val.items()}
+        elif hasattr(val, "ndim"):
+            out[slot] = PartitionSpec()
+        else:
+            out[slot] = None
+    return out
+
+
+class DistributedTrainStep:
+    """pjit'd hybrid-parallel train step.
+
+    Composition by configuration (the ``DistributedStrategy`` analogue):
+      - data parallel: batch sharded over ("dp", "sdp")
+      - tensor parallel: layer-declared "mp" specs
+      - ZeRO: ``sharding_stage`` 1/2 -> opt-state (+grad) sharded over "sdp";
+        3 -> params too
+      - recompute: wrap blocks with paddle_tpu.distributed.recompute
+      - sp/pp: see sequence_parallel.py / pipeline.py
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn=None, inputs_fn=None,
+                 mesh=None, batch_axes=("dp", "sdp"), sharding_stage: int = 0,
+                 grad_transform=None, donate: bool = True):
+        from ..framework.jit import DEFAULT_RNG_STREAMS, resolve_inputs_fn
+
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.inputs_fn = resolve_inputs_fn(inputs_fn, loss_fn)
+        self.grad_transform = grad_transform
+        self.mesh = mesh or require_mesh()
+        self.batch_axes = batch_axes
+
+        zero3 = "sdp" if sharding_stage >= 3 else None
+        self.specs = param_specs(model, self.mesh, zero3_axis=zero3)
+        self.params = shard_params(param_state(model), self.specs, self.mesh)
+        self.buffers = {k: jax.device_put(np.asarray(v), NamedSharding(self.mesh, P()))
+                        for k, v in buffer_state(model).items()}
+        opt_state = optimizer.init(self.params)
+        shard_axis = "sdp" if sharding_stage >= 1 else None
+        self.opt_specs = opt_state_specs(opt_state, self.specs, shard_axis, self.mesh)
+        self.opt_state = self._shard_opt_state(opt_state)
+
+        batch_spec = PartitionSpec(tuple(a for a in batch_axes if a in self.mesh.shape) or None)
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec)
+        self._base_key = framework_random.next_key()
+        self._count = 0
+        self._rng_streams = DEFAULT_RNG_STREAMS
+        donate_argnums = (0, 1, 2) if donate else ()
+        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
+
+    def _shard_opt_state(self, opt_state):
+        out = {}
+        for slot, val in opt_state.items():
+            spec = self.opt_specs.get(slot)
+            if isinstance(val, dict) and isinstance(spec, dict):
+                out[slot] = {k: jax.device_put(v, NamedSharding(self.mesh, spec[k]))
+                             for k, v in val.items()}
+            elif hasattr(val, "ndim"):
+                out[slot] = jax.device_put(val, NamedSharding(self.mesh, P()))
+            else:
+                out[slot] = val
+        return out
+
+    def _step(self, params, buffers, opt_state, batch, key):
+        from ..framework.jit import split_rng_streams
+
+        rngs = split_rng_streams(key, self._rng_streams)
+
+        def compute_loss(p):
+            # keep params at their declared shardings inside the traced fn
+            p = {k: jax.lax.with_sharding_constraint(v, NamedSharding(self.mesh, self.specs[k]))
+                 for k, v in p.items()}
+            inputs = self.inputs_fn(batch)
+            if not isinstance(inputs, (tuple, list)):
+                inputs = (inputs,)
+            out, new_buf = functional_call(self.model, p, buffers, *inputs, rngs=rngs)
+            loss = out if self.loss_fn is None else self.loss_fn(out, batch)
+            return jnp.asarray(loss, jnp.float32), (new_buf, out)
+
+        (loss, (new_buffers, _)), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        if self.grad_transform is not None:
+            grads = self.grad_transform(grads)
+        new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        new_params = {k: jax.lax.with_sharding_constraint(
+            v, NamedSharding(self.mesh, self.specs[k])) for k, v in new_params.items()}
+        return loss, new_params, new_buffers, new_opt_state
+
+    def __call__(self, batch):
+        batch = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
+            if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
+        key = jax.random.fold_in(self._base_key, self._count)
+        self._count += 1
+        with self.mesh:
+            loss, self.params, self.buffers, self.opt_state = self._compiled(
+                self.params, self.buffers, self.opt_state, batch, key)
+        return loss
+
+    def sync_to_model(self):
+        for name, v in self.params.items():
+            self.model._set_by_path(name, v)
+        for name, v in self.buffers.items():
+            self.model._set_by_path(name, v)
+        return self.model
+
+    def state_dict(self):
+        return {"params": self.params, "buffers": self.buffers,
+                "opt_state": self.opt_state, "count": self._count}

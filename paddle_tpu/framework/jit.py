@@ -23,6 +23,24 @@ from . import random as framework_random
 from ..nn.layer import Layer, buffer_state, functional_call, param_state
 
 
+DEFAULT_RNG_STREAMS = ("dropout", "rrelu", "gumbel", "default")
+
+
+def resolve_inputs_fn(inputs_fn, loss_fn):
+    """Default batch->model-inputs mapping shared by TrainStep and
+    DistributedTrainStep: with a loss_fn, (inputs, labels) tuples feed the
+    model their first element; otherwise the whole batch is the input."""
+    if inputs_fn is not None:
+        return inputs_fn
+    if loss_fn is not None:
+        return lambda b: b[0] if isinstance(b, (tuple, list)) else b
+    return lambda b: b
+
+
+def split_rng_streams(key, streams=DEFAULT_RNG_STREAMS):
+    return dict(zip(streams, jax.random.split(key, len(streams))))
+
+
 def jit(fn=None, *, static_argnums=(), static_argnames=(), donate_argnums=()):
     """``paddle.jit.to_static`` analogue. Accepts a function or a Layer.
 
@@ -66,21 +84,15 @@ class TrainStep:
     def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
                  inputs_fn: Optional[Callable] = None,
                  grad_transform: Optional[Callable] = None, donate: bool = True,
-                 rng_streams=("dropout", "rrelu", "gumbel", "default")):
+                 rng_streams=DEFAULT_RNG_STREAMS):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
-        # which part of the batch feeds the model: default is batch[0] for
-        # (inputs, labels) tuples when a loss_fn is given, whole batch otherwise
-        if inputs_fn is None:
-            if loss_fn is not None:
-                inputs_fn = lambda b: b[0] if isinstance(b, (tuple, list)) else b  # noqa: E731
-            else:
-                inputs_fn = lambda b: b  # noqa: E731
-        self.inputs_fn = inputs_fn
+        self.inputs_fn = resolve_inputs_fn(inputs_fn, loss_fn)
         self.grad_transform = grad_transform
-        self.params = param_state(model)
-        self.buffers = buffer_state(model)
+        # copy: the step donates its buffers; the Layer must keep valid arrays
+        self.params = jax.tree.map(lambda x: jnp.array(x, copy=True), param_state(model))
+        self.buffers = jax.tree.map(lambda x: jnp.array(x, copy=True), buffer_state(model))
         self.opt_state = optimizer.init(self.params)
         self._rng_streams = tuple(rng_streams)
         self._base_key = framework_random.next_key()
@@ -88,12 +100,8 @@ class TrainStep:
         donate_argnums = (0, 1, 2) if donate else ()
         self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
 
-    def _make_rngs(self, key):
-        keys = jax.random.split(key, len(self._rng_streams))
-        return dict(zip(self._rng_streams, keys))
-
     def _step(self, params, buffers, opt_state, batch, key):
-        rngs = self._make_rngs(key)
+        rngs = split_rng_streams(key, self._rng_streams)
 
         def compute_loss(p):
             inputs = self.inputs_fn(batch)

@@ -17,25 +17,36 @@ import jax
 
 
 class Generator:
-    """Splittable stateful PRNG wrapper around ``jax.random.key``."""
+    """Splittable stateful PRNG wrapper around ``jax.random.key``.
+
+    Key creation is lazy: importing the framework must not initialize a
+    backend (set_device("cpu") must still be able to flip platforms)."""
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.manual_seed(seed)
+        self._seed = int(seed)
+        self._key = None
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
         self._key = jax.random.key(self._seed)
         return self
 
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def next_key(self):
         """Return a fresh subkey; mutates internal state."""
         with self._lock:
+            self._ensure()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            self._ensure()
+            return self._key
 
     def set_state(self, key):
         self._key = key

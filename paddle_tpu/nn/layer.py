@@ -103,6 +103,9 @@ class Layer:
         object.__setattr__(self, "_sub_layers", OrderedDict())
         self.training = True
         self._dtype = convert_dtype(dtype) or get_default_dtype()
+        # per-parameter PartitionSpec-like tuples (local names); collected
+        # tree-wide by paddle_tpu.distributed.shard.param_shardings()
+        self._param_shardings: Dict[str, tuple] = {}
         self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
         self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
         self._hook_id = 0
@@ -202,6 +205,21 @@ class Layer:
     def add_sublayer(self, name: str, sublayer: "Layer"):
         self._sub_layers[name] = sublayer
         return sublayer
+
+    def set_param_sharding(self, name: str, spec: tuple):
+        """Declare how parameter ``name`` (local) shards over mesh axes,
+        e.g. ``("mp", None)`` for a vocab-sharded embedding. GSPMD inserts
+        the collectives the reference writes by hand in mp_layers.py."""
+        self._param_shardings[name] = tuple(spec)
+
+    def named_param_shardings(self, prefix: str = ""):
+        for name, spec in self._param_shardings.items():
+            yield (f"{prefix}.{name}" if prefix else name), spec
+        for sname, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sp = f"{prefix}.{sname}" if prefix else sname
+            yield from sub.named_param_shardings(prefix=sp)
 
     # ------------------------------------------------------------- traversal
     def named_sublayers(self, prefix: str = "", include_self: bool = False) -> Iterator[Tuple[str, "Layer"]]:
@@ -434,7 +452,9 @@ def functional_call(
             layer._set_by_path(name, v)
         for name, v in (buffers or {}).items():
             layer._set_by_path(name, v)
-        with rng_context(rngs or {}):
+        # rngs=None inherits any ambient rng context (nested functional calls)
+        ctx = rng_context(rngs) if rngs is not None else contextlib.nullcontext()
+        with ctx:
             out = layer(*args, **kwargs)
         new_buffers = {name: layer._get_by_path(name) for name in (buffers or {})}
     finally:
