@@ -1,0 +1,109 @@
+"""Benchmark: GPT pretrain step throughput + MFU on the available device.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The BASELINE.md north star is GPT-3 1.3B at >=35% MFU on v5p-32. This bench
+runs the largest GPT config that fits the available chip (single chip under
+the driver), measures tokens/sec/chip over timed steps, and reports MFU
+against the chip's peak FLOPs. ``vs_baseline`` = measured MFU / 0.35.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+# peak bf16 FLOPs/s per chip by TPU generation (public figures)
+PEAK_FLOPS = {
+    "v2": 22.5e12, "v3": 123e12 / 2, "v4": 275e12, "v5e": 197e12,
+    "v5lite": 197e12, "v5p": 459e12, "v5": 459e12, "v6e": 918e12,
+}
+
+
+def _chip_peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind.replace(" ", ""):
+            return val
+    if "tpu" in kind:
+        return 275e12  # conservative default: v4
+    return 1e12  # CPU fallback so the bench still runs
+
+
+def main():
+    import jax
+    import paddle_tpu
+    from paddle_tpu import amp
+    from paddle_tpu.framework.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       gpt_flops_per_token, gpt_loss_fn)
+    from paddle_tpu.optimizer import AdamW
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # largest single-chip config: GPT ~350M in bf16 params+opt fits HBM
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position_embeddings=1024,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        use_recompute=False, use_flash_attention=True,
+                        dtype="bfloat16")
+        batch, seq = 8, 1024
+        timed_steps, warmup = 20, 3
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=256,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        use_flash_attention=False)
+        batch, seq = 4, 128
+        timed_steps, warmup = 5, 2
+
+    paddle_tpu.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01)
+    if on_tpu:
+        # O2: bf16 params, f32 master weights in the optimizer
+        model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = TrainStep(model, opt, loss_fn=gpt_loss_fn(model))
+
+    rng = np.random.default_rng(0)
+    ids = np.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), np.int32)
+    batch_data = (ids, ids)
+
+    # NOTE: sync via a host read of the loss; block_until_ready does not
+    # fully synchronize through the axon TPU tunnel.
+    for _ in range(warmup):
+        loss = step(batch_data)
+    float(np.asarray(loss))
+
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        loss = step(batch_data)
+    final_loss = float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * timed_steps / dt
+    flops_per_token = gpt_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_per_token / _chip_peak_flops()
+
+    print(json.dumps({
+        "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "config": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
+                       "batch": batch, "seq": seq},
+            "final_loss": final_loss,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -122,20 +122,46 @@ def flash_attention_bhld(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q, block_k
     )(q, k, v)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention_diff(q, k, v, causal):
+    return flash_attention_bhld(q, k, v, causal=causal)
+
+
+def _flash_fwd(q, k, v, causal):
+    return flash_attention_bhld(q, k, v, causal=causal), (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    # backward = recompute through the XLA reference (fused-softmax) path.
+    # Correct for any shape; materializes [L, L] scores in the backward only.
+    # TODO(pallas): blockwise dq/dk/dv kernel to keep backward O(L) in HBM.
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: reference_attention_bhld(a, b, c, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
 def flash_attention_blhd(q, k, v, causal=False):
-    """Public entry on paddle-layout [B, L, H, D] tensors."""
+    """Public entry on paddle-layout [B, L, H, D] tensors. Differentiable:
+    Pallas blockwise forward + recompute backward."""
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-    out = flash_attention_bhld(qt, kt, vt, causal=causal)
+    out = _flash_attention_diff(qt, kt, vt, causal)
     return jnp.swapaxes(out, 1, 2)
 
 
 def reference_attention_bhld(q, k, v, causal=False):
-    """Unfused reference for kernel tests."""
+    """Unfused reference for kernel tests and the recompute backward.
+
+    Causal mask is top-left aligned (q_pos >= k_pos), matching
+    ``_attn_kernel`` exactly — including when Lq != Lk."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:
         Lq, Lk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
+        mask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool))
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

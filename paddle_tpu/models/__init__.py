@@ -1,0 +1,12 @@
+"""Model zoo.
+
+Reference parity: the reference ships models in two places —
+``python/paddle/vision/models`` (ResNet/VGG/MobileNet/..., SURVEY §2.2) and
+the PaddleNLP-side GPT/BERT/ERNIE configs the BASELINE targets. Here both
+families live under ``paddle_tpu.models`` (vision re-exports them at
+``paddle_tpu.vision.models``).
+"""
+from . import gpt  # noqa: F401
+from . import resnet  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_1p3b, gpt_tiny  # noqa: F401
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401

@@ -1,0 +1,277 @@
+"""GPT decoder-only language model — the flagship pretrain config.
+
+Reference parity: the BASELINE north-star is PaddleNLP's GPT-3 1.3B hybrid
+DP+MP pretrain (BASELINE.md). The reference implements the parallel pieces as
+hand-written collective layers (``fleet/layers/mpu/mp_layers.py``) plus fused
+CUDA attention (``paddle/fluid/operators/fused/fused_attention_op.cu``); here
+the same model is written once against TP-annotated layers and GSPMD derives
+the collectives, while attention dispatches to the Pallas flash kernel on TPU.
+
+Parallelism knobs (all composable, set on :class:`GPTConfig`):
+- ``mp``: tensor parallel via Column/RowParallelLinear + VocabParallelEmbedding
+- ``dp``/``sdp``: batch sharding + ZeRO via DistributedTrainStep
+- ``sp``: sequence parallel — activations sharded over the sequence dim
+  between blocks (Ulysses/ring attention in ``parallel/sequence_parallel.py``)
+- ``recompute``: activation checkpointing per block (jax.checkpoint)
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.initializer import Constant, Normal
+from ..nn.layer import Layer
+from ..nn.layers.norm import LayerNorm
+from ..nn.layers.common import Dropout
+from ..distributed.mesh import get_mesh, sharding
+from ..distributed.parallel.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    parallel_matmul,
+)
+from ..distributed.parallel.recompute import recompute_wrap
+from ..kernels import flash_attention as fa
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 2048
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    use_recompute: bool = False
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False  # shard activations over "sp" between blocks
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+def gpt_tiny(**overrides) -> "GPTConfig":
+    cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+               max_position_embeddings=256)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+def gpt_1p3b(**overrides) -> "GPTConfig":
+    """GPT-3 1.3B: the BASELINE.md v5p-32 target config."""
+    cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+               max_position_embeddings=2048)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+def _constrain_seq(x, cfg):
+    """Between-block activation sharding: [dp, sp, mp-free] when sequence
+    parallel is on, else [dp, None, None]."""
+    mesh = get_mesh()
+    if mesh is None or x.ndim != 3:
+        return x
+    seq_axis = "sp" if (cfg.sequence_parallel and "sp" in mesh.shape) else None
+    batch_axes = tuple(a for a in ("dp", "sdp") if a in mesh.shape) or None
+    return jax.lax.with_sharding_constraint(
+        x, sharding(batch_axes, seq_axis, None, mesh=mesh))
+
+
+def causal_attention(q, k, v, dropout_p=0.0, training=True, use_flash=True):
+    """Causal self-attention on [B, L, H, D]; Pallas flash path when the
+    gate allows, XLA-fused softmax otherwise."""
+    if use_flash and fa.should_use_flash(q, k, None, dropout_p if training else 0.0):
+        return fa.flash_attention_blhd(q, k, v, causal=True)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
+    s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        p = F.dropout(p, p=dropout_p, training=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = Normal(0.0, cfg.initializer_range)
+        # fused qkv, column-split over mp (each mp shard owns whole heads)
+        self.qkv_proj = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=init,
+            has_bias=True, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size,
+            weight_attr=Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
+            has_bias=True, input_is_parallel=True)
+
+    def forward(self, x):
+        B, L, _ = x.shape
+        qkv = self.qkv_proj(x)  # [B, L, 3*H*D] (mp-sharded feature dim)
+        qkv = qkv.reshape(B, L, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = causal_attention(
+            q, k, v, dropout_p=self.cfg.attention_dropout_prob,
+            training=self.training, use_flash=self.cfg.use_flash_attention)
+        out = out.reshape(B, L, self.num_heads * self.head_dim)
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, cfg.initializer_range)
+        self.fc_in = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, weight_attr=init,
+            has_bias=True, gather_output=False)
+        self.fc_out = RowParallelLinear(
+            cfg.intermediate_size, cfg.hidden_size,
+            weight_attr=Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
+            has_bias=True, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTBlock(Layer):
+    """Pre-LN transformer decoder block."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return _constrain_seq(x, self.cfg)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=Normal(0.0, cfg.initializer_range))
+        self.position_embeddings = self.create_parameter(
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            default_initializer=Normal(0.0, cfg.initializer_range))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_offset=0):
+        L = input_ids.shape[1]
+        h = self.word_embeddings(input_ids)
+        pos = jax.lax.dynamic_slice_in_dim(
+            self.position_embeddings, position_offset, L, axis=0)
+        return self.dropout(h + pos)
+
+
+class GPTModel(Layer):
+    """Embeddings + N decoder blocks + final LN. Returns hidden states."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.h = _BlockList(cfg)
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        x = _constrain_seq(x, self.cfg)
+        x = self.h(x)
+        return self.ln_f(x)
+
+
+class _BlockList(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        for i in range(cfg.num_layers):
+            self.add_sublayer(str(i), GPTBlock(cfg))
+
+    def forward(self, x):
+        for blk in self._sub_layers.values():
+            fn = recompute_wrap(blk) if self.cfg.use_recompute else blk
+            x = fn(x)
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """LM head model. ``forward`` returns logits; ``loss`` computes shifted
+    next-token cross entropy (the pretrain objective)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size,
+                weight_attr=Normal(0.0, cfg.initializer_range),
+                has_bias=False, gather_output=False)
+        self.parallel_ce = ParallelCrossEntropy()
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight
+            return parallel_matmul(h, w, transpose_y=True)
+        return self.lm_head(h)
+
+    def loss(self, logits, labels):
+        """Shifted LM loss: predict token t+1 from prefix ..t."""
+        shift_logits = logits[:, :-1, :]
+        shift_labels = jnp.asarray(labels)[:, 1:]
+        per_tok = self.parallel_ce(shift_logits, shift_labels)
+        return jnp.mean(per_tok)
+
+    def forward_with_loss(self, input_ids, labels):
+        return self.loss(self.forward(input_ids), labels)
+
+
+def gpt_loss_fn(model: GPTForCausalLM):
+    """loss_fn for TrainStep/DistributedTrainStep on (input_ids, labels)
+    batches."""
+
+    def loss_fn(outputs, batch):
+        return model.loss(outputs, batch[1])
+
+    return loss_fn
+
+
+def gpt_flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
+    """Model FLOPs per token for MFU accounting (fwd+bwd, 6ND + attention
+    term — the standard PaLM-paper formula)."""
+    n_params = (
+        cfg.vocab_size * cfg.hidden_size  # embeddings (tied head reused)
+        + cfg.max_position_embeddings * cfg.hidden_size
+        + cfg.num_layers * (
+            4 * cfg.hidden_size * cfg.hidden_size  # qkv + out
+            + 2 * cfg.hidden_size * cfg.intermediate_size  # mlp
+            + 4 * cfg.hidden_size)  # ln/bias approx
+    )
+    attn = 12 * cfg.num_layers * cfg.hidden_size * seq_len
+    return 6.0 * n_params + attn
