@@ -33,6 +33,9 @@ from .framework.jit import jit  # noqa: F401
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import metric  # noqa: F401
+from . import callbacks  # noqa: F401
+from .hapi import InputSpec, Model, flops, summary  # noqa: F401
 
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax

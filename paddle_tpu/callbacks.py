@@ -1,0 +1,6 @@
+"""``paddle.callbacks`` namespace (alias of :mod:`paddle_tpu.hapi.callbacks`,
+as the reference aliases ``python/paddle/hapi/callbacks.py``)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, History, LRScheduler,
+    ModelCheckpoint, ProgBarLogger, ScalarLogger,
+)

@@ -1,0 +1,13 @@
+"""High-level API (reference: ``python/paddle/hapi/``)."""
+from .callbacks import (Callback, CallbackList, EarlyStopping, History,
+                        LRScheduler, ModelCheckpoint, ProgBarLogger,
+                        ScalarLogger)
+from .dynamic_flops import flops
+from .model import InputSpec, Model
+from .model_summary import summary
+
+__all__ = [
+    "Model", "InputSpec", "summary", "flops", "Callback", "CallbackList",
+    "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "LRScheduler",
+    "History", "ScalarLogger",
+]

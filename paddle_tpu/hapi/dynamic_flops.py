@@ -1,0 +1,107 @@
+"""FLOPs counting (reference: ``python/paddle/hapi/dynamic_flops.py``).
+
+TPU-native approach: instead of per-layer hook formulas, trace the network to
+a jaxpr and count FLOPs on the primitives XLA will actually run —
+``dot_general`` (MXU matmuls) and ``conv_general_dilated``; elementwise ops
+are counted one FLOP per output element. This matches compiled reality far
+closer than the reference's layer-formula tables.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+from ..nn.layer import Layer, buffer_state, functional_call, param_state
+
+__all__ = ["flops", "count_jaxpr_flops"]
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "exp", "log", "tanh",
+    "logistic", "rsqrt", "sqrt", "neg", "abs", "erf", "integer_pow",
+    "select_n",
+}
+
+
+def _dot_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    lhs = eqn.invars[0].aval
+    dnums = eqn.params["dimension_numbers"]
+    (contract_l, _), _ = dnums
+    k = float(np.prod([lhs.shape[d] for d in contract_l])) if contract_l else 1.0
+    return 2.0 * float(np.prod(out.shape)) * k
+
+
+def _conv_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval
+    dnums = eqn.params["dimension_numbers"]
+    rhs_spec = dnums.rhs_spec  # (out_c, in_c, *spatial)
+    kernel_spatial = [rhs.shape[d] for d in rhs_spec[2:]]
+    in_c = rhs.shape[rhs_spec[1]]
+    groups = eqn.params.get("feature_group_count", 1) or 1
+    per_out = 2.0 * in_c * float(np.prod(kernel_spatial))
+    return float(np.prod(out.shape)) * per_out / 1.0  # in_c already per-group
+
+
+def count_jaxpr_flops(jaxpr) -> Dict[str, float]:
+    """Walk a (closed) jaxpr, return {primitive: flops} totals."""
+    totals: Dict[str, float] = {}
+
+    def visit(jxpr):
+        for eqn in jxpr.eqns:
+            name = eqn.primitive.name
+            for sub in jax.core.jaxprs_in_params(eqn.params) if hasattr(
+                    jax.core, "jaxprs_in_params") else []:
+                visit(sub)
+            if "jaxpr" in eqn.params:
+                inner = eqn.params["jaxpr"]
+                visit(getattr(inner, "jaxpr", inner))
+                continue
+            if "branches" in eqn.params:
+                for br in eqn.params["branches"]:
+                    visit(getattr(br, "jaxpr", br))
+                continue
+            if name == "dot_general":
+                totals["dot_general"] = totals.get("dot_general", 0.0) + _dot_flops(eqn)
+            elif name == "conv_general_dilated":
+                totals["conv"] = totals.get("conv", 0.0) + _conv_flops(eqn)
+            elif name in _ELEMENTWISE:
+                out = eqn.outvars[0].aval
+                totals["elementwise"] = totals.get("elementwise", 0.0) + \
+                    float(np.prod(out.shape))
+    visit(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return totals
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False) -> int:
+    """Total forward FLOPs for one batch of ``input_size``."""
+    sizes = input_size
+    if isinstance(sizes, tuple) and sizes and isinstance(sizes[0], int):
+        sizes = [sizes]
+    args = tuple(jax.ShapeDtypeStruct(tuple(s), jnp.float32) for s in sizes)
+    params = param_state(net)
+    buffers = buffer_state(net)
+    was_training = net.training
+    net.eval()
+
+    def fwd(p, b, *xs):
+        out, _ = functional_call(net, p, b, *xs)
+        return out
+
+    try:
+        jaxpr = jax.make_jaxpr(fwd)(params, buffers, *args)
+    finally:
+        if was_training:
+            net.train()
+    totals = count_jaxpr_flops(jaxpr)
+    total = int(sum(totals.values()))
+    if print_detail:
+        for k, v in sorted(totals.items(), key=lambda kv: -kv[1]):
+            print(f"{k:<24}{v:,.0f}")
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -1,0 +1,329 @@
+"""High-level ``Model`` API (reference: ``python/paddle/hapi/model.py:1008``).
+
+Keras-style ``prepare``/``fit``/``evaluate``/``predict``/``save``/``load``
+over an ``nn.Layer``. TPU-native execution: one compiled XLA train step
+(forward+grad+update, donated buffers) instead of the reference's dual
+dygraph/static adapters — compilation *is* the static mode.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..framework import io as framework_io
+from ..framework.jit import EvalStep, TrainStep, resolve_inputs_fn
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..metric import Metric
+from ..nn.layer import Layer, buffer_state, functional_call, param_state
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype spec (reference ``paddle.static.InputSpec``)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class _HapiTrainStep(TrainStep):
+    """TrainStep variant that also returns the model outputs (for train-time
+    metric updates, as the reference's ``DynamicGraphAdapter.train_batch``)."""
+
+    def _step(self, params, buffers, opt_state, batch, key):
+        from ..framework.jit import split_rng_streams
+
+        rngs = split_rng_streams(key, self._rng_streams)
+
+        def compute_loss(p):
+            inputs = self.inputs_fn(batch)
+            if not isinstance(inputs, (tuple, list)):
+                inputs = (inputs,)
+            out, new_buf = functional_call(self.model, p, buffers, *inputs, rngs=rngs)
+            loss = out if self.loss_fn is None else self.loss_fn(out, batch)
+            return jnp.asarray(loss, jnp.float32), (new_buf, out)
+
+        (loss, (new_buffers, out)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
+        if self.grad_transform is not None:
+            grads = self.grad_transform(grads)
+        new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        return loss, out, new_params, new_buffers, new_opt_state
+
+    def __call__(self, batch):
+        key = jax.random.fold_in(self._base_key, self._count)
+        self._count += 1
+        loss, out, self.params, self.buffers, self.opt_state = self._compiled(
+            self.params, self.buffers, self.opt_state, batch, key)
+        return loss, out
+
+
+def _as_loader(data, batch_size, shuffle, num_workers, drop_last=False):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+    return data  # any iterable of batches
+
+
+def _split_batch(batch, n_labels):
+    """(inputs..., labels...) -> (inputs tuple, labels tuple)."""
+    if not isinstance(batch, (tuple, list)):
+        return (batch,), ()
+    batch = tuple(batch)
+    if n_labels == 0:
+        return batch, ()
+    return batch[:-n_labels], batch[-n_labels:]
+
+
+class Model:
+    """``paddle.Model`` analogue (reference ``python/paddle/hapi/model.py``)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = list(inputs) if inputs is not None else None
+        self._labels = list(labels) if labels is not None else None
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_step = None
+        self._save_dir = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable (a loss Layer or function)")
+        self._loss = loss
+        metrics = metrics or []
+        metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be Metric instances, got {type(m)}")
+        self._metrics = list(metrics)
+        self._amp_configs = amp_configs
+        self._train_step = None  # rebuilt lazily on first fit
+        self._eval_step = EvalStep(self.network)
+        return self
+
+    @property
+    def _n_labels(self):
+        return len(self._labels) if self._labels is not None else 1
+
+    def _loss_on_batch(self, out, batch):
+        _, labels = _split_batch(batch, self._n_labels)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return self._loss(*outs, *labels)
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            if self._optimizer is None:
+                raise RuntimeError("call prepare(optimizer=..., loss=...) first")
+            n_lab = self._n_labels
+
+            def inputs_fn(batch):
+                ins, _ = _split_batch(batch, n_lab)
+                return ins
+
+            self._train_step = _HapiTrainStep(
+                self.network, self._optimizer,
+                loss_fn=self._loss_on_batch if self._loss else None,
+                inputs_fn=inputs_fn)
+        return self._train_step
+
+    # ------------------------------------------------------- batch methods
+    def train_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+        labels = [] if labels is None else (
+            labels if isinstance(labels, (tuple, list)) else [labels])
+        batch = tuple(inputs) + tuple(labels)
+        step = self._ensure_train_step()
+        loss, out = step(batch)
+        metrics = self._update_metrics(out, tuple(labels))
+        return [float(loss)] + metrics if metrics else [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+        labels = [] if labels is None else (
+            labels if isinstance(labels, (tuple, list)) else [labels])
+        self._sync_eval_weights()
+        out = self._eval_step(*inputs)
+        losses = []
+        if self._loss is not None and labels:
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            losses = [float(self._loss(*outs, *labels))]
+        metrics = self._update_metrics(out, tuple(labels))
+        return losses + metrics
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+        self._sync_eval_weights()
+        out = self._eval_step(*inputs)
+        return jax.tree.map(np.asarray, out)
+
+    def _update_metrics(self, out, labels):
+        vals = []
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for m in self._metrics:
+            computed = m.compute(*outs, *labels)
+            if not isinstance(computed, (tuple, list)):
+                computed = (computed,)
+            m.update(*[np.asarray(c) for c in computed])
+            vals.append(m.accumulate())
+        return vals
+
+    def _sync_eval_weights(self):
+        """Push the train step's live params back into the network so eval
+        and save see the trained weights."""
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+
+    # ------------------------------------------------------------ fit/eval
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = _as_loader(train_data, batch_size, shuffle, num_workers, drop_last)
+        eval_loader = _as_loader(eval_data, batch_size, False, num_workers)
+        self._save_dir = save_dir
+        self.stop_training = False
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=self._metrics_name())
+
+        cbks.on_train_begin()
+        history = None
+        for cb in cbks:
+            if cb.__class__.__name__ == "History":
+                history = cb
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step_i, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step_i)
+                vals = self.train_batch(*_split_batch(tuple(batch) if
+                                        isinstance(batch, (tuple, list)) else batch,
+                                        self._n_labels))
+                logs = dict(zip(["loss"] + self._metrics_name(), vals))
+                cbks.on_train_batch_end(step_i, logs)
+            if eval_loader is not None and (epoch % eval_freq == 0 or
+                                            epoch == epochs - 1):
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          num_workers=num_workers,
+                                          _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end(logs if 'logs' in dir() else None)
+        return history.history if history is not None else None
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        loader = _as_loader(eval_data, batch_size, False, num_workers)
+        cbks = _callbacks or config_callbacks(
+            callbacks, model=self, batch_size=batch_size,
+            steps=len(loader) if hasattr(loader, "__len__") else None,
+            log_freq=log_freq, verbose=verbose, metrics=self._metrics_name(),
+            mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        loss_sum, n = 0.0, 0
+        for step_i, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step_i)
+            ins, labels = _split_batch(
+                tuple(batch) if isinstance(batch, (tuple, list)) else batch,
+                self._n_labels)
+            vals = self.eval_batch(ins, labels)
+            names = (["loss"] if self._loss is not None and labels else []) + \
+                self._metrics_name()
+            logs = dict(zip(names, vals))
+            if "loss" in logs:
+                loss_sum += logs["loss"]
+                n += 1
+            cbks.on_eval_batch_end(step_i, logs)
+        if n:
+            logs["loss"] = loss_sum / n
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
+            # with an inputs spec, anything beyond it (labels) is dropped,
+            # as the reference does via self._inputs
+            if self._inputs is not None:
+                batch = batch[: len(self._inputs)]
+            outputs.append(self.predict_batch(batch))
+        if stack_outputs and outputs:
+            outputs = jax.tree.map(lambda *xs: np.concatenate(xs, 0), *outputs)
+        return outputs
+
+    def _metrics_name(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # ------------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        """Save ``path + '.pdparams'`` (+ ``'.pdopt'`` when training)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._sync_eval_weights()
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._train_step is not None:
+            framework_io.save(
+                {"opt_state": self._train_step.opt_state,
+                 "count": self._train_step._count},
+                path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if self._train_step is not None:
+            self._train_step.load_from_model()
+            if not reset_optimizer and os.path.exists(path + ".pdopt"):
+                opt = framework_io.load(path + ".pdopt")
+                self._train_step.opt_state = opt["opt_state"]
+                self._train_step._count = opt.get("count", 0)
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,92 @@
+"""Model summary (reference: ``python/paddle/hapi/model_summary.py``).
+
+``summary(net, input_size)`` prints a per-layer table (output shape, #params)
+and returns ``{'total_params': N, 'trainable_params': M}``. Shapes come from
+one real forward on zeros — on TPU this also warms the compile cache.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from ..framework.dtype import convert_dtype
+from ..nn.layer import Layer
+
+__all__ = ["summary"]
+
+
+def _num_params(layer: Layer, include_sublayers=False):
+    total = trainable = 0
+    for _, p in layer.named_parameters(include_sublayers=include_sublayers):
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+    return total, trainable
+
+
+def _shape_of(out):
+    if hasattr(out, "shape"):
+        return list(out.shape)
+    if isinstance(out, (tuple, list)):
+        return [_shape_of(o) for o in out]
+    return []
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print layer table; returns dict with param counts."""
+    rows: List[Tuple[str, str, list, int]] = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            total, _ = _num_params(layer, include_sublayers=False)
+            rows.append((name, type(layer).__name__, _shape_of(outputs), total))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(make_hook(name)))
+
+    try:
+        if input is not None:
+            args = input if isinstance(input, (tuple, list)) else (input,)
+            net(*args)
+        elif input_size is not None:
+            sizes = input_size
+            if isinstance(sizes, tuple) and sizes and isinstance(sizes[0], int):
+                sizes = [sizes]
+            dts = dtypes or ["float32"] * len(sizes)
+            if isinstance(dts, str):
+                dts = [dts] * len(sizes)
+            args = tuple(
+                np.zeros(s, dtype=np.dtype(convert_dtype(d)))
+                for s, d in zip(sizes, dts))
+            was_training = net.training
+            net.eval()
+            net(*args)
+            if was_training:
+                net.train()
+        else:
+            raise ValueError("summary needs input_size or input")
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total, trainable = _num_params(net, include_sublayers=True)
+
+    name_w = max([len(r[0]) for r in rows] + [10]) + 2
+    type_w = max([len(r[1]) for r in rows] + [10]) + 2
+    print("-" * (name_w + type_w + 40))
+    print(f"{'Layer':<{name_w}}{'Type':<{type_w}}{'Output Shape':<26}{'Params':>12}")
+    print("=" * (name_w + type_w + 40))
+    for name, tname, shape, n in rows:
+        print(f"{name:<{name_w}}{tname:<{type_w}}{str(shape):<26}{n:>12,}")
+    print("=" * (name_w + type_w + 40))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * (name_w + type_w + 40))
+    return {"total_params": total, "trainable_params": trainable}

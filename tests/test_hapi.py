@@ -1,0 +1,143 @@
+"""hapi Model / callbacks / metric tests (reference test pattern:
+``python/paddle/tests/test_model.py``, ``test_metrics.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.hapi import EarlyStopping, History, Model, ScalarLogger
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall, accuracy
+from paddle_tpu.optimizer import Adam
+
+
+class MLP(nn.Layer):
+    def __init__(self, in_dim=8, n_classes=4):
+        super().__init__()
+        self.fc1 = nn.Linear(in_dim, 16)
+        self.fc2 = nn.Linear(16, n_classes)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def make_data(n=64, in_dim=8, n_classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, in_dim)).astype(np.float32)
+    y = rng.integers(0, n_classes, size=(n, 1)).astype(np.int64)
+    return TensorDataset([x, y])
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    pt.seed(0)
+    model = Model(MLP())
+    model.prepare(optimizer=Adam(learning_rate=0.01),
+                  loss=lambda logits, label: F.cross_entropy(logits, label),
+                  metrics=Accuracy())
+    train = make_data(64)
+    val = make_data(32, seed=1)
+    history = model.fit(train, val, batch_size=16, epochs=2, verbose=0)
+    assert "loss" in history and len(history["loss"]) == 2
+
+    res = model.evaluate(val, batch_size=16, verbose=0)
+    assert "acc" in res and 0.0 <= res["acc"] <= 1.0
+    assert "loss" in res
+
+    test_x = np.random.default_rng(2).normal(size=(8, 8)).astype(np.float32)
+    out = model.predict(TensorDataset([test_x]), batch_size=4, stack_outputs=True)
+    assert out.shape == (8, 4)
+
+    # save / load round trip
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams") and os.path.exists(path + ".pdopt")
+    model2 = Model(MLP())
+    model2.prepare(optimizer=Adam(learning_rate=0.01),
+                   loss=lambda logits, label: F.cross_entropy(logits, label))
+    model2.load(path)
+    p1 = model.predict_batch(np.ones((2, 8), np.float32))
+    p2 = model2.predict_batch(np.ones((2, 8), np.float32))
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_model_fit_decreases_loss():
+    pt.seed(0)
+    model = Model(MLP())
+    model.prepare(optimizer=Adam(learning_rate=0.05),
+                  loss=lambda logits, label: F.cross_entropy(logits, label))
+    data = make_data(128)
+    hist = model.fit(data, batch_size=32, epochs=5, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_early_stopping_and_scalar_logger(tmp_path):
+    pt.seed(0)
+    model = Model(MLP())
+    model.prepare(optimizer=Adam(learning_rate=0.0),  # frozen -> no improvement
+                  loss=lambda logits, label: F.cross_entropy(logits, label),
+                  metrics=Accuracy())
+    data = make_data(32)
+    es = EarlyStopping(monitor="eval_loss", patience=0, verbose=0,
+                       save_best_model=False)
+    # EarlyStopping monitors eval logs; hapi fit merges eval logs with
+    # an eval_ prefix into epoch logs, the callback reads on_eval_end logs
+    es.monitor = "loss"
+    logger = ScalarLogger(log_dir=str(tmp_path / "runs"), log_freq=1)
+    model.fit(data, data, batch_size=16, epochs=5, verbose=0,
+              callbacks=[es, logger])
+    assert model.stop_training
+    assert (tmp_path / "runs" / "scalars.jsonl").exists()
+
+
+def test_summary_and_flops():
+    net = MLP()
+    info = pt.summary(net, (2, 8))
+    # fc1: 8*16+16, fc2: 16*4+4
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    n_flops = pt.flops(net, (2, 8))
+    assert n_flops >= 2 * 2 * (8 * 16 + 16 * 4)  # at least the matmul flops
+
+
+def test_accuracy_metric():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32)
+    label = np.array([[1], [2]])
+    correct = m.compute(pred, label)
+    m.update(np.asarray(correct))
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(0.5)
+    assert top2 == pytest.approx(1.0)  # row1's label 2 is in its top-2
+    m.reset()
+    assert m.accumulate() == [0.0, 0.0]
+    # functional
+    acc = accuracy(pred, label, k=1)
+    assert float(acc) == pytest.approx(0.5)
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # predicted positive: 0.9,0.8,0.6 -> tp=2 fp=1; fn=1 (the 0.2)
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+
+
+def test_auc_metric():
+    m = Auc(num_thresholds=255)
+    rng = np.random.default_rng(0)
+    # perfectly separable -> auc ~ 1
+    pos = rng.uniform(0.8, 1.0, 100)
+    neg = rng.uniform(0.0, 0.2, 100)
+    m.update(np.concatenate([pos, neg]),
+             np.concatenate([np.ones(100), np.zeros(100)]))
+    assert m.accumulate() > 0.99
+    # random -> auc ~ 0.5
+    m.reset()
+    m.update(rng.uniform(0, 1, 4000), rng.integers(0, 2, 4000))
+    assert 0.4 < m.accumulate() < 0.6
