@@ -27,3 +27,7 @@ from .parallel import (  # noqa: F401
     mp_layers, moe, pipeline, recompute as recompute_mod, sequence_parallel,
 )
 from .parallel.recompute import recompute  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    AsyncSaver, AutoCheckpoint, latest_checkpoint, load_state, save_state,
+)
