@@ -91,3 +91,41 @@ def distributed_optimizer(optimizer, strategy=None):
     if strategy is not None:
         _fleet_state["strategy"] = strategy
     return optimizer
+
+
+# ------------------------------------------------------------------ PS mode
+# Reference: fleet.init_server/init_worker/run_server/stop_worker
+# (python/paddle/distributed/fleet/fleet.py) backed by the_one_ps.py. Here
+# the PS is the in-proc local client (distributed/ps/__init__.py).
+def init_server(*model_dir, **kw):
+    from ..ps import get_ps_context
+
+    ctx = get_ps_context()
+    ctx.init_server()
+    if model_dir:
+        ctx.load_persistables(model_dir[0])
+    return ctx
+
+
+def run_server():
+    from ..ps import get_ps_context
+
+    return get_ps_context()
+
+
+def init_worker():
+    from ..ps import get_ps_context
+
+    get_ps_context().init_worker()
+
+
+def stop_worker():
+    from ..ps import get_ps_context
+
+    get_ps_context().stop_server()
+
+
+def save_persistables(dirname: str, *a, **kw):
+    from ..ps import get_ps_context
+
+    get_ps_context().save_persistables(dirname)

@@ -1,0 +1,91 @@
+"""Parameter-server subsystem (TPU-native "the one PS").
+
+Reference parity: ``paddle/fluid/distributed/ps/`` (brpc tables/services,
+``ps/README.md``), ``python/paddle/distributed/ps/the_one_ps.py`` (table
+construction from strategy), and the in-process ``PsLocalClient``
+(``ps/service/ps_local_client.h``) that the GPU-PS path uses.
+
+TPU-native shape: tables are host-RAM C++ (:mod:`.table`); the *local
+client* is the default deployment — every host in a TPU pod holds a shard
+of the key space (keys route by hash, same as ``HeterComm``'s shard-by-hash)
+and exchanges rows during pull/push via ``jax`` collectives when multi-host.
+Single-host (this round): one process owns all shards in-proc, zero RPC —
+exactly the PsLocalClient trick the reference uses for GpuPS.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .embedding import SparseEmbedding, make_lookup
+from .table import MemorySparseTable, SSDSparseTable, SparseAccessorConfig
+
+__all__ = [
+    "SparseAccessorConfig", "MemorySparseTable", "SSDSparseTable",
+    "SparseEmbedding", "make_lookup", "PSContext", "get_ps_context",
+]
+
+
+class PSContext:
+    """Table registry + lifecycle — the ``the_one_ps.py`` analogue.
+
+    ``init_server``/``init_worker`` mirror ``fleet.init_server()`` /
+    ``init_worker()``; with the local client they only manage the registry
+    (no network to bring up).
+    """
+
+    def __init__(self):
+        self._tables: Dict[str, MemorySparseTable] = {}
+        self._running = False
+
+    def create_table(self, name: str,
+                     accessor: Optional[SparseAccessorConfig] = None,
+                     ssd_spill_dir: Optional[str] = None,
+                     **accessor_kw) -> MemorySparseTable:
+        if name in self._tables:
+            raise ValueError(f"table {name!r} already exists")
+        accessor = accessor or SparseAccessorConfig(**accessor_kw)
+        if ssd_spill_dir:
+            table = SSDSparseTable(ssd_spill_dir, accessor)
+        else:
+            table = MemorySparseTable(accessor)
+        self._tables[name] = table
+        return table
+
+    def get_table(self, name: str) -> MemorySparseTable:
+        return self._tables[name]
+
+    @property
+    def tables(self) -> Dict[str, MemorySparseTable]:
+        return dict(self._tables)
+
+    def init_server(self) -> None:
+        self._running = True
+
+    def init_worker(self) -> None:
+        self._running = True
+
+    def stop_server(self) -> None:
+        self._running = False
+
+    def save_persistables(self, dirname: str) -> None:
+        """``fleet.save_persistables`` analogue: one snapshot per table."""
+        import os
+
+        os.makedirs(dirname, exist_ok=True)
+        for name, table in self._tables.items():
+            table.save(os.path.join(dirname, f"{name}.table"))
+
+    def load_persistables(self, dirname: str) -> None:
+        import os
+
+        for name, table in self._tables.items():
+            path = os.path.join(dirname, f"{name}.table")
+            if os.path.exists(path):
+                table.load(path)
+
+
+_ctx = PSContext()
+
+
+def get_ps_context() -> PSContext:
+    return _ctx

@@ -1,0 +1,103 @@
+"""Sparse embedding lookup fused into jitted steps via host callbacks.
+
+Reference parity: ``distributed_lookup_table``/``c_embedding`` +
+``PSGPUWrapper::PullSparse``/``PushSparseGrad``
+(``paddle/fluid/framework/fleet/ps_gpu_wrapper.h:157,170``) and the Python
+``paddle.static.nn.sparse_embedding``. TPU-native: the pull is a
+``jax.pure_callback`` into the host C++ table (dense [batch, dim] rows cross
+PCIe, never the full table), and the push rides the backward pass as an
+``io_callback`` inside a ``custom_vjp`` — the optimizer update happens
+server-side in C++, so the embedding never appears in the jitted step's
+parameter pytree. This is the reference's "hide the host↔device hop behind
+the step" trick (``pre_build_thread`` pipelining) restated for XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from .table import MemorySparseTable, SparseAccessorConfig
+
+
+def make_lookup(table: MemorySparseTable):
+    """Build a differentiable ``lookup(ids, anchor) -> f32[..., dim]`` bound
+    to ``table``. Works eagerly and under ``jit``; backward pushes grads into
+    the table (which applies its optimizer rule).
+
+    ``anchor`` is a throwaway *differentiable* scalar: reverse-mode AD only
+    visits a node on a path from a differentiated input, and ``ids`` is
+    integer, so without the anchor the vjp (and therefore the grad push)
+    would be dead-code-eliminated. Thread any trainable scalar through it
+    (:class:`SparseEmbedding` registers one).
+    """
+    dim = table.embed_dim
+
+    def _pull_host(ids):
+        return table.pull(np.asarray(ids))
+
+    def _push_host(ids, grads):
+        table.push(np.asarray(ids), np.asarray(grads))
+        return np.int32(0)
+
+    @jax.custom_vjp
+    def lookup(ids, anchor):
+        del anchor  # connectivity only; numerically unused
+        flat = ids.reshape(-1)
+        out = jax.pure_callback(
+            _pull_host,
+            jax.ShapeDtypeStruct((flat.shape[0], dim), jnp.float32),
+            flat)
+        return out.reshape(ids.shape + (dim,))
+
+    def fwd(ids, anchor):
+        return lookup(ids, anchor), ids
+
+    def bwd(ids, g):
+        flat_ids = ids.reshape(-1)
+        flat_g = g.reshape(-1, dim).astype(jnp.float32)
+        jax.experimental.io_callback(
+            _push_host, jax.ShapeDtypeStruct((), jnp.int32),
+            flat_ids, flat_g, ordered=False)
+        return (np.zeros(ids.shape, dtype=jax.dtypes.float0),
+                jnp.zeros(()))
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+class SparseEmbedding(Layer):
+    """Embedding layer backed by a PS table instead of a dense parameter.
+
+    Unlike :class:`paddle_tpu.nn.Embedding` (dense [vocab, dim] parameter on
+    device), ids here are arbitrary int64 feature hashes — no vocab bound —
+    and rows live host-side, the CTR/recsys regime the reference's HeterPS
+    serves. The update is applied by the table on ``push`` during backward,
+    so this layer contributes no entries to ``param_state``.
+    """
+
+    def __init__(self, embed_dim: int, table: MemorySparseTable = None,
+                 **accessor_kw):
+        super().__init__()
+        if table is None:
+            table = MemorySparseTable(
+                SparseAccessorConfig(embed_dim=embed_dim, **accessor_kw))
+        assert table.embed_dim == embed_dim
+        self.table = table
+        self.embed_dim = embed_dim
+        self._lookup = make_lookup(table)
+        # Differentiable anchor so the push-vjp survives AD pruning (see
+        # make_lookup). Always receives zero gradient; numerically unused.
+        from ...nn.initializer import Constant
+
+        self.grad_anchor = self.create_parameter(
+            (), default_initializer=Constant(0.0))
+
+    def forward(self, ids):
+        return self._lookup(jnp.asarray(ids), self.grad_anchor)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, "
+                f"optimizer={self.table.accessor.optimizer}")

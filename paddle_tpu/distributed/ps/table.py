@@ -1,0 +1,152 @@
+"""Sparse embedding tables over the native C++ runtime.
+
+Reference parity: ``paddle/fluid/distributed/ps/table/memory_sparse_table.cc``
+(sharded hash of embeddings), ``ssd_sparse_table.cc`` (beyond-RAM spill),
+``sparse_sgd_rule.cc`` (per-table optimizer rules), and the GPU-resident
+HeterPS path (``paddle/fluid/framework/fleet/heter_ps/``). TPU-native: the
+table is host-RAM C++ (no device hashtable on TPU); the chip sees dense
+gathered minibatch rows via JAX callbacks (:mod:`.embedding`).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ... import native
+
+_OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+@dataclass
+class SparseAccessorConfig:
+    """Accessor = value layout + update rule, cf. ``CtrCommonAccessor``
+    (``table/ctr_common_accessor.h``) reduced to the functional fields."""
+
+    embed_dim: int = 8
+    optimizer: str = "adagrad"
+    learning_rate: float = 0.05
+    initial_range: float = 0.01
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    seed: int = 0
+    num_shards: int = 16
+
+    def __post_init__(self):
+        if self.optimizer not in _OPTIMIZERS:
+            raise ValueError(
+                f"optimizer must be one of {sorted(_OPTIMIZERS)}, "
+                f"got {self.optimizer!r}")
+
+
+class MemorySparseTable:
+    """Thread-sharded in-memory embedding table with C++ update rules.
+
+    ``pull`` auto-initializes missing keys (deterministic per (seed, key));
+    ``push`` applies the accessor's optimizer rule server-side — gradients
+    never materialize as a dense [vocab, dim] array, which is the whole
+    point of the PS design for >HBM vocabularies.
+    """
+
+    def __init__(self, accessor: Optional[SparseAccessorConfig] = None, **kw):
+        self.accessor = accessor or SparseAccessorConfig(**kw)
+        a = self.accessor
+        self._lib = native.get_lib()
+        self._h = self._lib.pt_table_create(
+            a.embed_dim, _OPTIMIZERS[a.optimizer], a.learning_rate,
+            a.initial_range, a.beta1, a.beta2, a.epsilon, a.seed,
+            a.num_shards)
+
+    @property
+    def embed_dim(self) -> int:
+        return self.accessor.embed_dim
+
+    def pull(self, keys) -> np.ndarray:
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        out = np.empty((keys.size, self.embed_dim), np.float32)
+        self._lib.pt_table_pull(self._h, native.as_i64_ptr(keys), keys.size,
+                                native.as_f32_ptr(out))
+        return out
+
+    def push(self, keys, grads) -> None:
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(keys.size, self.embed_dim))
+        self._lib.pt_table_push(self._h, native.as_i64_ptr(keys),
+                                native.as_f32_ptr(grads), keys.size)
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._lib.pt_table_set_lr(self._h, float(lr))
+
+    def __len__(self) -> int:
+        return int(self._lib.pt_table_size(self._h))
+
+    def keys(self) -> np.ndarray:
+        n = len(self)
+        out = np.empty(n, np.int64)
+        w = self._lib.pt_table_keys(self._h, native.as_i64_ptr(out), n)
+        return out[:w]
+
+    def shrink(self, threshold: float = 1.0) -> int:
+        """Evict keys with usage counter below ``threshold`` (counters decay
+        by half each call), cf. ``MemorySparseTable::Shrink``."""
+        return int(self._lib.pt_table_shrink(self._h, float(threshold)))
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        rc = self._lib.pt_table_save(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"table save failed ({rc}): {path}")
+
+    def load(self, path: str) -> None:
+        rc = self._lib.pt_table_load(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"table load failed ({rc}): {path}")
+
+    def clear(self) -> None:
+        self._lib.pt_table_clear(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and native is not None:  # interpreter teardown safety
+            try:
+                self._lib.pt_table_destroy(h)
+            except Exception:
+                pass
+
+
+class SSDSparseTable(MemorySparseTable):
+    """Beyond-RAM table with pass-based spill, cf. ``SSDSparseTable``
+    (``table/ssd_sparse_table.cc``: hot keys in RAM, cold on SSD).
+
+    TPU-native lifecycle mirrors the reference's *pass* structure
+    (``PSGPUWrapper::BuildGPUTask`` / ``EndPass``,
+    ``ps_gpu_wrapper.h:191``): train on the in-RAM working set, then
+    ``end_pass()`` persists everything to the spill file and evicts cold
+    keys; a later pass touching an evicted key transparently reloads from
+    the snapshot on construction/``begin_pass``.
+    """
+
+    def __init__(self, spill_dir: str,
+                 accessor: Optional[SparseAccessorConfig] = None,
+                 cache_threshold: float = 1.0, **kw):
+        super().__init__(accessor, **kw)
+        self.spill_dir = spill_dir
+        self.cache_threshold = cache_threshold
+        os.makedirs(spill_dir, exist_ok=True)
+        self._snapshot = os.path.join(spill_dir, "table.bin")
+        if os.path.exists(self._snapshot):
+            self.load(self._snapshot)
+
+    def end_pass(self) -> int:
+        """Persist the full table, then evict cold keys from RAM."""
+        self.save(self._snapshot)
+        return self.shrink(self.cache_threshold)
+
+    def begin_pass(self) -> None:
+        """Reload the snapshot so previously evicted keys are warm again."""
+        if os.path.exists(self._snapshot):
+            self.load(self._snapshot)

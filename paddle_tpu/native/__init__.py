@@ -1,0 +1,123 @@
+"""Native runtime loader.
+
+The reference implements its PS/graph runtime in C++/CUDA
+(``paddle/fluid/framework/fleet/heter_ps/``); here the host-side runtime is
+C++ compiled on first use into ``_paddle_tpu_native.so`` and bound via
+ctypes (no pybind11 in this image). Rebuilds automatically when sources are
+newer than the library.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "src")
+_LIB_PATH = os.path.join(_HERE, "_paddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_SRC_DIR):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_SRC_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
+def build(verbose: bool = False) -> str:
+    """Compile the native sources into a shared library (idempotent)."""
+    with _lock:
+        if not _needs_build():
+            return _LIB_PATH
+        sources = sorted(
+            os.path.join(_SRC_DIR, f)
+            for f in os.listdir(_SRC_DIR) if f.endswith(".cc")
+        )
+        tmp = _LIB_PATH + ".tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               "-o", tmp] + sources
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+        os.replace(tmp, _LIB_PATH)
+        if verbose:
+            print(f"built {_LIB_PATH}")
+        return _LIB_PATH
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    i64p = c.POINTER(c.c_int64)
+    f32p = c.POINTER(c.c_float)
+    i32p = c.POINTER(c.c_int32)
+
+    lib.pt_table_create.restype = c.c_void_p
+    lib.pt_table_create.argtypes = [
+        c.c_int32, c.c_int32, c.c_float, c.c_float, c.c_float, c.c_float,
+        c.c_float, c.c_uint64, c.c_int32]
+    lib.pt_table_destroy.argtypes = [c.c_void_p]
+    lib.pt_table_pull.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
+    lib.pt_table_push.argtypes = [c.c_void_p, i64p, f32p, c.c_int64]
+    lib.pt_table_size.restype = c.c_int64
+    lib.pt_table_size.argtypes = [c.c_void_p]
+    lib.pt_table_keys.restype = c.c_int64
+    lib.pt_table_keys.argtypes = [c.c_void_p, i64p, c.c_int64]
+    lib.pt_table_shrink.restype = c.c_int64
+    lib.pt_table_shrink.argtypes = [c.c_void_p, c.c_float]
+    lib.pt_table_save.restype = c.c_int32
+    lib.pt_table_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_table_load.restype = c.c_int32
+    lib.pt_table_load.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_table_clear.argtypes = [c.c_void_p]
+    lib.pt_table_set_lr.argtypes = [c.c_void_p, c.c_float]
+
+    lib.pt_graph_create.restype = c.c_void_p
+    lib.pt_graph_create.argtypes = []
+    lib.pt_graph_destroy.argtypes = [c.c_void_p]
+    lib.pt_graph_add_edges.argtypes = [c.c_void_p, i64p, i64p, c.c_int64]
+    lib.pt_graph_build.argtypes = [c.c_void_p, c.c_int32]
+    lib.pt_graph_num_nodes.restype = c.c_int64
+    lib.pt_graph_num_nodes.argtypes = [c.c_void_p]
+    lib.pt_graph_num_edges.restype = c.c_int64
+    lib.pt_graph_num_edges.argtypes = [c.c_void_p]
+    lib.pt_graph_node_ids.restype = c.c_int64
+    lib.pt_graph_node_ids.argtypes = [c.c_void_p, i64p, c.c_int64]
+    lib.pt_graph_degree.restype = c.c_int64
+    lib.pt_graph_degree.argtypes = [c.c_void_p, c.c_int64]
+    lib.pt_graph_sample_neighbors.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_int32, c.c_int32, c.c_uint64, i64p,
+        i32p]
+    lib.pt_graph_random_walk.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_int32, c.c_uint64, i64p]
+
+
+def get_lib() -> ctypes.CDLL:
+    """Build (if needed) and load the native library."""
+    global _lib
+    if _lib is None:
+        path = build()
+        lib = ctypes.CDLL(path)
+        _declare(lib)
+        _lib = lib
+    return _lib
+
+
+def as_i64_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def as_i32_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def as_f32_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))

@@ -1,0 +1,65 @@
+// Shared helpers for the paddle_tpu native runtime.
+//
+// TPU-native analogue of the reference's device-side PS machinery
+// (paddle/fluid/framework/fleet/heter_ps/): TPUs have no device hashtable,
+// so the sharded tables live in host RAM and run on host threads, feeding
+// the chip through batched pull/push (SURVEY.md §7 "Embedding PS at TPU").
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace ptn {
+
+// Parallel-for over [0, n) in contiguous chunks. Degrades to inline
+// execution when n is small or only one core is available.
+inline void parallel_for(size_t n, const std::function<void(size_t, size_t)>& fn,
+                         size_t min_chunk = 1024) {
+  size_t hw = std::thread::hardware_concurrency();
+  size_t workers = hw ? hw : 1;
+  if (workers <= 1 || n <= min_chunk) {
+    fn(0, n);
+    return;
+  }
+  size_t chunks = std::min(workers, (n + min_chunk - 1) / min_chunk);
+  size_t per = (n + chunks - 1) / chunks;
+  std::vector<std::thread> ts;
+  ts.reserve(chunks);
+  for (size_t c = 0; c < chunks; ++c) {
+    size_t lo = c * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// splitmix64: deterministic per-key/seed mixing for initializers & samplers.
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct XorShift128 {
+  uint64_t s0, s1;
+  explicit XorShift128(uint64_t seed) {
+    s0 = splitmix64(seed);
+    s1 = splitmix64(s0);
+  }
+  uint64_t next() {
+    uint64_t x = s0, y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+  // uniform in [0, 1)
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+  // uniform integer in [0, n)
+  uint64_t bounded(uint64_t n) { return n ? next() % n : 0; }
+};
+
+}  // namespace ptn

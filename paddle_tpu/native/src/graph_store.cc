@@ -1,0 +1,208 @@
+// CSR graph store with thread-parallel neighbor sampling and random walks.
+//
+// TPU-native rebuild of the reference's GPU graph engine:
+//   - GpuPsGraphTable CSR store + graph_neighbor_sample_v2
+//     (paddle/fluid/framework/fleet/heter_ps/graph_gpu_ps_table.h:32,128-134)
+//   - walk kernel GraphDoWalkKernel / FillWalkBuf
+//     (paddle/fluid/framework/data_feed.cu:708,883)
+//   - CPU-side CommonGraphTable (paddle/fluid/distributed/ps/table/
+//     common_graph_table.cc)
+// On TPU the sampler runs on host threads (no device hashtable); sampled
+// batches are padded to static shapes before they ever reach XLA, which is
+// the dynamic-shape strategy SURVEY.md §7 calls for ("bucketing + padding
+// designed in the data layer").
+//
+// Node ids are arbitrary int64; internally remapped to dense int32. Padding
+// value for absent neighbors / terminated walks is -1.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+class GraphStore {
+ public:
+  // Edge ingestion happens pre-Build into COO buffers.
+  void AddEdges(const int64_t* src, const int64_t* dst, int64_t n) {
+    coo_src_.insert(coo_src_.end(), src, src + n);
+    coo_dst_.insert(coo_dst_.end(), dst, dst + n);
+  }
+
+  void Build(bool symmetric) {
+    if (symmetric) {
+      size_t n = coo_src_.size();
+      coo_src_.reserve(2 * n);
+      coo_dst_.reserve(2 * n);
+      for (size_t i = 0; i < n; ++i) {
+        coo_src_.push_back(coo_dst_[i]);
+        coo_dst_.push_back(coo_src_[i]);
+      }
+    }
+    // Dense remap.
+    id_of_.clear();
+    ids_.clear();
+    auto intern = [&](int64_t k) -> int32_t {
+      auto it = id_of_.find(k);
+      if (it != id_of_.end()) return it->second;
+      int32_t idx = static_cast<int32_t>(ids_.size());
+      id_of_.emplace(k, idx);
+      ids_.push_back(k);
+      return idx;
+    };
+    std::vector<int32_t> s(coo_src_.size()), d(coo_dst_.size());
+    for (size_t i = 0; i < coo_src_.size(); ++i) {
+      s[i] = intern(coo_src_[i]);
+      d[i] = intern(coo_dst_[i]);
+    }
+    const size_t nn = ids_.size();
+    row_ptr_.assign(nn + 1, 0);
+    for (int32_t u : s) row_ptr_[static_cast<size_t>(u) + 1]++;
+    for (size_t i = 0; i < nn; ++i) row_ptr_[i + 1] += row_ptr_[i];
+    col_.resize(s.size());
+    std::vector<int64_t> cursor(row_ptr_.begin(), row_ptr_.end() - 1);
+    for (size_t i = 0; i < s.size(); ++i) {
+      col_[static_cast<size_t>(cursor[s[i]]++)] = d[i];
+    }
+    coo_src_.clear();
+    coo_src_.shrink_to_fit();
+    coo_dst_.clear();
+    coo_dst_.shrink_to_fit();
+  }
+
+  int64_t NumNodes() const { return static_cast<int64_t>(ids_.size()); }
+  int64_t NumEdges() const { return static_cast<int64_t>(col_.size()); }
+
+  int64_t NodeIds(int64_t* out, int64_t cap) const {
+    int64_t w = std::min<int64_t>(cap, static_cast<int64_t>(ids_.size()));
+    std::memcpy(out, ids_.data(), sizeof(int64_t) * w);
+    return w;
+  }
+
+  int64_t Degree(int64_t key) const {
+    auto it = id_of_.find(key);
+    if (it == id_of_.end()) return 0;
+    return row_ptr_[it->second + 1] - row_ptr_[it->second];
+  }
+
+  // Sample up to k neighbors for each of n query nodes into out[n*k]
+  // (padded -1); counts[n] = actual neighbor count sampled. replace=0 uses
+  // partial Fisher-Yates without replacement (matches neighbor_sample_v2
+  // semantics); unknown nodes get count 0.
+  void SampleNeighbors(const int64_t* nodes, int64_t n, int32_t k,
+                       int32_t replace, uint64_t seed, int64_t* out,
+                       int32_t* counts) const {
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        int64_t* row = out + i * k;
+        std::fill(row, row + k, int64_t{-1});
+        counts[i] = 0;
+        auto it = id_of_.find(nodes[i]);
+        if (it == id_of_.end()) continue;
+        const int64_t beg = row_ptr_[it->second], end = row_ptr_[it->second + 1];
+        const int64_t deg = end - beg;
+        if (deg == 0) continue;
+        ptn::XorShift128 rng(ptn::splitmix64(seed) ^
+                             ptn::splitmix64(static_cast<uint64_t>(nodes[i])));
+        if (replace || deg <= k) {
+          if (replace) {
+            for (int32_t j = 0; j < k; ++j) {
+              row[j] = ids_[col_[beg + static_cast<int64_t>(rng.bounded(deg))]];
+            }
+            counts[i] = k;
+          } else {
+            for (int64_t j = 0; j < deg; ++j) row[j] = ids_[col_[beg + j]];
+            counts[i] = static_cast<int32_t>(deg);
+          }
+        } else {
+          // Reservoir sample k of deg without replacement.
+          std::vector<int64_t> res(k);
+          for (int32_t j = 0; j < k; ++j) res[j] = col_[beg + j];
+          for (int64_t j = k; j < deg; ++j) {
+            uint64_t r = rng.bounded(static_cast<uint64_t>(j + 1));
+            if (r < static_cast<uint64_t>(k)) res[r] = col_[beg + j];
+          }
+          for (int32_t j = 0; j < k; ++j) row[j] = ids_[res[j]];
+          counts[i] = k;
+        }
+      }
+    }, 64);
+  }
+
+  // Random walks of fixed length from each start; out[n * walk_len] holds the
+  // visited nodes (start excluded), padded -1 after a dead end — the
+  // FillWalkBuf/GraphDoWalkKernel analogue.
+  void RandomWalk(const int64_t* starts, int64_t n, int32_t walk_len,
+                  uint64_t seed, int64_t* out) const {
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        int64_t* row = out + i * walk_len;
+        std::fill(row, row + walk_len, int64_t{-1});
+        auto it = id_of_.find(starts[i]);
+        if (it == id_of_.end()) continue;
+        int32_t cur = it->second;
+        ptn::XorShift128 rng(ptn::splitmix64(seed + i) ^
+                             ptn::splitmix64(static_cast<uint64_t>(starts[i])));
+        for (int32_t step = 0; step < walk_len; ++step) {
+          const int64_t beg = row_ptr_[cur], end = row_ptr_[cur + 1];
+          if (beg == end) break;
+          cur = col_[beg + static_cast<int64_t>(rng.bounded(end - beg))];
+          row[step] = ids_[cur];
+        }
+      }
+    }, 64);
+  }
+
+ private:
+  std::vector<int64_t> coo_src_, coo_dst_;
+  std::unordered_map<int64_t, int32_t> id_of_;
+  std::vector<int64_t> ids_;       // dense idx -> original id
+  std::vector<int64_t> row_ptr_;   // CSR offsets
+  std::vector<int32_t> col_;       // CSR neighbor dense indices
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_graph_create() { return new GraphStore(); }
+void pt_graph_destroy(void* h) { delete static_cast<GraphStore*>(h); }
+
+void pt_graph_add_edges(void* h, const int64_t* src, const int64_t* dst,
+                        int64_t n) {
+  static_cast<GraphStore*>(h)->AddEdges(src, dst, n);
+}
+
+void pt_graph_build(void* h, int32_t symmetric) {
+  static_cast<GraphStore*>(h)->Build(symmetric != 0);
+}
+
+int64_t pt_graph_num_nodes(void* h) {
+  return static_cast<GraphStore*>(h)->NumNodes();
+}
+int64_t pt_graph_num_edges(void* h) {
+  return static_cast<GraphStore*>(h)->NumEdges();
+}
+int64_t pt_graph_node_ids(void* h, int64_t* out, int64_t cap) {
+  return static_cast<GraphStore*>(h)->NodeIds(out, cap);
+}
+int64_t pt_graph_degree(void* h, int64_t key) {
+  return static_cast<GraphStore*>(h)->Degree(key);
+}
+
+void pt_graph_sample_neighbors(void* h, const int64_t* nodes, int64_t n,
+                               int32_t k, int32_t replace, uint64_t seed,
+                               int64_t* out, int32_t* counts) {
+  static_cast<GraphStore*>(h)->SampleNeighbors(nodes, n, k, replace, seed, out,
+                                               counts);
+}
+
+void pt_graph_random_walk(void* h, const int64_t* starts, int64_t n,
+                          int32_t walk_len, uint64_t seed, int64_t* out) {
+  static_cast<GraphStore*>(h)->RandomWalk(starts, n, walk_len, seed, out);
+}
+}

@@ -1,0 +1,343 @@
+// Sharded in-memory sparse embedding table with C++ optimizer rules.
+//
+// TPU-native rebuild of the reference's GPU parameter server
+// (paddle/fluid/framework/fleet/heter_ps/: HeterComm `heter_comm.h:52`,
+// GPU hashtable `hashtable_kernel.cu`, device optimizers `optimizer.cuh.h`)
+// and the brpc-side tables (paddle/fluid/distributed/ps/table/
+// memory_sparse_table.cc, sparse_sgd_rule.cc). TPUs have no device-resident
+// hashtable, so the table lives in host RAM, sharded for thread-parallel
+// pull/push; the chip sees dense gathered minibatch embeddings via JAX
+// callbacks (see python/paddle_tpu/distributed/ps/).
+//
+// Value layout per key: [show, click?no — slot counters kept minimal]
+//   embedding: dim floats
+//   optimizer state appended: SGD none | AdaGrad dim (g2sum) |
+//   Adam 2*dim + 2 (m, v, beta1^t, beta2^t)
+// plus one float of usage counter ("show") for shrink(), mirroring the CTR
+// accessors (table/ctr_common_accessor.h).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+enum OptimizerKind : int32_t {
+  kSGD = 0,
+  kAdaGrad = 1,
+  kAdam = 2,
+};
+
+struct TableConfig {
+  int32_t dim = 8;
+  int32_t optimizer = kAdaGrad;
+  float lr = 0.05f;
+  float initial_range = 0.01f;
+  float beta1 = 0.9f;
+  float beta2 = 0.999f;
+  float eps = 1e-8f;
+  uint64_t seed = 0;
+  int32_t num_shards = 16;
+};
+
+struct Shard {
+  // key -> index into `values` arena (in units of value_width)
+  std::unordered_map<int64_t, uint32_t> index;
+  std::vector<float> values;
+  std::mutex mu;
+};
+
+class SparseTable {
+ public:
+  explicit SparseTable(const TableConfig& cfg) : cfg_(cfg), shards_(cfg.num_shards) {}
+
+  int32_t dim() const { return cfg_.dim; }
+
+  void SetLr(float lr) { cfg_.lr = lr; }
+
+  int32_t value_width() const {
+    switch (cfg_.optimizer) {
+      case kSGD: return cfg_.dim + 1;
+      case kAdaGrad: return 2 * cfg_.dim + 1;
+      case kAdam: return 3 * cfg_.dim + 3;
+    }
+    return cfg_.dim + 1;
+  }
+
+  size_t shard_of(int64_t key) const {
+    return ptn::splitmix64(static_cast<uint64_t>(key)) % shards_.size();
+  }
+
+  // Gather embeddings for n keys into out[n * dim]; missing keys are
+  // initialized uniform(-initial_range, initial_range), deterministically
+  // from (table seed, key) — analogous to the sgd-rule init_value paths
+  // (table/sparse_sgd_rule.cc).
+  void Pull(const int64_t* keys, int64_t n, float* out) {
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        int64_t key = keys[i];
+        Shard& sh = shards_[shard_of(key)];
+        std::lock_guard<std::mutex> g(sh.mu);
+        float* v = FindOrInit(sh, key);
+        std::memcpy(out + i * cfg_.dim, v, sizeof(float) * cfg_.dim);
+        v[usage_offset()] += 1.0f;  // show counter
+      }
+    }, 256);
+  }
+
+  // Apply grads for n keys. Duplicate keys within the batch are applied in
+  // order (shard mutex serializes). grads[n * dim].
+  void Push(const int64_t* keys, const float* grads, int64_t n) {
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        int64_t key = keys[i];
+        Shard& sh = shards_[shard_of(key)];
+        std::lock_guard<std::mutex> g(sh.mu);
+        float* v = FindOrInit(sh, key);
+        ApplyRule(v, grads + i * cfg_.dim);
+      }
+    }, 256);
+  }
+
+  int64_t Size() const {
+    int64_t total = 0;
+    for (auto& sh : shards_) total += static_cast<int64_t>(sh.index.size());
+    return total;
+  }
+
+  // Copy up to cap keys into out; returns count written.
+  int64_t Keys(int64_t* out, int64_t cap) const {
+    int64_t w = 0;
+    for (auto& sh : shards_) {
+      for (auto& kv : sh.index) {
+        if (w >= cap) return w;
+        out[w++] = kv.first;
+      }
+    }
+    return w;
+  }
+
+  // Drop keys whose usage counter < threshold; counters halve each call
+  // (decayed shrink, cf. MemorySparseTable::Shrink).
+  int64_t Shrink(float threshold) {
+    std::atomic<int64_t> dropped{0};
+    ptn::parallel_for(shards_.size(), [&](size_t lo, size_t hi) {
+      for (size_t s = lo; s < hi; ++s) {
+        Shard& sh = shards_[s];
+        std::lock_guard<std::mutex> g(sh.mu);
+        std::unordered_map<int64_t, uint32_t> keep;
+        std::vector<float> values;
+        keep.reserve(sh.index.size());
+        const int32_t w = value_width();
+        for (auto& kv : sh.index) {
+          float* v = sh.values.data() + static_cast<size_t>(kv.second) * w;
+          if (v[usage_offset()] >= threshold) {
+            uint32_t idx = static_cast<uint32_t>(keep.size());
+            keep.emplace(kv.first, idx);
+            values.insert(values.end(), v, v + w);
+            values[static_cast<size_t>(idx) * w + usage_offset()] *= 0.5f;
+          } else {
+            dropped.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
+        sh.index.swap(keep);
+        sh.values.swap(values);
+      }
+    }, 1);
+    return dropped.load();
+  }
+
+  // Binary snapshot: [magic, value_width, count, (key, value_width floats)*].
+  int32_t Save(const char* path) const {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return -1;
+    const uint64_t magic = 0x5054424c45303146ULL;  // "PTBLE01F"
+    const int32_t w = value_width();
+    uint64_t count = static_cast<uint64_t>(Size());
+    std::fwrite(&magic, sizeof(magic), 1, f);
+    std::fwrite(&w, sizeof(w), 1, f);
+    std::fwrite(&count, sizeof(count), 1, f);
+    for (auto& sh : shards_) {
+      for (auto& kv : sh.index) {
+        const float* v = sh.values.data() + static_cast<size_t>(kv.second) * w;
+        std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+        std::fwrite(v, sizeof(float), w, f);
+      }
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+  int32_t Load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    uint64_t magic = 0;
+    int32_t w = 0;
+    uint64_t count = 0;
+    if (std::fread(&magic, sizeof(magic), 1, f) != 1 ||
+        magic != 0x5054424c45303146ULL ||
+        std::fread(&w, sizeof(w), 1, f) != 1 || w != value_width() ||
+        std::fread(&count, sizeof(count), 1, f) != 1) {
+      std::fclose(f);
+      return -2;
+    }
+    std::vector<float> buf(w);
+    for (uint64_t i = 0; i < count; ++i) {
+      int64_t key;
+      if (std::fread(&key, sizeof(key), 1, f) != 1 ||
+          std::fread(buf.data(), sizeof(float), w, f) != static_cast<size_t>(w)) {
+        std::fclose(f);
+        return -3;
+      }
+      Shard& sh = shards_[shard_of(key)];
+      auto it = sh.index.find(key);
+      uint32_t idx;
+      if (it == sh.index.end()) {
+        idx = static_cast<uint32_t>(sh.index.size());
+        sh.index.emplace(key, idx);
+        sh.values.resize(static_cast<size_t>(idx + 1) * w);
+      } else {
+        idx = it->second;
+      }
+      std::memcpy(sh.values.data() + static_cast<size_t>(idx) * w, buf.data(),
+                  sizeof(float) * w);
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+  void Clear() {
+    for (auto& sh : shards_) {
+      sh.index.clear();
+      sh.values.clear();
+    }
+  }
+
+ private:
+  int32_t usage_offset() const { return value_width() - 1 - (cfg_.optimizer == kAdam ? 2 : 0); }
+
+  // Adam scalar state lives at the tail: [beta1^t, beta2^t].
+  float* FindOrInit(Shard& sh, int64_t key) {
+    const int32_t w = value_width();
+    auto it = sh.index.find(key);
+    if (it != sh.index.end()) {
+      return sh.values.data() + static_cast<size_t>(it->second) * w;
+    }
+    uint32_t idx = static_cast<uint32_t>(sh.index.size());
+    sh.index.emplace(key, idx);
+    sh.values.resize(static_cast<size_t>(idx + 1) * w, 0.0f);
+    float* v = sh.values.data() + static_cast<size_t>(idx) * w;
+    ptn::XorShift128 rng(ptn::splitmix64(cfg_.seed) ^ static_cast<uint64_t>(key));
+    for (int32_t d = 0; d < cfg_.dim; ++d) {
+      v[d] = static_cast<float>((rng.uniform() * 2.0 - 1.0) * cfg_.initial_range);
+    }
+    if (cfg_.optimizer == kAdam) {
+      v[w - 2] = 1.0f;  // beta1^t accumulator starts at 1 (pre-step)
+      v[w - 1] = 1.0f;
+    }
+    return v;
+  }
+
+  void ApplyRule(float* v, const float* g) {
+    const int32_t dim = cfg_.dim;
+    switch (cfg_.optimizer) {
+      case kSGD: {
+        for (int32_t d = 0; d < dim; ++d) v[d] -= cfg_.lr * g[d];
+        break;
+      }
+      case kAdaGrad: {
+        float* g2 = v + dim;
+        for (int32_t d = 0; d < dim; ++d) {
+          g2[d] += g[d] * g[d];
+          v[d] -= cfg_.lr * g[d] / (std::sqrt(g2[d]) + cfg_.eps);
+        }
+        break;
+      }
+      case kAdam: {
+        const int32_t w = value_width();
+        float* m = v + dim;
+        float* vv = v + 2 * dim;
+        v[w - 2] *= cfg_.beta1;
+        v[w - 1] *= cfg_.beta2;
+        const float bc1 = 1.0f - v[w - 2];
+        const float bc2 = 1.0f - v[w - 1];
+        for (int32_t d = 0; d < dim; ++d) {
+          m[d] = cfg_.beta1 * m[d] + (1.0f - cfg_.beta1) * g[d];
+          vv[d] = cfg_.beta2 * vv[d] + (1.0f - cfg_.beta2) * g[d] * g[d];
+          const float mhat = m[d] / bc1;
+          const float vhat = vv[d] / bc2;
+          v[d] -= cfg_.lr * mhat / (std::sqrt(vhat) + cfg_.eps);
+        }
+        break;
+      }
+    }
+  }
+
+  TableConfig cfg_;
+  mutable std::vector<Shard> shards_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_table_create(int32_t dim, int32_t optimizer, float lr,
+                      float initial_range, float beta1, float beta2, float eps,
+                      uint64_t seed, int32_t num_shards) {
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.optimizer = optimizer;
+  cfg.lr = lr;
+  cfg.initial_range = initial_range;
+  cfg.beta1 = beta1;
+  cfg.beta2 = beta2;
+  cfg.eps = eps;
+  cfg.seed = seed;
+  cfg.num_shards = num_shards > 0 ? num_shards : 16;
+  return new SparseTable(cfg);
+}
+
+void pt_table_destroy(void* h) { delete static_cast<SparseTable*>(h); }
+
+void pt_table_pull(void* h, const int64_t* keys, int64_t n, float* out) {
+  static_cast<SparseTable*>(h)->Pull(keys, n, out);
+}
+
+void pt_table_push(void* h, const int64_t* keys, const float* grads, int64_t n) {
+  static_cast<SparseTable*>(h)->Push(keys, grads, n);
+}
+
+int64_t pt_table_size(void* h) { return static_cast<SparseTable*>(h)->Size(); }
+
+int64_t pt_table_keys(void* h, int64_t* out, int64_t cap) {
+  return static_cast<SparseTable*>(h)->Keys(out, cap);
+}
+
+int64_t pt_table_shrink(void* h, float threshold) {
+  return static_cast<SparseTable*>(h)->Shrink(threshold);
+}
+
+int32_t pt_table_save(void* h, const char* path) {
+  return static_cast<SparseTable*>(h)->Save(path);
+}
+
+int32_t pt_table_load(void* h, const char* path) {
+  return static_cast<SparseTable*>(h)->Load(path);
+}
+
+void pt_table_clear(void* h) { static_cast<SparseTable*>(h)->Clear(); }
+
+// lr setter so Python LR schedules drive the C++ rule (the reference plumbs
+// this through sgd-rule `learning_rate`, table/sparse_sgd_rule.cc).
+void pt_table_set_lr(void* h, float lr) {
+  static_cast<SparseTable*>(h)->SetLr(lr);
+}
+}
