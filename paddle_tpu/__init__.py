@@ -32,6 +32,7 @@ from .framework.jit import EvalStep, TrainStep  # noqa: F401
 from .framework.jit import jit  # noqa: F401
 
 from . import nn  # noqa: F401
+from . import geometric  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import metric  # noqa: F401
 from . import callbacks  # noqa: F401

@@ -16,12 +16,14 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from .embedding import SparseEmbedding, make_lookup
+from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
+                        make_lookup)
 from .table import MemorySparseTable, SSDSparseTable, SparseAccessorConfig
 
 __all__ = [
     "SparseAccessorConfig", "MemorySparseTable", "SSDSparseTable",
-    "SparseEmbedding", "make_lookup", "PSContext", "get_ps_context",
+    "SparseEmbedding", "StagedPull", "callbacks_supported", "make_lookup",
+    "PSContext", "get_ps_context",
 ]
 
 

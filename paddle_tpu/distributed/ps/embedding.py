@@ -21,6 +21,26 @@ import jax.numpy as jnp
 from ...nn.layer import Layer
 from .table import MemorySparseTable, SparseAccessorConfig
 
+_callbacks_supported = None
+
+
+def callbacks_supported() -> bool:
+    """Whether the active backend supports host callbacks inside jit.
+
+    Standard CPU/TPU runtimes do; some tunneled PJRT plugins (axon) don't —
+    there the staged :class:`StagedPull` path is the way to train.
+    """
+    global _callbacks_supported
+    if _callbacks_supported is None:
+        try:
+            out = jax.jit(lambda x: jax.pure_callback(
+                lambda y: y, jax.ShapeDtypeStruct((), jnp.float32), x))(
+                    jnp.float32(3.0))
+            _callbacks_supported = float(out) == 3.0
+        except Exception:
+            _callbacks_supported = False
+    return _callbacks_supported
+
 
 def make_lookup(table: MemorySparseTable):
     """Build a differentiable ``lookup(ids, anchor) -> f32[..., dim]`` bound
@@ -96,8 +116,51 @@ class SparseEmbedding(Layer):
             (), default_initializer=Constant(0.0))
 
     def forward(self, ids):
-        return self._lookup(jnp.asarray(ids), self.grad_anchor)
+        ids = jnp.asarray(ids)
+        if not isinstance(ids, jax.core.Tracer) and \
+                not isinstance(self.grad_anchor, jax.core.Tracer):
+            # Eager path: plain host pull, no callback machinery (works on
+            # backends without host-callback support).
+            rows = self.table.pull(np.asarray(ids).reshape(-1))
+            return jnp.asarray(rows).reshape(ids.shape + (self.embed_dim,))
+        return self._lookup(ids, self.grad_anchor)
 
     def extra_repr(self):
         return (f"embed_dim={self.embed_dim}, "
                 f"optimizer={self.table.accessor.optimizer}")
+
+
+class StagedPull:
+    """Pull-before / push-after staging for training without in-graph
+    callbacks — the reference's actual structure (``PSGPUWorker`` pulls via
+    ``PullSparse`` before the program runs and pushes via ``PushSparseGrad``
+    after it, ``ps_gpu_wrapper.h:157,170``), restated for XLA: the jitted
+    step takes dense ``rows`` as a regular differentiable input; duplicate
+    ids are deduplicated so row grads come back merged (the communicator's
+    batched-merge, ``communicator.h:426``).
+
+    Usage::
+
+        staged = StagedPull(table)
+        rows, inv, uniq = staged.pull(ids)          # host side
+        loss, row_grads = step(params, rows, inv)   # jit: emb = rows[inv]
+        staged.push(uniq, row_grads)                # host side, C++ update
+    """
+
+    def __init__(self, table: MemorySparseTable):
+        self.table = table
+
+    def pull(self, ids):
+        ids = np.asarray(ids)
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = self.table.pull(uniq)
+        return (jnp.asarray(rows), jnp.asarray(inv.reshape(ids.shape)),
+                uniq)
+
+    @staticmethod
+    def lookup(rows, inv):
+        """In-graph gather: embedding activations for the original ids."""
+        return rows[inv]
+
+    def push(self, uniq, row_grads) -> None:
+        self.table.push(uniq, np.asarray(row_grads))

@@ -1,0 +1,154 @@
+"""Graph table + walk-based batch generator over the native CSR store.
+
+Reference parity:
+  - ``GraphGpuWrapper``/``GpuPsGraphTable`` (``paddle/fluid/framework/fleet/
+    heter_ps/graph_gpu_wrapper.h:25``, ``graph_gpu_ps_table.h:32``) — graph
+    storage + ``graph_neighbor_sample_v2``;
+  - ``GraphDataGenerator`` (``paddle/fluid/framework/data_feed.h:893``,
+    walk kernel ``data_feed.cu:708``, ``FillWalkBuf`` ``data_feed.cu:883``) —
+    random-walk window batches with negative sampling for
+    deepwalk/node2vec-style GNN+CTR training;
+  - CPU-side ``CommonGraphTable`` (``ps/table/common_graph_table.cc``).
+
+TPU-native: sampling runs on host C++ threads (no device hashtable); every
+batch is padded to static shapes before reaching XLA (SURVEY.md §7 dynamic-
+shape strategy), so the jitted model never recompiles.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ... import native
+
+
+class GraphTable:
+    """CSR graph with thread-parallel neighbor sampling and random walks."""
+
+    def __init__(self):
+        self._lib = native.get_lib()
+        self._h = self._lib.pt_graph_create()
+        self._built = False
+
+    def add_edges(self, src, dst) -> None:
+        src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.int64)
+        dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.int64)
+        assert src.size == dst.size
+        self._lib.pt_graph_add_edges(
+            self._h, native.as_i64_ptr(src), native.as_i64_ptr(dst), src.size)
+        self._built = False
+
+    def build(self, symmetric: bool = False) -> None:
+        """Finalize into CSR. ``symmetric=True`` adds reverse edges."""
+        self._lib.pt_graph_build(self._h, 1 if symmetric else 0)
+        self._built = True
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self._lib.pt_graph_num_nodes(self._h))
+
+    @property
+    def num_edges(self) -> int:
+        return int(self._lib.pt_graph_num_edges(self._h))
+
+    def node_ids(self) -> np.ndarray:
+        n = self.num_nodes
+        out = np.empty(n, np.int64)
+        w = self._lib.pt_graph_node_ids(self._h, native.as_i64_ptr(out), n)
+        return out[:w]
+
+    def degree(self, key: int) -> int:
+        return int(self._lib.pt_graph_degree(self._h, int(key)))
+
+    def sample_neighbors(self, nodes, sample_size: int, replace: bool = False,
+                         seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        """Sample up to ``sample_size`` neighbors per node.
+
+        Returns ``(neighbors [n, k] int64 padded -1, counts [n] int32)`` —
+        the padded-static-shape form of ``graph_neighbor_sample_v2``.
+        """
+        assert self._built, "call build() first"
+        nodes = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.int64)
+        out = np.empty((nodes.size, sample_size), np.int64)
+        counts = np.empty(nodes.size, np.int32)
+        self._lib.pt_graph_sample_neighbors(
+            self._h, native.as_i64_ptr(nodes), nodes.size, sample_size,
+            1 if replace else 0, seed, native.as_i64_ptr(out),
+            native.as_i32_ptr(counts))
+        return out, counts
+
+    def random_walk(self, starts, walk_len: int, seed: int = 0) -> np.ndarray:
+        """Fixed-length uniform random walks; [n, walk_len] int64, padded -1
+        after dead ends (start node excluded)."""
+        assert self._built, "call build() first"
+        starts = np.ascontiguousarray(np.asarray(starts).reshape(-1), np.int64)
+        out = np.empty((starts.size, walk_len), np.int64)
+        self._lib.pt_graph_random_walk(
+            self._h, native.as_i64_ptr(starts), starts.size, walk_len, seed,
+            native.as_i64_ptr(out))
+        return out
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and native is not None:
+            try:
+                self._lib.pt_graph_destroy(h)
+            except Exception:
+                pass
+
+
+class GraphDataGenerator:
+    """Walk-window skip-gram batch stream with negative sampling.
+
+    The ``GraphDataGenerator`` analogue (``data_feed.h:893``): walks start
+    from every node (shuffled per epoch), a sliding window over each walk
+    emits (center, context) positive pairs, and negatives are drawn uniformly
+    from the node set — the deepwalk training feed of the reference's PGLBox
+    pipeline. Batches are constant-shape ``(batch_size,)`` int64 triples
+    (center, context, negatives[batch, num_neg]) so the jitted step compiles
+    once; the final partial batch is dropped (reference drops it too).
+    """
+
+    def __init__(self, graph: GraphTable, batch_size: int = 512,
+                 walk_len: int = 8, window: int = 2, num_neg: int = 4,
+                 seed: int = 0, starts: Optional[np.ndarray] = None):
+        self.graph = graph
+        self.batch_size = batch_size
+        self.walk_len = walk_len
+        self.window = window
+        self.num_neg = num_neg
+        self.seed = seed
+        self._starts = (np.asarray(starts, np.int64) if starts is not None
+                        else graph.node_ids())
+        self._nodes = graph.node_ids()
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        rng = np.random.default_rng(self.seed + self._epoch)
+        self._epoch += 1
+        starts = rng.permutation(self._starts)
+        walks = self.graph.random_walk(
+            starts, self.walk_len, seed=int(rng.integers(2 ** 62)))
+        # full sequences: start node + its walk
+        seqs = np.concatenate([starts[:, None], walks], axis=1)
+        centers, contexts = [], []
+        L = seqs.shape[1]
+        for off in range(1, self.window + 1):
+            src = seqs[:, :-off].reshape(-1)
+            dst = seqs[:, off:].reshape(-1)
+            ok = (src >= 0) & (dst >= 0)
+            centers.append(src[ok])
+            contexts.append(dst[ok])
+            centers.append(dst[ok])   # symmetric window
+            contexts.append(src[ok])
+        centers = np.concatenate(centers)
+        contexts = np.concatenate(contexts)
+        perm = rng.permutation(centers.size)
+        centers, contexts = centers[perm], contexts[perm]
+        bs = self.batch_size
+        for i in range(centers.size // bs):
+            c = centers[i * bs:(i + 1) * bs]
+            x = contexts[i * bs:(i + 1) * bs]
+            neg = rng.choice(self._nodes, size=(bs, self.num_neg))
+            yield c, x, neg.astype(np.int64)

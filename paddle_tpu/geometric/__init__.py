@@ -1,0 +1,18 @@
+"""paddle_tpu.geometric — GNN message passing + graph sampling.
+
+Reference parity: ``python/paddle/geometric/`` (``message_passing/send_recv.py``,
+``sampling/neighbors.py``) and the incubate wrappers
+(``python/paddle/incubate/operators/graph_send_recv.py``,
+``graph_sample_neighbors.py:28``, ``graph_reindex.py:28``,
+``graph_khop_sampler.py:21``). TPU-native: aggregation lowers to XLA
+``segment_sum``-family ops (device-side, differentiable); samplers run in
+the native C++ CSR store or over in-memory CSC arrays, returning padded
+static shapes.
+"""
+from .message_passing import segment_pool, send_u_recv, send_ue_recv, send_uv
+from .sampling import khop_sampler, reindex_graph, sample_neighbors
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv", "segment_pool",
+    "sample_neighbors", "reindex_graph", "khop_sampler",
+]

@@ -1,0 +1,119 @@
+"""Graph sampling + reindex (host-side, static-shape outputs).
+
+Reference parity: ``python/paddle/geometric/sampling/neighbors.py``
+(``sample_neighbors`` over CSC ``row``/``colptr`` tensors; CUDA kernel
+``paddle/phi/kernels/gpu/graph_sample_neighbors_kernel.cu``),
+``graph_reindex.py:28`` and ``graph_khop_sampler.py:21``. TPU-native:
+sampling is host work feeding padded batches to the chip (SURVEY.md §7);
+the heavy store lives in C++ (:class:`paddle_tpu.distributed.ps.graph.GraphTable`),
+while this module also accepts plain CSC numpy arrays for API parity.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _sample_from_csc(row: np.ndarray, colptr: np.ndarray, node: int,
+                     k: int, rng: np.random.Generator,
+                     replace: bool) -> np.ndarray:
+    beg, end = int(colptr[node]), int(colptr[node + 1])
+    neigh = row[beg:end]
+    # k <= 0 is the "take all neighbors" sentinel regardless of `replace`.
+    if neigh.size == 0 or k <= 0 or (not replace and neigh.size <= k):
+        return neigh.copy()
+    return rng.choice(neigh, size=k, replace=replace)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     replace: bool = False, seed: Optional[int] = None,
+                     return_eids: bool = False):
+    """Sample neighbors of ``input_nodes`` from a CSC graph.
+
+    Returns ``(out_neighbors, out_count)`` as int64/int32 numpy arrays —
+    ``out_neighbors`` is the concatenation of each node's sampled
+    neighbors (ragged, like the reference), ``out_count[i]`` its length.
+    """
+    row = np.asarray(row, np.int64).reshape(-1)
+    colptr = np.asarray(colptr, np.int64).reshape(-1)
+    nodes = np.asarray(input_nodes, np.int64).reshape(-1)
+    rng = np.random.default_rng(seed)
+    k = int(sample_size)
+    outs, counts = [], np.empty(nodes.size, np.int32)
+    for i, u in enumerate(nodes):
+        s = _sample_from_csc(row, colptr, int(u), k, rng, replace)
+        outs.append(s)
+        counts[i] = s.size
+    out = (np.concatenate(outs) if outs else np.empty(0, np.int64))
+    if return_eids:
+        raise NotImplementedError("eids not tracked; store edge ids as "
+                                  "features if needed")
+    return out.astype(np.int64), counts
+
+
+def reindex_graph(x, neighbors, count) -> Tuple[np.ndarray, np.ndarray,
+                                                np.ndarray]:
+    """Relabel global ids to a compact local space.
+
+    Returns ``(reindex_src, reindex_dst, out_nodes)`` where ``out_nodes``
+    starts with ``x`` then first-seen new neighbor ids;
+    ``reindex_src[i]`` is the local id of ``neighbors[i]`` and
+    ``reindex_dst`` repeats each center's local id ``count[i]`` times —
+    exactly the reference's ``graph_reindex`` contract.
+    """
+    x = np.asarray(x, np.int64).reshape(-1)
+    neighbors = np.asarray(neighbors, np.int64).reshape(-1)
+    count = np.asarray(count, np.int64).reshape(-1)
+    local = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(x)
+    src = np.empty(neighbors.size, np.int64)
+    for i, v in enumerate(neighbors):
+        vi = int(v)
+        idx = local.get(vi)
+        if idx is None:
+            idx = len(out_nodes)
+            local[vi] = idx
+            out_nodes.append(vi)
+        src[i] = idx
+    dst = np.repeat(np.arange(x.size, dtype=np.int64), count)
+    return src, dst, np.asarray(out_nodes, np.int64)
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes,
+                 seed: Optional[int] = None):
+    """Multi-hop neighborhood sampling (reference ``graph_khop_sampler``).
+
+    Returns ``(edge_src, edge_dst, sample_index)``: local-id edges over the
+    union frontier and the global ids backing each local id.
+    """
+    nodes = np.asarray(input_nodes, np.int64).reshape(-1)
+    local = {}
+    table = []
+
+    def intern(v: int) -> int:
+        idx = local.get(v)
+        if idx is None:
+            idx = len(table)
+            local[v] = idx
+            table.append(v)
+        return idx
+
+    for u in nodes:
+        intern(int(u))
+    all_src, all_dst = [], []
+    frontier = nodes
+    for hop, k in enumerate(sample_sizes):
+        neigh, cnt = sample_neighbors(
+            row, colptr, frontier, k,
+            seed=None if seed is None else seed + hop)
+        pos = 0
+        for u, c in zip(frontier, cnt):
+            du = intern(int(u))
+            for v in neigh[pos:pos + c]:
+                all_src.append(intern(int(v)))
+                all_dst.append(du)
+            pos += c
+        frontier = np.unique(neigh)
+    return (np.asarray(all_src, np.int64), np.asarray(all_dst, np.int64),
+            np.asarray(table, np.int64))

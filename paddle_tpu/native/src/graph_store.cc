@@ -33,16 +33,10 @@ class GraphStore {
     coo_dst_.insert(coo_dst_.end(), dst, dst + n);
   }
 
+  // Rebuildable: the COO edge list is retained, so add_edges -> build ->
+  // add_edges -> build accumulates (the CSR is derived state).
   void Build(bool symmetric) {
-    if (symmetric) {
-      size_t n = coo_src_.size();
-      coo_src_.reserve(2 * n);
-      coo_dst_.reserve(2 * n);
-      for (size_t i = 0; i < n; ++i) {
-        coo_src_.push_back(coo_dst_[i]);
-        coo_dst_.push_back(coo_src_[i]);
-      }
-    }
+    const size_t n = coo_src_.size();
     // Dense remap.
     id_of_.clear();
     ids_.clear();
@@ -54,24 +48,27 @@ class GraphStore {
       ids_.push_back(k);
       return idx;
     };
-    std::vector<int32_t> s(coo_src_.size()), d(coo_dst_.size());
-    for (size_t i = 0; i < coo_src_.size(); ++i) {
+    const size_t m = symmetric ? 2 * n : n;
+    std::vector<int32_t> s(m), d(m);
+    for (size_t i = 0; i < n; ++i) {
       s[i] = intern(coo_src_[i]);
       d[i] = intern(coo_dst_[i]);
+    }
+    if (symmetric) {
+      for (size_t i = 0; i < n; ++i) {
+        s[n + i] = d[i];
+        d[n + i] = s[i];
+      }
     }
     const size_t nn = ids_.size();
     row_ptr_.assign(nn + 1, 0);
     for (int32_t u : s) row_ptr_[static_cast<size_t>(u) + 1]++;
     for (size_t i = 0; i < nn; ++i) row_ptr_[i + 1] += row_ptr_[i];
-    col_.resize(s.size());
+    col_.resize(m);
     std::vector<int64_t> cursor(row_ptr_.begin(), row_ptr_.end() - 1);
-    for (size_t i = 0; i < s.size(); ++i) {
+    for (size_t i = 0; i < m; ++i) {
       col_[static_cast<size_t>(cursor[s[i]]++)] = d[i];
     }
-    coo_src_.clear();
-    coo_src_.shrink_to_fit();
-    coo_dst_.clear();
-    coo_dst_.shrink_to_fit();
   }
 
   int64_t NumNodes() const { return static_cast<int64_t>(ids_.size()); }

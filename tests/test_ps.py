@@ -179,3 +179,30 @@ def test_ps_context_persistables(tmp_path):
     ctx2.create_table("emb_a", embed_dim=4, optimizer="sgd", seed=1)
     ctx2.load_persistables(str(tmp_path / "ps"))
     np.testing.assert_array_equal(ctx2.get_table("emb_a").pull([1, 2]), want)
+
+
+def test_staged_pull_train_dedup():
+    """StagedPull: pull-before/push-after staging (the PSGPUWorker
+    PullSparse/PushSparseGrad structure) — works on backends without
+    host-callback support; duplicate ids arrive merged."""
+    from paddle_tpu.distributed.ps import StagedPull
+
+    t = make_table("sgd", lr=1.0)
+    staged = StagedPull(t)
+    ids = np.asarray([7, 9, 7, 7])
+    rows, inv, uniq = staged.pull(ids)
+    assert rows.shape == (2, 4) and uniq.tolist() == [7, 9]
+    np.testing.assert_array_equal(np.asarray(inv), [0, 1, 0, 0])
+    w7 = np.asarray(rows[0])
+
+    @jax.jit
+    def step(rows, inv):
+        def loss_fn(rows):
+            return jnp.sum(StagedPull.lookup(rows, inv))
+        return jax.value_and_grad(loss_fn)(rows)
+
+    _, g = step(rows, inv)
+    # id 7 appears 3x -> merged grad 3.0 per element
+    np.testing.assert_allclose(np.asarray(g), [[3.0] * 4, [1.0] * 4])
+    staged.push(uniq, g)
+    np.testing.assert_allclose(t.pull([7])[0], w7 - 3.0, rtol=1e-6)
