@@ -27,9 +27,7 @@ from .framework.random import (  # noqa: F401
     default_generator, get_rng_state, next_key, seed, set_rng_state,
 )
 from .framework.io import load, save  # noqa: F401
-from .framework import jit as _jit_module  # noqa: F401
 from .framework.jit import EvalStep, TrainStep  # noqa: F401
-from .framework.jit import jit  # noqa: F401
 
 from . import nn  # noqa: F401
 from . import geometric  # noqa: F401
@@ -37,6 +35,10 @@ from . import optimizer  # noqa: F401
 from . import metric  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import InputSpec, Model, flops, summary  # noqa: F401
+# paddle.jit module parity (to_static/save/load); the bare compile decorator
+# stays available as paddle_tpu.jit.to_static and framework.jit.jit
+from . import jit  # noqa: F401
+from . import inference  # noqa: F401
 
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax

@@ -1,0 +1,138 @@
+"""paddle_tpu.inference — the serving-side predictor API.
+
+Reference parity: ``paddle/fluid/inference/`` ``AnalysisPredictor``
+(``api/analysis_predictor.h:95``) + ``paddle_infer::Config`` and the
+zero-copy input/output handles (``api/details/``). TPU-native: the saved
+program is StableHLO (see :mod:`paddle_tpu.jit`), so the "analysis pass
+pipeline" (IR fusion, memory optimize, subgraph engines) collapses into
+XLA compilation at load time; Config switches that exist to toggle
+hand-written fusions are accepted and ignored for API compatibility.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+
+class Config:
+    """``paddle_infer.Config`` analogue."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either the artifact prefix or the explicit .pdmodel path
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self.device = None  # None = default backend (tpu when present)
+        self._memory_optim = True
+
+    # ---- device selection -------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self.device = None  # accelerator path = default backend
+
+    def disable_gpu(self):
+        self.device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+    # ---- legacy switches accepted for compatibility ----------------------
+    def switch_ir_optim(self, flag: bool = True):
+        pass
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        raise NotImplementedError(
+            "TensorRT is a CUDA engine; on TPU the XLA path is always on")
+
+
+class Tensor:
+    """Zero-copy-style IO handle (reference ``paddle_infer::Tensor``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr) -> None:
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def reshape(self, shape: Sequence[int]) -> None:
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    @property
+    def shape(self):
+        return None if self._value is None else tuple(self._value.shape)
+
+
+class Predictor:
+    """Loads a ``jit.save``d program and runs it (AnalysisPredictor shape)."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        if not config.prog_file:
+            raise ValueError("Config.prog_file (artifact prefix) required")
+        self.config = config
+        self._layer = jit_load(config.prog_file)
+        n_in = (self._layer._exported.in_tree.num_leaves
+                - self._layer._n_params - len(self._layer._buffers))
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n) for n in self._input_names}
+        n_out = len(self._layer._exported.out_avals)
+        self._output_names = [f"out{i}" for i in range(n_out)]
+        self._outputs: Dict[str, Tensor] = {
+            n: Tensor(n) for n in self._output_names}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute. Either pass arrays directly (convenience) or use the
+        handle API (copy_from_cpu -> run() -> copy_to_cpu)."""
+        if inputs is None:
+            unset = [n for n in self._input_names
+                     if self._inputs[n]._value is None]
+            if unset:
+                raise RuntimeError(
+                    f"inputs not set: {unset} — call "
+                    f"get_input_handle(name).copy_from_cpu(arr) first")
+            inputs = [self._inputs[n].copy_to_cpu() for n in self._input_names]
+        # honor Config device selection (disable_gpu -> host CPU execution;
+        # the export is multi-platform so both lower)
+        if self.config.device is not None:
+            device = jax.local_devices(backend=self.config.device)[0]
+            with jax.default_device(device):
+                out = self._layer(*inputs)
+        else:
+            out = self._layer(*inputs)
+        flat = jax.tree_util.tree_leaves(out)
+        for name, leaf in zip(self._output_names, flat):
+            self._outputs[name].copy_from_cpu(np.asarray(leaf))
+        return [self._outputs[n].copy_to_cpu() for n in self._output_names]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,171 @@
+"""paddle_tpu.jit — program capture, serialization, and loading.
+
+Reference parity: ``python/paddle/jit/`` (``@to_static`` AST transpiler,
+``paddle.jit.save/load`` → TranslatedLayer) and the C++ loader
+(``paddle/fluid/jit/``: CompilationUnit/serializer). TPU-native: "static
+graph" = StableHLO captured by ``jax.export`` — no AST transpilation needed
+(jax traces Python directly), no ProgramDesc protobuf (StableHLO *is* the
+portable IR), and the saved artifact runs under any XLA runtime incl. C++
+(PjRt) without Python model code.
+
+Artifacts (mirroring the reference's ``.pdmodel``/``.pdiparams`` pair):
+  ``<path>.pdmodel``   — serialized StableHLO (jax.export bytes)
+  ``<path>.pdiparams`` — pickled param/buffer pytree
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..framework.dtype import convert_dtype
+from ..framework.jit import jit  # re-export: @to_static alias  # noqa: F401
+from ..hapi.model import InputSpec
+from ..nn.layer import Layer, buffer_state, functional_call, param_state
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "InputSpec",
+           "not_to_static"]
+
+to_static = jit
+
+
+def not_to_static(fn):
+    """Marker for API parity (reference skips transpiling the function; here
+    tracing is structural, so this is identity)."""
+    return fn
+
+
+def _spec_to_shape_dtype(spec, scope, idx):
+    """InputSpec -> jax ShapeDtypeStruct; dynamic dims become symbolic
+    (shape-polymorphic export, the LoD/dynamic-batch analogue).
+
+    Dim conventions: ``None``/-1 at axis 0 = the shared ``batch`` symbol
+    (all inputs' leading dynamic dims agree, the common case); ``None``
+    elsewhere = a unique per-position symbol; a string names the symbol
+    explicitly (inputs using the same string share it). All specs of one
+    save() share ``scope`` — mixing scopes is an export error."""
+    dims = []
+    for i, d in enumerate(spec.shape):
+        if isinstance(d, str):
+            dims.append(d)
+        elif d is None or (isinstance(d, int) and d < 0):
+            dims.append("batch" if i == 0 else f"d{idx}_{i}")
+        else:
+            dims.append(str(d))
+    if any(not s.isdigit() for s in dims):
+        shape = jax_export.symbolic_shape("(" + ", ".join(dims) + ")",
+                                          scope=scope)
+    else:
+        shape = tuple(int(s) for s in dims)
+    return jax.ShapeDtypeStruct(shape, convert_dtype(spec.dtype or "float32"))
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None,
+         **config) -> None:
+    """``paddle.jit.save`` analogue.
+
+    ``layer`` may be a :class:`Layer` (its eval-mode forward is captured) or
+    a jit-wrapped function from :func:`to_static` over a Layer. The export
+    is multi-platform (cpu + tpu) so a model saved on a TPU host serves
+    anywhere XLA runs.
+    """
+    if callable(layer) and hasattr(layer, "__wrapped_layer__"):
+        layer = layer.__wrapped_layer__
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer or to_static(Layer)")
+    if input_spec is None:
+        raise ValueError(
+            "input_spec is required: pass [InputSpec(shape, dtype), ...] "
+            "(dims of None export shape-polymorphically)")
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        params = param_state(layer)
+        buffers = buffer_state(layer)
+
+        def infer(params, buffers, *inputs):
+            out, _ = functional_call(layer, params, buffers, *inputs)
+            return out
+
+        scope = jax_export.SymbolicScope()
+        in_specs = []
+        for idx, spec in enumerate(input_spec):
+            if isinstance(spec, InputSpec):
+                in_specs.append(_spec_to_shape_dtype(spec, scope, idx))
+            else:  # concrete example array
+                arr = jnp.asarray(spec)
+                in_specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        state_specs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype),
+            (params, buffers))
+        platforms = config.get("platforms", ("cpu", "tpu"))
+        exported = jax_export.export(
+            jax.jit(infer), platforms=tuple(platforms))(
+                *state_specs, *in_specs)
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        host_state = jax.tree.map(np.asarray, (params, buffers))
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(host_state, f, protocol=4)
+    finally:
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer(Layer):
+    """A loaded serialized program, callable like the original Layer
+    (reference ``TranslatedLayer``, ``python/paddle/jit/translated_layer.py``).
+
+    Parameters are restored as this layer's state and passed to the compiled
+    StableHLO program at call time, so they remain inspectable/replaceable
+    (``state_dict``/``set_state_dict`` work).
+    """
+
+    def __init__(self, exported: "jax_export.Exported", params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._params_tree = params
+        self._buffers_tree = buffers
+        # flatten into registered state for state_dict parity
+        def flat_name(prefix, kp):
+            raw = "_".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                           for k in kp)
+            # state-dict names must be dot-free (dots split layer paths)
+            return prefix + raw.replace(".", "__")
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        for kp, leaf in flat:
+            self._parameters[flat_name("p_", kp)] = jnp.asarray(leaf)
+        self._n_params = len(flat)
+        flatb, _ = jax.tree_util.tree_flatten_with_path(buffers)
+        for kp, leaf in flatb:
+            self._buffers[flat_name("b_", kp)] = jnp.asarray(leaf)
+
+    def forward(self, *inputs):
+        # rebuild trees from (possibly updated) registered state
+        leaves = list(self._parameters.values())
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._params_tree), leaves)
+        bleaves = list(self._buffers.values())
+        buffers = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._buffers_tree), bleaves)
+        inputs = tuple(jnp.asarray(x) for x in inputs)
+        return self._exported.call(params, buffers, *inputs)
+
+
+def load(path: str) -> TranslatedLayer:
+    """``paddle.jit.load`` analogue: deserialize StableHLO + params."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        params, buffers = pickle.load(f)
+    return TranslatedLayer(exported, params, buffers)
