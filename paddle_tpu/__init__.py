@@ -27,6 +27,8 @@ from .framework.random import (  # noqa: F401
     default_generator, get_rng_state, next_key, seed, set_rng_state,
 )
 from .framework.io import load, save  # noqa: F401
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework.debugging import check_numerics  # noqa: F401
 from .framework.jit import EvalStep, TrainStep  # noqa: F401
 
 from . import nn  # noqa: F401
@@ -39,6 +41,7 @@ from .hapi import InputSpec, Model, flops, summary  # noqa: F401
 # stays available as paddle_tpu.jit.to_static and framework.jit.jit
 from . import jit  # noqa: F401
 from . import inference  # noqa: F401
+from . import profiler  # noqa: F401
 
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax

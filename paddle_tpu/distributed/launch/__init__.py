@@ -1,0 +1,21 @@
+"""paddle_tpu.distributed.launch — the multi-process job launcher.
+
+Reference parity: ``python/paddle/distributed/launch/`` — ``main.py:18``
+CLI, ``CollectiveController`` (``controllers/collective.py``), ``Master``
+rendezvous with its builtin HTTP ``KVServer``
+(``controllers/master.py:27``, ``utils/kv_server.py``), ``Job/Pod/
+Container`` supervision (``job/``), ``Watcher`` (``controllers/
+watcher.py``), and the etcd-backed ``ElasticManager``
+(``fleet/elastic/manager.py:127``).
+
+TPU-native shape: one worker process per *host* (JAX SPMD drives every
+local chip from one process — no proc-per-GPU fan-out), coordination via
+jax's distributed service whose address the launcher distributes through
+its KV store; elastic restart re-executes workers with regenerated rank
+env on failure.
+"""
+from .job import Container, Pod
+from .kv_server import KVClient, KVServer
+from .main import launch, main
+
+__all__ = ["main", "launch", "KVServer", "KVClient", "Pod", "Container"]

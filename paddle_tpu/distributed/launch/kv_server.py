@@ -1,0 +1,143 @@
+"""Builtin HTTP KV store for rendezvous.
+
+Reference parity: ``python/paddle/distributed/launch/utils/kv_server.py``
+(``KVServer`` used by ``Master.sync_peers``) and the C++ ``TCPStore``
+(``paddle/fluid/distributed/store/tcp_store.h``) — wait/barrier semantics
+over a tiny KV namespace. Same role here: exchange the JAX coordinator
+address and worker endpoints before ``jax.distributed.initialize``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_kv/1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _store(self) -> Dict[str, bytes]:
+        return self.server.kv  # type: ignore[attr-defined]
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.lock:  # type: ignore[attr-defined]
+            self._store()[self.path] = value
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        with self.server.lock:  # type: ignore[attr-defined]
+            if self.path == "/":
+                body = json.dumps(
+                    {k: v.decode("utf-8", "replace")
+                     for k, v in self._store().items()}).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            value = self._store().get(self.path)
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(value)
+
+    def do_DELETE(self):
+        with self.server.lock:  # type: ignore[attr-defined]
+            self._store().pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    """Threaded HTTP KV server; ``with KVServer(port) as s: ...``."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.kv = {}          # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class KVClient:
+    """Client with the TCPStore-style wait/barrier helpers."""
+
+    def __init__(self, endpoint: str):
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+
+    def put(self, key: str, value: str) -> None:
+        req = urllib.request.Request(
+            f"{self.endpoint}/{key.lstrip('/')}",
+            data=value.encode(), method="PUT")
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(
+                    f"{self.endpoint}/{key.lstrip('/')}", timeout=10) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete(self, key: str) -> None:
+        req = urllib.request.Request(
+            f"{self.endpoint}/{key.lstrip('/')}", method="DELETE")
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def wait(self, key: str, timeout: float = 300.0,
+             interval: float = 0.2) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"kv wait timed out on {key!r}")
+
+    def barrier(self, name: str, rank: int, world: int,
+                timeout: float = 300.0, gen: int = 0) -> None:
+        """All ranks put their mark, then wait for everyone. ``gen`` must
+        differ across reuses of the same name (e.g. elastic restart
+        attempts) so stale marks from a previous generation can't satisfy
+        the new barrier."""
+        self.put(f"barrier/{name}/{gen}/{rank}", "1")
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ok = all(self.get(f"barrier/{name}/{gen}/{r}") is not None
+                     for r in range(world))
+            if ok:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"barrier {name!r} (gen {gen}) timed out")

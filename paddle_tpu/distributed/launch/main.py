@@ -1,0 +1,164 @@
+"""Launcher CLI: ``python -m paddle_tpu.distributed.launch train.py``.
+
+Reference parity: ``python/paddle/distributed/launch/main.py:18`` +
+``CollectiveController`` (``controllers/collective.py``) + elastic restart
+(``fleet/elastic/manager.py:127``). TPU-native defaults: one worker per
+host (JAX SPMD owns all local chips); ``--nproc_per_node`` exists for
+CPU-simulated multi-process runs and debugging (each worker then gets a
+slice of CPU devices via ``--devices-per-proc``).
+
+Env contract handed to workers (superset of the reference's):
+  PADDLE_TRAINER_ID / RANK, PADDLE_TRAINERS_NUM / WORLD_SIZE,
+  PADDLE_MASTER (jax coordinator addr), PADDLE_KV_ENDPOINT.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import time
+from typing import List, Optional
+
+from .job import Container, Pod
+from .kv_server import KVClient, KVServer
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu multi-process launcher")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count (min:max range accepted; the job runs "
+                        "at min — elastic world resizing not yet supported)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_LAUNCH_MASTER"),
+                   help="kv server endpoint host:port (node 0 hosts it)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="workers per host (1 for real TPU; N for cpu sim)")
+    p.add_argument("--devices_per_proc", type=int, default=0,
+                   help="simulated CPU device count per worker (0 = off)")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: relaunch failed pods up to N times")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("script", type=str, help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _worker_env(args, local_rank: int, world: int, rank: int,
+                coordinator: str, kv_endpoint: Optional[str]) -> dict:
+    # workers must resolve the same paddle_tpu the launcher runs from
+    # (python <script> does not add the launcher cwd to sys.path)
+    import paddle_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+    py_path = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in py_path.split(os.pathsep):
+        py_path = pkg_root + (os.pathsep + py_path if py_path else "")
+    env = {
+        "PYTHONPATH": py_path,
+        "PADDLE_TRAINER_ID": str(rank),
+        "RANK": str(rank),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "WORLD_SIZE": str(world),
+        "PADDLE_MASTER": coordinator,
+        "PADDLE_JOB_ID": args.job_id,
+    }
+    if kv_endpoint:
+        env["PADDLE_KV_ENDPOINT"] = kv_endpoint
+    if args.devices_per_proc:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{args.devices_per_proc}")
+        # some PJRT plugins (axon TPU tunnel) pin jax_platforms via config
+        # at sitecustomize time, overriding JAX_PLATFORMS — disable their
+        # registration for cpu-sim workers
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
+    return env
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    min_nodes = int(args.nnodes.split(":")[0])
+    nproc = args.nproc_per_node
+    world = min_nodes * nproc
+    if args.node_rank >= min_nodes:
+        raise ValueError(
+            f"--node_rank {args.node_rank} out of range for --nnodes "
+            f"{min_nodes}")
+    if args.master and ":" not in args.master:
+        raise ValueError(f"--master must be host:port, got {args.master!r}")
+
+    kv_server = None
+    kv_endpoint = None
+    if min_nodes > 1:
+        # node 0 hosts the KV store; everyone rendezvous through it
+        if args.node_rank == 0:
+            port = (int(args.master.rsplit(":", 1)[1])
+                    if args.master else _free_port())
+            kv_server = KVServer(port).start()
+            host = socket.gethostbyname(socket.gethostname())
+            kv_endpoint = args.master or f"{host}:{port}"
+            kv = KVClient(kv_endpoint)
+            kv.put(f"{args.job_id}/coordinator", f"{host}:{_free_port()}")
+        else:
+            if not args.master:
+                raise ValueError("--master required for node_rank > 0")
+            kv_endpoint = args.master
+        coordinator = KVClient(kv_endpoint).wait(f"{args.job_id}/coordinator")
+    else:
+        coordinator = f"127.0.0.1:{_free_port()}"
+
+    attempt = 0
+    try:
+        while True:
+            pod = Pod()
+            for local_rank in range(nproc):
+                rank = args.node_rank * nproc + local_rank
+                env = _worker_env(args, local_rank, world, rank, coordinator,
+                                  kv_endpoint)
+                log = (os.path.join(args.log_dir, f"worker.{rank}.log")
+                       if args.log_dir else None)
+                pod.add(Container(
+                    [sys.executable, "-u", args.script, *args.script_args],
+                    env, log))
+            pod.deploy()
+            try:
+                status = pod.join(watcher_interval=30.0)
+            finally:
+                pod.terminate()  # idempotent; closes log fds
+            if status == 0:
+                print(f"[launch] job {args.job_id} finished", flush=True)
+                return 0
+            attempt += 1
+            if attempt > args.max_restarts:
+                print(f"[launch] job {args.job_id} FAILED (exit {status}) "
+                      f"after {attempt - 1} restarts", flush=True)
+                return status
+            # elastic restart: regenerate coordinator (old one is dead) and
+            # go again — the ElasticManager relaunch path, minus etcd
+            print(f"[launch] worker failed (exit {status}); restart "
+                  f"{attempt}/{args.max_restarts}", flush=True)
+            if min_nodes == 1:
+                coordinator = f"127.0.0.1:{_free_port()}"
+            time.sleep(1.0)
+    finally:
+        if kv_server:
+            kv_server.stop()
+
+
+def main() -> None:
+    sys.exit(launch())

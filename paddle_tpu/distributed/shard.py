@@ -164,6 +164,17 @@ class DistributedTrainStep:
         self._rng_streams = DEFAULT_RNG_STREAMS
         donate_argnums = (0, 1, 2) if donate else ()
         self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
+        self._donate_argnums = donate_argnums
+        self._compiled_checked = None
+
+    def _checked_compiled(self):
+        import functools
+
+        if self._compiled_checked is None:
+            self._compiled_checked = jax.jit(
+                functools.partial(self._step, with_check=True),
+                donate_argnums=self._donate_argnums)
+        return self._compiled_checked
 
     def _shard_opt_state(self, opt_state):
         out = {}
@@ -178,8 +189,8 @@ class DistributedTrainStep:
                 out[slot] = val
         return out
 
-    def _step(self, params, buffers, opt_state, batch, key):
-        from ..framework.jit import split_rng_streams
+    def _step(self, params, buffers, opt_state, batch, key, with_check=False):
+        from ..framework.jit import finite_guard, split_rng_streams
 
         rngs = split_rng_streams(key, self._rng_streams)
 
@@ -200,15 +211,29 @@ class DistributedTrainStep:
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
         new_params = {k: jax.lax.with_sharding_constraint(
             v, NamedSharding(self.mesh, self.specs[k])) for k, v in new_params.items()}
+        if with_check:
+            ok, (new_params, new_buffers, new_opt_state) = finite_guard(
+                grads, (new_params, new_buffers, new_opt_state),
+                (params, buffers, opt_state))
+            return loss, new_params, new_buffers, new_opt_state, ok
         return loss, new_params, new_buffers, new_opt_state
 
     def __call__(self, batch):
+        from ..framework import flags
+        from ..framework.jit import raise_if_bad_step
+
         batch = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
             if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
         key = jax.random.fold_in(self._base_key, self._count)
         self._count += 1
         with self.mesh:
+            if flags.flag("FLAGS_check_nan_inf"):
+                loss, self.params, self.buffers, self.opt_state, ok = \
+                    self._checked_compiled()(self.params, self.buffers,
+                                             self.opt_state, batch, key)
+                raise_if_bad_step(ok, loss)
+                return loss
             loss, self.params, self.buffers, self.opt_state = self._compiled(
                 self.params, self.buffers, self.opt_state, batch, key)
         return loss

@@ -72,6 +72,39 @@ def jit(fn=None, *, static_argnums=(), static_argnames=(), donate_argnums=()):
                    donate_argnums=donate_argnums)
 
 
+def finite_guard(grads, new_state, old_state):
+    """In-graph NaN/Inf gate for FLAGS_check_nan_inf: returns
+    ``(ok, selected_state)`` where each leaf of ``new_state`` is kept only
+    if every grad and every updated param is finite — otherwise the old
+    leaf survives. Keeping the selection in-graph means a bad batch can be
+    caught *without* corrupting donated buffers (the reference's per-op
+    scan aborts before the update; here the update is predicated instead).
+
+    ``new_state``/``old_state`` are matching tuples of pytrees; the first
+    tree is the params (checked), the rest (buffers/opt state) are selected
+    alongside.
+    """
+    from .debugging import tree_all_finite
+
+    ok = tree_all_finite(grads) & tree_all_finite(new_state[0])
+
+    def sel(n, o):
+        return jnp.where(ok, n, o)
+
+    selected = tuple(jax.tree.map(sel, n, o)
+                     for n, o in zip(new_state, old_state))
+    return ok, selected
+
+
+def raise_if_bad_step(ok, loss) -> None:
+    """Host-side companion to :func:`finite_guard`."""
+    if not bool(ok):
+        raise FloatingPointError(
+            f"NaN/Inf detected in gradients or updated parameters "
+            f"(FLAGS_check_nan_inf); update skipped, state preserved. "
+            f"loss={float(loss)}")
+
+
 class TrainStep:
     """One-call training: ``loss = step(batch)``.
 
@@ -99,8 +132,12 @@ class TrainStep:
         self._count = 0
         donate_argnums = (0, 1, 2) if donate else ()
         self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
+        # FLAGS_check_nan_inf variant: also reduces grads/params finiteness
+        # in-graph (framework/debugging.py) — compiled on first use
+        self._compiled_checked = None
+        self._donate_argnums = donate_argnums
 
-    def _step(self, params, buffers, opt_state, batch, key):
+    def _step(self, params, buffers, opt_state, batch, key, with_check=False):
         rngs = split_rng_streams(key, self._rng_streams)
 
         def compute_loss(p):
@@ -115,11 +152,31 @@ class TrainStep:
         if self.grad_transform is not None:
             grads = self.grad_transform(grads)
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        if with_check:
+            ok, (new_params, new_buffers, new_opt_state) = finite_guard(
+                grads, (new_params, new_buffers, new_opt_state),
+                (params, buffers, opt_state))
+            return loss, new_params, new_buffers, new_opt_state, ok
         return loss, new_params, new_buffers, new_opt_state
 
+    def _checked_compiled(self):
+        if self._compiled_checked is None:
+            self._compiled_checked = jax.jit(
+                functools.partial(self._step, with_check=True),
+                donate_argnums=self._donate_argnums)
+        return self._compiled_checked
+
     def __call__(self, batch):
+        from . import flags
+
         key = jax.random.fold_in(self._base_key, self._count)
         self._count += 1
+        if flags.flag("FLAGS_check_nan_inf"):
+            loss, self.params, self.buffers, self.opt_state, ok = \
+                self._checked_compiled()(self.params, self.buffers,
+                                         self.opt_state, batch, key)
+            raise_if_bad_step(ok, loss)
+            return loss
         loss, self.params, self.buffers, self.opt_state = self._compiled(
             self.params, self.buffers, self.opt_state, batch, key)
         return loss

@@ -45,8 +45,8 @@ class _HapiTrainStep(TrainStep):
     """TrainStep variant that also returns the model outputs (for train-time
     metric updates, as the reference's ``DynamicGraphAdapter.train_batch``)."""
 
-    def _step(self, params, buffers, opt_state, batch, key):
-        from ..framework.jit import split_rng_streams
+    def _step(self, params, buffers, opt_state, batch, key, with_check=False):
+        from ..framework.jit import finite_guard, split_rng_streams
 
         rngs = split_rng_streams(key, self._rng_streams)
 
@@ -63,11 +63,25 @@ class _HapiTrainStep(TrainStep):
         if self.grad_transform is not None:
             grads = self.grad_transform(grads)
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        if with_check:
+            ok, (new_params, new_buffers, new_opt_state) = finite_guard(
+                grads, (new_params, new_buffers, new_opt_state),
+                (params, buffers, opt_state))
+            return loss, out, new_params, new_buffers, new_opt_state, ok
         return loss, out, new_params, new_buffers, new_opt_state
 
     def __call__(self, batch):
+        from ..framework import flags
+        from ..framework.jit import raise_if_bad_step
+
         key = jax.random.fold_in(self._base_key, self._count)
         self._count += 1
+        if flags.flag("FLAGS_check_nan_inf"):
+            loss, out, self.params, self.buffers, self.opt_state, ok = \
+                self._checked_compiled()(self.params, self.buffers,
+                                         self.opt_state, batch, key)
+            raise_if_bad_step(ok, loss)
+            return loss, out
         loss, out, self.params, self.buffers, self.opt_state = self._compiled(
             self.params, self.buffers, self.opt_state, batch, key)
         return loss, out

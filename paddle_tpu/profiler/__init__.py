@@ -1,0 +1,315 @@
+"""paddle_tpu.profiler — tracing, host event spans, throughput timing.
+
+Reference parity: ``python/paddle/profiler/`` (``Profiler`` with scheduler
+states ``profiler.py:339``, ``RecordEvent``, ``profiler_statistic.py``
+summaries, ``timer.py`` throughput benchmarker) over the C++ tracers
+(``paddle/fluid/platform/profiler/``: HostTracer RAII spans, CUPTI
+CudaTracer, chrome-trace export). TPU-native: device tracing is delegated
+to ``jax.profiler`` (XPlane/ Perfetto, viewable in TensorBoard/xprof) —
+the CUPTI layer's job; host spans are recorded by a lightweight in-proc
+recorder (HostTracer's job) and feed the summary table.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from ..framework import flags as _flags
+
+__all__ = [
+    "ProfilerState", "ProfilerTarget", "Profiler", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "host_event_summary",
+    "benchmark", "Timer",
+]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1      # accepted for compat; accelerator = TPU here
+    TPU = 2
+
+
+# ------------------------------------------------------------- host events
+class _HostEventRecorder:
+    """Lock-free-ish per-process span store (HostEventRecorder analogue,
+    ``host_event_recorder.h``)."""
+
+    def __init__(self):
+        self.spans = []  # (name, t0, t1)
+        self.enabled = False
+
+    def clear(self):
+        self.spans = []
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """Context manager / decorator marking a named span.
+
+    Shows up in (a) the host-event summary table and (b) the device trace
+    timeline via ``jax.profiler.TraceAnnotation`` (the reference
+    auto-instruments ops in ``OperatorBase::Run``; under XLA the compiler
+    owns op boundaries, so annotations mark user-level phases instead).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+        self._jax_ctx.__enter__()
+
+    def end(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+        if self._t0 is not None and _recorder.enabled:
+            _recorder.spans.append((self.name, self._t0, time.perf_counter()))
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with RecordEvent(self.name):
+                return fn(*a, **kw)
+
+        return wrapped
+
+
+def host_event_summary(sort_by: str = "total"):
+    """Aggregate host spans: {name: (calls, total_s, avg_s, max_s)} —
+    the op-summary table of ``profiler_statistic.py`` for host phases."""
+    agg = defaultdict(list)
+    for name, t0, t1 in _recorder.spans:
+        agg[name].append(t1 - t0)
+    rows = {
+        name: (len(ts), sum(ts), sum(ts) / len(ts), max(ts))
+        for name, ts in agg.items()
+    }
+    key = {"total": 1, "calls": 0, "avg": 2, "max": 3}[sort_by]
+    return dict(sorted(rows.items(), key=lambda kv: -kv[1][key]))
+
+
+# ------------------------------------------------------------- scheduler
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-state machine identical to the reference
+    (``profiler.py:make_scheduler``): skip_first, then cycles of
+    closed -> ready -> record (last record step returns
+    RECORD_AND_RETURN)."""
+    if record < 1:
+        raise ValueError("make_scheduler requires record >= 1")
+    if closed < 0 or ready < 0 or repeat < 0 or skip_first < 0:
+        raise ValueError("make_scheduler arguments must be non-negative")
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory (API parity): traces land in ``dir_name``
+    (jax writes XPlane/trace.json.gz under <dir>/plugins/profile/...)."""
+
+    def handler(prof: "Profiler"):
+        prof.last_trace_dir = dir_name
+
+    handler.dir_name = dir_name
+    return handler
+
+
+class Profiler:
+    """Scheduled profiler driving ``jax.profiler`` trace capture.
+
+    Usage (same shape as the reference)::
+
+        p = Profiler(scheduler=make_scheduler(closed=1, ready=1, record=3),
+                     on_trace_ready=export_chrome_tracing('./prof'))
+        p.start()
+        for batch in loader:
+            train_step(batch)
+            p.step()
+        p.stop()
+        p.summary()
+    """
+
+    def __init__(self, *, targets: Iterable[ProfilerTarget] = (),
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 trace_dir: Optional[str] = None):
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir or getattr(on_trace_ready, "dir_name",
+                                              None) or "./profiler_log"
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self.last_trace_dir = None
+        self._tracing = False
+        self._timer = Timer()
+
+    # -- trace control
+    def _ensure_tracing(self, want: bool):
+        if self.timer_only:
+            return
+        if want and not self._tracing:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            _recorder.enabled = _flags.flag("FLAGS_profile_host_events")
+            self._tracing = True
+        elif not want and self._tracing:
+            jax.profiler.stop_trace()
+            _recorder.enabled = False
+            self._tracing = False
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+
+    def start(self):
+        self.current_state = self.scheduler(self.step_num)
+        self._ensure_tracing(self.current_state in
+                             (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN))
+        self._timer.begin()
+        return self
+
+    def step(self, num_samples: Optional[int] = None):
+        self._timer.step(num_samples)
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        want = self.current_state in (ProfilerState.RECORD,
+                                      ProfilerState.RECORD_AND_RETURN)
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            # cycle boundary: flush this capture, then (possibly) start the
+            # next cycle's capture immediately
+            self._ensure_tracing(False)
+        self._ensure_tracing(want)
+
+    def stop(self):
+        self._ensure_tracing(False)
+        self._timer.end()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting
+    def summary(self, sort_by: str = "total") -> str:
+        rows = host_event_summary(sort_by)
+        lines = [f"{'event':<40}{'calls':>8}{'total(s)':>12}{'avg(ms)':>12}"
+                 f"{'max(ms)':>12}"]
+        for name, (calls, total, avg, mx) in rows.items():
+            lines.append(f"{name:<40}{calls:>8}{total:>12.4f}"
+                         f"{avg * 1e3:>12.3f}{mx * 1e3:>12.3f}")
+        lines.append("")
+        lines.append(self._timer.report())
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+    def benchmark(self) -> "Timer":
+        return self._timer
+
+
+# ------------------------------------------------------------- throughput
+class Timer:
+    """Steps/s + samples/s (ips) benchmarker —
+    ``python/paddle/profiler/timer.py`` ``benchmark()`` analogue."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t_begin = None
+        self._t_end = None
+        self._steps = 0
+        self._samples = 0
+        self._step_times = []
+        self._last = None
+
+    def begin(self):
+        self._t_begin = time.perf_counter()
+        self._last = self._t_begin
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+
+    def end(self):
+        self._t_end = time.perf_counter()
+
+    @property
+    def elapsed(self) -> float:
+        end = self._t_end or time.perf_counter()
+        return (end - self._t_begin) if self._t_begin else 0.0
+
+    def steps_per_second(self) -> float:
+        if not self._step_times:
+            return 0.0
+        return len(self._step_times) / sum(self._step_times)
+
+    def ips(self) -> float:
+        """Samples/sec over the timed window (0 if samples not reported)."""
+        return self._samples / self.elapsed if self.elapsed and self._samples else 0.0
+
+    def report(self) -> str:
+        return (f"steps: {self._steps}  elapsed: {self.elapsed:.3f}s  "
+                f"steps/s: {self.steps_per_second():.2f}  "
+                f"ips: {self.ips():.2f}")
+
+
+_global_timer = Timer()
+
+
+def benchmark() -> Timer:
+    """Module-level benchmarker (reference ``paddle.profiler.utils`` style)."""
+    return _global_timer

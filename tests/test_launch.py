@@ -1,0 +1,98 @@
+"""Launcher tests: KV store wait/barrier, Pod supervision, CLI spawn with
+worker env, elastic restart — the reference's launch-CLI shell tests
+(``test_fleet_launch_*.sh``, SURVEY.md §4) in-process.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import KVClient, KVServer, launch
+from paddle_tpu.distributed.launch.job import Container, Pod
+
+
+def test_kv_put_get_wait_barrier():
+    with KVServer(0, host="127.0.0.1") as server:
+        kv = KVClient(f"127.0.0.1:{server.port}")
+        assert kv.get("missing") is None
+        kv.put("a/b", "hello")
+        assert kv.get("a/b") == "hello"
+        assert kv.wait("a/b", timeout=1) == "hello"
+        with pytest.raises(TimeoutError):
+            kv.wait("never", timeout=0.5)
+        kv.barrier("sync", rank=0, world=1, timeout=2)
+
+
+def test_pod_success_and_failure(tmp_path):
+    pod = Pod()
+    pod.add(Container([sys.executable, "-c", "print('w0')"], {},
+                      str(tmp_path / "w0.log")))
+    pod.add(Container([sys.executable, "-c", "print('w1')"], {},
+                      str(tmp_path / "w1.log")))
+    pod.deploy()
+    assert pod.join() == 0
+    assert "w0" in (tmp_path / "w0.log").read_text()
+
+    bad = Pod()
+    bad.add(Container([sys.executable, "-c", "import sys; sys.exit(3)"], {}))
+    bad.add(Container([sys.executable, "-c", "import time; time.sleep(60)"], {}))
+    bad.deploy()
+    assert bad.join() == 3  # failure propagates, peer terminated
+    assert all(not c.alive for c in bad.containers)
+
+
+def test_launch_sets_worker_env(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        print("RANK", os.environ["PADDLE_TRAINER_ID"],
+              "WORLD", os.environ["PADDLE_TRAINERS_NUM"],
+              "LOCAL", os.environ["PADDLE_LOCAL_RANK"], flush=True)
+    """))
+    log_dir = str(tmp_path / "logs")
+    rc = launch(["--nproc_per_node", "2", "--log_dir", log_dir, str(script)])
+    assert rc == 0
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["worker.0.log", "worker.1.log"]
+    t0 = open(os.path.join(log_dir, "worker.0.log")).read()
+    t1 = open(os.path.join(log_dir, "worker.1.log")).read()
+    assert "RANK 0 WORLD 2 LOCAL 0" in t0
+    assert "RANK 1 WORLD 2 LOCAL 1" in t1
+
+
+def test_launch_elastic_restart(tmp_path):
+    """Worker fails on first attempt, succeeds after restart (state via a
+    sentinel file) — the ElasticManager relaunch path."""
+    sentinel = tmp_path / "tried"
+    script = tmp_path / "flaky.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        s = {str(repr(str(sentinel)))}
+        if not os.path.exists(s):
+            open(s, "w").close()
+            sys.exit(7)
+        print("recovered", flush=True)
+    """))
+    log_dir = str(tmp_path / "logs")
+    rc = launch(["--max_restarts", "2", "--log_dir", log_dir, str(script)])
+    assert rc == 0
+    assert "recovered" in open(os.path.join(log_dir, "worker.0.log")).read()
+
+
+def test_launch_failure_exit_code(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(9)")
+    rc = launch([str(script)])
+    assert rc == 9
+
+
+def test_cli_module_entry(tmp_path):
+    script = tmp_path / "ok.py"
+    script.write_text("print('cli ok')")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch", str(script)],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]

@@ -1,0 +1,203 @@
+"""Profiler, flags, and NaN/Inf debugging tests (SURVEY.md §5 aux
+subsystems)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler
+from paddle_tpu.framework import debugging, flags
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent, Timer,
+                                 make_scheduler)
+
+
+# ------------------------------------------------------------------ flags
+def test_flags_roundtrip_and_unknown():
+    assert flags.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+    pt.set_flags({"FLAGS_check_nan_inf": 1})
+    assert flags.flag("FLAGS_check_nan_inf") is True
+    pt.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_nope": 1})
+    with pytest.raises(ValueError):
+        pt.get_flags("FLAGS_nope")
+    assert "FLAGS_v" in pt.get_flags()
+
+
+# ------------------------------------------------------------- debugging
+def test_tree_all_finite_in_jit():
+    good = {"a": jnp.ones(3), "b": {"c": jnp.zeros(2)}}
+    bad = {"a": jnp.asarray([1.0, np.nan]), "b": {"c": jnp.zeros(2)}}
+    f = jax.jit(debugging.tree_all_finite)
+    assert bool(f(good)) and not bool(f(bad))
+    # int leaves are ignored
+    assert bool(debugging.tree_all_finite({"i": jnp.arange(3)}))
+
+
+def test_check_numerics_names_offender():
+    bad = {"w": jnp.asarray([np.inf, 1.0]), "ok": jnp.ones(2)}
+    with pytest.raises(FloatingPointError, match="w.*inf=1"):
+        debugging.check_numerics(bad, "params")
+
+
+def test_train_step_nan_check_flag():
+    model = nn.Linear(4, 2)
+    from paddle_tpu.optimizer import SGD
+
+    step = pt.TrainStep(model, SGD(learning_rate=1e30),
+                        loss_fn=lambda out, b: (out ** 2).mean())
+    x = jnp.ones((2, 4), jnp.float32)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError):
+            for _ in range(40):  # lr=1e30 overflows within a few steps
+                step((x,))
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_train_step_nan_check_passes_when_clean():
+    model = nn.Linear(4, 2)
+    from paddle_tpu.optimizer import SGD
+
+    step = pt.TrainStep(model, SGD(learning_rate=0.1),
+                        loss_fn=lambda out, b: (out ** 2).mean())
+    x = jnp.ones((2, 4), jnp.float32)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        l0 = float(step((x,)))
+        l1 = float(step((x,)))
+        assert np.isfinite(l0) and l1 < l0
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# -------------------------------------------------------------- scheduler
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states == [
+        ProfilerState.CLOSED,          # skip_first
+        ProfilerState.CLOSED,
+        ProfilerState.READY,
+        ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED,          # repeat exhausted
+    ]
+
+
+# ------------------------------------------------------------- host events
+def test_record_event_summary():
+    profiler._recorder.clear()
+    profiler._recorder.enabled = True
+    try:
+        with RecordEvent("phase_a"):
+            pass
+        with RecordEvent("phase_a"):
+            pass
+        with RecordEvent("phase_b"):
+            pass
+    finally:
+        profiler._recorder.enabled = False
+    rows = profiler.host_event_summary()
+    assert rows["phase_a"][0] == 2 and rows["phase_b"][0] == 1
+
+
+def test_record_event_decorator():
+    profiler._recorder.clear()
+    profiler._recorder.enabled = True
+
+    @RecordEvent("fn_span")
+    def fn(x):
+        return x + 1
+
+    try:
+        assert fn(1) == 2
+    finally:
+        profiler._recorder.enabled = False
+    assert profiler.host_event_summary()["fn_span"][0] == 1
+
+
+# ---------------------------------------------------------------- profiler
+def test_profiler_trace_capture(tmp_path):
+    tdir = str(tmp_path / "prof")
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=1, record=2,
+                                          repeat=1),
+                 on_trace_ready=profiler.export_chrome_tracing(tdir),
+                 trace_dir=tdir)
+    p.start()
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((64, 64))
+    for _ in range(4):
+        f(x).block_until_ready()
+        p.step(num_samples=64)
+    p.stop()
+    text = p.summary()
+    assert "steps/s" in text
+    import os
+
+    assert os.path.isdir(tdir) and any(os.scandir(tdir)), "no trace written"
+    assert p.benchmark().ips() > 0
+
+
+def test_timer_reports():
+    t = Timer()
+    t.begin()
+    for _ in range(3):
+        t.step(num_samples=10)
+    t.end()
+    assert t.steps_per_second() > 0
+    assert t.ips() > 0
+    assert "steps: 3" in t.report()
+
+
+def test_nan_check_preserves_state():
+    """On a bad step the update must be skipped in-graph: params stay at
+    their pre-step values even with donated buffers."""
+    from paddle_tpu.optimizer import SGD
+
+    model = nn.Linear(4, 2)
+    step = pt.TrainStep(model, SGD(learning_rate=0.1),
+                        loss_fn=lambda out, b: (out * b[1]).mean())
+    x = jnp.ones((2, 4), jnp.float32)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        step((x, jnp.ones((2, 2))))  # good step
+        good_params = jax.tree.map(np.asarray, step.params)
+        with pytest.raises(FloatingPointError, match="state preserved"):
+            step((x, jnp.full((2, 2), np.nan)))  # poisoned batch
+        for k in good_params:
+            np.testing.assert_array_equal(np.asarray(step.params[k]),
+                                          good_params[k])
+        # recovery: a clean batch continues training from intact state
+        loss = step((x, jnp.ones((2, 2))))
+        assert np.isfinite(float(loss))
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_nan_check_distributed_step():
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.distributed.shard import DistributedTrainStep
+    from paddle_tpu.optimizer import SGD
+
+    mesh = init_mesh(dp=8)
+    model = nn.Linear(4, 2)
+    step = DistributedTrainStep(
+        model, SGD(learning_rate=0.1),
+        loss_fn=lambda out, b: (out * b[1]).mean(), mesh=mesh,
+        batch_axes=("dp",))
+    x = jnp.ones((8, 4), jnp.float32)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        step((x, jnp.ones((8, 2))))
+        with pytest.raises(FloatingPointError):
+            step((x, jnp.full((8, 2), np.nan)))
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in step.params.values())
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
