@@ -33,6 +33,11 @@ from .framework.jit import EvalStep, TrainStep  # noqa: F401
 
 from . import nn  # noqa: F401
 from . import geometric  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import vision  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import metric  # noqa: F401
 from . import callbacks  # noqa: F401

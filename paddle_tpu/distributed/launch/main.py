@@ -112,17 +112,29 @@ def launch(argv: Optional[List[str]] = None) -> int:
             kv_server = KVServer(port).start()
             host = socket.gethostbyname(socket.gethostname())
             kv_endpoint = args.master or f"{host}:{port}"
-            kv = KVClient(kv_endpoint)
-            kv.put(f"{args.job_id}/coordinator", f"{host}:{_free_port()}")
         else:
             if not args.master:
                 raise ValueError("--master required for node_rank > 0")
             kv_endpoint = args.master
-        coordinator = KVClient(kv_endpoint).wait(f"{args.job_id}/coordinator")
-    else:
-        coordinator = f"127.0.0.1:{_free_port()}"
+
+    def rendezvous(attempt: int) -> str:
+        """Per-attempt coordinator exchange. Keys are generation-scoped so a
+        relaunched pod never picks up a dead incarnation's address; peer
+        nodes converge on the new attempt once their own pod fails and
+        re-enters here (failure detection is per-node: a peer notices via
+        its collectives erroring, then its launcher restarts into the same
+        attempt key)."""
+        if min_nodes == 1:
+            return f"127.0.0.1:{_free_port()}"
+        key = f"{args.job_id}/coordinator/a{attempt}"
+        kv = KVClient(kv_endpoint)
+        if args.node_rank == 0:
+            host = socket.gethostbyname(socket.gethostname())
+            kv.put(key, f"{host}:{_free_port()}")
+        return kv.wait(key)
 
     attempt = 0
+    coordinator = rendezvous(attempt)
     try:
         while True:
             pod = Pod()
@@ -152,9 +164,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # go again — the ElasticManager relaunch path, minus etcd
             print(f"[launch] worker failed (exit {status}); restart "
                   f"{attempt}/{args.max_restarts}", flush=True)
-            if min_nodes == 1:
-                coordinator = f"127.0.0.1:{_free_port()}"
             time.sleep(1.0)
+            coordinator = rendezvous(attempt)
     finally:
         if kv_server:
             kv_server.stop()

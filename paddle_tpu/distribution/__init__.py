@@ -1,0 +1,26 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Reference parity: ``python/paddle/distribution/`` (Distribution base,
+Normal/Uniform/Bernoulli/Beta/Categorical/Dirichlet/Exponential/Gamma/
+Gumbel/Laplace/LogNormal/Multinomial, TransformedDistribution + transforms,
+``kl_divergence`` registry). TPU-native: sampling uses explicit jax PRNG
+keys (a ``seed`` argument or the global generator), densities are jnp —
+everything traces under jit and vmaps.
+"""
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Distribution, Exponential, Gamma, Geometric,
+                            Gumbel, Laplace, LogNormal, Multinomial, Normal,
+                            Uniform)
+from .kl import kl_divergence, register_kl
+from .transformed import (AbsTransform, AffineTransform, ChainTransform,
+                          ExpTransform, PowerTransform, SigmoidTransform,
+                          Transform, TransformedDistribution, TanhTransform)
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Beta", "Categorical",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "kl_divergence", "register_kl", "Transform",
+    "AffineTransform", "ExpTransform", "AbsTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "ChainTransform",
+    "TransformedDistribution",
+]

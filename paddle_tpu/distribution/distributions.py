@@ -1,0 +1,490 @@
+"""Concrete distributions.
+
+Reference parity: ``python/paddle/distribution/{normal,uniform,beta,
+categorical,dirichlet,...}.py``. Math via jnp/jax.scipy; sampling via
+jax.random with keys from the framework generator (so ``paddle_tpu.seed``
+governs reproducibility, like the reference's global generator).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp
+
+from ..framework import random as framework_random
+
+
+def _key(seed: Optional[int] = None):
+    if seed is not None:
+        return jax.random.key(seed)
+    return framework_random.next_key()
+
+
+def _shape(sample_shape, batch_shape) -> tuple:
+    return tuple(sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    """Base (reference ``distribution.py``): sample/log_prob/prob/entropy +
+    mean/variance properties; ``rsample`` is the reparameterized path."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=(), seed: Optional[int] = None):
+        return lax.stop_gradient(self.rsample(shape, seed))
+
+    def rsample(self, shape=(), seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.result_type(float))
+        self.scale = jnp.asarray(scale, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+    @property
+    def stddev(self):
+        return jnp.broadcast_to(self.scale, self.batch_shape)
+
+    def rsample(self, shape=(), seed=None):
+        eps = jax.random.normal(_key(seed),
+                                _shape(shape, self.batch_shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * jnp.log(2 * jnp.pi))
+
+    def entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jsp.erf((jnp.asarray(value) - self.loc)
+                                  / (self.scale * np.sqrt(2.0))))
+
+    def icdf(self, q):
+        return self.loc + self.scale * np.sqrt(2.0) * jsp.erfinv(
+            2 * jnp.asarray(q) - 1)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.result_type(float))
+        self.high = jnp.asarray(high, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to((self.low + self.high) / 2, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                self.batch_shape)
+
+    def rsample(self, shape=(), seed=None):
+        u = jax.random.uniform(_key(seed), _shape(shape, self.batch_shape))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self.batch_shape)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.result_type(float))
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), seed=None):
+        return jax.random.bernoulli(
+            _key(seed), self.probs,
+            _shape(shape, self.batch_shape)).astype(self.probs.dtype)
+
+    rsample = sample  # not reparameterizable; kept for API shape
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        eps = jnp.finfo(self.probs.dtype).tiny
+        return (value * jnp.log(jnp.maximum(self.probs, eps))
+                + (1 - value) * jnp.log(jnp.maximum(1 - self.probs, eps)))
+
+    def entropy(self):
+        p = self.probs
+        eps = jnp.finfo(p.dtype).tiny
+        return -(p * jnp.log(jnp.maximum(p, eps))
+                 + (1 - p) * jnp.log(jnp.maximum(1 - p, eps)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            probs = jnp.asarray(probs, jnp.result_type(float))
+            logits = jnp.log(jnp.maximum(
+                probs / probs.sum(-1, keepdims=True),
+                jnp.finfo(probs.dtype).tiny))
+        self.logits = jnp.asarray(logits, jnp.result_type(float))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, -1)
+
+    def sample(self, shape=(), seed=None):
+        return jax.random.categorical(_key(seed), self.logits,
+                                      shape=_shape(shape, self.batch_shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(logp, value[..., None], -1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return -(jnp.exp(logp) * logp).sum(-1)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(alpha, jnp.result_type(float))
+        self.beta = jnp.asarray(beta, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+    def rsample(self, shape=(), seed=None):
+        return jax.random.beta(_key(seed), self.alpha, self.beta,
+                               _shape(shape, self.batch_shape))
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        return ((self.alpha - 1) * jnp.log(value)
+                + (self.beta - 1) * jnp.log1p(-value)
+                - (jsp.betaln(self.alpha, self.beta)))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return (jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+                - (b - 1) * jsp.digamma(b)
+                + (a + b - 2) * jsp.digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(concentration,
+                                         jnp.result_type(float))
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(-1, keepdims=True)
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = a.sum(-1, keepdims=True)
+        return a * (a0 - a) / (a0 ** 2 * (a0 + 1))
+
+    def rsample(self, shape=(), seed=None):
+        return jax.random.dirichlet(_key(seed), self.concentration,
+                                    _shape(shape, self.batch_shape))
+
+    def log_prob(self, value):
+        a = self.concentration
+        value = jnp.asarray(value)
+        norm = jsp.gammaln(a).sum(-1) - jsp.gammaln(a.sum(-1))
+        return ((a - 1) * jnp.log(value)).sum(-1) - norm
+
+    def entropy(self):
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        norm = jsp.gammaln(a).sum(-1) - jsp.gammaln(a0)
+        return (norm + (a0 - k) * jsp.digamma(a0)
+                - ((a - 1) * jsp.digamma(a)).sum(-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.result_type(float))
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1 / self.rate
+
+    @property
+    def variance(self):
+        return 1 / self.rate ** 2
+
+    def rsample(self, shape=(), seed=None):
+        return jax.random.exponential(
+            _key(seed), _shape(shape, self.batch_shape)) / self.rate
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        return jnp.where(value >= 0, jnp.log(self.rate) - self.rate * value,
+                         -jnp.inf)
+
+    def entropy(self):
+        return 1 - jnp.log(self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = jnp.asarray(concentration,
+                                         jnp.result_type(float))
+        self.rate = jnp.asarray(rate, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / self.rate ** 2
+
+    def rsample(self, shape=(), seed=None):
+        return jax.random.gamma(
+            _key(seed), self.concentration,
+            _shape(shape, self.batch_shape)) / self.rate
+
+    def log_prob(self, value):
+        a, b = self.concentration, self.rate
+        value = jnp.asarray(value)
+        return (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
+                - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return (a - jnp.log(b) + jsp.gammaln(a)
+                + (1 - a) * jsp.digamma(a))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0, 1, ...} (failures before success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.result_type(float))
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+    def sample(self, shape=(), seed=None):
+        return jax.random.geometric(
+            _key(seed), self.probs,
+            _shape(shape, self.batch_shape)).astype(jnp.result_type(float)) - 1
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.result_type(float))
+        self.scale = jnp.asarray(scale, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * np.euler_gamma
+
+    @property
+    def variance(self):
+        return (np.pi ** 2 / 6) * self.scale ** 2
+
+    def rsample(self, shape=(), seed=None):
+        g = jax.random.gumbel(_key(seed), _shape(shape, self.batch_shape))
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        z = (jnp.asarray(value) - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1 + np.euler_gamma,
+                                self.batch_shape)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.result_type(float))
+        self.scale = jnp.asarray(scale, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=(), seed=None):
+        lap = jax.random.laplace(_key(seed), _shape(shape, self.batch_shape))
+        return self.loc + self.scale * lap
+
+    def log_prob(self, value):
+        return (-jnp.abs(jnp.asarray(value) - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self.batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.result_type(float))
+        self.scale = jnp.asarray(scale, jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+        self._normal = Normal(self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+    def rsample(self, shape=(), seed=None):
+        return jnp.exp(self._normal.rsample(shape, seed))
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        return self._normal.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        # H[LogNormal] = H[Normal] + mu (the 1/2 term is already in
+        # the normal entropy)
+        return self._normal.entropy() + self.loc
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        probs = jnp.asarray(probs, jnp.result_type(float))
+        self.probs = probs / probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), seed=None):
+        logits = jnp.log(jnp.maximum(self.probs,
+                                     jnp.finfo(self.probs.dtype).tiny))
+        draws = jax.random.categorical(
+            _key(seed), logits,
+            shape=(self.total_count,) + _shape(shape, self.batch_shape))
+        k = self.probs.shape[-1]
+        one_hot = jax.nn.one_hot(draws, k, dtype=self.probs.dtype)
+        return one_hot.sum(0)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = jnp.asarray(value)
+        logp = jnp.log(jnp.maximum(self.probs,
+                                   jnp.finfo(self.probs.dtype).tiny))
+        coeff = (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
+                 - jsp.gammaln(value + 1.0).sum(-1))
+        return coeff + (value * logp).sum(-1)

@@ -1,0 +1,100 @@
+"""KL divergence registry — ``python/paddle/distribution/kl.py`` analogue."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple, Type
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Distribution, Exponential, Gamma, Laplace,
+                            Normal, Uniform)
+
+_KL: Dict[Tuple[Type, Type], Callable] = {}
+
+
+def register_kl(p_cls: Type, q_cls: Type):
+    def deco(fn):
+        _KL[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    import jax
+
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return (jnp.exp(logp) * (logp - logq)).sum(-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = jnp.finfo(p.probs.dtype).tiny
+    a, b = p.probs, q.probs
+    return (a * (jnp.log(jnp.maximum(a, eps)) - jnp.log(jnp.maximum(b, eps)))
+            + (1 - a) * (jnp.log(jnp.maximum(1 - a, eps))
+                         - jnp.log(jnp.maximum(1 - b, eps))))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return jnp.log(1 / r) + r - 1
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    sp = p.alpha + p.beta
+    return (jsp.betaln(q.alpha, q.beta) - jsp.betaln(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * jsp.digamma(p.alpha)
+            + (p.beta - q.beta) * jsp.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * jsp.digamma(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    t1 = jsp.gammaln(a0) - jsp.gammaln(b.sum(-1))
+    t2 = (jsp.gammaln(b) - jsp.gammaln(a)).sum(-1)
+    t3 = ((a - b) * (jsp.digamma(a) - jsp.digamma(a0)[..., None])).sum(-1)
+    return t1 + t2 + t3
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    a, b = p.concentration, p.rate
+    c, d = q.concentration, q.rate
+    return ((a - c) * jsp.digamma(a) - jsp.gammaln(a) + jsp.gammaln(c)
+            + c * (jnp.log(b) - jnp.log(d)) + a * (d - b) / b)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc) / q.scale
+    return (-jnp.log(scale_ratio) + scale_ratio
+            * jnp.exp(-loc_abs / scale_ratio) + loc_abs - 1)

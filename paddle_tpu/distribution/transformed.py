@@ -1,0 +1,147 @@
+"""Transforms + TransformedDistribution.
+
+Reference parity: ``python/paddle/distribution/transform.py`` (Transform
+hierarchy with forward/inverse/log_det_jacobian) and
+``transformed_distribution.py``.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .distributions import Distribution
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc)
+        self.scale = jnp.asarray(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.asarray(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return jnp.abs(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        import jax
+
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        import jax
+
+        # log(1 - tanh^2) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base sample pushed through transforms; log_prob via change of
+    variables (scalar/elementwise transforms, like the reference)."""
+
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(list(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=(), seed=None):
+        return self.transform.forward(self.base.rsample(shape, seed))
+
+    def sample(self, shape=(), seed=None):
+        return self.transform.forward(self.base.sample(shape, seed))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return (self.base.log_prob(x)
+                - self.transform.forward_log_det_jacobian(x))

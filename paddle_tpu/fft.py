@@ -1,0 +1,96 @@
+"""paddle_tpu.fft — torch/paddle-style FFT module.
+
+Reference parity: ``python/paddle/fft.py`` (fft/ifft/rfft/irfft + 2d/nd
+variants, hfft/ihfft, fftshift, frequency helpers) over cuFFT kernels.
+TPU-native: jnp.fft (XLA FFT HLO).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+    "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm not in ("backward", "ortho", "forward", None):
+        raise ValueError(
+            f"norm must be 'backward', 'ortho' or 'forward', got {norm!r}")
+    return norm or "backward"
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.fft(jnp.asarray(x), n=n, axis=axis, norm=_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ifft(jnp.asarray(x), n=n, axis=axis, norm=_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.rfft(jnp.asarray(x), n=n, axis=axis, norm=_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.irfft(jnp.asarray(x), n=n, axis=axis, norm=_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.hfft(jnp.asarray(x), n=n, axis=axis, norm=_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ihfft(jnp.asarray(x), n=n, axis=axis, norm=_norm(norm))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.fft2(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ifft2(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.rfft2(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.fftn(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.ifftn(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.rfftn(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(jnp.asarray(x), s=s, axes=axes, norm=_norm(norm))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(jnp.asarray(x), axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(jnp.asarray(x), axes=axes)

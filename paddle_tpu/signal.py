@@ -1,0 +1,127 @@
+"""paddle_tpu.signal — frame/STFT/ISTFT.
+
+Reference parity: ``python/paddle/signal.py`` (``frame``, ``overlap_add``,
+``stft``, ``istft``). TPU-native: framing is a gather (static shapes), the
+transform is jnp.fft — all jittable; no cuFFT plans to manage.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis``; output has
+    ``frame_length`` then frame-count dims in place of ``axis`` (matching
+    the reference layout: [..., frame_length, num_frames] for axis=-1)."""
+    x = jnp.asarray(x)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise ValueError("frame: axis must be first or last")
+    last = axis in (-1, x.ndim - 1)
+    n = x.shape[-1] if last else x.shape[0]
+    if frame_length > n:
+        raise ValueError(f"frame_length {frame_length} > signal length {n}")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [F, L]
+    if last:
+        frames = x[..., idx]                  # [..., F, L]
+        return jnp.swapaxes(frames, -1, -2)   # [..., L, F]
+    frames = x[idx]                            # [F, L, ...]
+    return jnp.moveaxis(frames, 1, 0)          # [L, F, ...]
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of :func:`frame` (sum overlapping frames).
+
+    ``x``: [..., frame_length, num_frames] (axis=-1) or
+    [frame_length, num_frames, ...] (axis=0).
+    """
+    x = jnp.asarray(x)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise ValueError("overlap_add: axis must be first or last")
+    last = axis in (-1, x.ndim - 1)
+    if not last:
+        # normalize to [..., L, F]
+        x = jnp.moveaxis(x, (0, 1), (-2, -1))
+    L, F = x.shape[-2], x.shape[-1]
+    out_len = (F - 1) * hop_length + L
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    idx = (jnp.arange(F)[:, None] * hop_length
+           + jnp.arange(L)[None, :]).reshape(-1)          # [F*L]
+    vals = jnp.swapaxes(x, -1, -2).reshape(x.shape[:-2] + (F * L,))
+    out = out.at[..., idx].add(vals)
+    if not last:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform; returns [..., freq, num_frames]
+    complex (reference ``paddle.signal.stft``)."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones(win_length, x.dtype)
+    window = jnp.asarray(window)
+    if win_length < n_fft:  # center-pad window to n_fft
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(x, n_fft, hop_length, axis=-1)      # [..., n_fft, F]
+    frames = frames * window[:, None]
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-2)
+    else:
+        spec = jnp.fft.fft(frames, axis=-2)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return spec
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    ``paddle.signal.istft``)."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones(win_length)
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2)    # [..., n_fft, F]
+    else:
+        frames = jnp.fft.ifft(x, axis=-2)
+        frames = frames.real if not return_complex else frames
+    frames = frames * window[:, None]
+    sig = overlap_add(frames, hop_length, axis=-1)
+    # window envelope for COLA normalization
+    env_frames = jnp.broadcast_to((window ** 2)[:, None],
+                                  (n_fft, x.shape[-1]))
+    env = overlap_add(env_frames, hop_length, axis=-1)
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        pad = n_fft // 2
+        sig = sig[..., pad:sig.shape[-1] - pad]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

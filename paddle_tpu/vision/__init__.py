@@ -1,0 +1,11 @@
+"""paddle_tpu.vision — models, transforms, datasets.
+
+Reference parity: ``python/paddle/vision/`` (``models`` ResNet/VGG/
+MobileNet/LeNet..., ``transforms`` functional + compose pipeline,
+``datasets``). Models keep the reference's NCHW layout so ported
+checkpoints line up name-for-name (XLA lowers NCHW convs onto the MXU
+directly — see ``paddle_tpu.models.resnet``).
+"""
+from . import datasets, models, transforms
+
+__all__ = ["models", "transforms", "datasets"]

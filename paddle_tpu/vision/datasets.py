@@ -1,0 +1,203 @@
+"""Vision datasets.
+
+Reference parity: ``python/paddle/vision/datasets/`` (MNIST/Cifar/
+ImageFolder/DatasetFolder/Flowers). Zero-egress environment: the
+downloadable datasets accept a local ``data_file``/``data_dir`` and raise a
+clear error when absent (no network fetch); ``FakeData`` provides the
+synthetic stand-in the reference uses in CI.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["FakeData", "MNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (reference CI stand-in)."""
+
+    def __init__(self, num_samples: int = 128,
+                 image_shape: Sequence[int] = (3, 32, 32),
+                 num_classes: int = 10, transform: Optional[Callable] = None,
+                 seed: int = 0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        img = rng.integers(0, 256, self.image_shape, np.uint8)
+        label = np.int64(rng.integers(self.num_classes))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """IDX-format reader (``vision/datasets/mnist.py``); pass local
+    ``image_path``/``label_path`` (.gz or raw idx)."""
+
+    def __init__(self, image_path: str, label_path: str, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 backend: str = "cv2"):
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        assert len(self.images) == len(self.labels)
+
+    @staticmethod
+    def _open(path: str):
+        if path.endswith(".gz"):
+            return gzip.open(path, "rb")
+        return open(path, "rb")
+
+    def _read_images(self, path: str) -> np.ndarray:
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad MNIST image magic {magic} in {path}")
+            data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path: str) -> np.ndarray:
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad MNIST label magic {magic} in {path}")
+            return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar10(Dataset):
+    """Reads the python-pickle CIFAR tarball from a local ``data_file``
+    (``vision/datasets/cifar.py`` minus the downloader)."""
+
+    _TRAIN_MEMBERS = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_MEMBERS = ["test_batch"]
+    _LABEL_KEY = b"labels"
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 transform: Optional[Callable] = None):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; download the CIFAR python tarball "
+                f"out-of-band (no network access here)")
+        members = (self._TRAIN_MEMBERS if mode == "train"
+                   else self._TEST_MEMBERS)
+        images, labels = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                if base in members:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(d[self._LABEL_KEY])
+        self.images = np.concatenate(images)
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC uint8
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    _TRAIN_MEMBERS = ["train"]
+    _TEST_MEMBERS = ["test"]
+    _LABEL_KEY = b"fine_labels"
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdir layout (``vision/datasets/folder.py``); .npy or
+    image files (image decoding needs an out-of-band loader arg)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions: Sequence[str] = _IMG_EXTS,
+                 transform: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or self._default_loader
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class subdirectories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path: str):
+        if path.endswith(".npy"):
+            return np.load(path)
+        raise ValueError(
+            f"no builtin decoder for {path}; pass loader= (e.g. PIL/cv2)")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled flat/recursive image list (reference ``ImageFolder``)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions: Sequence[str] = _IMG_EXTS,
+                 transform: Optional[Callable] = None):
+        self.loader = loader or DatasetFolder._default_loader
+        self.transform = transform
+        self.samples: List[str] = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append(os.path.join(dirpath, fname))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return (img,)

@@ -1,0 +1,324 @@
+"""Vision model zoo beyond ResNet: LeNet, VGG, MobileNetV1/V2/V3(small).
+
+Reference parity: ``python/paddle/vision/models/{lenet,vgg,mobilenetv1,
+mobilenetv2,mobilenetv3}.py``. Same layer graphs and naming style; NCHW.
+ResNet family lives in ``paddle_tpu.models.resnet`` (re-exported here).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+from ..models.resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                             resnet152, resnext50_32x4d, wide_resnet50_2)
+
+__all__ = [
+    "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1",
+    "MobileNetV2", "MobileNetV3Small", "mobilenet_v1", "mobilenet_v2",
+    "mobilenet_v3_small", "ResNet", "resnet18", "resnet34", "resnet50",
+    "resnet101", "resnet152", "wide_resnet50_2", "resnext50_32x4d",
+]
+
+
+class LeNet(nn.Layer):
+    """``paddle.vision.models.LeNet`` (28x28 single-channel input)."""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        if num_classes > 0:
+            self.fc = nn.Sequential(
+                nn.Linear(400, 120), nn.Linear(120, 84),
+                nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.fc(x)
+        return x
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512,
+          512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _vgg_features(cfg: List, batch_norm: bool) -> nn.Sequential:
+    layers = []
+    c_in = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers.append(nn.Conv2D(c_in, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            c_in = v
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    """``paddle.vision.models.VGG`` (global 7x7 pool + 3 FC head)."""
+
+    def __init__(self, features: nn.Layer, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, num_classes))
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.classifier(x)
+        return x
+
+
+def _vgg(cfg: str, batch_norm=False, **kw):
+    return VGG(_vgg_features(_VGG_CFGS[cfg], batch_norm), **kw)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kw):
+    return _vgg("A", batch_norm, **kw)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kw):
+    return _vgg("B", batch_norm, **kw)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kw):
+    return _vgg("D", batch_norm, **kw)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kw):
+    return _vgg("E", batch_norm, **kw)
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, c_in, c_out, k, stride=1, groups=1, act=nn.ReLU):
+        pad = (k - 1) // 2
+        layers = [nn.Conv2D(c_in, c_out, k, stride=stride, padding=pad,
+                            groups=groups, bias_attr=False),
+                  nn.BatchNorm2D(c_out)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    """Depthwise-separable stack (``mobilenetv1.py``)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (out, stride) for each depthwise separable block
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1),
+        ]
+        layers = [_ConvBNReLU(3, c(32), 3, stride=2)]
+        c_in = c(32)
+        for out, stride in cfg:
+            layers.append(_ConvBNReLU(c_in, c_in, 3, stride=stride,
+                                      groups=c_in))     # depthwise
+            layers.append(_ConvBNReLU(c_in, c(out), 1))  # pointwise
+            c_in = c(out)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(c_in, hidden, 1, act=nn.ReLU6))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden,
+                        act=nn.ReLU6),
+            nn.Conv2D(hidden, c_out, 1, bias_attr=False),
+            nn.BatchNorm2D(c_out),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """``mobilenetv2.py`` inverted-residual network."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        layers = [_ConvBNReLU(3, c(32), 3, stride=2, act=nn.ReLU6)]
+        c_in = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(InvertedResidual(c_in, c(ch),
+                                               s if i == 0 else 1, t))
+                c_in = c(ch)
+        out_c = max(int(1280 * scale), 1280) if scale > 1.0 else 1280
+        layers.append(_ConvBNReLU(c_in, out_c, 1, act=nn.ReLU6))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(out_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.classifier(x)
+        return x
+
+
+class _SEBlock(nn.Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // reduction, 1)
+        self.fc2 = nn.Conv2D(ch // reduction, ch, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, c_in, hidden, c_out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if hidden != c_in:
+            layers.append(_ConvBNReLU(c_in, hidden, 1, act=act))
+        layers.append(_ConvBNReLU(hidden, hidden, k, stride=stride,
+                                  groups=hidden, act=act))
+        if use_se:
+            layers.append(_SEBlock(hidden))
+        layers += [nn.Conv2D(hidden, c_out, 1, bias_attr=False),
+                   nn.BatchNorm2D(c_out)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3Small(nn.Layer):
+    """``mobilenetv3.py`` small variant (hardswish + SE blocks)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        HS, RE = nn.Hardswish, nn.ReLU
+        cfg = [  # k, hidden, out, se, act, stride
+            (3, 16, 16, True, RE, 2), (3, 72, 24, False, RE, 2),
+            (3, 88, 24, False, RE, 1), (5, 96, 40, True, HS, 2),
+            (5, 240, 40, True, HS, 1), (5, 240, 40, True, HS, 1),
+            (5, 120, 48, True, HS, 1), (5, 144, 48, True, HS, 1),
+            (5, 288, 96, True, HS, 2), (5, 576, 96, True, HS, 1),
+            (5, 576, 96, True, HS, 1),
+        ]
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        layers = [_ConvBNReLU(3, c(16), 3, stride=2, act=HS)]
+        c_in = c(16)
+        for k, hidden, out, se, act, s in cfg:
+            layers.append(_MBV3Block(c_in, c(hidden), c(out), k, s, se, act))
+            c_in = c(out)
+        layers.append(_ConvBNReLU(c_in, c(576), 1, act=HS))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(576), 1024), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)

@@ -1,0 +1,252 @@
+"""Image transforms — functional ops + composable pipeline.
+
+Reference parity: ``python/paddle/vision/transforms/`` (``transforms.py``
+Compose/Resize/CenterCrop/RandomCrop/RandomHorizontalFlip/Normalize/
+ToTensor..., ``functional.py``). TPU-native: transforms are host-side numpy
+(they run in DataLoader workers feeding the device, like the reference's
+CPU pipeline); arrays are HWC uint8/float in, CHW float out of ``ToTensor``.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Compose", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Normalize", "ToTensor",
+    "Transpose", "BrightnessTransform", "Pad", "resize", "center_crop",
+    "crop", "hflip", "vflip", "normalize", "to_tensor", "pad",
+]
+
+
+def _as_hwc(img) -> np.ndarray:
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def _pair(size) -> Tuple[int, int]:
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# ------------------------------------------------------------- functional
+def resize(img, size, interpolation: str = "bilinear") -> np.ndarray:
+    """Resize HWC image. int size = short side (aspect preserved), like the
+    reference."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        if h < w:
+            oh, ow = int(size), max(1, int(size * w / h))
+        else:
+            oh, ow = max(1, int(size * h / w)), int(size)
+    else:
+        oh, ow = _pair(size)
+    if (oh, ow) == (h, w):
+        return img
+    dtype = img.dtype
+    x = img.astype(np.float32)
+    if interpolation == "nearest":
+        ri = (np.arange(oh) * h / oh).astype(np.int64).clip(0, h - 1)
+        ci = (np.arange(ow) * w / ow).astype(np.int64).clip(0, w - 1)
+        out = x[ri][:, ci]
+    else:  # bilinear, align_corners=False convention
+        ry = (np.arange(oh) + 0.5) * h / oh - 0.5
+        rx = (np.arange(ow) + 0.5) * w / ow - 0.5
+        y0 = np.floor(ry).astype(np.int64)
+        x0 = np.floor(rx).astype(np.int64)
+        wy = (ry - y0)[:, None, None]
+        wx = (rx - x0)[None, :, None]
+        y0c = y0.clip(0, h - 1)
+        y1c = (y0 + 1).clip(0, h - 1)
+        x0c = x0.clip(0, w - 1)
+        x1c = (x0 + 1).clip(0, w - 1)
+        out = ((1 - wy) * (1 - wx) * x[y0c][:, x0c]
+               + (1 - wy) * wx * x[y0c][:, x1c]
+               + wy * (1 - wx) * x[y1c][:, x0c]
+               + wy * wx * x[y1c][:, x1c])
+    if np.issubdtype(dtype, np.integer):
+        out = np.round(out).clip(np.iinfo(dtype).min,
+                                 np.iinfo(dtype).max).astype(dtype)
+    else:
+        out = out.astype(dtype)
+    return out
+
+
+def crop(img, top: int, left: int, height: int, width: int) -> np.ndarray:
+    img = _as_hwc(img)
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size) -> np.ndarray:
+    img = _as_hwc(img)
+    th, tw = _pair(output_size)
+    h, w = img.shape[:2]
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img) -> np.ndarray:
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img) -> np.ndarray:
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant") -> np.ndarray:
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    pads = [(pt, pb), (pl, pr), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(img, pads, mode="constant", constant_values=fill)
+    return np.pad(img, pads, mode=padding_mode)
+
+
+def normalize(img, mean, std, data_format: str = "CHW") -> np.ndarray:
+    img = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor(img, data_format: str = "CHW") -> np.ndarray:
+    """HWC [0,255] uint8 (or float) -> CHW float32 [0,1]."""
+    img = _as_hwc(img)
+    out = img.astype(np.float32)
+    if np.issubdtype(np.asarray(img).dtype, np.integer):
+        out = out / 255.0
+    if data_format == "CHW":
+        out = out.transpose(2, 0, 1)
+    return out
+
+
+# ---------------------------------------------------------------- classes
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class Resize:
+    def __init__(self, size, interpolation: str = "bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed: bool = False,
+                 fill=0):
+        self.size = _pair(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill)
+        th, tw = self.size
+        h, w = img.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            # pad() tuple order is (left, top, right, bottom)
+            img = pad(img, (0, 0, max(0, tw - w), max(0, th - h)), self.fill)
+            h, w = img.shape[:2]
+        top = np.random.randint(0, h - th + 1)
+        left = np.random.randint(0, w - tw + 1)
+        return crop(img, top, left, th, tw)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if np.random.rand() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if np.random.rand() < self.prob else _as_hwc(img)
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format: str = "CHW"):
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class ToTensor:
+    def __init__(self, data_format: str = "CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode: str = "constant"):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform:
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        factor = 1.0 + np.random.uniform(-self.value, self.value)
+        dtype = img.dtype
+        out = img.astype(np.float32) * factor
+        if np.issubdtype(dtype, np.integer):
+            out = out.clip(0, 255)
+        return out.astype(dtype)

@@ -1,0 +1,250 @@
+"""distribution / sparse / fft / signal tests (SURVEY.md §2.2 API-breadth
+components), numpy/scipy references where available."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+from paddle_tpu import fft as pfft
+from paddle_tpu import signal as psignal
+from paddle_tpu import sparse as psparse
+
+
+# ---------------------------------------------------------- distribution
+def test_normal_moments_and_logprob():
+    d = D.Normal(loc=1.0, scale=2.0)
+    s = d.sample((20000,), seed=0)
+    assert abs(float(s.mean()) - 1.0) < 0.05
+    assert abs(float(s.std()) - 2.0) < 0.05
+    from scipy import stats
+
+    np.testing.assert_allclose(d.log_prob(jnp.asarray([0.5, 3.0])),
+                               stats.norm.logpdf([0.5, 3.0], 1.0, 2.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(d.cdf(1.0), 0.5, atol=1e-6)
+    np.testing.assert_allclose(d.icdf(d.cdf(2.5)), 2.5, rtol=1e-4)
+    np.testing.assert_allclose(d.entropy(),
+                               stats.norm.entropy(1.0, 2.0), rtol=1e-6)
+
+
+def test_uniform_bernoulli_categorical():
+    u = D.Uniform(0.0, 4.0)
+    assert float(u.log_prob(jnp.asarray(5.0))) == -np.inf
+    np.testing.assert_allclose(u.entropy(), np.log(4.0), rtol=1e-6)
+
+    b = D.Bernoulli(probs=jnp.asarray([0.2, 0.8]))
+    s = b.sample((5000,), seed=1)
+    np.testing.assert_allclose(s.mean(0), [0.2, 0.8], atol=0.03)
+
+    c = D.Categorical(probs=jnp.asarray([0.1, 0.2, 0.7]))
+    s = c.sample((8000,), seed=2)
+    counts = np.bincount(np.asarray(s), minlength=3) / 8000
+    np.testing.assert_allclose(counts, [0.1, 0.2, 0.7], atol=0.03)
+    from scipy import stats
+
+    np.testing.assert_allclose(c.entropy(),
+                               stats.entropy([0.1, 0.2, 0.7]), rtol=1e-5)
+
+
+@pytest.mark.parametrize("dist,scipy_name,args", [
+    (lambda: D.Beta(2.0, 3.0), "beta", (2.0, 3.0)),
+    (lambda: D.Gamma(2.0, 3.0), "gamma", None),
+    (lambda: D.Laplace(0.5, 1.5), "laplace", None),
+    (lambda: D.Gumbel(0.0, 1.0), "gumbel_r", None),
+])
+def test_logprob_vs_scipy(dist, scipy_name, args):
+    from scipy import stats
+
+    d = dist()
+    xs = np.asarray([0.3, 0.7], np.float32)
+    if scipy_name == "beta":
+        want = stats.beta.logpdf(xs, 2.0, 3.0)
+    elif scipy_name == "gamma":
+        want = stats.gamma.logpdf(xs, 2.0, scale=1 / 3.0)
+    elif scipy_name == "laplace":
+        want = stats.laplace.logpdf(xs, 0.5, 1.5)
+    else:
+        want = stats.gumbel_r.logpdf(xs)
+    np.testing.assert_allclose(d.log_prob(jnp.asarray(xs)), want, rtol=1e-4)
+
+
+def test_dirichlet_multinomial():
+    d = D.Dirichlet(jnp.asarray([1.0, 2.0, 3.0]))
+    s = d.sample((4000,), seed=3)
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6], atol=0.03)
+
+    m = D.Multinomial(10, jnp.asarray([0.3, 0.7]))
+    s = m.sample((2000,), seed=4)
+    assert s.shape == (2000, 2)
+    np.testing.assert_array_equal(np.asarray(s.sum(-1)), 10)
+    np.testing.assert_allclose(s.mean(0), [3.0, 7.0], atol=0.2)
+    from scipy import stats
+
+    np.testing.assert_allclose(
+        m.log_prob(jnp.asarray([4.0, 6.0])),
+        stats.multinomial.logpmf([4, 6], 10, [0.3, 0.7]), rtol=1e-4)
+
+
+def test_kl_divergences():
+    from scipy import stats
+
+    p = D.Normal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    # closed form
+    want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(D.kl_divergence(p, q), want, rtol=1e-5)
+    # self-KL = 0
+    np.testing.assert_allclose(
+        D.kl_divergence(D.Beta(2.0, 3.0), D.Beta(2.0, 3.0)), 0.0, atol=1e-6)
+    cp = D.Categorical(probs=jnp.asarray([0.5, 0.5]))
+    cq = D.Categorical(probs=jnp.asarray([0.9, 0.1]))
+    want = stats.entropy([0.5, 0.5], [0.9, 0.1])
+    np.testing.assert_allclose(D.kl_divergence(cp, cq), want, rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(p, cp)
+
+
+def test_transformed_distribution_lognormal_consistency():
+    base = D.Normal(0.2, 0.5)
+    t = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.2, 0.5)
+    xs = jnp.asarray([0.5, 1.0, 2.0])
+    np.testing.assert_allclose(t.log_prob(xs), ln.log_prob(xs), rtol=1e-5)
+    s = t.sample((2000,), seed=5)
+    assert float(s.min()) > 0
+
+
+def test_affine_chain_transform():
+    t = D.ChainTransform([D.AffineTransform(1.0, 2.0), D.TanhTransform()])
+    x = jnp.asarray([0.1, -0.3])
+    np.testing.assert_allclose(t.inverse(t.forward(x)), x, rtol=1e-5)
+
+
+# ---------------------------------------------------------------- sparse
+def test_sparse_coo_roundtrip():
+    dense = np.asarray([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32)
+    idx = np.nonzero(dense)
+    st = psparse.sparse_coo_tensor(np.stack(idx), dense[idx], dense.shape)
+    assert st.nnz() == 3 and st.shape == (2, 3)
+    np.testing.assert_array_equal(st.to_dense(), dense)
+    np.testing.assert_array_equal(np.asarray(st.indices()), np.stack(idx))
+
+
+def test_sparse_csr_and_matmul():
+    # [[1, 0], [0, 2], [3, 0]]
+    st = psparse.sparse_csr_tensor([0, 1, 2, 3], [0, 1, 0], [1.0, 2.0, 3.0],
+                                   (3, 2))
+    dense = st.to_dense()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4)), jnp.float32)
+    np.testing.assert_allclose(psparse.matmul(st, x), dense @ x, rtol=1e-5)
+
+
+def test_sparse_add_mul_relu():
+    a = psparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, -2.0], (2, 2))
+    b = psparse.sparse_coo_tensor([[0, 1], [0, 0]], [5.0, 1.0], (2, 2))
+    np.testing.assert_array_equal(psparse.add(a, b).to_dense(),
+                                  [[6.0, 0], [1.0, -2.0]])
+    np.testing.assert_array_equal(psparse.relu(a).to_dense(),
+                                  [[1.0, 0], [0, 0.0]])
+    d = jnp.full((2, 2), 3.0)
+    np.testing.assert_array_equal(psparse.multiply(a, d).to_dense(),
+                                  [[3.0, 0], [0, -6.0]])
+
+
+def test_sparse_masked_matmul():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(3, 4)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    mask = psparse.sparse_coo_tensor([[0, 2], [1, 0]], [1.0, 1.0], (3, 3))
+    out = psparse.masked_matmul(x, y, mask)
+    full = np.asarray(x @ y)
+    np.testing.assert_allclose(np.asarray(out.values()),
+                               [full[0, 1], full[2, 0]], rtol=1e-5)
+
+
+def test_sparse_matmul_grad():
+    st = psparse.sparse_coo_tensor([[0, 1], [1, 0]], [2.0, 4.0], (2, 2))
+
+    def f(x):
+        return psparse.matmul(st, x).sum()
+
+    g = jax.grad(f)(jnp.ones((2, 3)))
+    np.testing.assert_allclose(g, np.asarray([[4.0] * 3, [2.0] * 3]),
+                               rtol=1e-6)
+
+
+# ------------------------------------------------------------------- fft
+def test_fft_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+    np.testing.assert_allclose(pfft.fft(x), np.fft.fft(x), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(pfft.rfft(x), np.fft.rfft(x), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(pfft.irfft(pfft.rfft(x)), x, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(pfft.fft2(x), np.fft.fft2(x), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(pfft.fftshift(pfft.fftfreq(8)),
+                               np.fft.fftshift(np.fft.fftfreq(8)), rtol=1e-6)
+    np.testing.assert_allclose(pfft.fft(x, norm="ortho"),
+                               np.fft.fft(x, norm="ortho"), rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------- signal
+def test_frame_overlap_add_inverse():
+    x = jnp.asarray(np.arange(16, dtype=np.float32))
+    fr = psignal.frame(x, frame_length=4, hop_length=4)  # non-overlapping
+    assert fr.shape == (4, 4)
+    back = psignal.overlap_add(fr, hop_length=4)
+    np.testing.assert_array_equal(back, x)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 256)), jnp.float32)
+    window = jnp.asarray(np.hanning(64), jnp.float32)
+    spec = psignal.stft(x, n_fft=64, hop_length=16, window=window)
+    assert spec.shape[-2] == 33  # onesided bins
+    back = psignal.istft(spec, n_fft=64, hop_length=16, window=window,
+                         length=256)
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_stft_matches_scipy():
+    from scipy import signal as ssig
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=512).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    ours = np.asarray(psignal.stft(jnp.asarray(x), n_fft=128, hop_length=32,
+                                   window=jnp.asarray(win), center=False))
+    _, _, want = ssig.stft(x, window=win, nperseg=128, noverlap=96,
+                           boundary=None, padded=False)
+    # scipy normalizes by window.sum(); undo
+    want = want * win.sum()
+    np.testing.assert_allclose(ours, want, atol=1e-3)
+
+
+def test_sparse_multiply_sparse():
+    a = psparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0], (2, 2))
+    b = psparse.sparse_coo_tensor([[0, 1], [0, 0]], [5.0, 7.0], (2, 2))
+    out = psparse.multiply(a, b)
+    np.testing.assert_array_equal(out.to_dense(), [[10.0, 0], [0, 0.0]])
+
+
+def test_lognormal_entropy_matches_scipy():
+    from scipy import stats
+
+    d = D.LogNormal(0.3, 0.7)
+    want = stats.lognorm.entropy(0.7, scale=np.exp(0.3))
+    np.testing.assert_allclose(d.entropy(), want, rtol=1e-6)
+
+
+def test_fft_invalid_norm_raises():
+    with pytest.raises(ValueError, match="norm"):
+        pfft.fft(np.ones(4), norm="orthogonal")

@@ -1,0 +1,161 @@
+"""Vision package tests: transforms math, datasets, model zoo forward
+shapes + trainability."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.vision import datasets, models, transforms as T
+
+
+# -------------------------------------------------------------- transforms
+def test_resize_shapes_and_short_side():
+    img = np.zeros((40, 80, 3), np.uint8)
+    assert T.resize(img, (20, 30)).shape == (20, 30, 3)
+    out = T.resize(img, 20)  # short side -> 20, aspect kept
+    assert out.shape == (20, 40, 3)
+    assert T.resize(img, 20, "nearest").shape == (20, 40, 3)
+
+
+def test_resize_bilinear_values():
+    img = np.asarray([[0.0, 10.0], [20.0, 30.0]], np.float32)[:, :, None]
+    out = T.resize(img, (4, 4))[:, :, 0]
+    # corners approach original corner values; center is the mean
+    assert out[0, 0] == 0.0 and out[-1, -1] == 30.0
+    np.testing.assert_allclose(out.mean(), 15.0, atol=0.5)
+
+
+def test_crops_flips_pad():
+    img = np.arange(24, dtype=np.uint8).reshape(4, 6, 1)
+    c = T.center_crop(img, 2)
+    np.testing.assert_array_equal(c[:, :, 0], [[8, 9], [14, 15]])
+    np.testing.assert_array_equal(T.hflip(img)[:, :, 0], img[:, ::-1, 0])
+    np.testing.assert_array_equal(T.vflip(img)[:, :, 0], img[::-1, :, 0])
+    p = T.pad(img, 1, fill=7)
+    assert p.shape == (6, 8, 1) and p[0, 0, 0] == 7
+    rc = T.RandomCrop(3)(img)
+    assert rc.shape == (3, 3, 1)
+
+
+def test_to_tensor_normalize_compose():
+    img = np.full((4, 4, 3), 255, np.uint8)
+    pipeline = T.Compose([T.ToTensor(),
+                          T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    out = pipeline(img)
+    assert out.shape == (3, 4, 4)
+    np.testing.assert_allclose(out, 1.0, rtol=1e-6)
+
+
+# --------------------------------------------------------------- datasets
+def test_fake_data_deterministic():
+    ds = datasets.FakeData(num_samples=8, image_shape=(1, 8, 8), seed=3)
+    img0, y0 = ds[0]
+    img0b, y0b = ds[0]
+    np.testing.assert_array_equal(img0, img0b)
+    assert y0 == y0b and len(ds) == 8
+
+
+def test_mnist_idx_reader(tmp_path):
+    import gzip
+    import struct
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (5, 28, 28), np.uint8)
+    labels = rng.integers(0, 10, 5, np.uint8)
+    ip = str(tmp_path / "img.gz")
+    lp = str(tmp_path / "lab.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28) + images.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+    ds = datasets.MNIST(ip, lp)
+    assert len(ds) == 5
+    img, y = ds[2]
+    np.testing.assert_array_equal(img, images[2])
+    assert y == labels[2]
+    # corrupt magic -> clear error
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 1234, 5, 28, 28))
+    with pytest.raises(ValueError, match="magic"):
+        datasets.MNIST(ip, lp)
+
+
+def test_cifar_tarball_reader(tmp_path):
+    import pickle
+    import tarfile
+
+    rng = np.random.default_rng(1)
+    data = {b"data": rng.integers(0, 256, (10, 3072), np.uint8),
+            b"labels": list(rng.integers(0, 10, 10))}
+    tar_path = str(tmp_path / "cifar.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tar:
+        import io
+
+        blob = pickle.dumps(data)
+        info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+        info.size = len(blob)
+        tar.addfile(info, io.BytesIO(blob))
+    ds = datasets.Cifar10(tar_path, mode="train")
+    assert len(ds) == 10
+    img, y = ds[0]
+    assert img.shape == (32, 32, 3)
+    with pytest.raises(FileNotFoundError):
+        datasets.Cifar10(str(tmp_path / "nope.tar.gz"))
+
+
+def test_dataset_folder_npy(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(2):
+            np.save(str(d / f"{i}.npy"),
+                    np.zeros((8, 8, 3), np.uint8))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"] and len(ds) == 4
+    img, y = ds[3]
+    assert img.shape == (8, 8, 3) and y == 1
+    flat = datasets.ImageFolder(str(tmp_path))
+    assert len(flat) == 4
+
+
+# ------------------------------------------------------------------ models
+@pytest.mark.parametrize("ctor,in_shape,n_out", [
+    (lambda: models.LeNet(num_classes=10), (2, 1, 28, 28), 10),
+    (lambda: models.vgg11(num_classes=7), (1, 3, 32, 32), 7),
+    (lambda: models.mobilenet_v1(scale=0.25, num_classes=5), (1, 3, 32, 32), 5),
+    (lambda: models.mobilenet_v2(scale=0.25, num_classes=5), (1, 3, 32, 32), 5),
+    (lambda: models.mobilenet_v3_small(scale=0.5, num_classes=5),
+     (1, 3, 64, 64), 5),
+])
+def test_model_forward_shapes(ctor, in_shape, n_out):
+    pt.seed(0)
+    model = ctor()
+    model.eval()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=in_shape),
+                    jnp.float32)
+    out = model(x)
+    assert out.shape == (in_shape[0], n_out)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_lenet_trains():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.optimizer import Adam
+
+    pt.seed(0)
+    model = models.LeNet(num_classes=4)
+    step = pt.TrainStep(model, Adam(learning_rate=1e-3),
+                        loss_fn=lambda out, b: F.cross_entropy(out, b[1]))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 1, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 4, 16).astype(np.int32)
+    losses = [float(step((x, y))) for _ in range(15)]
+    assert losses[-1] < losses[0]
+
+
+def test_random_crop_pad_if_needed_narrow_image():
+    img = np.zeros((40, 20, 3), np.uint8)
+    out = T.RandomCrop(32, pad_if_needed=True)(img)
+    assert out.shape == (32, 32, 3)
