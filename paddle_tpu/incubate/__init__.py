@@ -1,0 +1,11 @@
+"""paddle_tpu.incubate — experimental APIs.
+
+Reference parity: ``python/paddle/incubate/`` — ``autograd/`` (functional
+jvp/vjp/Jacobian/Hessian), ``asp/`` (2:4 structured sparsity),
+``optimizer/`` (LookAhead, ModelAverage). The MoE layers live in
+``paddle_tpu.distributed.parallel.moe`` (already first-class here).
+"""
+from . import asp, autograd
+from .optimizer import LookAhead, ModelAverage
+
+__all__ = ["autograd", "asp", "LookAhead", "ModelAverage"]

@@ -1,0 +1,101 @@
+"""ASP — 2:4 structured sparsity (automatic sparsity pruning).
+
+Reference parity: ``python/paddle/incubate/asp/`` (``calculate_density``,
+``prune_model`` computing 2:4 masks per FC/conv weight, mask checking
+``utils.py``). TPU-native: masks are plain arrays applied by elementwise
+multiply — XLA fuses the mask into the producing op. (The reference
+targets Ampere sparse tensor cores; on TPU the win is model compression
+semantics, kept for parity.)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2_4",
+           "prune_model", "ASPHelper"]
+
+
+def calculate_density(x) -> float:
+    x = np.asarray(x)
+    return float((x != 0).sum() / x.size)
+
+
+def create_mask(weight, n: int = 2, m: int = 4, axis: int = -1) -> np.ndarray:
+    """n:m mask along ``axis``: keep the n largest-|w| of every m."""
+    w = np.asarray(weight)
+    w_moved = np.moveaxis(w, axis, -1)
+    if w_moved.shape[-1] % m != 0:
+        raise ValueError(
+            f"axis {axis} size {w_moved.shape[-1]} not divisible by m={m}")
+    groups = np.abs(w_moved).reshape(-1, m)
+    keep = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return np.moveaxis(mask.reshape(w_moved.shape), -1,
+                       axis).astype(w.dtype)
+
+
+def check_mask_2_4(x, n: int = 2, m: int = 4, axis: int = -1) -> bool:
+    """True iff every group of m along ``axis`` has <= n nonzeros."""
+    w = np.moveaxis(np.asarray(x), axis, -1)
+    if w.shape[-1] % m != 0:
+        return False
+    nz = (w.reshape(-1, m) != 0).sum(1)
+    return bool((nz <= n).all())
+
+
+def _prunable(name: str, arr, m: int) -> bool:
+    if not name.endswith("weight") or arr.ndim < 2:
+        return False
+    if arr.ndim == 2:
+        return arr.shape[0] % m == 0
+    if arr.ndim == 4:
+        return (int(np.prod(arr.shape[1:]))) % m == 0
+    return False
+
+
+def prune_model(model, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> Dict[str, np.ndarray]:
+    """Apply n:m masks along the reduction axis of every prunable weight of
+    a Layer in place; returns the masks (reference ``prune_model``)."""
+    from ..nn.layer import param_state
+
+    masks = {}
+    for name, value in param_state(model).items():
+        if not _prunable(name, value, m):
+            continue
+        w = np.asarray(value)
+        if w.ndim == 2:                      # Linear [in, out]
+            mask = create_mask(w, n, m, axis=0)
+        else:                                # Conv [out, in/g, kh, kw]
+            flat = w.reshape(w.shape[0], -1)
+            mask = create_mask(flat, n, m, axis=-1).reshape(w.shape)
+        model._set_by_path(name, jnp.asarray(w * mask))
+        masks[name] = mask
+    return masks
+
+
+class ASPHelper:
+    """Keeps masks and re-applies them after optimizer steps (the
+    reference hooks ``optimizer.step``; here call ``apply_masks`` after
+    each update or use it as a TrainStep ``grad_transform``)."""
+
+    def __init__(self, model, n: int = 2, m: int = 4):
+        self.model = model
+        self.masks = prune_model(model, n, m)
+
+    def apply_masks(self, params: Dict[str, jnp.ndarray]):
+        out = dict(params)
+        for name, mask in self.masks.items():
+            if name in out:
+                out[name] = out[name] * jnp.asarray(mask)
+        return out
+
+    def mask_grads(self, grads):
+        """grad_transform hook: masked weights receive no gradient, so
+        pruned entries stay zero through training."""
+        return self.apply_masks(grads)

@@ -1,0 +1,226 @@
+"""Quantization (QAT/PTQ) and incubate (autograd, asp, LookAhead/
+ModelAverage) tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import incubate, quantization as Q
+from paddle_tpu.optimizer import SGD, Adam
+
+
+# ------------------------------------------------------------ quantization
+def test_quant_dequant_values_and_ste():
+    x = jnp.asarray([-1.5, -0.4, 0.0, 0.3, 0.9, 2.0])
+    scale = jnp.asarray(1.0)
+    out = Q.quant_dequant(x, scale, 8)
+    # in-range values round to the 127-level grid
+    np.testing.assert_allclose(out[1], np.round(-0.4 * 127) / 127, rtol=1e-6)
+    assert float(out[-1]) <= 1.0 + 1e-6  # clipped
+    # STE: grad 1 inside [-scale, scale], 0 outside
+    g = jax.grad(lambda x: Q.quant_dequant(x, scale, 8).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), [0, 1, 1, 1, 1, 0])
+
+
+def test_observers():
+    obs = Q.AbsmaxObserver()
+    s = obs.init_state()
+    s = obs.update(s, jnp.asarray([1.0, -3.0]))
+    s = obs.update(s, jnp.asarray([2.0]))
+    assert float(obs.scale(s)) == 3.0
+    ema = Q.MovingAverageAbsmaxObserver(momentum=0.5)
+    s = ema.init_state()
+    s = ema.update(s, jnp.asarray([4.0]))     # first adopts
+    assert float(s) == 4.0
+    s = ema.update(s, jnp.asarray([2.0]))
+    assert float(s) == 3.0
+
+
+def test_qat_swaps_and_trains():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = Q.QAT().quantize(model)
+    assert isinstance(model[0], Q.QuantedLinear)
+    assert isinstance(model[2], Q.QuantedLinear)
+
+    step = pt.TrainStep(model, Adam(learning_rate=1e-2),
+                        loss_fn=lambda out, b: F.cross_entropy(out, b[1]))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 32).astype(np.int32)
+    losses = [float(step((x, y))) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # observer buffers moved off zero
+    assert float(step.buffers["0.act_scale_state"]) > 0
+
+
+def test_qat_eval_close_to_float():
+    pt.seed(1)
+    model = nn.Linear(8, 4)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8)), jnp.float32)
+    want = np.asarray(model(x))
+    qmodel = Q.QAT().quantize(model)
+    qmodel.train()
+    qmodel(x)  # one observer pass
+    qmodel.eval()
+    got = np.asarray(qmodel(x))
+    assert np.abs(got - want).max() < 0.1  # int8 sim error is small
+
+
+def test_ptq_calibrate_convert():
+    pt.seed(2)
+    base = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ptq = Q.PTQ()
+    model = ptq.quantize(base)
+    rng = np.random.default_rng(2)
+    for _ in range(4):
+        model(jnp.asarray(rng.normal(size=(16, 8)), jnp.float32))
+    model = ptq.convert(model)
+    s_before = float(model[0].act_scale_state)
+    model(jnp.asarray(rng.normal(size=(16, 8)) * 100, jnp.float32))
+    assert float(model[0].act_scale_state) == s_before  # frozen
+    # freeze survives a train() flip (convert is permanent)
+    model.train()
+    model(jnp.asarray(rng.normal(size=(16, 8)) * 100, jnp.float32))
+    assert float(model[0].act_scale_state) == s_before
+
+
+def test_uncalibrated_qat_passes_through():
+    pt.seed(6)
+    model = nn.Linear(8, 4)
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(4, 8)), jnp.float32)
+    want = np.asarray(model(x))
+    q = Q.QAT().quantize(model)
+    q.eval()  # never calibrated: activations pass through, weights quantize
+    got = np.asarray(q(x))
+    assert np.abs(got - want).max() < 0.1
+    assert np.abs(got).max() > 1e-3  # not collapsed to zero
+
+
+def test_qat_weight_scale_tracks_current_weights():
+    model = nn.Linear(4, 4)
+    q = Q.QAT().quantize(model)
+    q.train()
+    x = jnp.ones((2, 4), jnp.float32)
+    q(x)
+    # shrink weights 10x: quantized output must shrink accordingly (fresh
+    # abs-max each forward, not a sticky running max)
+    small = model.weight * 0.1
+    model.weight = small
+    out = q(x)
+    direct = F.linear(x, np.asarray(small), model.bias)
+    assert np.abs(np.asarray(out) - np.asarray(direct)).max() < 0.01
+
+
+# ---------------------------------------------------------------- autograd
+def test_jvp_vjp():
+    f = lambda x: (x ** 2).sum()
+    x = jnp.asarray([1.0, 2.0])
+    out, tangent = incubate.autograd.jvp(f, x, jnp.asarray([1.0, 0.0]))
+    assert float(out) == 5.0 and float(tangent) == 2.0
+    out, grad = incubate.autograd.vjp(f, x)
+    np.testing.assert_allclose(grad, [2.0, 4.0])
+
+
+def test_jacobian_hessian():
+    f = lambda x: jnp.asarray([x[0] * x[1], x[0] + x[1]])
+    x = jnp.asarray([2.0, 3.0])
+    J = incubate.autograd.Jacobian(f, x)
+    np.testing.assert_allclose(J[:], [[3.0, 2.0], [1.0, 1.0]])
+    assert J.shape == (2, 2)
+    g = lambda x: (x[0] ** 2) * x[1]
+    H = incubate.autograd.Hessian(g, x)
+    np.testing.assert_allclose(H[:], [[6.0, 4.0], [4.0, 0.0]])
+    np.testing.assert_allclose(incubate.autograd.hessian(g, x),
+                               [[6.0, 4.0], [4.0, 0.0]])
+
+
+def test_batched_jacobian():
+    f = lambda x: x ** 2
+    xs = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    J = incubate.autograd.Jacobian(f, xs, is_batched=True)
+    assert J.shape == (2, 2, 2)
+    np.testing.assert_allclose(J[0], [[2.0, 0], [0, 4.0]])
+
+
+# --------------------------------------------------------------------- asp
+def test_create_mask_2_4():
+    w = np.asarray([[0.1, -0.5, 0.3, 0.05], [1.0, 0.2, -0.8, 0.6]])
+    mask = incubate.asp.create_mask(w)
+    np.testing.assert_array_equal(mask, [[0, 1, 1, 0], [1, 0, 1, 0]])
+    assert incubate.asp.check_mask_2_4(w * mask)
+    assert not incubate.asp.check_mask_2_4(np.ones((2, 4)))
+    assert incubate.asp.calculate_density(w * mask) == 0.5
+    # axis=0 pruning (the Linear reduction axis)
+    mask0 = incubate.asp.create_mask(w.T, axis=0)
+    np.testing.assert_array_equal(mask0, mask.T)
+    assert incubate.asp.check_mask_2_4(w.T * mask0, axis=0)
+
+
+def test_prune_model_and_training_keeps_sparsity():
+    pt.seed(3)
+    model = nn.Linear(8, 8)
+    helper = incubate.asp.ASPHelper(model)
+    # Linear weight is [in, out]: 2:4 along the reduction (input) axis,
+    # matching reference ASP semantics
+    assert incubate.asp.check_mask_2_4(np.asarray(model.weight), axis=0)
+    step = pt.TrainStep(model, SGD(learning_rate=0.1),
+                        loss_fn=lambda out, b: (out ** 2).mean(),
+                        grad_transform=helper.mask_grads)
+    x = np.random.default_rng(3).normal(size=(4, 8)).astype(np.float32)
+    for _ in range(5):
+        step((x,))
+    w = np.asarray(step.params["weight"])
+    assert incubate.asp.check_mask_2_4(w, axis=0)
+    assert incubate.asp.calculate_density(w) <= 0.5
+
+
+def test_prune_conv_weight():
+    pt.seed(5)
+    conv = nn.Conv2D(8, 4, 3)  # weight [4, 8, 3, 3]; in*kh*kw = 72 % 4 == 0
+    masks = incubate.asp.prune_model(conv)
+    assert "weight" in masks
+    w = np.asarray(conv.weight).reshape(4, -1)
+    assert incubate.asp.check_mask_2_4(w)
+
+
+# --------------------------------------------------------- wrap optimizers
+def test_lookahead_syncs_every_k():
+    opt = incubate.LookAhead(SGD(learning_rate=1.0), alpha=0.5, k=2)
+    params = {"w": jnp.asarray([0.0])}
+    state = opt.init(params)
+    g = {"w": jnp.asarray([1.0])}
+    # step1: fast=-1, no sync; step2: fast=-2 -> slow=0.5*(-2)= -1, fast=-1
+    params, state = opt.update(g, state, params)
+    np.testing.assert_allclose(params["w"], [-1.0])
+    params, state = opt.update(g, state, params)
+    np.testing.assert_allclose(params["w"], [-1.0])
+    np.testing.assert_allclose(state["slow"]["w"], [-1.0])
+
+
+def test_model_average_apply():
+    opt = incubate.ModelAverage(SGD(learning_rate=1.0),
+                                max_average_window=100)
+    params = {"w": jnp.asarray([0.0])}
+    state = opt.init(params)
+    g = {"w": jnp.asarray([1.0])}
+    for _ in range(3):  # params go -1, -2, -3; sum = -6
+        params, state = opt.update(g, state, params)
+    avg = opt.apply(state)
+    np.testing.assert_allclose(avg["w"], [-2.0])
+    np.testing.assert_allclose(params["w"], [-3.0])
+
+
+def test_lookahead_composes_with_train_step():
+    pt.seed(4)
+    model = nn.Linear(4, 2)
+    opt = incubate.LookAhead(Adam(learning_rate=1e-2), k=3)
+    step = pt.TrainStep(model, opt,
+                        loss_fn=lambda out, b: (out ** 2).mean())
+    x = np.random.default_rng(4).normal(size=(8, 4)).astype(np.float32)
+    losses = [float(step((x,))) for _ in range(12)]
+    assert losses[-1] < losses[0]
