@@ -30,6 +30,7 @@ from .parallel.recompute import recompute  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import ps  # noqa: F401
 from .ps.graph import GraphDataGenerator, GraphTable  # noqa: F401
+from . import auto_parallel  # noqa: F401
 from .checkpoint import (  # noqa: F401
     AsyncSaver, AutoCheckpoint, latest_checkpoint, load_state, save_state,
 )

@@ -48,30 +48,39 @@ def check_mask_2_4(x, n: int = 2, m: int = 4, axis: int = -1) -> bool:
     return bool((nz <= n).all())
 
 
-def _prunable(name: str, arr, m: int) -> bool:
-    if not name.endswith("weight") or arr.ndim < 2:
-        return False
-    if arr.ndim == 2:
-        return arr.shape[0] % m == 0
-    if arr.ndim == 4:
-        return (int(np.prod(arr.shape[1:]))) % m == 0
-    return False
+def _iter_layers(layer, prefix: str = ""):
+    yield prefix, layer
+    for name, sub in layer._sub_layers.items():
+        if sub is not None:
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from _iter_layers(sub, sub_prefix)
 
 
 def prune_model(model, n: int = 2, m: int = 4,
                 mask_algo: str = "mask_1d") -> Dict[str, np.ndarray]:
-    """Apply n:m masks along the reduction axis of every prunable weight of
-    a Layer in place; returns the masks (reference ``prune_model``)."""
-    from ..nn.layer import param_state
+    """Apply n:m masks along the reduction axis of every FC/conv weight of
+    a Layer in place; returns the masks (reference ``prune_model``, which
+    likewise restricts to FC/Conv — embeddings and norm scales are never
+    pruned)."""
+    import paddle_tpu.nn as nn
 
     masks = {}
-    for name, value in param_state(model).items():
-        if not _prunable(name, value, m):
+    for path, layer in _iter_layers(model):
+        if isinstance(layer, nn.Linear):
+            reduction_ok = layer.weight.shape[0] % m == 0
+            kind = "linear"
+        elif isinstance(layer, nn.Conv2D):
+            reduction_ok = int(np.prod(layer.weight.shape[1:])) % m == 0
+            kind = "conv"
+        else:
             continue
-        w = np.asarray(value)
-        if w.ndim == 2:                      # Linear [in, out]
+        if not reduction_ok:
+            continue
+        name = f"{path}.weight" if path else "weight"
+        w = np.asarray(layer.weight)
+        if kind == "linear":                 # [in, out]: reduction axis 0
             mask = create_mask(w, n, m, axis=0)
-        else:                                # Conv [out, in/g, kh, kw]
+        else:                                # [out, in/g, kh, kw]
             flat = w.reshape(w.shape[0], -1)
             mask = create_mask(flat, n, m, axis=-1).reshape(w.shape)
         model._set_by_path(name, jnp.asarray(w * mask))

@@ -110,13 +110,15 @@ class _QuantedBase(Layer):
         super().__init__()
         self.inner = inner
         self.config = config
-        self._frozen = False  # set by PTQ.convert: scales stop updating
+        self._frozen = False       # set by PTQ.convert: scales stop updating
+        self._calibrating = False  # PTQ: observe in eval mode (dropout/BN
+        #                            must behave as inference during calib)
         self.register_buffer("act_scale_state",
                              config.activation.init_state())
 
     def _observe_and_quant(self, x, weight):
         cfg = self.config
-        if self.training and not self._frozen:
+        if (self.training or self._calibrating) and not self._frozen:
             self.act_scale_state = cfg.activation.update(
                 self.act_scale_state, x)
         act_scale = cfg.activation.scale(self.act_scale_state)
@@ -186,22 +188,30 @@ class PTQ:
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig(activation=AbsmaxObserver())
 
+    @staticmethod
+    def _walk_quanted(layer):
+        if isinstance(layer, _QuantedBase):
+            yield layer
+        for sub in layer._sub_layers.values():
+            if sub is not None:
+                yield from PTQ._walk_quanted(sub)
+
     def quantize(self, model: Layer) -> Layer:
         model = QAT(self.config).quantize(model)
-        model.train()  # observers record during calibration
+        # calibration runs in eval mode (dropout off, BN uses running
+        # stats — inference-time activation ranges are what we calibrate
+        # against); observers record via the _calibrating flag
+        model.eval()
+        for q in self._walk_quanted(model):
+            q._calibrating = True
         return model
 
     def convert(self, model: Layer) -> Layer:
         """Freeze scales at their calibrated values — permanent, not a
         train/eval mode flag: later ``train()`` calls won't resume
         observer updates."""
-        def freeze(layer):
-            if isinstance(layer, _QuantedBase):
-                layer._frozen = True
-            for sub in layer._sub_layers.values():
-                if sub is not None:
-                    freeze(sub)
-
-        freeze(model)
+        for q in self._walk_quanted(model):
+            q._frozen = True
+            q._calibrating = False
         model.eval()
         return model

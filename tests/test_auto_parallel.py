@@ -1,0 +1,201 @@
+"""Auto-parallel tests: annotations, cost-model planner, Engine on the
+8-device CPU mesh (reference auto_parallel/ engine + tuner unittests,
+SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import auto_parallel as ap
+from paddle_tpu.distributed.auto_parallel.planner import (ClusterSpec,
+                                                          CostModel,
+                                                          ModelSpec, Planner)
+from paddle_tpu.distributed.mesh import init_mesh
+from paddle_tpu.optimizer import AdamW
+
+
+def gpt_1p3b_spec(batch_tokens=0.5e6):
+    """GPT-3 1.3B-ish workload (BASELINE.md north star config)."""
+    n_params = 1.3e9
+    return ModelSpec(
+        n_params=n_params, flops_per_token=6 * n_params,
+        hidden_size=2048, n_layers=24, seq_len=2048,
+        global_batch_tokens=batch_tokens)
+
+
+# ----------------------------------------------------------- annotations
+def test_shard_tensor_eager_and_jit():
+    mesh = init_mesh(dp=4, mp=2)
+    x = np.ones((8, 16), np.float32)
+    sx = ap.shard_tensor(x, shard_spec=["dp", None])
+    assert sx.sharding.spec == PartitionSpec("dp", None)
+
+    @jax.jit
+    def f(x):
+        h = ap.shard_tensor(x * 2, shard_spec=["dp", "mp"])
+        return h.sum()
+
+    with mesh:
+        out = f(jnp.ones((8, 16)))
+    assert float(out) == 256.0
+
+
+def test_process_mesh_wrapper():
+    pm = ap.ProcessMesh(shape=(4, 2), dim_names=["x", "y"])
+    assert pm.shape == {"x": 4, "y": 2}
+    with pm:
+        s = ap.shard_tensor(np.ones((4, 4), np.float32),
+                            shard_spec=["x", None])
+        assert s.sharding.spec == PartitionSpec("x", None)
+
+
+def test_shard_op_wrapper():
+    init_mesh(dp=8)
+
+    def matmul(a, b):
+        return a @ b
+
+    op = ap.shard_op(matmul, in_shard_specs=[["dp", None], None],
+                     out_shard_specs=[["dp", None]])
+    out = op(np.ones((8, 4), np.float32), np.ones((4, 2), np.float32))
+    np.testing.assert_allclose(out, 4.0)
+    assert out.sharding.spec == PartitionSpec("dp", None)
+
+
+# ---------------------------------------------------------------- planner
+def test_cost_model_scaling_laws():
+    spec = gpt_1p3b_spec()
+    cm = CostModel(spec)
+    pure_dp8 = cm.evaluate(dp=8, mp=1)
+    pure_dp4 = cm.evaluate(dp=4, mp=1)
+    # more chips -> less compute time
+    assert pure_dp8.compute_time < pure_dp4.compute_time
+    # TP adds activation comm: mp=8 costs more comm than dp=8
+    mp8 = cm.evaluate(dp=1, mp=8)
+    assert mp8.comm_time > pure_dp8.comm_time
+    # ZeRO shards memory
+    z = cm.evaluate(dp=1, mp=1, sdp=8)
+    assert z.mem_per_chip < pure_dp8.mem_per_chip
+
+
+def test_planner_picks_feasible_minimum():
+    spec = gpt_1p3b_spec()
+    planner = Planner(spec, n_devices=16)
+    cands = planner.candidates()
+    assert len(cands) > 3
+    best = planner.best()
+    assert best.feasible
+    # best is the fastest feasible candidate
+    feas = [c for c in cands if c.feasible]
+    assert best.step_time == min(c.step_time for c in feas)
+    assert best.dp * best.mp * best.sdp == 16
+    # on small-HBM chips (v5e-like 16GB), 1.3B + adam state (~18GB) does
+    # not fit pure-dp; the planner must shard (sdp/mp)
+    small = Planner(spec, n_devices=16,
+                    cluster=ClusterSpec(hbm_per_chip=16e9))
+    scands = small.candidates()
+    assert all(not c.feasible for c in scands if c.dp == 16)
+    sbest = small.best()
+    assert sbest.feasible and (sbest.sdp > 1 or sbest.mp > 1)
+
+
+def test_planner_infeasible_raises():
+    # 100B params on 1 chip: nothing fits
+    spec = ModelSpec(n_params=1e11, flops_per_token=6e11, hidden_size=8192,
+                     n_layers=80, seq_len=2048, global_batch_tokens=1e6)
+    with pytest.raises(ValueError, match="feasible"):
+        Planner(spec, n_devices=1).best()
+
+
+def test_plan_mesh_returns_usable_mesh():
+    spec = gpt_1p3b_spec(batch_tokens=8 * 128)
+    mesh, plan = ap.plan_mesh(spec, n_devices=8)
+    assert int(np.prod(list(mesh.shape.values()))) == 8
+    assert plan.feasible
+
+
+# ----------------------------------------------------------------- engine
+def test_engine_fit_evaluate_predict():
+    pt.seed(0)
+    mesh = init_mesh(dp=4, mp=2)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    eng = ap.Engine(model,
+                    loss_fn=lambda out, b: F.cross_entropy(out, b[1]),
+                    optimizer=AdamW(learning_rate=1e-2), mesh=mesh,
+                    batch_axes=("dp",))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 16).astype(np.int32)
+    data = [(x, y)] * 8
+    hist = eng.fit(data, epochs=3)
+    assert hist["loss"][-1] < hist["loss"][0]
+    ev = eng.evaluate([(x, y)])
+    assert np.isfinite(ev["loss"])
+    preds = eng.predict([(x, y)])
+    assert preds[0].shape == (16, 4)
+
+
+def test_engine_save_load_roundtrip(tmp_path):
+    pt.seed(1)
+    init_mesh(dp=8)
+    model = nn.Linear(8, 4)
+    eng = ap.Engine(model, loss_fn=lambda out, b: (out ** 2).mean(),
+                    optimizer=AdamW(learning_rate=1e-2),
+                    batch_axes=("dp",))
+    x = np.ones((8, 8), np.float32)
+    eng.fit([(x,)] * 4)
+    path = str(tmp_path / "eng.pdparams")
+    eng.save(path)
+    pred1 = eng.predict([(x,)])[0]
+
+    model2 = nn.Linear(8, 4)
+    eng2 = ap.Engine(model2, batch_axes=("dp",))
+    eng2.load(path)
+    pred2 = eng2.predict([(x,)])[0]
+    np.testing.assert_allclose(pred1, pred2, rtol=1e-5)
+
+
+def test_engine_with_planner_spec():
+    """Engine + model_spec: planner chooses the mesh, training runs."""
+    pt.seed(2)
+    spec = ModelSpec(n_params=1e4, flops_per_token=6e4, hidden_size=16,
+                     n_layers=2, seq_len=8, global_batch_tokens=64,
+                     optim_state_mult=6.0)
+    model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 2))
+    eng = ap.Engine(model, loss_fn=lambda out, b: (out ** 2).mean(),
+                    optimizer=AdamW(learning_rate=1e-2), model_spec=spec,
+                    batch_axes=("dp",))
+    assert eng.plan is not None and eng.plan.feasible
+    x = np.ones((8, 16), np.float32)
+    hist = eng.fit([(x,)] * 6)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_engine_metrics():
+    from paddle_tpu.metric import Accuracy
+
+    pt.seed(3)
+    init_mesh(dp=8)
+    model = nn.Linear(8, 4)
+    eng = ap.Engine(model, loss_fn=lambda out, b: F.cross_entropy(out, b[1]),
+                    optimizer=AdamW(learning_rate=1e-2),
+                    metrics=[Accuracy()], batch_axes=("dp",))
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 8).astype(np.int32)
+    res = eng.evaluate([(x, y)])
+    assert "acc" in res or any(k != "loss" for k in res), res
+    non_loss = [v for k, v in res.items() if k != "loss"]
+    assert 0.0 <= float(np.asarray(non_loss[0]).reshape(-1)[0]) <= 1.0
+
+
+def test_shard_op_spec_mismatch_raises():
+    init_mesh(dp=8)
+    op = ap.shard_op(lambda a, b: a + b, in_shard_specs=[["dp", None]])
+    with pytest.raises(ValueError, match="in_shard_specs"):
+        op(np.ones((8, 2), np.float32), np.ones((8, 2), np.float32))

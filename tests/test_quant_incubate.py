@@ -224,3 +224,29 @@ def test_lookahead_composes_with_train_step():
     x = np.random.default_rng(4).normal(size=(8, 4)).astype(np.float32)
     losses = [float(step((x,))) for _ in range(12)]
     assert losses[-1] < losses[0]
+
+
+def test_prune_model_skips_embedding():
+    emb = nn.Embedding(16, 8)
+    model = nn.Sequential(emb, nn.Linear(8, 8))
+    masks = incubate.asp.prune_model(model)
+    assert list(masks) == ["1.weight"]  # only the Linear
+    assert incubate.asp.calculate_density(np.asarray(emb.weight)) > 0.9
+
+
+def test_ptq_calibrates_in_eval_mode():
+    """Dropout must be inert during PTQ calibration: scales reflect
+    inference ranges."""
+    pt.seed(7)
+    base = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.9), nn.Linear(8, 2))
+    ptq = Q.PTQ()
+    model = ptq.quantize(base)
+    assert not model.training  # eval-mode calibration
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(64, 8)), jnp.float32)
+    model(x)
+    s = float(model[2].act_scale_state)
+    assert s > 0
+    # with dropout inert, repeated calibration is deterministic
+    model2 = ptq.quantize(nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.9),
+                                        nn.Linear(8, 2)))
+    assert not model2.training
