@@ -99,6 +99,21 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_graph_random_walk.argtypes = [
         c.c_void_p, i64p, c.c_int64, c.c_int32, c.c_uint64, i64p]
 
+    lib.pt_feed_create.restype = c.c_void_p
+    lib.pt_feed_create.argtypes = [i64p, c.c_int64]
+    lib.pt_feed_destroy.argtypes = [c.c_void_p]
+    lib.pt_feed_load_file.restype = c.c_int64
+    lib.pt_feed_load_file.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_feed_num_records.restype = c.c_int64
+    lib.pt_feed_num_records.argtypes = [c.c_void_p]
+    lib.pt_feed_shuffle.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pt_feed_clear.argtypes = [c.c_void_p]
+    lib.pt_feed_batch_slot.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+        i64p, i32p]
+    lib.pt_feed_batch_labels.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
+                                         f32p]
+
 
 def get_lib() -> ctypes.CDLL:
     """Build (if needed) and load the native library."""

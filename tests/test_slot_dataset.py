@@ -1,0 +1,150 @@
+"""InMemoryDataset (industrial slot feed) tests + CTR end-to-end with the
+PS sparse embedding — the reference's train_from_dataset path (SURVEY.md
+§3.5) on TPU-native machinery."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.io.slot_dataset import InMemoryDataset
+
+
+def write_ctr_file(path, n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        label = int(rng.integers(2))
+        s1 = ",".join(str(int(x)) for x in rng.integers(0, 1000, 3))
+        s2 = ",".join(str(int(x)) for x in rng.integers(1000, 2000,
+                                                        rng.integers(1, 5)))
+        lines.append(f"{label}\t101:{s1}\t102:{s2}")
+    path.write_text("\n".join(lines) + "\n")
+    return lines
+
+
+def test_load_and_batch(tmp_path):
+    f = tmp_path / "part-0"
+    write_ctr_file(f, n=100)
+    ds = InMemoryDataset(slots=[101, 102], batch_size=32, max_per_slot=4)
+    assert ds.load_into_memory([str(f)]) == 100
+    assert len(ds) == 100
+    batches = list(ds)
+    assert len(batches) == 3  # drop_last
+    signs, counts, labels = batches[0]
+    assert signs[101].shape == (32, 4) and signs[102].shape == (32, 4)
+    assert labels.shape == (32,)
+    assert set(np.unique(labels)) <= {0.0, 1.0}
+    # slot 101 always has 3 signs
+    assert (counts[101] == 3).all()
+    assert (signs[101][:, 3] == -1).all()  # padded
+    # slot 102 has 1..4 signs
+    assert counts[102].min() >= 1 and counts[102].max() <= 4
+
+
+def test_first_record_content(tmp_path):
+    f = tmp_path / "part-0"
+    f.write_text("1\t101:5,7\t102:42\n0\t101:9\n")
+    ds = InMemoryDataset(slots=[101, 102], batch_size=2, max_per_slot=3,
+                         drop_last=False)
+    ds.load_into_memory([str(f)])
+    signs, counts, labels = next(iter(ds))
+    np.testing.assert_array_equal(labels, [1.0, 0.0])
+    np.testing.assert_array_equal(signs[101], [[5, 7, -1], [9, -1, -1]])
+    np.testing.assert_array_equal(signs[102], [[42, -1, -1], [-1, -1, -1]])
+    np.testing.assert_array_equal(counts[102], [1, 0])
+
+
+def test_unknown_slots_ignored_and_errors(tmp_path):
+    f = tmp_path / "part-0"
+    f.write_text("1\t999:1,2\t101:3\n")
+    ds = InMemoryDataset(slots=[101], batch_size=1, drop_last=False)
+    ds.load_into_memory([str(f)])
+    signs, _, _ = next(iter(ds))
+    np.testing.assert_array_equal(signs[101][0][:1], [3])
+    with pytest.raises(IOError):
+        ds.load_into_memory([str(tmp_path / "missing")])
+    bad = tmp_path / "bad"
+    bad.write_text("not_a_label\t101:1\n")
+    with pytest.raises(ValueError, match="malformed"):
+        ds.load_into_memory([str(bad)])
+
+
+def test_shuffle_is_permutation(tmp_path):
+    f = tmp_path / "part-0"
+    write_ctr_file(f, n=64)
+    ds = InMemoryDataset(slots=[101], batch_size=64, max_per_slot=3)
+    ds.load_into_memory([str(f)])
+    before = next(iter(ds))[0][101].copy()
+    ds.local_shuffle(seed=7)
+    after = next(iter(ds))[0][101]
+    assert not np.array_equal(before, after)
+    # same multiset of rows
+    assert sorted(map(tuple, before.tolist())) == \
+        sorted(map(tuple, after.tolist()))
+    ds.release_memory()
+    assert len(ds) == 0
+
+
+def test_ctr_train_e2e(tmp_path):
+    """The train_from_dataset slice: slot file -> InMemoryDataset ->
+    SparseEmbedding (PS table) via staged pull/push -> logistic loss ->
+    AUC improves. Labels are made learnable: clicky signs occur in clicked
+    records."""
+    from paddle_tpu.distributed.ps import (MemorySparseTable,
+                                           SparseAccessorConfig, StagedPull)
+    from paddle_tpu.metric import Auc
+
+    rng = np.random.default_rng(5)
+    lines = []
+    for i in range(512):
+        label = int(rng.integers(2))
+        base = 0 if label else 500
+        signs = rng.integers(base, base + 200, 3)
+        lines.append(f"{label}\t101:" + ",".join(map(str, signs)))
+    f = tmp_path / "train"
+    f.write_text("\n".join(lines))
+
+    ds = InMemoryDataset(slots=[101], batch_size=128, max_per_slot=3)
+    ds.load_into_memory([str(f)])
+    table = MemorySparseTable(SparseAccessorConfig(
+        embed_dim=8, optimizer="adagrad", learning_rate=0.2, seed=0))
+    staged = StagedPull(table)
+
+    @jax.jit
+    def step(rows, inv, mask, labels):
+        def loss_fn(rows):
+            emb = StagedPull.lookup(rows, inv)          # [B, K, D]
+            emb = emb * mask[:, :, None]                # zero the padding
+            logit = emb.sum((1, 2))
+            return -jnp.mean(labels * jax.nn.log_sigmoid(logit)
+                             + (1 - labels) * jax.nn.log_sigmoid(-logit))
+        return jax.value_and_grad(loss_fn)(rows)
+
+    auc = Auc()
+    first = last = None
+    for epoch in range(6):
+        ds.local_shuffle(seed=epoch)
+        for signs, counts, labels in ds:
+            ids = signs[101].clip(min=0)  # pad -1 -> id 0, masked anyway
+            mask = (signs[101] >= 0).astype(np.float32)
+            rows, inv, uniq = staged.pull(ids)
+            loss, g = step(rows, inv, jnp.asarray(mask), jnp.asarray(labels))
+            staged.push(uniq, g)
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+    assert last < first * 0.5, (first, last)
+
+    # eval AUC on the training set (memorization check)
+    for signs, counts, labels in ds:
+        ids = signs[101].clip(min=0)
+        mask = (signs[101] >= 0).astype(np.float32)
+        rows, inv, _ = staged.pull(ids)
+        emb = np.asarray(StagedPull.lookup(rows, inv)) * mask[:, :, None]
+        logit = emb.sum((1, 2))
+        prob = 1 / (1 + np.exp(-logit))
+        preds = np.stack([1 - prob, prob], axis=1)
+        auc.update(preds, labels[:, None])
+    assert auc.accumulate() > 0.9
