@@ -45,12 +45,15 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        # largest single-chip config: GPT ~350M in bf16 params+opt fits HBM
+        # largest single-chip config: GPT ~350M in bf16 params+opt fits HBM.
+        # loss_chunk fuses head+CE so [B, L, vocab] logits never materialize;
+        # at L=1024 the should_use_flash gate keeps attention on the (faster)
+        # XLA fused path — measured sweep results in tools/bench_sweep.py
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=1024,
                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                         use_recompute=False, use_flash_attention=True,
-                        dtype="bfloat16")
+                        loss_chunk=256, dtype="bfloat16")
         batch, seq = 8, 1024
         timed_steps, warmup = 20, 3
     else:
@@ -67,7 +70,11 @@ def main():
     if on_tpu:
         # O2: bf16 params, f32 master weights in the optimizer
         model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
-    step = TrainStep(model, opt, loss_fn=gpt_loss_fn(model))
+    if cfg.loss_chunk:
+        # fused path: forward(ids, labels) returns the loss directly
+        step = TrainStep(model, opt, loss_fn=None)
+    else:
+        step = TrainStep(model, opt, loss_fn=gpt_loss_fn(model))
 
     rng = np.random.default_rng(0)
     ids = np.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), np.int32)

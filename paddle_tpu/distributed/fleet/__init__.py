@@ -81,6 +81,10 @@ def distributed_model(model, optimizer=None, loss_fn=None, inputs_fn=None, **kw)
 
     strategy: DistributedStrategy = _fleet_state["strategy"] or DistributedStrategy()
     stage = strategy.sharding_stage
+    if strategy.gradient_merge and "grad_accum_steps" not in kw:
+        cfg = strategy.gradient_merge_configs or {}
+        kw["grad_accum_steps"] = int(cfg.get("k_steps", 1))
+        kw["grad_accum_avg"] = bool(cfg.get("avg", True))
     return DistributedTrainStep(model, optimizer, loss_fn=loss_fn, inputs_fn=inputs_fn,
                                 mesh=get_mesh(), sharding_stage=stage, **kw)
 

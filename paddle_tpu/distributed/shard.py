@@ -136,8 +136,10 @@ class DistributedTrainStep:
 
     def __init__(self, model: Layer, optimizer, loss_fn=None, inputs_fn=None,
                  mesh=None, batch_axes=("dp", "sdp"), sharding_stage: int = 0,
-                 grad_transform=None, donate: bool = True):
-        from ..framework.jit import DEFAULT_RNG_STREAMS, resolve_inputs_fn
+                 grad_transform=None, donate: bool = True,
+                 grad_accum_steps: int = 1, grad_accum_avg: bool = True):
+        from ..framework.jit import (DEFAULT_RNG_STREAMS, _grad_dtype,
+                                     resolve_inputs_fn)
 
         self.model = model
         self.optimizer = optimizer
@@ -162,8 +164,20 @@ class DistributedTrainStep:
         self._base_key = framework_random.next_key()
         self._count = 0
         self._rng_streams = DEFAULT_RNG_STREAMS
-        donate_argnums = (0, 1, 2) if donate else ()
-        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
+        # gradient merge (reference gradient_merge_optimizer.py): accumulator
+        # sharded like the params (grads inherit param shardings under GSPMD)
+        self.grad_accum_steps = int(grad_accum_steps)
+        self.grad_accum_avg = grad_accum_avg
+        self._grad_accum = None
+        if self.grad_accum_steps > 1:
+            self._grad_accum = {
+                k: jax.device_put(
+                    jnp.zeros(v.shape, _grad_dtype(v.dtype)),
+                    NamedSharding(self.mesh, self.specs[k]))
+                for k, v in self.params.items()}
+        donate_argnums = (0, 1, 2, 3) if donate else ()
+        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums,
+                                 static_argnames=("do_update",))
         self._donate_argnums = donate_argnums
         self._compiled_checked = None
 
@@ -189,8 +203,10 @@ class DistributedTrainStep:
                 out[slot] = val
         return out
 
-    def _step(self, params, buffers, opt_state, batch, key, with_check=False):
-        from ..framework.jit import finite_guard, split_rng_streams
+    def _step(self, params, buffers, opt_state, accum, batch, key,
+              with_check=False, do_update=True):
+        from ..framework.jit import (accumulate_grads, finite_guard,
+                                     merge_accumulated, split_rng_streams)
 
         rngs = split_rng_streams(key, self._rng_streams)
 
@@ -206,6 +222,11 @@ class DistributedTrainStep:
             return jnp.asarray(loss, jnp.float32), (new_buf, out)
 
         (loss, (new_buffers, _)), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        accum = accumulate_grads(accum, grads)
+        if not do_update:
+            return loss, params, new_buffers, opt_state, accum
+        grads, accum = merge_accumulated(accum, grads, self.grad_accum_steps,
+                                         self.grad_accum_avg)
         if self.grad_transform is not None:
             grads = self.grad_transform(grads)
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
@@ -215,8 +236,8 @@ class DistributedTrainStep:
             ok, (new_params, new_buffers, new_opt_state) = finite_guard(
                 grads, (new_params, new_buffers, new_opt_state),
                 (params, buffers, opt_state))
-            return loss, new_params, new_buffers, new_opt_state, ok
-        return loss, new_params, new_buffers, new_opt_state
+            return loss, new_params, new_buffers, new_opt_state, accum, ok
+        return loss, new_params, new_buffers, new_opt_state, accum
 
     def __call__(self, batch):
         from ..framework import flags
@@ -227,15 +248,19 @@ class DistributedTrainStep:
             if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
         key = jax.random.fold_in(self._base_key, self._count)
         self._count += 1
+        do_update = (self.grad_accum_steps <= 1
+                     or self._count % self.grad_accum_steps == 0)
         with self.mesh:
-            if flags.flag("FLAGS_check_nan_inf"):
-                loss, self.params, self.buffers, self.opt_state, ok = \
+            if flags.flag("FLAGS_check_nan_inf") and do_update:
+                loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
                     self._checked_compiled()(self.params, self.buffers,
-                                             self.opt_state, batch, key)
+                                             self.opt_state, self._grad_accum,
+                                             batch, key)
                 raise_if_bad_step(ok, loss)
                 return loss
-            loss, self.params, self.buffers, self.opt_state = self._compiled(
-                self.params, self.buffers, self.opt_state, batch, key)
+            loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
+                self._compiled(self.params, self.buffers, self.opt_state,
+                               self._grad_accum, batch, key, do_update=do_update)
         return loss
 
     def sync_to_model(self):
@@ -246,5 +271,8 @@ class DistributedTrainStep:
         return self.model
 
     def state_dict(self):
-        return {"params": self.params, "buffers": self.buffers,
-                "opt_state": self.opt_state, "count": self._count}
+        sd = {"params": self.params, "buffers": self.buffers,
+              "opt_state": self.opt_state, "count": self._count}
+        if self._grad_accum is not None:
+            sd["grad_accum"] = self._grad_accum
+        return sd

@@ -26,6 +26,29 @@ from ..nn.layer import Layer, buffer_state, functional_call, param_state
 DEFAULT_RNG_STREAMS = ("dropout", "rrelu", "gumbel", "default")
 
 
+def _grad_dtype(dtype):
+    """Accumulate low-precision grads in f32 (gradient-merge accumulators)."""
+    return jnp.float32 if dtype in (jnp.bfloat16, jnp.float16) else dtype
+
+
+def accumulate_grads(accum, grads):
+    """Gradient-merge accumulate (no-op when accumulation is off)."""
+    if accum is None:
+        return None
+    return jax.tree.map(lambda a, g: a + g.astype(a.dtype), accum, grads)
+
+
+def merge_accumulated(accum, grads, k_steps, avg):
+    """Finish a gradient-merge window: returns (grads_for_update,
+    reset_accum). ``grads`` supplies the target dtypes."""
+    if accum is None:
+        return grads, None
+    k = float(k_steps)
+    merged = jax.tree.map(
+        lambda a, g: (a / k if avg else a).astype(g.dtype), accum, grads)
+    return merged, jax.tree.map(jnp.zeros_like, accum)
+
+
 def resolve_inputs_fn(inputs_fn, loss_fn):
     """Default batch->model-inputs mapping shared by TrainStep and
     DistributedTrainStep: with a loss_fn, (inputs, labels) tuples feed the
@@ -117,7 +140,13 @@ class TrainStep:
     def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
                  inputs_fn: Optional[Callable] = None,
                  grad_transform: Optional[Callable] = None, donate: bool = True,
-                 rng_streams=DEFAULT_RNG_STREAMS):
+                 rng_streams=DEFAULT_RNG_STREAMS, grad_accum_steps: int = 1,
+                 grad_accum_avg: bool = True):
+        """``grad_accum_steps`` (k>1) enables gradient merge (reference
+        ``fleet/meta_optimizers/gradient_merge_optimizer.py``): each call
+        accumulates grads; every k-th call applies one optimizer update with
+        the sum (mean when ``grad_accum_avg``). k calls on batch B equal one
+        k=1 call on batch k*B."""
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -130,14 +159,23 @@ class TrainStep:
         self._rng_streams = tuple(rng_streams)
         self._base_key = framework_random.next_key()
         self._count = 0
-        donate_argnums = (0, 1, 2) if donate else ()
-        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums)
+        self.grad_accum_steps = int(grad_accum_steps)
+        self.grad_accum_avg = grad_accum_avg
+        self._grad_accum = None
+        if self.grad_accum_steps > 1:
+            self._grad_accum = jax.tree.map(
+                lambda x: jnp.zeros(x.shape, _grad_dtype(x.dtype)), self.params)
+        donate_argnums = (0, 1, 2, 3) if donate else ()
+        # two specializations when accumulating: accumulate-only / apply
+        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums,
+                                 static_argnames=("do_update",))
         # FLAGS_check_nan_inf variant: also reduces grads/params finiteness
         # in-graph (framework/debugging.py) — compiled on first use
         self._compiled_checked = None
         self._donate_argnums = donate_argnums
 
-    def _step(self, params, buffers, opt_state, batch, key, with_check=False):
+    def _step(self, params, buffers, opt_state, accum, batch, key,
+              with_check=False, do_update=True):
         rngs = split_rng_streams(key, self._rng_streams)
 
         def compute_loss(p):
@@ -149,6 +187,11 @@ class TrainStep:
             return jnp.asarray(loss, jnp.float32), (new_buf, out)
 
         (loss, (new_buffers, _)), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        accum = accumulate_grads(accum, grads)
+        if not do_update:
+            return loss, params, new_buffers, opt_state, accum
+        grads, accum = merge_accumulated(accum, grads, self.grad_accum_steps,
+                                         self.grad_accum_avg)
         if self.grad_transform is not None:
             grads = self.grad_transform(grads)
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
@@ -156,8 +199,8 @@ class TrainStep:
             ok, (new_params, new_buffers, new_opt_state) = finite_guard(
                 grads, (new_params, new_buffers, new_opt_state),
                 (params, buffers, opt_state))
-            return loss, new_params, new_buffers, new_opt_state, ok
-        return loss, new_params, new_buffers, new_opt_state
+            return loss, new_params, new_buffers, new_opt_state, accum, ok
+        return loss, new_params, new_buffers, new_opt_state, accum
 
     def _checked_compiled(self):
         if self._compiled_checked is None:
@@ -171,14 +214,18 @@ class TrainStep:
 
         key = jax.random.fold_in(self._base_key, self._count)
         self._count += 1
-        if flags.flag("FLAGS_check_nan_inf"):
-            loss, self.params, self.buffers, self.opt_state, ok = \
+        do_update = (self.grad_accum_steps <= 1
+                     or self._count % self.grad_accum_steps == 0)
+        if flags.flag("FLAGS_check_nan_inf") and do_update:
+            loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
                 self._checked_compiled()(self.params, self.buffers,
-                                         self.opt_state, batch, key)
+                                         self.opt_state, self._grad_accum,
+                                         batch, key)
             raise_if_bad_step(ok, loss)
             return loss
-        loss, self.params, self.buffers, self.opt_state = self._compiled(
-            self.params, self.buffers, self.opt_state, batch, key)
+        loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
+            self._compiled(self.params, self.buffers, self.opt_state,
+                           self._grad_accum, batch, key, do_update=do_update)
         return loss
 
     # ----------------------------------------------------------- state sync
@@ -197,14 +244,19 @@ class TrainStep:
         return self
 
     def state_dict(self):
-        return {"params": self.params, "buffers": self.buffers,
-                "opt_state": self.opt_state, "count": self._count}
+        sd = {"params": self.params, "buffers": self.buffers,
+              "opt_state": self.opt_state, "count": self._count}
+        if self._grad_accum is not None:
+            sd["grad_accum"] = self._grad_accum
+        return sd
 
     def set_state_dict(self, sd):
         self.params = sd["params"]
         self.buffers = sd["buffers"]
         self.opt_state = sd["opt_state"]
         self._count = sd.get("count", 0)
+        if "grad_accum" in sd:
+            self._grad_accum = sd["grad_accum"]
 
 
 class EvalStep:

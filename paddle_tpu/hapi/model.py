@@ -45,8 +45,10 @@ class _HapiTrainStep(TrainStep):
     """TrainStep variant that also returns the model outputs (for train-time
     metric updates, as the reference's ``DynamicGraphAdapter.train_batch``)."""
 
-    def _step(self, params, buffers, opt_state, batch, key, with_check=False):
-        from ..framework.jit import finite_guard, split_rng_streams
+    def _step(self, params, buffers, opt_state, accum, batch, key,
+              with_check=False, do_update=True):
+        from ..framework.jit import (accumulate_grads, finite_guard,
+                                     merge_accumulated, split_rng_streams)
 
         rngs = split_rng_streams(key, self._rng_streams)
 
@@ -60,6 +62,11 @@ class _HapiTrainStep(TrainStep):
 
         (loss, (new_buffers, out)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(params)
+        accum = accumulate_grads(accum, grads)
+        if not do_update:
+            return loss, out, params, new_buffers, opt_state, accum
+        grads, accum = merge_accumulated(accum, grads, self.grad_accum_steps,
+                                         self.grad_accum_avg)
         if self.grad_transform is not None:
             grads = self.grad_transform(grads)
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
@@ -67,8 +74,8 @@ class _HapiTrainStep(TrainStep):
             ok, (new_params, new_buffers, new_opt_state) = finite_guard(
                 grads, (new_params, new_buffers, new_opt_state),
                 (params, buffers, opt_state))
-            return loss, out, new_params, new_buffers, new_opt_state, ok
-        return loss, out, new_params, new_buffers, new_opt_state
+            return loss, out, new_params, new_buffers, new_opt_state, accum, ok
+        return loss, out, new_params, new_buffers, new_opt_state, accum
 
     def __call__(self, batch):
         from ..framework import flags
@@ -76,14 +83,18 @@ class _HapiTrainStep(TrainStep):
 
         key = jax.random.fold_in(self._base_key, self._count)
         self._count += 1
-        if flags.flag("FLAGS_check_nan_inf"):
-            loss, out, self.params, self.buffers, self.opt_state, ok = \
+        do_update = (self.grad_accum_steps <= 1
+                     or self._count % self.grad_accum_steps == 0)
+        if flags.flag("FLAGS_check_nan_inf") and do_update:
+            loss, out, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
                 self._checked_compiled()(self.params, self.buffers,
-                                         self.opt_state, batch, key)
+                                         self.opt_state, self._grad_accum,
+                                         batch, key)
             raise_if_bad_step(ok, loss)
             return loss, out
-        loss, out, self.params, self.buffers, self.opt_state = self._compiled(
-            self.params, self.buffers, self.opt_state, batch, key)
+        loss, out, self.params, self.buffers, self.opt_state, self._grad_accum = \
+            self._compiled(self.params, self.buffers, self.opt_state,
+                           self._grad_accum, batch, key, do_update=do_update)
         return loss, out
 
 

@@ -54,8 +54,20 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_recompute: bool = False
+    # recompute only the attention sublayer (drops the [B, H, L, L] softmax
+    # stash from saved activations; MLP activations stay resident). The
+    # cheap middle ground between no-remat and per-block remat on chips
+    # where the XLA attention path is used
+    recompute_attn_only: bool = False
+    # jax.checkpoint policy name for recompute (see parallel/recompute.py
+    # POLICIES): "save_dots_no_batch" keeps matmul outputs and recomputes
+    # only elementwise/norm ops — a fraction of full-remat's FLOP cost
+    recompute_policy: str = None
     use_flash_attention: bool = True
     sequence_parallel: bool = False  # shard activations over "sp" between blocks
+    # fused head+CE over sequence chunks of this size (0 = off): the full
+    # [B, L, vocab] logits never materialize (see chunked_lm_loss)
+    loss_chunk: int = 0
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -93,8 +105,16 @@ def _constrain_seq(x, cfg):
 def causal_attention(q, k, v, dropout_p=0.0, training=True, use_flash=True):
     """Causal self-attention on [B, L, H, D]; Pallas flash path when the
     gate allows, XLA-fused softmax otherwise."""
-    if use_flash and fa.should_use_flash(q, k, None, dropout_p if training else 0.0):
-        return fa.flash_attention_blhd(q, k, v, causal=True)
+    p_drop = dropout_p if training else 0.0
+    if use_flash and fa.should_use_flash(q, k, None, p_drop):
+        if p_drop > 0.0:
+            from ..nn.layer import take_rng_key
+
+            seed = jax.random.randint(take_rng_key("dropout"), (), 0, 2**31 - 1)
+        else:
+            seed = 0
+        return fa.flash_attention_blhd(q, k, v, causal=True,
+                                       dropout_p=p_drop, seed=seed)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -164,7 +184,10 @@ class GPTBlock(Layer):
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+        attn = self.attn
+        if self.cfg.recompute_attn_only and not self.cfg.use_recompute:
+            attn = recompute_wrap(self.attn)
+        x = x + self.dropout(attn(self.ln_1(x)))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return _constrain_seq(x, self.cfg)
 
@@ -214,7 +237,8 @@ class _BlockList(Layer):
 
     def forward(self, x):
         for blk in self._sub_layers.values():
-            fn = recompute_wrap(blk) if self.cfg.use_recompute else blk
+            fn = (recompute_wrap(blk, policy=self.cfg.recompute_policy)
+                  if self.cfg.use_recompute else blk)
             x = fn(x)
         return x
 
@@ -234,12 +258,27 @@ class GPTForCausalLM(Layer):
                 has_bias=False, gather_output=False)
         self.parallel_ce = ParallelCrossEntropy()
 
-    def forward(self, input_ids):
+    def _head_weight(self):
+        if self.cfg.tie_word_embeddings:
+            return self.gpt.embeddings.word_embeddings.weight
+        return None
+
+    def forward(self, input_ids, labels=None):
+        """Logits when ``labels`` is None; otherwise the LM loss directly —
+        via the memory-fused chunked path when ``cfg.loss_chunk > 0`` (the
+        full [B, L, vocab] logits tensor never exists; see
+        ``chunked_lm_loss``)."""
+        if labels is not None and self.cfg.loss_chunk:
+            return self.chunked_lm_loss(self.gpt(input_ids), labels,
+                                        chunk=self.cfg.loss_chunk)
         h = self.gpt(input_ids)
         if self.cfg.tie_word_embeddings:
-            w = self.gpt.embeddings.word_embeddings.weight
-            return parallel_matmul(h, w, transpose_y=True)
-        return self.lm_head(h)
+            logits = parallel_matmul(h, self._head_weight(), transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        return self.loss(logits, labels)
 
     def loss(self, logits, labels):
         """Shifted LM loss: predict token t+1 from prefix ..t."""
@@ -248,8 +287,50 @@ class GPTForCausalLM(Layer):
         per_tok = self.parallel_ce(shift_logits, shift_labels)
         return jnp.mean(per_tok)
 
+    def chunked_lm_loss(self, h, labels, chunk=256):
+        """Head-projection + softmax-CE fused over sequence chunks.
+
+        The [B, L, vocab] logits tensor (the single largest HBM allocation in
+        GPT pretrain — e.g. 1.5 GB per materialization at B=16, L=1024,
+        V=50304) is never formed: each chunk's logits live only inside a
+        ``jax.checkpoint`` region, so the backward recomputes them per chunk
+        instead of stashing them. Reference contrast:
+        ``c_softmax_with_cross_entropy_op.cu`` fuses softmax+CE but still
+        materializes full logits."""
+        hs = h[:, :-1]
+        ys = jnp.asarray(labels)[:, 1:]
+        B, Lm1, H = hs.shape
+        nchunk = -(-Lm1 // chunk)
+        pad = nchunk * chunk - Lm1
+        hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+        ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-100)
+        # [nchunk, B, chunk, *]
+        hs = jnp.swapaxes(hs.reshape(B, nchunk, chunk, H), 0, 1)
+        ys = jnp.swapaxes(ys.reshape(B, nchunk, chunk), 0, 1)
+        w = self._head_weight()
+        if w is None:
+            w = self.lm_head.weight
+
+        @jax.checkpoint
+        def chunk_losses(h_c, y_c):
+            if self.cfg.tie_word_embeddings:
+                logits = parallel_matmul(h_c, w, transpose_y=True)
+            else:
+                logits = self.lm_head(h_c)
+            per_tok = self.parallel_ce(logits, y_c)
+            valid = (y_c != -100).astype(jnp.float32)
+            return jnp.sum(per_tok * valid), jnp.sum(valid)
+
+        def body(carry, xs):
+            s, c = chunk_losses(*xs)
+            return (carry[0] + s, carry[1] + c), None
+
+        (total, count), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)),
+                                         (hs, ys))
+        return total / jnp.maximum(count, 1.0)
+
     def forward_with_loss(self, input_ids, labels):
-        return self.loss(self.forward(input_ids), labels)
+        return self.forward(input_ids, labels)
 
 
 def gpt_loss_fn(model: GPTForCausalLM):

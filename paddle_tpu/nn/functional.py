@@ -901,8 +901,23 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     q, k, v = jnp.asarray(query), jnp.asarray(key), jnp.asarray(value)
     from ..kernels import flash_attention as _fa
 
-    if _fa.should_use_flash(q, k, attn_mask, dropout_p):
-        return _fa.flash_attention_blhd(q, k, v, causal=is_causal)
+    p_drop = dropout_p if training else 0.0
+    if _fa.should_use_flash(q, k, attn_mask, p_drop):
+        bias, bias_grad = None, True
+        if attn_mask is not None:
+            m = jnp.asarray(attn_mask)
+            if m.dtype == jnp.bool_:
+                # boolean keep-mask: not trainable -> skip the dbias pass
+                bias, bias_grad = jnp.where(m, 0.0, -1e30).astype(jnp.float32), False
+            else:
+                bias = m
+        if p_drop > 0.0:
+            seed = jax.random.randint(take_rng_key("dropout"), (), 0, 2**31 - 1)
+        else:
+            seed = 0
+        return _fa.flash_attention_blhd(q, k, v, causal=is_causal, bias=bias,
+                                        dropout_p=p_drop, seed=seed,
+                                        bias_grad=bias_grad)
     scale = 1.0 / math.sqrt(q.shape[-1])
     # -> [B, H, L, D]
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))

@@ -381,3 +381,89 @@ def test_fleet_init_and_hcg():
     assert hcg.get_data_parallel_world_size() == 2
     assert fleet.worker_num() == 1  # single host
     set_mesh(None)
+
+
+# ------------------------------------------------------------ gradient merge
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_gradient_merge_matches_big_batch(mesh8):
+    """k_steps=4 on batch B == k_steps=1 on batch 4B (SGD, avg=True).
+
+    Reference: fleet/meta_optimizers/gradient_merge_optimizer.py — VERDICT r1
+    item 6 (the config was declared but never consumed)."""
+    from paddle_tpu.optimizer import SGD
+
+    pt.seed(11)
+    model_a = MLP()
+    model_b = MLP()
+    model_b.set_state_dict(model_a.state_dict())
+
+    loss_fn = lambda out, b: F.cross_entropy(out, b[1])  # noqa: E731
+    x = np.random.randn(32, 16).astype(np.float32)
+    y = np.random.randint(0, 4, (32,))
+
+    # accumulating step sees the 4 quarters, then applies one update
+    astep = dist.DistributedTrainStep(model_a, SGD(learning_rate=0.1),
+                                      loss_fn=loss_fn, mesh=mesh8,
+                                      grad_accum_steps=4)
+    for i in range(4):
+        astep((x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]))
+
+    # big-batch step applies the same update in one call
+    bstep = dist.DistributedTrainStep(model_b, SGD(learning_rate=0.1),
+                                      loss_fn=loss_fn, mesh=mesh8)
+    bstep((x, y))
+
+    for k in astep.params:
+        np.testing.assert_allclose(np.asarray(astep.params[k]),
+                                   np.asarray(bstep.params[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_gradient_merge_trainstep_single_device():
+    from paddle_tpu.framework.jit import TrainStep
+    from paddle_tpu.optimizer import SGD
+
+    pt.seed(12)
+    model_a = MLP()
+    model_b = MLP()
+    model_b.set_state_dict(model_a.state_dict())
+    loss_fn = lambda out, b: F.cross_entropy(out, b[1])  # noqa: E731
+    x = np.random.randn(16, 16).astype(np.float32)
+    y = np.random.randint(0, 4, (16,))
+
+    astep = TrainStep(model_a, SGD(learning_rate=0.1), loss_fn=loss_fn,
+                      grad_accum_steps=2)
+    astep((x[:8], y[:8]))
+    astep((x[8:], y[8:]))
+    bstep = TrainStep(model_b, SGD(learning_rate=0.1), loss_fn=loss_fn)
+    bstep((x, y))
+    for k in astep.params:
+        np.testing.assert_allclose(np.asarray(astep.params[k]),
+                                   np.asarray(bstep.params[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_fleet_gradient_merge_wiring(mesh8):
+    """strategy.gradient_merge reaches DistributedTrainStep."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.optimizer import SGD
+
+    s = DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 3, "avg": False}
+    fleet._fleet_state.update(strategy=s)
+    with mesh_scope(mesh8):
+        step = fleet.distributed_model(MLP(), SGD(learning_rate=0.1),
+                                       loss_fn=lambda o, b: jnp.mean(o ** 2))
+    assert step.grad_accum_steps == 3 and step.grad_accum_avg is False
+    fleet._fleet_state.update(strategy=None)

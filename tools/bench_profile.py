@@ -1,0 +1,86 @@
+"""Break down the b8 bench step: fwd / fwd+bwd / full step, flash variants.
+
+Run: python -m tools.bench_profile
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import amp
+from paddle_tpu.framework.jit import TrainStep
+from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                   gpt_flops_per_token, gpt_loss_fn)
+from paddle_tpu.nn.layer import buffer_state, functional_call, param_state
+from paddle_tpu.optimizer import AdamW
+from bench import _chip_peak_flops
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree.map(lambda x: x.block_until_ready()
+                 if hasattr(x, "block_until_ready") else x, out)
+    # host-read sync (block_until_ready is unreliable through the tunnel)
+    leaf = jax.tree.leaves(out)[0]
+    float(np.asarray(leaf).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    leaf = jax.tree.leaves(out)[0]
+    float(np.asarray(leaf).reshape(-1)[0])
+    return (time.perf_counter() - t0) / n
+
+
+def main(batch=8, seq=1024, flash=True, loss_chunk=256):
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=16, max_position_embeddings=seq,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=flash, loss_chunk=loss_chunk,
+                    dtype="bfloat16")
+    paddle_tpu.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01)
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    params = jax.tree.map(lambda x: jnp.array(x, copy=True), param_state(model))
+    buffers = buffer_state(model)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    tok = batch * seq
+    fpt = gpt_flops_per_token(cfg, seq)
+    peak = _chip_peak_flops()
+
+    @jax.jit
+    def fwd(p, ids):
+        out, _ = functional_call(model, p, buffers, ids, ids)
+        return out
+
+    @jax.jit
+    def fwdbwd(p, ids):
+        def loss(p):
+            out, _ = functional_call(model, p, buffers, ids, ids)
+            return out
+
+        l, g = jax.value_and_grad(loss)(p)
+        return l, g
+
+    t_f = timeit(fwd, params, ids)
+    print(f"fwd          {t_f*1e3:8.2f} ms  ({tok/t_f:9.0f} tok/s, "
+          f"'fwd-MFU' {tok/t_f*fpt/3*1/peak:.3f} of peak w/ 2N/tok)")
+    t_fb = timeit(fwdbwd, params, ids)
+    print(f"fwd+bwd      {t_fb*1e3:8.2f} ms  (MFU {tok/t_fb*fpt/peak:.4f})")
+
+    step = TrainStep(model, opt, loss_fn=None)
+    t_s = timeit(lambda b: step(b), (np.asarray(ids), np.asarray(ids)))
+    print(f"full step    {t_s*1e3:8.2f} ms  (MFU {tok/t_s*fpt/peak:.4f}) "
+          f"[optimizer+transfer overhead {100*(t_s-t_fb)/t_s:.1f}%]")
+
+
+if __name__ == "__main__":
+    import sys
+
+    flash = "--noflash" not in sys.argv
+    main(flash=flash)

@@ -1,0 +1,113 @@
+"""MFU sweep over GPT configs on the available chip (VERDICT r1 weak #1).
+
+Usage: python -m tools.bench_sweep [one <label>]
+Each config runs in its own subprocess (isolates HBM + compile-helper state).
+Not part of the driver bench — a tuning tool for picking bench.py's config.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+CONFIGS = [
+    # label, hidden, layers, batch, seq, remat, flash, loss_chunk
+    ("base_h1024_b8", 1024, 24, 8, 1024, False, True, 0),
+    ("h1024_b8_fused", 1024, 24, 8, 1024, False, True, 256),
+    ("h1024_b16_fused", 1024, 24, 16, 1024, False, True, 256),
+    ("h1024_b24_fused", 1024, 24, 24, 1024, False, True, 256),
+    ("h1024_b32_fused", 1024, 24, 32, 1024, False, True, 256),
+    ("h1024_b32_remat_fused", 1024, 24, 32, 1024, True, True, 256),
+    ("h1024_b64_remat_fused", 1024, 24, 64, 1024, True, True, 256),
+    ("h1024_b8_s2048_fused", 1024, 24, 8, 2048, False, True, 256),
+    ("h1536_b16_fused", 1536, 24, 16, 1024, False, True, 256),
+    ("h1024_b16_fused_c128", 1024, 24, 16, 1024, False, True, 128),
+    ("h1024_b16_fused_c512", 1024, 24, 16, 1024, False, True, 512),
+    ("h1024_b12_fused", 1024, 24, 12, 1024, False, True, 256),
+    ("h1024_b16_dots", 1024, 24, 16, 1024, "dots", True, 256),
+    ("h1024_b32_dots", 1024, 24, 32, 1024, "dots", True, 256),
+    ("h1024_b64_dots", 1024, 24, 64, 1024, "dots", True, 256),
+    ("h1536_b32_dots", 1536, 24, 32, 1024, "dots", True, 256),
+    ("h1024_b64_s2048_dots", 1024, 24, 64, 2048, "dots", True, 256),
+    ("h1024_b8_noflash_fused", 1024, 24, 8, 1024, False, False, 256),
+    ("h1024_b12_noflash_fused", 1024, 24, 12, 1024, False, False, 256),
+    ("h1024_b16_noflash_fused", 1024, 24, 16, 1024, False, False, 256),
+    ("h1024_b16_noflash_dots", 1024, 24, 16, 1024, "dots", False, 256),
+    ("h1024_b32_noflash_dots", 1024, 24, 32, 1024, "dots", False, 256),
+    ("h1536_b8_noflash_fused", 1536, 24, 8, 1024, False, False, 256),
+    ("h1024_b16_noflash_rattn", 1024, 24, 16, 1024, "attn", False, 256),
+    ("h1024_b32_noflash_rattn", 1024, 24, 32, 1024, "attn", False, 256),
+    ("h1024_b10_noflash_fused", 1024, 24, 10, 1024, False, False, 256),
+]
+
+
+def run_config(hidden, layers, batch, seq, remat, flash, loss_chunk=0, heads=16,
+               timed_steps=10, warmup=3, label=""):
+    import jax
+    import paddle_tpu
+    from paddle_tpu import amp
+    from paddle_tpu.framework.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       gpt_flops_per_token, gpt_loss_fn)
+    from paddle_tpu.optimizer import AdamW
+    from bench import _chip_peak_flops
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=seq,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_recompute=bool(remat) and remat != "attn",
+                    recompute_attn_only=remat == "attn",
+                    recompute_policy="save_dots_no_batch" if remat == "dots" else None,
+                    use_flash_attention=flash,
+                    loss_chunk=loss_chunk, dtype="bfloat16")
+    paddle_tpu.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01)
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    if loss_chunk:
+        # fused path: model consumes (ids, labels) and returns the loss
+        step = TrainStep(model, opt, loss_fn=None)
+    else:
+        step = TrainStep(model, opt, loss_fn=gpt_loss_fn(model))
+
+    rng = np.random.default_rng(0)
+    ids = np.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), np.int32)
+    batch_data = (ids, ids)
+    for _ in range(warmup):
+        loss = step(batch_data)
+    float(np.asarray(loss))
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        loss = step(batch_data)
+    float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+    tps = batch * seq * timed_steps / dt
+    mfu = tps * gpt_flops_per_token(cfg, seq) / _chip_peak_flops()
+    print(f"{label:24s} {tps:10.0f} tok/s  MFU {mfu:.4f}", flush=True)
+    return mfu
+
+
+def main():
+    if len(sys.argv) > 2 and sys.argv[1] == "one":
+        cfg = next(c for c in CONFIGS if c[0] == sys.argv[2])
+        label, h, l, b, s, r, f, lc = cfg
+        run_config(h, l, b, s, r, f, lc, label=label)
+        return
+    for cfg in CONFIGS:
+        label = cfg[0]
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.bench_sweep", "one", label],
+            capture_output=True, text=True, timeout=900)
+        out = [ln for ln in proc.stdout.splitlines() if "MFU" in ln]
+        if proc.returncode == 0 and out:
+            print(out[0], flush=True)
+        else:
+            err = (proc.stderr or "").strip().splitlines()
+            print(f"{label:24s} FAILED: {err[-1][:140] if err else 'no output'}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

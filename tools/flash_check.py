@@ -1,0 +1,76 @@
+"""Real-TPU flash-kernel validation (dropout needs the TPU PRNG, which has
+no CPU/interpret lowering — this complements tests/test_flash_attention.py).
+
+Run: python -m tools.flash_check
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.kernels import flash_attention as fa
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.default_rng(seed).standard_normal(shape),
+                       jnp.float32)
+
+
+def main():
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    B, H, L, D = 2, 4, 1024, 64
+    q, k, v = _rand((B, H, L, D), 0), _rand((B, H, L, D), 1), _rand((B, H, L, D), 2)
+
+    # fwd/bwd parity vs reference
+    o = fa.flash_attention_bhld(q, k, v, causal=True)
+    ref = fa.reference_attention_bhld(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(o - ref)))
+    print("fwd max err", err)
+    assert err < 2e-5, err
+
+    g = jax.grad(lambda *a: jnp.sum(fa.flash_attention_bhld(*a, causal=True) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(fa.reference_attention_bhld(*a, causal=True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g, gr, "qkv"):
+        e = float(jnp.max(jnp.abs(a - b)))
+        print(f"d{n} max err", e)
+        assert e < 5e-4, (n, e)
+
+    # bias path
+    bias = 0.5 * _rand((1, 1, L, L), 3)
+    o = fa.flash_attention_bhld(q, k, v, causal=True, bias=bias)
+    ref = fa.reference_attention_bhld(q, k, v, causal=True, bias=bias)
+    e = float(jnp.max(jnp.abs(o - ref)))
+    print("bias fwd max err", e)
+    assert e < 2e-5, e
+
+    # dropout: mean preserved (upscale_in_train), deterministic per seed,
+    # different across seeds, zero-fraction ~ p
+    p_drop = 0.2
+    o1 = fa.flash_attention_bhld(q, k, v, causal=True, dropout_p=p_drop, seed=7)
+    o2 = fa.flash_attention_bhld(q, k, v, causal=True, dropout_p=p_drop, seed=7)
+    o3 = fa.flash_attention_bhld(q, k, v, causal=True, dropout_p=p_drop, seed=8)
+    assert float(jnp.max(jnp.abs(o1 - o2))) == 0.0, "dropout not deterministic per seed"
+    assert float(jnp.max(jnp.abs(o1 - o3))) > 0.0, "dropout ignores seed"
+    rel = abs(float(o1.mean()) - float(o.mean() if False else ref.mean()))
+    print("dropout mean |drop - ref|:", rel, "(ref mean", float(ref.mean()), ")")
+    # dropout bwd runs and is finite
+    gd = jax.grad(lambda q: jnp.sum(fa.flash_attention_bhld(
+        q, k, v, causal=True, dropout_p=p_drop, seed=7) ** 2))(q)
+    assert bool(jnp.isfinite(gd).all())
+    print("dropout bwd finite OK")
+
+    # traced seed: no retrace across seeds inside jit
+    @jax.jit
+    def step(q, seed):
+        return fa.flash_attention_bhld(q, k, v, causal=True, dropout_p=p_drop,
+                                       seed=seed).sum()
+
+    s1 = step(q, jnp.int32(1))
+    s2 = step(q, jnp.int32(2))
+    assert float(s1) != float(s2)
+    print("traced-seed jit OK; all flash TPU checks passed")
+
+
+if __name__ == "__main__":
+    main()
