@@ -49,6 +49,7 @@ from .hapi import InputSpec, Model, flops, summary  # noqa: F401
 from . import jit  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
+from . import eager  # noqa: F401  (Tensor.backward dygraph facade)
 
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax

@@ -1,0 +1,537 @@
+"""Eager dygraph ergonomics: ``Tensor`` with ``.backward()`` / ``.grad``.
+
+Reference parity: the eager autograd engine —
+``paddle/fluid/eager/backward.cc:393`` (``egr::Backward`` queue-based topo
+traversal over ``GradNodeBase``) and the python ``Tensor.backward`` patch
+(``python/paddle/fluid/dygraph/varbase_patch_methods.py:224``).
+
+TPU-native redesign: instead of 21k LoC of per-op GradNode classes, every
+eager op executes through ``jax.vjp`` — the op IS its own grad node. A
+:class:`Tensor` wraps a ``jax.Array`` plus a tape node (the vjp closure and
+its parent tensors); ``backward()`` runs the same reverse topological
+accumulation the reference does, seeding with ones. A whole ``nn.Layer``
+call is ONE tape node (vjp over ``functional_call``), so layer parameters
+get ``.grad``-style accumulation without per-op Python dispatch overhead —
+the eager path stays usable while ``jit``/TrainStep remains the perf path.
+
+Usage (ported paddle script shape)::
+
+    import paddle_tpu as pt
+    pt.eager.enable()                 # install Tensor-aware dispatch
+    model = MyNet()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model)
+    for x, y in loader:
+        out = model(pt.eager.to_tensor(x))
+        loss = F.cross_entropy(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Tensor", "to_tensor", "enable", "enabled", "no_grad", "grads_of",
+    "clear_grads", "apply_op",
+]
+
+_state = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """``paddle.no_grad`` analogue for the eager tape."""
+    prev = _grad_enabled()
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+class _Node:
+    """One tape entry: a vjp closure + the tensors/param-sinks it feeds."""
+
+    __slots__ = ("vjp_fn", "parents", "out_treedef")
+
+    def __init__(self, vjp_fn, parents):
+        self.vjp_fn = vjp_fn
+        self.parents = parents  # list of Tensor | _ParamSink
+
+
+class _ParamSink:
+    """Grad destination for a Layer's parameter pytree (one per layer call)."""
+
+    __slots__ = ("layer", )
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def deposit(self, grads: Dict[str, Any]):
+        store = getattr(self.layer, "_eager_grads", None)
+        if store is None:
+            store = {}
+            object.__setattr__(self.layer, "_eager_grads", store)
+        for k, g in grads.items():
+            if g is None:
+                continue
+            store[k] = g if k not in store else store[k] + g
+
+
+class Tensor:
+    """Eager tensor: a ``jax.Array`` + autograd metadata.
+
+    ``stop_gradient`` follows paddle semantics (True by default for data;
+    ops that depend on a grad-requiring input produce grad-requiring
+    outputs)."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node")
+
+    def __init__(self, data, stop_gradient: bool = True, _node: Optional[_Node] = None):
+        self._data = data if isinstance(data, jax.Array) else jnp.asarray(data)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = _node
+
+    # ------------------------------------------------------------- basics
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.ndim else 1
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True)
+
+    def clone(self) -> "Tensor":
+        return apply_op(lambda x: x * 1, self)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def astype(self, dtype) -> "Tensor":
+        from ..framework.dtype import convert_dtype
+
+        return apply_op(lambda x: x.astype(convert_dtype(dtype)), self)
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={self._data.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n{np.asarray(self._data)})")
+
+    def __len__(self):
+        return self._data.shape[0]
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    # ------------------------------------------------------------ backward
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """Reverse accumulation from this tensor (reference
+        ``egr::Backward``): topological walk over tape nodes, cotangent
+        accumulation per tensor, leaf grads deposited on ``.grad`` /
+        layer parameter stores."""
+        if self._node is None and self.stop_gradient:
+            raise RuntimeError("backward() on a tensor with no grad history")
+        seed = (jnp.ones_like(self._data) if grad_tensor is None
+                else jnp.asarray(getattr(grad_tensor, "_data", grad_tensor)))
+
+        # topo order over the Tensor graph
+        order: List[Tensor] = []
+        seen = set()
+
+        def visit(t: "Tensor"):
+            if id(t) in seen or t._node is None:
+                return
+            seen.add(id(t))
+            for p in t._node.parents:
+                if isinstance(p, Tensor):
+                    visit(p)
+            order.append(t)
+
+        visit(self)
+        cotangents: Dict[int, Any] = {id(self): seed}
+        for t in reversed(order):
+            ct = cotangents.pop(id(t), None)
+            if ct is None:
+                continue
+            parent_cts = t._node.vjp_fn(ct)
+            for p, pct in zip(t._node.parents, parent_cts):
+                if pct is None:
+                    continue
+                if isinstance(p, _ParamSink):
+                    p.deposit(pct)
+                elif isinstance(p, Tensor):
+                    if p._node is not None:
+                        cur = cotangents.get(id(p))
+                        cotangents[id(p)] = pct if cur is None else cur + pct
+                    if not p.stop_gradient:
+                        p.grad = pct if p.grad is None else p.grad + pct
+            if not retain_graph:
+                t._node = None
+
+    # ---------------------------------------------------------- operators
+    def _binop(self, other, fn):
+        return apply_op(fn, self, other)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return apply_op(jnp.subtract, o, self)
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        return apply_op(jnp.divide, o, self)
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul)
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __getitem__(self, idx):
+        return apply_op(lambda x: x[idx], self)
+
+    def __eq__(self, o):  # noqa: E501 comparison returns data tensor (no grad)
+        return Tensor(self._data == _unwrap(o))
+
+    def __ne__(self, o):
+        return Tensor(self._data != _unwrap(o))
+
+    def __lt__(self, o):
+        return Tensor(self._data < _unwrap(o))
+
+    def __le__(self, o):
+        return Tensor(self._data <= _unwrap(o))
+
+    def __gt__(self, o):
+        return Tensor(self._data > _unwrap(o))
+
+    def __ge__(self, o):
+        return Tensor(self._data >= _unwrap(o))
+
+    def __hash__(self):
+        return id(self)
+
+    # common methods routed through the tape
+    def reshape(self, shape):
+        return apply_op(lambda x: jnp.reshape(x, shape), self)
+
+    def transpose(self, perm=None):
+        return apply_op(lambda x: jnp.transpose(x, perm), self)
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        from .. import ops
+
+        return apply_op(lambda x: ops.flatten(x, start_axis, stop_axis), self)
+
+    def sum(self, axis=None, keepdim=False):
+        return apply_op(lambda x: jnp.sum(x, axis=axis, keepdims=keepdim), self)
+
+    def mean(self, axis=None, keepdim=False):
+        return apply_op(lambda x: jnp.mean(x, axis=axis, keepdims=keepdim), self)
+
+    def max(self, axis=None, keepdim=False):
+        return apply_op(lambda x: jnp.max(x, axis=axis, keepdims=keepdim), self)
+
+    def min(self, axis=None, keepdim=False):
+        return apply_op(lambda x: jnp.min(x, axis=axis, keepdims=keepdim), self)
+
+    def matmul(self, other):
+        return self.__matmul__(other)
+
+    def __getattr__(self, name):
+        # delegate unknown methods to paddle_tpu.ops through the tape
+        from .. import ops
+
+        fn = getattr(ops, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError(name)
+
+        def method(*args, **kwargs):
+            return apply_op(fn, self, *args, **kwargs)
+
+        return method
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _requires_grad(t: Tensor) -> bool:
+    return (not t.stop_gradient) or t._node is not None
+
+
+def to_tensor(data, dtype=None, stop_gradient: bool = True) -> Tensor:
+    from ..framework.dtype import convert_dtype
+
+    arr = jnp.asarray(_unwrap(data))
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype))
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def apply_op(fn: Callable, *args, **kwargs) -> Any:
+    """Execute ``fn`` on unwrapped arrays, recording a tape node when any
+    Tensor argument requires grad. Non-Tensor args pass through; Tensor
+    kwargs are unwrapped without grad tracking."""
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    diff_pos = [i for i in tensor_pos
+                if _grad_enabled() and _requires_grad(args[i])]
+    kw = {k: _unwrap(v) for k, v in kwargs.items()}
+
+    if not diff_pos:
+        out = fn(*[_unwrap(a) for a in args], **kw)
+        return _wrap_out(out, node=None)
+
+    fixed = list(args)
+
+    def call(*diff_vals):
+        xs = list(fixed)
+        for i, v in zip(diff_pos, diff_vals):
+            xs[i] = v
+        return fn(*[_unwrap(a) for a in xs], **kw)
+
+    primals = tuple(args[i]._data for i in diff_pos)
+    out, vjp_fn = jax.vjp(call, *primals)
+    node = _Node(vjp_fn, [args[i] for i in diff_pos])
+    return _wrap_out(out, node)
+
+
+def _wrap_out(out, node):
+    if isinstance(out, (tuple, list)):
+        # multi-output: each element shares the node; backward seeds zeros
+        # for the siblings of the tensor actually differentiated
+        return type(out)(_wrap_single(o, node, out, i) for i, o in enumerate(out))
+    return _wrap_single(out, node, None, None)
+
+
+def _wrap_single(o, node, siblings, idx):
+    if not hasattr(o, "ndim"):
+        return o
+    if node is None:
+        return Tensor(o)
+    if siblings is None:
+        return Tensor(o, stop_gradient=False, _node=node)
+
+    # wrap element of a tuple output: vjp expects the full tuple cotangent
+    def elem_vjp(ct, _vjp=node.vjp_fn, _idx=idx, _sib=siblings):
+        full = tuple(ct if j == _idx else jnp.zeros_like(s)
+                     for j, s in enumerate(_sib))
+        return _vjp(full)
+
+    return Tensor(o, stop_gradient=False, _node=_Node(elem_vjp, node.parents))
+
+
+# --------------------------------------------------------- layer integration
+def eager_layer_call(layer, *args, **kwargs):
+    """Run a whole Layer as ONE tape op: vjp over functional_call. Buffers
+    (BN stats...) update eagerly on the layer, matching dygraph."""
+    from ..nn.layer import buffer_state, functional_call, param_state
+
+    params = param_state(layer)
+    buffers = buffer_state(layer)
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    diff_pos = [i for i in tensor_pos
+                if _grad_enabled() and _requires_grad(args[i])]
+    track_params = _grad_enabled() and not getattr(layer, "stop_gradient", False)
+
+    if not track_params and not diff_pos:
+        out, new_buf = functional_call(
+            layer, params, buffers,
+            *[_unwrap(a) for a in args], **{k: _unwrap(v) for k, v in kwargs.items()})
+        _write_buffers(layer, new_buf)
+        return _wrap_out(out, None)
+
+    fixed = list(args)
+    kw = {k: _unwrap(v) for k, v in kwargs.items()}
+
+    def call(p, *diff_vals):
+        xs = list(fixed)
+        for i, v in zip(diff_pos, diff_vals):
+            xs[i] = v
+        out, new_buf = functional_call(layer, p, buffers,
+                                       *[_unwrap(a) for a in xs], **kw)
+        return out, new_buf
+
+    primals = (params,) + tuple(args[i]._data for i in diff_pos)
+    (out, new_buf), vjp_fn = jax.vjp(call, *primals, has_aux=False)
+
+    # vjp over (out, new_buf): cotangent for new_buf is zeros
+    def out_vjp(ct, _vjp=vjp_fn, _buf=new_buf):
+        zeros_buf = jax.tree.map(jnp.zeros_like, _buf)
+        cts = _vjp((ct, zeros_buf))
+        return cts
+
+    _write_buffers(layer, new_buf)
+    parents = [_ParamSink(layer)] + [args[i] for i in diff_pos]
+    return _wrap_out(out, _Node(out_vjp, parents))
+
+
+def _write_buffers(layer, new_buf: Dict[str, Any]):
+    for name, v in new_buf.items():
+        layer._set_by_path(name, v)
+
+
+def grads_of(layer) -> Dict[str, Any]:
+    """Accumulated eager grads for a layer's parameters (path -> array)."""
+    return dict(getattr(layer, "_eager_grads", {}) or {})
+
+
+def clear_grads(layer):
+    if getattr(layer, "_eager_grads", None):
+        layer._eager_grads.clear()
+
+
+# --------------------------------------------------------------- dispatch
+_enabled = [False]
+
+
+def enabled() -> bool:
+    return _enabled[0]
+
+
+def enable():
+    """Install eager dispatch: Layer.__call__ becomes Tensor-aware and the
+    stateful Optimizer step consumes layer grads. Idempotent. The jit /
+    TrainStep path is untouched (it never sees Tensor wrappers)."""
+    if _enabled[0]:
+        return
+    from ..nn import layer as layer_mod
+    from ..optimizer import optimizer as opt_mod
+
+    orig_call = layer_mod.Layer.__call__
+
+    def call(self, *args, **kwargs):
+        if any(isinstance(a, Tensor) for a in args) or \
+           any(isinstance(v, Tensor) for v in kwargs.values()):
+            for hook in self._forward_pre_hooks.values():
+                res = hook(self, args)
+                if res is not None:
+                    args = res if isinstance(res, tuple) else (res,)
+            out = eager_layer_call(self, *args, **kwargs)
+            for hook in self._forward_post_hooks.values():
+                res = hook(self, args, out)
+                if res is not None:
+                    out = res
+            return out
+        return orig_call(self, *args, **kwargs)
+
+    layer_mod.Layer.__call__ = call
+
+    # optimizer: step() over a bound Layer pulls eager grads
+    orig_step = opt_mod.Optimizer.step
+
+    def step(self, params=None, grads=None):
+        target = self._parameters
+        if params is None and grads is None and isinstance(target, layer_mod.Layer):
+            from ..nn.layer import param_state
+
+            model = target
+            params = param_state(model)
+            grads = {k: getattr(model, "_eager_grads", {}).get(k) for k in params}
+            grads = {k: (g if g is not None else jnp.zeros_like(params[k]))
+                     for k, g in grads.items()}
+            if self._state is None:
+                self._state = self.init(params)
+            new_params, self._state = self.update(grads, self._state, params)
+            for k, v in new_params.items():
+                model._set_by_path(k, v)
+            clear_grads(model)
+            return new_params
+        return orig_step(self, params=params, grads=grads)
+
+    opt_mod.Optimizer.step = step
+
+    orig_clear = opt_mod.Optimizer.clear_grad
+
+    def clear_grad(self, set_to_zero=True):
+        if isinstance(self._parameters, layer_mod.Layer):
+            clear_grads(self._parameters)
+        return orig_clear(self, set_to_zero=set_to_zero)
+
+    opt_mod.Optimizer.clear_grad = clear_grad
+
+    # nn.functional + ops become Tensor-aware
+    from .. import ops as ops_pkg
+    from ..nn import functional as F
+
+    _wrap_module(F)
+    _wrap_module(ops_pkg)
+    _enabled[0] = True
+
+
+def _wrap_module(mod):
+    """Wrap a module's public callables with Tensor-aware dispatch (original
+    behavior preserved when no Tensor is passed)."""
+    for name in dir(mod):
+        if name.startswith("_"):
+            continue
+        fn = getattr(mod, name)
+        if not callable(fn) or isinstance(fn, type) or hasattr(fn, "__eager_wrapped__"):
+            continue
+
+        def make(fn):
+            def wrapped(*args, **kwargs):
+                if any(isinstance(a, Tensor) for a in args) or \
+                   any(isinstance(v, Tensor) for v in kwargs.values()):
+                    return apply_op(fn, *args, **kwargs)
+                return fn(*args, **kwargs)
+
+            wrapped.__eager_wrapped__ = True
+            wrapped.__name__ = getattr(fn, "__name__", "op")
+            wrapped.__doc__ = fn.__doc__
+            return wrapped
+
+        try:
+            setattr(mod, name, make(fn))
+        except (AttributeError, TypeError):
+            pass

@@ -1,0 +1,158 @@
+"""Eager dygraph ergonomics tests (VERDICT r1 item 5).
+
+Reference semantics being matched: ``varbase_patch_methods.py:224``
+(``Tensor.backward``) + ``egr::Backward`` reverse accumulation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import eager
+from paddle_tpu.optimizer import SGD, AdamW
+
+
+@pytest.fixture(autouse=True)
+def _enable():
+    eager.enable()
+    yield
+
+
+def test_tensor_basics():
+    t = eager.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert t.shape == [2, 3]
+    assert t.stop_gradient
+    assert float(t.sum()) == 15.0
+    np.testing.assert_allclose((t + 1).numpy(), t.numpy() + 1)
+    np.testing.assert_allclose((t * 2 - t).numpy(), t.numpy())
+
+
+def test_backward_simple():
+    x = eager.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [2.0, 4.0, 6.0])
+
+
+def test_backward_chain_and_accumulation():
+    x = eager.to_tensor([2.0], stop_gradient=False)
+    (x * 3).backward()
+    (x * 5).backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [8.0])  # 3 + 5
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    with eager.no_grad():
+        y = x * 2
+    assert y._node is None
+
+
+def test_branching_graph():
+    """Diamond graph: z = x*y + x."""
+    x = eager.to_tensor([3.0], stop_gradient=False)
+    y = eager.to_tensor([4.0], stop_gradient=False)
+    z = x * y + x
+    z.backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [5.0])  # y + 1
+    np.testing.assert_allclose(np.asarray(y.grad), [3.0])  # x
+
+
+def test_layer_backward_and_grads():
+    pt.seed(0)
+    fc = nn.Linear(4, 2)
+    x = eager.to_tensor(np.random.randn(3, 4).astype(np.float32))
+    out = fc(x)
+    assert isinstance(out, eager.Tensor)
+    loss = (out * out).mean()
+    loss.backward()
+    g = eager.grads_of(fc)
+    assert set(g) == {"weight", "bias"}
+    assert float(jnp.abs(g["weight"]).sum()) > 0
+
+    # parity with jax.grad over functional_call
+    from paddle_tpu.nn import functional_call, param_state
+
+    params = param_state(fc)
+
+    def ref_loss(p):
+        o, _ = functional_call(fc, p, {}, jnp.asarray(x.numpy()))
+        return jnp.mean(o * o)
+
+    ref = jax.grad(ref_loss)(params)
+    np.testing.assert_allclose(np.asarray(g["weight"]), np.asarray(ref["weight"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_functional_dispatch():
+    x = eager.to_tensor(np.random.randn(2, 5).astype(np.float32),
+                        stop_gradient=False)
+    out = F.relu(x)
+    assert isinstance(out, eager.Tensor)
+    out.sum().backward()
+    assert x.grad is not None
+
+
+def test_reference_style_training_loop_matches_trainstep():
+    """model -> loss.backward() -> opt.step() matches TrainStep losses."""
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((4, 8, 8)).astype(np.float32)
+    ys = rng.integers(0, 4, (4, 8))
+
+    pt.seed(7)
+    model_a = Net()
+    model_b = Net()
+    model_b.set_state_dict(model_a.state_dict())
+
+    # eager reference-style loop
+    opt = SGD(learning_rate=0.1, parameters=model_a)
+    eager_losses = []
+    for x, y in zip(xs, ys):
+        out = model_a(eager.to_tensor(x))
+        loss = F.cross_entropy(out, eager.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        eager_losses.append(float(loss))
+
+    # compiled TrainStep
+    from paddle_tpu.framework.jit import TrainStep
+
+    step = TrainStep(model_b, SGD(learning_rate=0.1),
+                     loss_fn=lambda out, b: F.cross_entropy(out, b[1]))
+    jit_losses = [float(step((x, y))) for x, y in zip(xs, ys)]
+
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_buffers_update_eagerly():
+    bn = nn.BatchNorm1D(4)
+    x = eager.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    before = np.asarray(bn._buffers["_mean"]).copy()
+    bn(x)
+    after = np.asarray(bn._buffers["_mean"])
+    assert not np.allclose(before, after)
+
+
+def test_ops_method_delegation():
+    x = eager.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                        stop_gradient=False)
+    out = x.exp()
+    assert isinstance(out, eager.Tensor)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), np.exp(x.numpy()), rtol=1e-5)
