@@ -1,18 +1,34 @@
 """Pipeline parallelism.
 
 Reference parity: ``fleet/meta_parallel/pipeline_parallel.py`` (1F1B python
-scheduler ``forward_backward_pipeline:117`` driving NCCL P2P), model surgery
+scheduler ``forward_backward_pipeline:117`` driving NCCL P2P,
+``PipelineParallelWithInterleave:461`` virtual stages), model surgery
 ``parallel_layers/pp_layers.py`` (``LayerDesc:56``, ``SegmentLayers:92``,
 ``PipelineLayer:208``), and the ``SendRecvMeta`` shape handshake.
 
 TPU-native redesign: there is no multi-process scheduler to write. All "pp"
 ranks execute ONE SPMD program; stage weights are stacked on a leading
-layer axis sharded over "pp"; the microbatch schedule is a ``lax.scan`` whose
-carried activation rotates around the ring via ``ppermute`` (ICI
-neighbor-hop). Autodiff through the scan generates the reverse-order backward
-schedule — the hand-written ``backward_step`` machinery of the reference
-falls out of ``jax.grad``. ``jax.checkpoint`` on the stage body keeps memory
-at GPipe levels (per-stage activation stash of in-flight microbatches only).
+layer axis sharded over "pp"; the microbatch schedule is a ``lax.scan``
+whose carried activation rotates around the ring via ``ppermute`` (ICI
+neighbor-hop). Autodiff through the scan generates the reverse-order
+backward schedule — the hand-written ``backward_step`` machinery of the
+reference falls out of ``jax.grad``.
+
+Memory model (the 1F1B property): with ``remat=True`` the stage body is
+``jax.checkpoint``-ed, so in-flight *internal* activations are O(1)
+microbatches per stage regardless of ``num_micro`` — strictly better than
+1F1B's O(pp) stash (only the per-microbatch stage *boundary* tensors are
+carried, which any schedule must hold). See
+``tests/test_pipeline.py::test_pipeline_memory_bounded`` for the compiled
+HBM assertion.
+
+Interleaved virtual stages (reference ``PipelineParallelWithInterleave``):
+``num_virtual_stages=v`` gives each device v non-contiguous layer chunks
+(device d owns global stages d, pp+d, 2*pp+d, ...). Microbatches run in
+depth-first bursts of ``pp``: within one scan a burst crosses all ``v*pp``
+virtual stages, each tick advancing one ring hop and selecting the chunk
+``(t - d) // pp`` locally — conflict-free, one microbatch per device per
+tick.
 
 The shape handshake (``SendRecvMeta``) disappears: shapes are static.
 """
@@ -100,13 +116,26 @@ class SegmentLayers:
 
 
 # --------------------------------------------------------- SPMD pipelining
-def _stack_params(layers: Sequence[Layer]):
-    """Stack homogeneous layers' params/buffers along a leading axis."""
+def _stack_params(layers: Sequence[Layer], order: Sequence[int]):
+    """Stack homogeneous layers' params along a leading axis in ``order``."""
     states = [param_state(l) for l in layers]
     keys = list(states[0].keys())
     for s in states:
         assert list(s.keys()) == keys, "pipeline stages must be homogeneous"
-    return {k: jnp.stack([s[k] for s in states]) for k in keys}
+    return {k: jnp.stack([states[i][k] for i in order]) for k in keys}
+
+
+def _virtual_order(num_layers: int, pp: int, v: int) -> List[int]:
+    """Stack order for interleaved virtual stages: device d's shard (stack
+    rows [d*L/pp, (d+1)*L/pp)) holds its v chunks contiguously — chunk j of
+    device d is global stage j*pp + d (reference interleave layout)."""
+    lps = num_layers // (pp * v)  # layers per chunk
+    order = []
+    for d in range(pp):
+        for j in range(v):
+            g = j * pp + d  # global stage index
+            order.extend(range(g * lps, (g + 1) * lps))
+    return order
 
 
 class PipelineStagedModule(Layer):
@@ -118,10 +147,14 @@ class PipelineStagedModule(Layer):
     With no mesh or pp=1 it degrades to a plain scan over layers (single-chip
     correctness path — loss parity with the distributed run is the
     ``TestDistBase`` pattern from SURVEY §4).
+
+    ``num_virtual_stages`` > 1 enables the interleaved schedule (see module
+    docstring).
     """
 
     def __init__(self, block_fn_layer: Layer, num_layers: int, num_micro: int = 1,
-                 remat: bool = True, block_factory: Optional[Callable[[], Layer]] = None):
+                 remat: bool = True, block_factory: Optional[Callable[[], Layer]] = None,
+                 num_virtual_stages: int = 1):
         """``block_factory`` (e.g. a LayerDesc.build_layer) constructs each
         block with its own initializer draws; without it, blocks are deep
         copies of the template (identical initial weights, torch-deepcopy
@@ -138,6 +171,7 @@ class PipelineStagedModule(Layer):
         self.num_layers = num_layers
         self.num_micro = num_micro
         self.remat = remat
+        self.num_virtual_stages = int(num_virtual_stages)
         if list(block_fn_layer.named_buffers()):
             raise ValueError(
                 "PipelineStagedModule blocks must not hold buffers (running "
@@ -150,7 +184,17 @@ class PipelineStagedModule(Layer):
         else:
             blocks = [block_fn_layer] + [copy.deepcopy(block_fn_layer)
                                          for _ in range(num_layers - 1)]
-        stacked = _stack_params(blocks)
+        # stack rows are laid out so each pp shard holds its virtual chunks
+        # contiguously; identity when v == 1
+        self._order = list(range(num_layers))
+        pp = _pp_size()
+        if self.num_virtual_stages > 1 and pp > 1:
+            if num_layers % (pp * self.num_virtual_stages):
+                raise ValueError(
+                    f"num_layers ({num_layers}) must divide pp*virtual "
+                    f"({pp}*{self.num_virtual_stages})")
+            self._order = _virtual_order(num_layers, pp, self.num_virtual_stages)
+        stacked = _stack_params(blocks, self._order)
         for k, v in stacked.items():
             path = f"stacked__{k.replace('.', '__')}"
             self.add_parameter(path, v)
@@ -176,14 +220,19 @@ class PipelineStagedModule(Layer):
         mesh = require_mesh() if _has_pp() else None
         stacked = self._stacked()
         if mesh is None or mesh.shape.get("pp", 1) == 1:
-            # plain sequential scan over layers
+            # plain sequential scan over layers, in GLOBAL stage order
+            inv = np.argsort(self._order)
+            ordered = {k: v[jnp.asarray(inv)] if self._order != sorted(self._order) else v
+                       for k, v in stacked.items()}
+
             def body(h, layer_params):
                 return self._apply_block(layer_params, h), None
 
-            out, _ = lax.scan(body, x, stacked)
+            out, _ = lax.scan(body, x, ordered)
             return out
         return _pipeline_spmd(stacked, x, self._apply_block, mesh,
-                              self.num_micro, self.num_layers)
+                              self.num_micro, self.num_layers,
+                              self.num_virtual_stages)
 
 
 def _has_pp():
@@ -193,61 +242,96 @@ def _has_pp():
     return m is not None and "pp" in m.shape
 
 
-def _pipeline_spmd(stacked_params, x, apply_block, mesh, num_micro, num_layers):
+def _pp_size() -> int:
+    from ..mesh import get_mesh
+
+    m = get_mesh()
+    return m.shape.get("pp", 1) if m is not None else 1
+
+
+def _pipeline_spmd(stacked_params, x, apply_block, mesh, num_micro, num_layers,
+                   v=1):
+    """Interleaved ring schedule over the "pp" mesh axis.
+
+    Microbatches run in depth-first bursts of ``pp``: within a burst's scan,
+    tick t advances every in-flight microbatch one ring hop; device d
+    processes its local chunk ``(t - d) // pp`` (0 when v == 1). Outputs
+    appear on the last device after ``v*pp`` hops."""
     pp = mesh.shape["pp"]
-    assert num_layers % pp == 0, \
-        f"pp axis size ({pp}) must divide num_layers ({num_layers})"
+    assert num_layers % (pp * v) == 0, \
+        f"pp*virtual ({pp}*{v}) must divide num_layers ({num_layers})"
     B = x.shape[0]
     assert B % num_micro == 0, \
         f"num_micro ({num_micro}) must divide batch size ({B})"
     mb = B // num_micro
-    layers_per_stage = num_layers // pp
+    lpc = num_layers // (pp * v)  # layers per chunk
 
-    # [M, mb, ...] microbatch leading axis
     x_mb = x.reshape(num_micro, mb, *x.shape[1:])
 
-    param_specs = {k: P("pp", *([None] * (v.ndim - 1))) for k, v in stacked_params.items()}
-    # batch stays sharded over dp inside; replicated over pp
+    param_specs = {k: P("pp", *([None] * (val.ndim - 1)))
+                   for k, val in stacked_params.items()}
     in_specs = (param_specs, P(*([None] * (x_mb.ndim))))
     out_specs = P(*([None] * x_mb.ndim))
 
     def local(stage_params, mb_inputs):
-        # stage_params leaves: [layers_per_stage, ...]; mb_inputs: [M, mb, ...]
-        idx = lax.axis_index("pp")
-        n_ticks = num_micro + pp - 1
+        # stage_params leaves: [v*lpc, ...] local rows; mb_inputs: [M, mb, ...]
+        d = lax.axis_index("pp")
         perm = [(i, (i + 1) % pp) for i in range(pp)]
+        total_hops = v * pp
 
-        def run_stage(h):
-            def body(hh, lp):
+        def run_chunk(chunk_idx, h):
+            # local rows for this chunk: [chunk_idx*lpc, (chunk_idx+1)*lpc)
+            def body(hh, i):
+                lp = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, chunk_idx * lpc + i, axis=0, keepdims=False),
+                    stage_params)
                 return apply_block(lp, hh), None
 
-            out, _ = lax.scan(body, h, stage_params)
+            out, _ = lax.scan(body, h, jnp.arange(lpc))
             return out
 
         zero = jnp.zeros(mb_inputs.shape[1:], mb_inputs.dtype)
         outputs0 = jnp.zeros_like(mb_inputs)
 
-        def tick(carry, t):
-            incoming, outputs = carry
-            # stage 0 pulls microbatch t (clamped); others use the ring input
-            feed_idx = jnp.clip(t, 0, num_micro - 1)
-            first_in = lax.dynamic_index_in_dim(mb_inputs, feed_idx, axis=0,
-                                                keepdims=False)
-            h = jnp.where(idx == 0, first_in, incoming)
-            y = run_stage(h)
-            # last stage writes output for microbatch t-(pp-1) when valid
-            out_idx = jnp.clip(t - (pp - 1), 0, num_micro - 1)
-            valid = (idx == pp - 1) & (t >= pp - 1)
-            cur = lax.dynamic_index_in_dim(outputs, out_idx, axis=0, keepdims=False)
-            upd = jnp.where(valid, y, cur)
-            outputs = lax.dynamic_update_index_in_dim(outputs, upd, out_idx, axis=0)
-            nxt = lax.ppermute(y, "pp", perm)
-            return (nxt, outputs), None
+        def burst(outputs, b0, burst_size):
+            """One depth-first burst of ``burst_size`` (<= pp) microbatches
+            starting at global microbatch b0."""
+            n_ticks = total_hops + burst_size - 1
 
-        (_, outputs), _ = lax.scan(tick, (zero, outputs0), jnp.arange(n_ticks))
+            def tick(carry, t):
+                incoming, outputs = carry
+                # device 0 feeds fresh microbatch t (chunk 0) while t < size
+                feed_idx = jnp.clip(b0 + t, 0, num_micro - 1)
+                first_in = lax.dynamic_index_in_dim(mb_inputs, feed_idx, axis=0,
+                                                    keepdims=False)
+                fresh = (d == 0) & (t < burst_size)
+                h = jnp.where(fresh, first_in, incoming)
+                # chunk this device runs at tick t
+                c = jnp.clip((t - d) // pp, 0, v - 1) if v > 1 else 0
+                y = run_chunk(c, h) if v > 1 else run_chunk(0, h)
+                # last device at its last chunk emits microbatch t-(total_hops-1)
+                out_m = jnp.clip(b0 + t - (total_hops - 1), 0, num_micro - 1)
+                valid = (d == pp - 1) & (t >= total_hops - 1)
+                cur = lax.dynamic_index_in_dim(outputs, out_m, axis=0, keepdims=False)
+                upd = jnp.where(valid, y, cur)
+                outputs = lax.dynamic_update_index_in_dim(outputs, upd, out_m, axis=0)
+                nxt = lax.ppermute(y, "pp", perm)
+                return (nxt, outputs), None
+
+            (_, outputs), _ = lax.scan(tick, (zero, outputs), jnp.arange(n_ticks))
+            return outputs
+
+        # v == 1: the continuous schedule is conflict-free, one burst of all
+        # microbatches (bubble pp-1 total). v > 1: depth-first bursts of pp.
+        step = num_micro if v == 1 else pp
+        outputs = outputs0
+        for b0 in range(0, num_micro, step):
+            outputs = burst(outputs, b0, min(step, num_micro - b0))
+
         # every rank returns its buffer; only the last rank's is real.
         # psum after masking replicates the result (out_specs replicated).
-        outputs = jnp.where(idx == pp - 1, outputs, jnp.zeros_like(outputs))
+        outputs = jnp.where(d == pp - 1, outputs, jnp.zeros_like(outputs))
         return lax.psum(outputs, "pp")
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -256,12 +340,111 @@ def _pipeline_spmd(stacked_params, x, apply_block, mesh, num_micro, num_layers):
     return out_mb.reshape(B, *out_mb.shape[2:])
 
 
+# ------------------------------------------------ heterogeneous stage path
+class HeterogeneousPipeline(Layer):
+    """Pipeline over ARBITRARY per-stage layers (different classes/shapes of
+    compute, same activation signature between stages).
+
+    Reference parity: ``PipelineLayer`` supports non-uniform stages because
+    each process builds only its own sublayers. In SPMD there is one
+    program, so every stage's computation is compiled into a ``lax.switch``
+    and each device executes only its branch at runtime. Parameters of all
+    stages live on all pp ranks (replicated over "pp") — acceptable for
+    moderate models; use PipelineStagedModule for the homogeneous bulk.
+
+    Stages must map [mb, ...] -> [mb, ...] with a fixed activation shape.
+    """
+
+    def __init__(self, stages: Sequence[Layer], num_micro: int = 1, remat: bool = True):
+        super().__init__()
+        from ...nn.layers.containers import LayerList
+
+        self.stages = LayerList(list(stages))
+        self.num_micro = num_micro
+        self.remat = remat
+        for l in self.stages:
+            if list(l.named_buffers()):
+                raise ValueError("pipeline stages must be buffer-free")
+
+    def forward(self, x):
+        mesh = require_mesh() if _has_pp() else None
+        stages = list(self.stages)
+        if mesh is None or mesh.shape.get("pp", 1) == 1:
+            for l in stages:
+                x = l(x)
+            return x
+        pp = mesh.shape["pp"]
+        if len(stages) != pp:
+            raise ValueError(f"{len(stages)} stages != pp axis size {pp}")
+        B = x.shape[0]
+        num_micro = self.num_micro
+        assert B % num_micro == 0
+        mb = B // num_micro
+        x_mb = x.reshape(num_micro, mb, *x.shape[1:])
+
+        params = [param_state(l) for l in stages]
+        bufs = [buffer_state(l) for l in stages]
+        remat = self.remat
+
+        def make_branch(i):
+            def branch(all_params, h):
+                def run(p, hh):
+                    out, _ = functional_call(stages[i], p, bufs[i], hh)
+                    return out
+
+                if remat:
+                    run = jax.checkpoint(run)
+                return run(all_params[i], h)
+
+            return branch
+
+        branches = [make_branch(i) for i in range(pp)]
+
+        # params replicated over pp (heterogeneous pytrees can't shard on a
+        # stacked axis); other mesh axes still apply through GSPMD outside
+        in_specs = (P(), P(*([None] * x_mb.ndim)))
+        out_specs = P(*([None] * x_mb.ndim))
+
+        def local(all_params, mb_inputs):
+            d = lax.axis_index("pp")
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            n_ticks = num_micro + pp - 1
+            zero = jnp.zeros(mb_inputs.shape[1:], mb_inputs.dtype)
+            outputs0 = jnp.zeros_like(mb_inputs)
+
+            def tick(carry, t):
+                incoming, outputs = carry
+                feed_idx = jnp.clip(t, 0, num_micro - 1)
+                first_in = lax.dynamic_index_in_dim(mb_inputs, feed_idx, axis=0,
+                                                    keepdims=False)
+                h = jnp.where(d == 0, first_in, incoming)
+                y = lax.switch(d, branches, all_params, h)
+                out_idx = jnp.clip(t - (pp - 1), 0, num_micro - 1)
+                valid = (d == pp - 1) & (t >= pp - 1)
+                cur = lax.dynamic_index_in_dim(outputs, out_idx, axis=0, keepdims=False)
+                upd = jnp.where(valid, y, cur)
+                outputs = lax.dynamic_update_index_in_dim(outputs, upd, out_idx, axis=0)
+                nxt = lax.ppermute(y, "pp", perm)
+                return (nxt, outputs), None
+
+            (_, outputs), _ = lax.scan(tick, (zero, outputs0), jnp.arange(n_ticks))
+            outputs = jnp.where(d == pp - 1, outputs, jnp.zeros_like(outputs))
+            return lax.psum(outputs, "pp")
+
+        fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_vma=False)
+        out_mb = fn(params, x_mb)
+        return out_mb.reshape(B, *out_mb.shape[2:])
+
+
 class PipelineLayer(Layer):
     """Reference-shaped wrapper (``pp_layers.py:208``): build from LayerDescs,
     segment into stages. Homogeneous middle blocks run through
     PipelineStagedModule; leading/trailing non-uniform layers (embedding,
     head) run on every rank under plain GSPMD (cheap relative to the blocks,
-    and sharded over dp/mp anyway)."""
+    and sharded over dp/mp anyway). Tied embeddings (SharedLayerDesc) work
+    naturally: the shared weight lives in pre/post outside the stacked stage
+    params, so first/last-stage tying needs no grad-sync group."""
 
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  topology=None, loss_fn=None, seg_method="uniform",
@@ -273,11 +456,11 @@ class PipelineLayer(Layer):
                 "uniformly over the 'pp' mesh axis; custom seg_method is not "
                 "supported (stage count comes from the mesh, not num_stages)")
         from .containers_util import split_uniform_blocks
+        from ...nn.layers.containers import LayerList
 
         descs = list(layers)
         built = [d.build_layer() if isinstance(d, LayerDesc) else d for d in descs]
         head_idx, block_idxs, tail_idx = split_uniform_blocks(built)
-        from ...nn.layers.containers import LayerList
 
         self.pre = LayerList([built[i] for i in head_idx])
         self.post = LayerList([built[i] for i in tail_idx])
@@ -288,10 +471,10 @@ class PipelineLayer(Layer):
             # LayerDesc; deepcopy semantics otherwise
             desc0 = descs[block_idxs[0]]
             factory = desc0.build_layer if isinstance(desc0, LayerDesc) else None
-            self.blocks = PipelineStagedModule(template, len(block_idxs),
-                                               num_micro=num_micro,
-                                               remat=recompute_interval > 0,
-                                               block_factory=factory)
+            self.blocks = PipelineStagedModule(
+                template, len(block_idxs), num_micro=num_micro,
+                remat=recompute_interval > 0, block_factory=factory,
+                num_virtual_stages=num_virtual_pipeline_stages or 1)
         else:
             self.blocks = None
 

@@ -1,0 +1,218 @@
+"""Pipeline parallelism tests (VERDICT r1 item 3).
+
+Covers: interleaved virtual stages, heterogeneous stages, tied-embedding
+GPT loss parity vs single device, and the bounded-activation-memory
+property of the remat'd ring schedule.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed.mesh import init_mesh, mesh_scope, set_mesh
+from paddle_tpu.distributed.parallel.pipeline import (
+    HeterogeneousPipeline, LayerDesc, PipelineLayer, PipelineStagedModule)
+from paddle_tpu.nn import functional_call, param_state
+
+
+class Block(nn.Layer):
+    def __init__(self, width=16):
+        super().__init__()
+        self.fc = nn.Linear(width, width)
+
+    def forward(self, x):
+        return x + 0.1 * F.tanh(self.fc(x))
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    set_mesh(None)
+    yield
+    set_mesh(None)
+
+
+def test_virtual_stages_parity():
+    """pp=2 x virtual=2 interleaved == sequential, incl. grads."""
+    pt.seed(5)
+    m = init_mesh(pp=2, dp=4)
+    set_mesh(None)
+    with mesh_scope(m):
+        pipe = PipelineStagedModule(Block(), num_layers=8, num_micro=4,
+                                    remat=True, num_virtual_stages=2,
+                                    block_factory=lambda: Block())
+    x = pt.randn([8, 16])
+
+    set_mesh(None)
+    ref = pipe(x)  # sequential path (global order)
+    with mesh_scope(m):
+        out = pipe(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    # grads parity between pipelined and sequential execution
+    params = param_state(pipe)
+
+    def loss_pp(p):
+        with mesh_scope(m):
+            o, _ = functional_call(pipe, p, {}, x)
+        return jnp.sum(o ** 2)
+
+    def loss_seq(p):
+        set_mesh(None)
+        o, _ = functional_call(pipe, p, {}, x)
+        return jnp.sum(o ** 2)
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for k in g_pp:
+        np.testing.assert_allclose(np.asarray(g_pp[k]), np.asarray(g_seq[k]),
+                                   rtol=1e-3, atol=1e-5, err_msg=k)
+
+
+def test_virtual_stages_many_microbatches():
+    """num_micro > pp exercises multiple depth-first bursts."""
+    pt.seed(6)
+    m = init_mesh(pp=2, dp=4)
+    set_mesh(None)
+    with mesh_scope(m):
+        pipe = PipelineStagedModule(Block(), num_layers=4, num_micro=6,
+                                    remat=False, num_virtual_stages=2,
+                                    block_factory=lambda: Block())
+    x = pt.randn([12, 16])
+    set_mesh(None)
+    ref = pipe(x)
+    with mesh_scope(m):
+        out = pipe(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_heterogeneous_pipeline_parity():
+    """Different layer types per stage (reference PipelineLayer hetero)."""
+
+    class Wide(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(16, 32)
+            self.b = nn.Linear(32, 16)
+
+        def forward(self, x):
+            return x + 0.1 * F.relu(self.b(F.relu(self.a(x))))
+
+    pt.seed(7)
+    stages = [Block(), Wide(), Block(), Wide()]
+    pipe = HeterogeneousPipeline(stages, num_micro=4, remat=True)
+    x = pt.randn([8, 16])
+    ref = pipe(x)  # no mesh -> sequential
+
+    m = init_mesh(pp=4, dp=2)
+    with mesh_scope(m):
+        out = pipe(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    # grads flow to every stage's params
+    with mesh_scope(m):
+        params = param_state(pipe)
+
+        def loss(p):
+            o, _ = functional_call(pipe, p, {}, x)
+            return jnp.sum(o ** 2)
+
+        g = jax.grad(loss)(params)
+    for k, v in g.items():
+        assert float(jnp.abs(v).sum()) > 0, k
+
+
+# ---------------------------------------------------- tied-embedding GPT
+class TiedGPT(nn.Layer):
+    """Tiny GPT arrangement: embed -> pipelined blocks -> tied-logits head.
+
+    The tied weight lives outside the stacked stage params (PipelineLayer
+    pre/post), matching the reference's SharedLayerDesc first/last-stage
+    tying without a grad-sync group."""
+
+    def __init__(self, vocab=64, width=16, layers=4, num_micro=2):
+        super().__init__()
+        self.embed = nn.Embedding(vocab, width)
+        self.blocks = PipelineStagedModule(Block(width), layers,
+                                           num_micro=num_micro, remat=True,
+                                           block_factory=lambda: Block(width))
+        self.ln = nn.LayerNorm(width)
+
+    def forward(self, ids):
+        h = self.embed(ids)
+        h = self.blocks(h)
+        h = self.ln(h)
+        # tied head: logits with the embedding matrix
+        return h @ jnp.swapaxes(self.embed.weight, 0, 1)
+
+
+def test_tied_embedding_gpt_pipeline_loss_parity():
+    """pp=4 training-loss trajectory == single-device (TestDistBase pattern),
+    with the embedding weight shared by first (embed) and last (head) stage."""
+    from paddle_tpu.optimizer import SGD
+
+    def loss_fn(out, batch):
+        ids, labels = batch
+        return F.cross_entropy(out.reshape(-1, out.shape[-1]),
+                               labels.reshape(-1))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (8, 12)).astype(np.int32)
+
+    pt.seed(9)
+    set_mesh(None)
+    model_ref = TiedGPT()
+    model_pp = TiedGPT()
+    model_pp.set_state_dict(model_ref.state_dict())
+
+    from paddle_tpu.framework.jit import TrainStep
+
+    ref_step = TrainStep(model_ref, SGD(learning_rate=0.1), loss_fn=loss_fn)
+    ref_losses = [float(ref_step((ids, ids))) for _ in range(4)]
+
+    m = init_mesh(pp=4, dp=2)
+    with mesh_scope(m):
+        pp_step = dist.DistributedTrainStep(model_pp, SGD(learning_rate=0.1),
+                                            loss_fn=loss_fn, mesh=m,
+                                            batch_axes=("dp",))
+        pp_losses = [float(pp_step((ids, ids))) for _ in range(4)]
+
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4, atol=1e-5)
+
+    # the tied weight received gradient (it moved)
+    before = np.asarray(model_ref.embed.weight)
+    after = np.asarray(pp_step.params["embed.weight"])
+    assert not np.allclose(before, after)
+
+
+def test_pipeline_memory_bounded():
+    """In-flight internal activations don't scale with num_micro: compiled
+    temp memory at M=8 stays well under 2x the M=2 program (the stage body
+    is remat'd, so only per-microbatch boundary tensors scale)."""
+    pt.seed(11)
+    m = init_mesh(pp=4, dp=2)
+    set_mesh(None)
+    # wide blocks so internal activations dominate boundaries
+    mems = {}
+    for M in (2, 8):
+        with mesh_scope(m):
+            pipe = PipelineStagedModule(Block(128), num_layers=4, num_micro=M,
+                                        remat=True,
+                                        block_factory=lambda: Block(128))
+            x = pt.randn([8, 128])
+            params = param_state(pipe)
+
+            def loss(p):
+                o, _ = functional_call(pipe, p, {}, x)
+                return jnp.sum(o ** 2)
+
+            compiled = jax.jit(jax.grad(loss)).lower(params).compile()
+            analysis = compiled.memory_analysis()
+            if analysis is None:
+                pytest.skip("backend provides no memory analysis")
+            mems[M] = analysis.temp_size_in_bytes
+        set_mesh(None)
+    assert mems[8] < 2 * mems[2], mems
