@@ -66,10 +66,14 @@ def make_lookup(table: MemorySparseTable):
     def lookup(ids, anchor):
         del anchor  # connectivity only; numerically unused
         flat = ids.reshape(-1)
-        out = jax.pure_callback(
+        # io_callback, not pure_callback: pull is effectful (initializes
+        # missing keys, bumps the show counter that drives shrink eviction),
+        # so it must run exactly once per step — pure callbacks may be
+        # cached, elided, or re-executed under retracing/vmap.
+        out = jax.experimental.io_callback(
             _pull_host,
             jax.ShapeDtypeStruct((flat.shape[0], dim), jnp.float32),
-            flat)
+            flat, ordered=False)
         return out.reshape(ids.shape + (dim,))
 
     def fwd(ids, anchor):

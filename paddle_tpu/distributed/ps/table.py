@@ -101,8 +101,11 @@ class MemorySparseTable:
         if rc != 0:
             raise IOError(f"table save failed ({rc}): {path}")
 
-    def load(self, path: str) -> None:
-        rc = self._lib.pt_table_load(self._h, path.encode())
+    def load(self, path: str, merge: bool = False) -> None:
+        """Load a snapshot. ``merge=True`` inserts only keys missing from
+        RAM — live rows win over snapshot rows (begin_pass semantics)."""
+        fn = self._lib.pt_table_load_merge if merge else self._lib.pt_table_load
+        rc = fn(self._h, path.encode())
         if rc != 0:
             raise IOError(f"table load failed ({rc}): {path}")
 
@@ -147,6 +150,11 @@ class SSDSparseTable(MemorySparseTable):
         return self.shrink(self.cache_threshold)
 
     def begin_pass(self) -> None:
-        """Reload the snapshot so previously evicted keys are warm again."""
+        """Reload the snapshot so previously evicted keys are warm again.
+
+        Merge-mode: only keys absent from RAM are inserted, so rows updated
+        since the last ``end_pass`` are never rolled back to snapshot values
+        (and shrink's counter decay is not undone) even when passes are not
+        strictly paired."""
         if os.path.exists(self._snapshot):
-            self.load(self._snapshot)
+            self.load(self._snapshot, merge=True)

@@ -77,6 +77,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_table_save.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_table_load.restype = c.c_int32
     lib.pt_table_load.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_table_load_merge.restype = c.c_int32
+    lib.pt_table_load_merge.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_table_clear.argtypes = [c.c_void_p]
     lib.pt_table_set_lr.argtypes = [c.c_void_p, c.c_float]
 

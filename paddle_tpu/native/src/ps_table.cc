@@ -107,9 +107,15 @@ class SparseTable {
     }, 256);
   }
 
+  // Size/Keys/Save/Load/Clear take each shard's mutex: they may run from
+  // host threads while Pull/Push mutate shards from JAX callback threads,
+  // and FindOrInit's insert/resize invalidates iterators and value pointers.
   int64_t Size() const {
     int64_t total = 0;
-    for (auto& sh : shards_) total += static_cast<int64_t>(sh.index.size());
+    for (auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      total += static_cast<int64_t>(sh.index.size());
+    }
     return total;
   }
 
@@ -117,6 +123,7 @@ class SparseTable {
   int64_t Keys(int64_t* out, int64_t cap) const {
     int64_t w = 0;
     for (auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
       for (auto& kv : sh.index) {
         if (w >= cap) return w;
         out[w++] = kv.first;
@@ -161,7 +168,15 @@ class SparseTable {
     if (!f) return -1;
     const uint64_t magic = 0x5054424c45303146ULL;  // "PTBLE01F"
     const int32_t w = value_width();
-    uint64_t count = static_cast<uint64_t>(Size());
+    // Hold ALL shard locks for the duration so the header count matches the
+    // rows written even with pushes in flight (consistent snapshot).
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(shards_.size());
+    uint64_t count = 0;
+    for (auto& sh : shards_) {
+      locks.emplace_back(sh.mu);
+      count += static_cast<uint64_t>(sh.index.size());
+    }
     std::fwrite(&magic, sizeof(magic), 1, f);
     std::fwrite(&w, sizeof(w), 1, f);
     std::fwrite(&count, sizeof(count), 1, f);
@@ -176,7 +191,10 @@ class SparseTable {
     return 0;
   }
 
-  int32_t Load(const char* path) {
+  // merge_only: insert snapshot rows only for keys absent from RAM — the
+  // begin_pass warm-reload mode, which must not roll live rows back to
+  // snapshot values (cf. SSDSparseTable pass lifecycle).
+  int32_t Load(const char* path, bool merge_only = false) {
     FILE* f = std::fopen(path, "rb");
     if (!f) return -1;
     uint64_t magic = 0;
@@ -198,12 +216,15 @@ class SparseTable {
         return -3;
       }
       Shard& sh = shards_[shard_of(key)];
+      std::lock_guard<std::mutex> g(sh.mu);
       auto it = sh.index.find(key);
       uint32_t idx;
       if (it == sh.index.end()) {
         idx = static_cast<uint32_t>(sh.index.size());
         sh.index.emplace(key, idx);
         sh.values.resize(static_cast<size_t>(idx + 1) * w);
+      } else if (merge_only) {
+        continue;  // live RAM row wins over snapshot
       } else {
         idx = it->second;
       }
@@ -216,6 +237,7 @@ class SparseTable {
 
   void Clear() {
     for (auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
       sh.index.clear();
       sh.values.clear();
     }
@@ -331,6 +353,12 @@ int32_t pt_table_save(void* h, const char* path) {
 
 int32_t pt_table_load(void* h, const char* path) {
   return static_cast<SparseTable*>(h)->Load(path);
+}
+
+// Insert-missing-only reload (begin_pass warm-up without rolling back rows
+// updated since the last end_pass snapshot).
+int32_t pt_table_load_merge(void* h, const char* path) {
+  return static_cast<SparseTable*>(h)->Load(path, /*merge_only=*/true);
 }
 
 void pt_table_clear(void* h) { static_cast<SparseTable*>(h)->Clear(); }

@@ -49,23 +49,29 @@ struct SlotFeed {
 bool ParseLine(const char* line, size_t len, const SlotFeed& feed,
                float* label, std::vector<std::vector<int64_t>>& slot_signs) {
   for (auto& v : slot_signs) v.clear();
+  // Lines are slices of one shared buffer, so they are NOT NUL-terminated:
+  // strtof/strtoll whitespace skipping includes '\n' and would silently run
+  // into the NEXT line on a truncated record. Every parse must be checked
+  // against `end` — consuming past the slice is a malformed line, not a
+  // continuation.
   const char* p = line;
   const char* end = line + len;
   char* next = nullptr;
   *label = std::strtof(p, &next);
-  if (next == p) return false;
+  if (next == p || next > end) return false;
   p = next;
   while (p < end && *p != '\0') {
     while (p < end && (*p == '\t' || *p == ' ')) ++p;
     if (p >= end || *p == '\0' || *p == '\n') break;
     int64_t slot = std::strtoll(p, &next, 10);
-    if (next == p || *next != ':') return false;
+    if (next == p || next >= end || *next != ':') return false;
     p = next + 1;
     auto it = feed.slot_index.find(slot);
     const bool keep = it != feed.slot_index.end();
     while (true) {
+      if (p >= end) return false;  // 'slot:' with no sign before line end
       int64_t sign = std::strtoll(p, &next, 10);
-      if (next == p) return false;
+      if (next == p || next > end) return false;
       if (keep) slot_signs[it->second].push_back(sign);
       p = next;
       if (p < end && *p == ',') {
@@ -137,8 +143,6 @@ int64_t pt_feed_load_file(void* h, const char* path) {
       float label;
       size_t lo = w * per, hi = std::min(lines.size(), lo + per);
       for (size_t i = lo; i < hi; ++i) {
-        // NUL-terminate via local copy only when needed: strtoll stops at
-        // non-numeric chars, and '\n' terminates every line slice here.
         if (!ParseLine(lines[i].first, lines[i].second, *f, &label, tmp)) {
           loc.bad = true;
           return;

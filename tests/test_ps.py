@@ -206,3 +206,36 @@ def test_staged_pull_train_dedup():
     np.testing.assert_allclose(np.asarray(g), [[3.0] * 4, [1.0] * 4])
     staged.push(uniq, g)
     np.testing.assert_allclose(t.pull([7])[0], w7 - 3.0, rtol=1e-6)
+
+
+def test_load_merge_keeps_live_rows(tmp_path):
+    """merge=True load inserts only missing keys — live rows win."""
+    t = make_table("sgd")
+    t.push([1, 2], np.ones((2, 4), np.float32))
+    path = str(tmp_path / "t.bin")
+    t.save(path)
+    # train key 1 further, drop key 2
+    t.push([1], np.ones((1, 4), np.float32))
+    live = t.pull([1])
+    t2 = make_table("sgd")
+    t2.push([1], np.ones((1, 4), np.float32) * 5)  # divergent live row
+    mine = t2.pull([1])
+    t2.load(path, merge=True)
+    np.testing.assert_array_equal(t2.pull([1]), mine)  # not rolled back
+    assert 2 in set(t2.keys().tolist())               # missing key inserted
+    # plain load overwrites
+    t.load(path)
+    assert not np.allclose(t.pull([1]), live)
+
+
+def test_begin_pass_no_rollback(tmp_path):
+    """begin_pass after extra training must not restore snapshot values."""
+    spill = str(tmp_path / "spill")
+    t = SSDSparseTable(spill, SparseAccessorConfig(
+        embed_dim=4, optimizer="sgd", learning_rate=1.0, seed=3))
+    t.pull([5])
+    t.end_pass()
+    t.push([5], np.ones((1, 4), np.float32))  # post-snapshot training
+    trained = t.pull([5])
+    t.begin_pass()  # unpaired begin_pass
+    np.testing.assert_array_equal(t.pull([5]), trained)

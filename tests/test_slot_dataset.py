@@ -148,3 +148,19 @@ def test_ctr_train_e2e(tmp_path):
         preds = np.stack([1 - prob, prob], axis=1)
         auc.update(preds, labels[:, None])
     assert auc.accumulate() > 0.9
+
+
+def test_truncated_line_rejected_not_merged(tmp_path):
+    """A line ending in 'slot:' must error, not silently consume the next
+    line's label as a sign (strtoll skips '\\n' in the shared buffer)."""
+    bad = tmp_path / "bad"
+    bad.write_text("1\t101:\n0\t101:7\n")
+    ds = InMemoryDataset(slots=[101], batch_size=1, drop_last=False)
+    with pytest.raises(ValueError, match="malformed"):
+        ds.load_into_memory([str(bad)])
+    # whitespace-only line is skipped by the line splitter; trailing junk
+    # after the last sign is tolerated only when numeric parsing stops at it
+    ok = tmp_path / "ok"
+    ok.write_text("1\t101:3\n\n0\t101:7\n")
+    ds2 = InMemoryDataset(slots=[101], batch_size=2, drop_last=False)
+    assert ds2.load_into_memory([str(ok)]) == 2
