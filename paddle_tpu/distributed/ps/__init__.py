@@ -5,12 +5,16 @@ Reference parity: ``paddle/fluid/distributed/ps/`` (brpc tables/services,
 construction from strategy), and the in-process ``PsLocalClient``
 (``ps/service/ps_local_client.h``) that the GPU-PS path uses.
 
-TPU-native shape: tables are host-RAM C++ (:mod:`.table`); the *local
-client* is the default deployment — every host in a TPU pod holds a shard
-of the key space (keys route by hash, same as ``HeterComm``'s shard-by-hash)
-and exchanges rows during pull/push via ``jax`` collectives when multi-host.
-Single-host (this round): one process owns all shards in-proc, zero RPC —
-exactly the PsLocalClient trick the reference uses for GpuPS.
+TPU-native shape: tables are host-RAM C++ (:mod:`.table`). Two deployments:
+
+- *Local client* (single host): one process owns all shards in-proc, zero
+  RPC — the PsLocalClient trick the reference uses for GpuPS.
+- *Service* (multi-host): each host runs a :class:`PsServer` process (C++
+  TCP service over its table shard, ``native/src/ps_service.cc``);
+  :class:`PsClient` partitions keys by hash across servers and presents the
+  same table interface, so :class:`SparseEmbedding` works over the network
+  unchanged. :class:`Communicator` adds the reference's sync/async/geo send
+  modes (``ps/service/communicator/communicator.h``).
 """
 from __future__ import annotations
 
@@ -18,11 +22,13 @@ from typing import Dict, Optional
 
 from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
                         make_lookup)
+from .service import Communicator, PsClient, PsServer, launch_servers, shard_of
 from .table import MemorySparseTable, SSDSparseTable, SparseAccessorConfig
 
 __all__ = [
     "SparseAccessorConfig", "MemorySparseTable", "SSDSparseTable",
     "SparseEmbedding", "StagedPull", "callbacks_supported", "make_lookup",
+    "PsServer", "PsClient", "Communicator", "launch_servers", "shard_of",
     "PSContext", "get_ps_context",
 ]
 

@@ -130,8 +130,9 @@ class SparseEmbedding(Layer):
         return self._lookup(ids, self.grad_anchor)
 
     def extra_repr(self):
-        return (f"embed_dim={self.embed_dim}, "
-                f"optimizer={self.table.accessor.optimizer}")
+        acc = getattr(self.table, "accessor", None)  # PsClient has none
+        opt = f", optimizer={acc.optimizer}" if acc is not None else ""
+        return f"embed_dim={self.embed_dim}{opt}"
 
 
 class StagedPull:

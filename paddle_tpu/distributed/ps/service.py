@@ -1,0 +1,448 @@
+"""Multi-host parameter-server service: servers, client, communicator.
+
+Reference parity: ``paddle/fluid/distributed/ps/service/brpc_ps_server.cc``
+(request dispatch into tables), ``brpc_ps_client.cc`` (client stubs with
+key->shard routing and request batching), and the communicator modes of
+``ps/service/communicator/communicator.h`` (``AsyncCommunicator:426``
+background send queue, ``HalfAsyncCommunicator:519`` barriered batches,
+``GeoCommunicator:596`` delta pushes every k steps).
+
+TPU-native shape: each server process owns one C++ :class:`MemorySparseTable`
+(a shard of the global key space) behind the plain-TCP framed protocol of
+``native/src/ps_service.cc``; the client partitions keys by splitmix64 hash —
+the same router the C++ shards use internally — batches per-server requests,
+and exposes the exact ``MemorySparseTable`` interface, so
+:class:`~paddle_tpu.distributed.ps.SparseEmbedding` works over the network
+unchanged (its JAX callbacks call ``client.pull``/``push``).
+"""
+from __future__ import annotations
+
+import queue
+import select
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import native
+from .table import MemorySparseTable, SparseAccessorConfig
+
+__all__ = ["PsServer", "PsClient", "Communicator", "launch_servers"]
+
+_OP_PULL = 1
+_OP_PUSH = 2
+_OP_SIZE = 3
+_OP_SAVE = 4
+_OP_LOAD = 5
+_OP_SHRINK = 6
+_OP_SET_LR = 7
+_OP_BARRIER = 8
+_OP_KEYS = 9
+_OP_STOP = 10
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over uint64 — MUST match ``ptn::splitmix64``
+    (native/src/common.h) bit for bit; it is the canonical key router."""
+    x = x.astype(np.uint64, copy=True)
+    x += np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def shard_of(keys: np.ndarray, num_servers: int) -> np.ndarray:
+    """Server index per key (client-side partitioning, brpc_ps_client.cc's
+    key->shard routing)."""
+    return (_splitmix64(np.asarray(keys, np.int64).view(np.uint64))
+            % np.uint64(num_servers)).astype(np.int64)
+
+
+class PsServer:
+    """One PS shard: a C++ table + the native TCP service.
+
+    In-process flavor (tests, single-host multi-shard); for real deployments
+    run one per host via ``python -m paddle_tpu.distributed.ps.server``.
+    """
+
+    def __init__(self, accessor: Optional[SparseAccessorConfig] = None,
+                 port: int = 0, **accessor_kw):
+        self.table = MemorySparseTable(accessor, **accessor_kw)
+        self._lib = native.get_lib()
+        self._h = self._lib.pt_ps_server_start(self.table._h, int(port))
+        if not self._h:
+            raise OSError(f"failed to bind PS server on port {port}")
+
+    @property
+    def port(self) -> int:
+        return int(self._lib.pt_ps_server_port(self._h))
+
+    def wait(self) -> None:
+        self._lib.pt_ps_server_wait(self._h)
+
+    def stop(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.pt_ps_server_stop(h)
+            self._lib.pt_ps_server_destroy(h)
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _Conn:
+    """One framed-protocol connection (thread-unsafe; callers lock)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def request(self, op: int, body: bytes = b"") -> bytes:
+        self.sock.sendall(struct.pack("<IB", len(body), op) + body)
+        hdr = self._read(8)
+        status, blen = struct.unpack("<iI", hdr)
+        payload = self._read(blen) if blen else b""
+        if status != 0:
+            raise IOError(f"PS rpc op={op} failed with status {status}")
+        return payload
+
+    def _read(self, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            c = self.sock.recv(n)
+            if not c:
+                raise ConnectionError("PS server closed connection")
+            chunks.append(c)
+            n -= len(c)
+        return b"".join(chunks)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Sharded-table client: same interface as :class:`MemorySparseTable`,
+    keys routed to ``endpoints[shard_of(key)]``. Thread-safe (one lock per
+    server connection, so concurrent requests to different shards overlap —
+    the brpc client's per-channel concurrency)."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]], embed_dim: int):
+        if not endpoints:
+            raise ValueError("need at least one PS endpoint")
+        self.endpoints = list(endpoints)
+        self.embed_dim = int(embed_dim)
+        self._conns = [_Conn(h, p) for h, p in self.endpoints]
+        self._locks = [threading.Lock() for _ in self._conns]
+        # persistent fan-out pool: pull+push run every training step, so
+        # per-call thread spawn/teardown would be pure hot-path overhead
+        self._pool = (ThreadPoolExecutor(max_workers=len(self._conns))
+                      if len(self._conns) > 1 else None)
+
+    # -- partitioned data plane -------------------------------------------
+    def _scatter(self, keys: np.ndarray):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        sid = shard_of(keys, len(self._conns))
+        order = np.argsort(sid, kind="stable")
+        sorted_keys = keys[order]
+        counts = np.bincount(sid, minlength=len(self._conns))
+        return keys, sid, order, sorted_keys, counts
+
+    def pull(self, keys) -> np.ndarray:
+        keys, sid, order, sorted_keys, counts = self._scatter(keys)
+        out = np.empty((keys.size, self.embed_dim), np.float32)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+
+        def one(s):
+            part = sorted_keys[offs[s]:offs[s + 1]]
+            if part.size == 0:
+                return
+            body = struct.pack("<I", part.size) + part.tobytes()
+            with self._locks[s]:
+                payload = self._conns[s].request(_OP_PULL, body)
+            rows = np.frombuffer(payload, np.float32).reshape(
+                part.size, self.embed_dim)
+            out[order[offs[s]:offs[s + 1]]] = rows
+
+        self._fanout(one)
+        return out
+
+    def push(self, keys, grads) -> None:
+        keys, sid, order, sorted_keys, counts = self._scatter(keys)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(keys.size, self.embed_dim))
+        sorted_grads = grads[order]
+        offs = np.concatenate([[0], np.cumsum(counts)])
+
+        def one(s):
+            part = sorted_keys[offs[s]:offs[s + 1]]
+            if part.size == 0:
+                return
+            g = sorted_grads[offs[s]:offs[s + 1]]
+            body = struct.pack("<I", part.size) + part.tobytes() + g.tobytes()
+            with self._locks[s]:
+                self._conns[s].request(_OP_PUSH, body)
+
+        self._fanout(one)
+
+    def _fanout(self, fn) -> None:
+        n = len(self._conns)
+        if n == 1:
+            fn(0)
+            return
+        futures = [self._pool.submit(fn, s) for s in range(n)]
+        for f in futures:
+            f.result()  # re-raises the first shard failure
+
+    # -- control plane (all servers) --------------------------------------
+    def __len__(self) -> int:
+        total = 0
+        for s, conn in enumerate(self._conns):
+            with self._locks[s]:
+                total += struct.unpack("<q", conn.request(_OP_SIZE))[0]
+        return total
+
+    def keys(self) -> np.ndarray:
+        parts = []
+        for s, conn in enumerate(self._conns):
+            with self._locks[s]:
+                parts.append(np.frombuffer(conn.request(_OP_KEYS), np.int64))
+        return np.concatenate(parts) if parts else np.empty(0, np.int64)
+
+    def shrink(self, threshold: float = 1.0) -> int:
+        dropped = 0
+        for s, conn in enumerate(self._conns):
+            body = struct.pack("<f", float(threshold))
+            with self._locks[s]:
+                dropped += struct.unpack("<q", conn.request(_OP_SHRINK, body))[0]
+        return dropped
+
+    def set_learning_rate(self, lr: float) -> None:
+        for s, conn in enumerate(self._conns):
+            with self._locks[s]:
+                conn.request(_OP_SET_LR, struct.pack("<f", float(lr)))
+
+    def save(self, path: str) -> None:
+        """Each server snapshots its shard to ``<path>.shard<i>``."""
+        for s, conn in enumerate(self._conns):
+            with self._locks[s]:
+                conn.request(_OP_SAVE, f"{path}.shard{s}".encode())
+
+    def load(self, path: str, merge: bool = False) -> None:
+        for s, conn in enumerate(self._conns):
+            body = struct.pack("<B", 1 if merge else 0) + \
+                f"{path}.shard{s}".encode()
+            with self._locks[s]:
+                conn.request(_OP_LOAD, body)
+
+    def barrier(self, world: int, timeout: Optional[float] = 600.0) -> None:
+        """Block until ``world`` clients reach the barrier (server 0
+        coordinates, cf. the reference's Gloo/brpc worker barrier).
+
+        Uses a dedicated connection: a barrier blocks server-side until the
+        world arrives, and holding the shared channel's lock for that long
+        would deadlock concurrent callers on this client."""
+        conn = _Conn(*self.endpoints[0], timeout=timeout)
+        try:
+            conn.request(_OP_BARRIER, struct.pack("<I", int(world)))
+        finally:
+            conn.close()
+
+    def stop_servers(self) -> None:
+        for s, conn in enumerate(self._conns):
+            try:
+                with self._locks[s]:
+                    conn.request(_OP_STOP)
+            except (IOError, ConnectionError):
+                pass  # server exits as it acks; a dropped ack is fine
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        for conn in self._conns:
+            conn.close()
+
+
+def _merge_grads(keys: np.ndarray, grads: np.ndarray):
+    """Sum grads of duplicate keys (the communicator's merge-before-send,
+    ``communicator.h`` MergeVars)."""
+    uniq, inv = np.unique(keys, return_inverse=True)
+    merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
+    np.add.at(merged, inv, grads)
+    return uniq, merged
+
+
+class Communicator:
+    """Background gradient sender over a :class:`PsClient`.
+
+    Modes (reference ``communicator.h``):
+      - ``"sync"``: ``push`` sends immediately (blocking), one RPC per call.
+      - ``"async"``: ``push`` enqueues; a background thread drains the queue,
+        merging duplicate keys per batch (``AsyncCommunicator::Start``).
+      - ``"geo"``: pushes accumulate locally and are sent merged every
+        ``k_steps`` calls (``GeoCommunicator``'s delta-train trick — the lag
+        is the price of hiding push latency entirely).
+
+    ``flush()`` drains everything (end of epoch / before save/eval).
+    """
+
+    def __init__(self, client: PsClient, mode: str = "async",
+                 k_steps: int = 4, max_queue: int = 64):
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"unknown communicator mode {mode!r}")
+        self.client = client
+        self.mode = mode
+        self.k_steps = int(k_steps)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._geo_buf: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._geo_count = 0
+        self._err: Optional[BaseException] = None
+        self._running = mode == "async"
+        self._thread = None
+        if self._running:
+            self._thread = threading.Thread(target=self._drain, daemon=True)
+            self._thread.start()
+
+    def push(self, keys, grads) -> None:
+        if self._err is not None:
+            raise self._err
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(
+            keys.size, self.client.embed_dim)
+        if self.mode == "sync":
+            self.client.push(keys, grads)
+        elif self.mode == "async":
+            self._queue.put((keys, grads))
+        else:  # geo
+            self._geo_buf.append((keys, grads))
+            self._geo_count += 1
+            if self._geo_count >= self.k_steps:
+                self._send_geo()
+
+    def _send_geo(self) -> None:
+        if not self._geo_buf:
+            return
+        keys = np.concatenate([k for k, _ in self._geo_buf])
+        grads = np.concatenate([g for _, g in self._geo_buf])
+        self._geo_buf.clear()
+        self._geo_count = 0
+        uniq, merged = _merge_grads(keys, grads)
+        self.client.push(uniq, merged)
+
+    def _drain(self) -> None:
+        while self._running or not self._queue.empty():
+            batch = []
+            try:
+                batch.append(self._queue.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            # opportunistically coalesce whatever is queued
+            while True:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            try:
+                keys = np.concatenate([k for k, _ in batch])
+                grads = np.concatenate([g for _, g in batch])
+                uniq, merged = _merge_grads(keys, grads)
+                self.client.push(uniq, merged)
+            except BaseException as e:
+                self._err = e
+                # account for everything queued so flush()'s join() can't
+                # hang on items this dead thread will never process
+                for _ in batch:
+                    self._queue.task_done()
+                while True:
+                    try:
+                        self._queue.get_nowait()
+                        self._queue.task_done()
+                    except queue.Empty:
+                        break
+                return
+            for _ in batch:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        if self._err is not None:
+            raise self._err
+        if self.mode == "geo":
+            self._send_geo()
+        elif self.mode == "async":
+            # join() with an escape hatch: if the drain thread died, items
+            # enqueued after its final sweep would never be task_done'd
+            with self._queue.all_tasks_done:
+                while self._queue.unfinished_tasks:
+                    if self._err is not None:
+                        raise self._err
+                    self._queue.all_tasks_done.wait(timeout=0.1)
+        if self._err is not None:
+            raise self._err
+
+    def stop(self) -> None:
+        self.flush()
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def launch_servers(num_servers: int, embed_dim: int, optimizer: str = "adagrad",
+                   learning_rate: float = 0.05, seed: int = 0,
+                   timeout: float = 30.0):
+    """Spawn ``num_servers`` PS server subprocesses on ephemeral ports.
+
+    Returns ``(procs, endpoints)``; each server prints ``PORT <p>`` on stdout
+    once bound (the rendezvous handshake — the reference publishes endpoints
+    through gloo/etcd instead).
+    """
+    procs, endpoints = [], []
+    for s in range(num_servers):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.ps.server",
+             "--port", "0", "--embed-dim", str(embed_dim),
+             "--optimizer", optimizer, "--lr", str(learning_rate),
+             "--seed", str(seed)],
+            stdout=subprocess.PIPE)
+        procs.append(p)
+    deadline = time.time() + timeout
+
+    def fail(exc):
+        for q in procs:
+            q.kill()
+        raise exc
+
+    for p in procs:
+        buf = b""
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                fail(TimeoutError("PS server startup timed out"))
+            # select, not readline: readline would block past the deadline
+            # if the server hangs before printing its PORT line
+            ready, _, _ = select.select([p.stdout], [], [], remaining)
+            if not ready:
+                fail(TimeoutError("PS server startup timed out"))
+            chunk = p.stdout.read1(4096)
+            if not chunk:
+                fail(RuntimeError("PS server failed to start"))
+            buf += chunk
+            for line in buf.decode(errors="replace").splitlines():
+                if line.startswith("PORT "):
+                    endpoints.append(("127.0.0.1", int(line.split()[1])))
+                    break
+            else:
+                continue
+            break
+    return procs, endpoints

@@ -33,25 +33,39 @@ def _needs_build() -> bool:
 
 
 def build(verbose: bool = False) -> str:
-    """Compile the native sources into a shared library (idempotent)."""
+    """Compile the native sources into a shared library (idempotent).
+
+    Safe across processes: concurrent builders (e.g. a test process and the
+    PS server subprocesses it spawns) serialize on a file lock, and the
+    per-pid tmp + atomic replace means a loser never loads a half-written
+    library."""
+    import fcntl
+
     with _lock:
         if not _needs_build():
             return _LIB_PATH
-        sources = sorted(
-            os.path.join(_SRC_DIR, f)
-            for f in os.listdir(_SRC_DIR) if f.endswith(".cc")
-        )
-        tmp = _LIB_PATH + ".tmp"
-        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-               "-o", tmp] + sources
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
-        os.replace(tmp, _LIB_PATH)
-        if verbose:
-            print(f"built {_LIB_PATH}")
-        return _LIB_PATH
+        with open(_LIB_PATH + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if not _needs_build():  # another process built it meanwhile
+                    return _LIB_PATH
+                sources = sorted(
+                    os.path.join(_SRC_DIR, f)
+                    for f in os.listdir(_SRC_DIR) if f.endswith(".cc")
+                )
+                tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
+                cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+                       "-pthread", "-o", tmp] + sources
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+                os.replace(tmp, _LIB_PATH)
+                if verbose:
+                    print(f"built {_LIB_PATH}")
+                return _LIB_PATH
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -81,6 +95,16 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_table_load_merge.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_table_clear.argtypes = [c.c_void_p]
     lib.pt_table_set_lr.argtypes = [c.c_void_p, c.c_float]
+    lib.pt_table_dim.restype = c.c_int32
+    lib.pt_table_dim.argtypes = [c.c_void_p]
+
+    lib.pt_ps_server_start.restype = c.c_void_p
+    lib.pt_ps_server_start.argtypes = [c.c_void_p, c.c_int32]
+    lib.pt_ps_server_port.restype = c.c_int32
+    lib.pt_ps_server_port.argtypes = [c.c_void_p]
+    lib.pt_ps_server_stop.argtypes = [c.c_void_p]
+    lib.pt_ps_server_wait.argtypes = [c.c_void_p]
+    lib.pt_ps_server_destroy.argtypes = [c.c_void_p]
 
     lib.pt_graph_create.restype = c.c_void_p
     lib.pt_graph_create.argtypes = []

@@ -363,6 +363,8 @@ int32_t pt_table_load_merge(void* h, const char* path) {
 
 void pt_table_clear(void* h) { static_cast<SparseTable*>(h)->Clear(); }
 
+int32_t pt_table_dim(void* h) { return static_cast<SparseTable*>(h)->dim(); }
+
 // lr setter so Python LR schedules drive the C++ rule (the reference plumbs
 // this through sgd-rule `learning_rate`, table/sparse_sgd_rule.cc).
 void pt_table_set_lr(void* h, float lr) {

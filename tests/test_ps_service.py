@@ -1,0 +1,175 @@
+"""Multi-host PS service tests: subprocess server cluster, client-side key
+partitioning, communicator modes, barrier — the reference's
+``test_dist_base.py`` subprocess-cluster pattern (SURVEY §4) applied to the
+TCP PS service."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt  # noqa: F401  (native build side effect)
+from paddle_tpu.distributed.ps import (Communicator, MemorySparseTable,
+                                       PsClient, PsServer, SparseAccessorConfig,
+                                       SparseEmbedding, launch_servers,
+                                       shard_of)
+
+DIM = 4
+
+
+def make_local(optimizer="sgd", lr=1.0, seed=11):
+    return MemorySparseTable(SparseAccessorConfig(
+        embed_dim=DIM, optimizer=optimizer, learning_rate=lr, seed=seed))
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """Two PS server subprocesses + a connected client."""
+    procs, endpoints = launch_servers(
+        2, embed_dim=DIM, optimizer="sgd", learning_rate=1.0, seed=11)
+    client = PsClient(endpoints, embed_dim=DIM)
+    yield client
+    client.stop_servers()
+    client.close()
+    for p in procs:
+        p.wait(timeout=10)
+
+
+def test_shard_of_matches_cpp_router():
+    """Python splitmix64 must agree with the C++ shard router bit-for-bit:
+    keys pulled through a 16-shard table land where shard_of says (we can't
+    observe C++ shards directly, so check the known vector instead)."""
+    # splitmix64(0) == 0xe220a8397b1dcdaf (published test vector)
+    from paddle_tpu.distributed.ps.service import _splitmix64
+    assert _splitmix64(np.array([0], np.uint64))[0] == np.uint64(
+        0xE220A8397B1DCDAF)
+
+
+def test_pull_parity_with_local_table(cluster):
+    """Deterministic per-(seed, key) init means the distributed pull matches
+    a local table with the same accessor, regardless of which server owns
+    each key."""
+    local = make_local()
+    keys = np.arange(100, dtype=np.int64)
+    np.testing.assert_array_equal(cluster.pull(keys), local.pull(keys))
+
+
+def test_push_parity_and_routing(cluster):
+    local = make_local()
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1000, 2000, 64).astype(np.int64)
+    grads = rng.normal(size=(64, DIM)).astype(np.float32)
+    # warm both (init), then push identical grads
+    cluster.pull(keys)
+    local.pull(keys)
+    cluster.push(keys, grads)
+    local.push(keys, grads)
+    np.testing.assert_allclose(cluster.pull(keys), local.pull(keys), rtol=1e-6)
+    # keys really are spread over both servers
+    sid = shard_of(np.unique(keys), 2)
+    assert 0 < sid.sum() < sid.size
+
+
+def test_size_keys_save_load(cluster, tmp_path):
+    before = len(cluster)
+    cluster.pull(np.arange(5000, 5010))
+    assert len(cluster) >= before + 10
+    ks = set(cluster.keys().tolist())
+    assert set(range(5000, 5010)) <= ks
+    path = str(tmp_path / "snap")
+    cluster.save(path)
+    rows = cluster.pull(np.arange(5000, 5010))
+    cluster.push(np.arange(5000, 5010), np.ones((10, DIM), np.float32))
+    cluster.load(path)  # overwrite restores snapshot
+    np.testing.assert_array_equal(cluster.pull(np.arange(5000, 5010)), rows)
+
+
+def test_barrier_releases_world(cluster):
+    order = []
+
+    def worker(i):
+        cluster.barrier(world=3)
+        order.append(i)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)
+    assert order == []  # 2 of 3 arrived: nobody released
+    cluster.barrier(world=3)
+    for t in ts:
+        t.join(timeout=5)
+    assert sorted(order) == [0, 1]
+
+
+def test_async_communicator_parity(cluster):
+    """Async-merged pushes equal the same merged grads applied locally (SGD
+    is order/merge-invariant, so parity is exact)."""
+    local = make_local()
+    rng = np.random.default_rng(3)
+    keys = np.arange(9000, 9032, dtype=np.int64)
+    cluster.pull(keys)
+    local.pull(keys)
+    comm = Communicator(cluster, mode="async")
+    total = np.zeros((keys.size, DIM), np.float32)
+    for _ in range(10):
+        g = rng.normal(size=(keys.size, DIM)).astype(np.float32)
+        comm.push(keys, g)
+        total += g
+    comm.stop()
+    local.push(keys, total)
+    # the drain thread coalesces a nondeterministic number of batches, so
+    # summation order differs from the single local push by float epsilon
+    np.testing.assert_allclose(cluster.pull(keys), local.pull(keys),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_geo_communicator_buffers_k_steps(cluster):
+    keys = np.arange(9500, 9504, dtype=np.int64)
+    base = cluster.pull(keys)
+    comm = Communicator(cluster, mode="geo", k_steps=4)
+    for _ in range(3):
+        comm.push(keys, np.ones((keys.size, DIM), np.float32))
+    np.testing.assert_array_equal(cluster.pull(keys), base)  # buffered
+    comm.push(keys, np.ones((keys.size, DIM), np.float32))  # 4th triggers
+    np.testing.assert_allclose(cluster.pull(keys), base - 4.0, rtol=1e-6)
+    comm.stop()
+
+
+def test_sparse_embedding_over_network(cluster):
+    """SparseEmbedding trains through the PsClient transparently: grads flow
+    through the jit callback -> TCP -> C++ optimizer rule."""
+    import jax
+    import jax.numpy as jnp
+
+    emb = SparseEmbedding(DIM, table=cluster)
+    target = jnp.asarray(np.random.default_rng(5).normal(size=(6, DIM)),
+                         jnp.float32)
+    ids = jnp.asarray(np.arange(7000, 7006))
+
+    def loss_fn(anchor):
+        e = emb._lookup(ids, anchor)
+        return jnp.mean((e - target) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    # mean-MSE grads carry a 1/(6*DIM) factor; lr 5 keeps the SGD contraction
+    # per step at ~0.58 so 15 steps shrink the loss by >10x
+    cluster.set_learning_rate(5.0)
+    losses = [float(step(emb.grad_anchor)[0]) for _ in range(15)]
+    cluster.set_learning_rate(1.0)
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_inproc_server_roundtrip():
+    """PsServer can also host in-process (single-host multi-shard tests)."""
+    srv = PsServer(SparseAccessorConfig(embed_dim=DIM, optimizer="sgd",
+                                        learning_rate=1.0, seed=7))
+    client = PsClient([("127.0.0.1", srv.port)], embed_dim=DIM)
+    local = make_local(seed=7)
+    keys = np.arange(10, dtype=np.int64)
+    np.testing.assert_array_equal(client.pull(keys), local.pull(keys))
+    client.push(keys, np.ones((10, DIM), np.float32))
+    local.push(keys, np.ones((10, DIM), np.float32))
+    np.testing.assert_array_equal(client.pull(keys), local.pull(keys))
+    client.close()
+    srv.stop()
