@@ -15,6 +15,7 @@ from typing import Optional
 
 from .. import env as _env
 from ..mesh import HybridCommunicateGroup, get_mesh, init_mesh
+from . import metrics  # noqa: F401  (fleet.metrics.sum/auc/... namespace)
 from .strategy import DistributedStrategy
 
 _fleet_state = {"strategy": None, "hcg": None, "initialized": False}

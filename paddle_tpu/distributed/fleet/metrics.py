@@ -1,0 +1,197 @@
+"""Cross-trainer metric reduction.
+
+Reference parity: ``python/paddle/distributed/fleet/metrics/metric.py``
+(``sum``/``max``/``min``/``auc``/``mae``/``rmse``/``acc`` all-reduced over
+trainers via the fleet util's Gloo/NCCL all_reduce). TPU-native: metric
+state lives host-side as numpy; reduction rides whichever transport the job
+already has —
+
+- a live ``jax.distributed`` multi-process world: reduce on-device over the
+  global device mesh (one tiny psum, ICI/DCN does the work);
+- a launch KV store (``PADDLE_KV_ENDPOINT``): HTTP gather-reduce-broadcast,
+  the TCPStore pattern — works between plain processes, no chips involved;
+- neither: single-trainer identity.
+
+All functions accept numpy arrays or scalars and return numpy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
+
+_builtin_sum, _builtin_max, _builtin_min = sum, max, min
+
+
+def _world() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM",
+                              os.environ.get("WORLD_SIZE", "1")))
+
+
+def _rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID",
+                              os.environ.get("RANK", "0")))
+
+
+def _jax_world_live() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def _device_allreduce(value: np.ndarray, op: str) -> np.ndarray:
+    """Reduce across processes through the global device world: each process
+    contributes its local array on its first addressable device; a tiny
+    jitted reduction over a 1-axis mesh spanning all devices returns the
+    global result everywhere."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("metric",))
+    # every process stacks its value on the leading axis; psum-style reduce
+    stacked = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("metric")),
+        np.repeat(value[None, ...], repeats=len(jax.local_devices()), axis=0),
+        (len(devs),) + value.shape)
+    red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+
+    @jax.jit
+    def reduce(x):
+        # device copies within one process hold the same value; global sum
+        # over-counts by local_device_count, so normalize for "sum"
+        if op == "sum":
+            return red(x, axis=0) / len(jax.local_devices())
+        return red(x, axis=0)
+
+    return np.asarray(jax.device_get(reduce(stacked)))
+
+
+_kv_seq = 0  # in-process call counter; see namespace derivation below
+_KV_KEY_TTL = 600.0  # metric keys are transient; lease them so the KV
+                     # store can't grow unboundedly with per-step metrics
+
+
+def _kv_allreduce(value: np.ndarray, op: str,
+                  timeout: float = 120.0) -> np.ndarray:
+    """TCPStore-style gather→reduce→broadcast over the launch KV server.
+
+    Namespace: ``metrics/{job}/{pod generation}/{call #}``. The generation
+    comes from ``PADDLE_MASTER`` (the coordinator address) — unique per pod
+    incarnation and identical across its ranks — and the call counter is
+    in-process, so an elastic restart resets every rank to call 0 together.
+    (A counter persisted in the KV would desynchronize ranks whenever a pod
+    died between increments, deadlocking all later reductions.)
+    """
+    global _kv_seq
+    from ..launch.kv_server import KVClient
+
+    kv = KVClient(os.environ["PADDLE_KV_ENDPOINT"])
+    world, rank = _world(), _rank()
+    gen = os.environ.get("PADDLE_MASTER",
+                         os.environ.get("PADDLE_METRIC_GEN", "0"))
+    gen = gen.replace("/", "_").replace(":", "_")
+    seq = _kv_seq
+    _kv_seq += 1
+    base = (f"metrics/{os.environ.get('PADDLE_JOB_ID', 'default')}"
+            f"/{gen}/{seq}")
+    kv.put(f"{base}/part/{rank}",
+           json.dumps({"shape": list(value.shape),
+                       "data": value.reshape(-1).tolist()}),
+           ttl=_KV_KEY_TTL)
+    if rank == 0:
+        parts = []
+        deadline = time.time() + timeout
+        for r in range(world):
+            raw = None
+            while raw is None:
+                raw = kv.get(f"{base}/part/{r}")
+                if raw is None:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"metric allreduce: rank {r} never reported")
+                    time.sleep(0.05)
+            obj = json.loads(raw)
+            parts.append(np.asarray(obj["data"]).reshape(obj["shape"]))
+        fn = {"sum": np.sum, "max": np.max, "min": np.min}[op]
+        out = fn(np.stack(parts), axis=0)
+        kv.put(f"{base}/result",
+               json.dumps({"shape": list(out.shape),
+                           "data": out.reshape(-1).tolist()}),
+               ttl=_KV_KEY_TTL)
+        return out.astype(value.dtype)
+    raw = kv.wait(f"{base}/result", timeout=timeout)
+    obj = json.loads(raw)
+    return np.asarray(obj["data"]).reshape(obj["shape"]).astype(value.dtype)
+
+
+def _allreduce(value, op: str) -> np.ndarray:
+    value = np.asarray(value, np.float64)
+    scalar = value.ndim == 0
+    value = np.atleast_1d(value)
+    if _world() > 1:
+        if _jax_world_live():
+            out = _device_allreduce(value, op)
+        elif "PADDLE_KV_ENDPOINT" in os.environ:
+            out = _kv_allreduce(value, op)
+        else:
+            raise RuntimeError(
+                "distributed metric reduction needs a jax.distributed world "
+                "or PADDLE_KV_ENDPOINT (run under paddle_tpu launch)")
+    else:
+        out = value
+    return out[0] if scalar else out
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 — reference name
+    """Global sum over trainers (``fleet.metrics.metric.sum``)."""
+    return _allreduce(input, "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(input, "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(input, "min")
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None) -> float:
+    """Global AUC from per-trainer histogram buckets — sums the bucket
+    arrays across trainers, then runs the same trapezoid accumulation as
+    :class:`paddle_tpu.metric.Auc` (reference ``metric.py`` ``auc``)."""
+    stat_pos = _allreduce(np.asarray(stat_pos, np.float64), "sum")
+    stat_neg = _allreduce(np.asarray(stat_neg, np.float64), "sum")
+    tot_pos = tot_neg = area = 0.0
+    for i in range(len(stat_pos) - 1, -1, -1):
+        prev_pos, prev_neg = tot_pos, tot_neg
+        tot_pos += float(stat_pos[i])
+        tot_neg += float(stat_neg[i])
+        area += abs(prev_neg - tot_neg) * (prev_pos + tot_pos) / 2.0
+    denom = tot_pos * tot_neg
+    return float(area / denom) if denom else 0.0
+
+
+def mae(abserr_sum, total_ins_num, scope=None, util=None) -> float:
+    """Global mean absolute error from (local abs-error sum, local count)."""
+    s = _allreduce(np.asarray(abserr_sum, np.float64), "sum")
+    n = _allreduce(np.asarray(total_ins_num, np.float64), "sum")
+    return float(np.sum(s) / np.sum(n)) if np.sum(n) else 0.0
+
+
+def rmse(sqrerr_sum, total_ins_num, scope=None, util=None) -> float:
+    s = _allreduce(np.asarray(sqrerr_sum, np.float64), "sum")
+    n = _allreduce(np.asarray(total_ins_num, np.float64), "sum")
+    return float(np.sqrt(np.sum(s) / np.sum(n))) if np.sum(n) else 0.0
+
+
+def acc(correct, total, scope=None, util=None) -> float:
+    c = _allreduce(np.asarray(correct, np.float64), "sum")
+    t = _allreduce(np.asarray(total, np.float64), "sum")
+    return float(np.sum(c) / np.sum(t)) if np.sum(t) else 0.0

@@ -1,0 +1,149 @@
+"""Elastic membership over the launch KV store.
+
+Reference parity: ``python/paddle/distributed/fleet/elastic/manager.py:127``
+(``ElasticManager``: etcd lease per node, watch on the node directory,
+world resize between ``--nnodes min:max``). TPU-native restatement: the
+builtin HTTP KV store grows etcd-style TTL leases (``kv_server.py``), each
+launcher heartbeats its node key, and membership IS the set of live lease
+keys — no etcd dependency, same semantics:
+
+- node loss    -> lease expires -> watchers see a smaller membership,
+  terminate their pods and re-rendezvous at the new world size;
+- node arrival -> new lease key -> watchers see a larger membership and
+  resize up (scale-up), as long as max_nodes allows.
+
+Workers resume from the latest AutoCheckpoint
+(:mod:`paddle_tpu.distributed.checkpoint`), which re-slices sharded state
+onto the new topology — the part the reference delegates to
+``fleet.save/load`` + program re-build.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import List, Optional
+
+from .kv_server import KVClient
+
+
+class ElasticManager:
+    """One per launcher process. ``node_id`` must be unique per launcher
+    incarnation (a rejoining host gets a fresh id, so membership hashes
+    never collide across generations)."""
+
+    def __init__(self, kv_endpoint: str, job_id: str, node_id: str,
+                 ttl: float = 6.0):
+        self.kv = KVClient(kv_endpoint)
+        self.job_id = job_id
+        self.node_id = node_id
+        self.ttl = ttl
+        self._prefix = f"elastic/{job_id}/nodes/"
+        self._key = f"{self._prefix}{node_id}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------ lease lifecycle
+    def register(self) -> None:
+        """Write our lease and start the heartbeat thread."""
+        self.kv.put(self._key, "1", ttl=self.ttl)
+        self._thread = threading.Thread(target=self._heartbeat, daemon=True)
+        self._thread.start()
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                self.kv.put(self._key, "1", ttl=self.ttl)
+            except OSError:
+                pass  # KV briefly unreachable; retry next tick
+
+    def leave(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.ttl)
+        try:
+            self.kv.delete(self._key)
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------- membership
+    def members(self) -> List[str]:
+        return sorted(k[len(self._prefix):]
+                      for k in self.kv.list(self._prefix))
+
+    def wait_stable(self, min_nodes: int, max_nodes: int,
+                    timeout: float = 300.0, settle: float = 1.0) -> List[str]:
+        """Block until membership has >= min_nodes and hasn't changed for
+        ``settle`` seconds (or has reached max_nodes) — the reference's
+        pre-launch hold that lets stragglers join before ranks freeze.
+
+        Returns the FULL membership (may exceed max_nodes): the caller
+        takes ``members[:max_nodes]`` as the active set and keeps overflow
+        nodes as spares, so every node computes the same view."""
+        deadline = time.time() + timeout
+        last, last_change = None, time.time()
+        while time.time() < deadline:
+            try:
+                cur = self.members()
+            except OSError:
+                time.sleep(0.5)  # transient KV hiccup; keep polling
+                continue
+            if cur != last:
+                last, last_change = cur, time.time()
+            if len(cur) >= max_nodes:
+                return cur
+            if (len(cur) >= min_nodes
+                    and time.time() - last_change >= settle):
+                return cur
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"elastic rendezvous: {len(last or [])}/{min_nodes} nodes after "
+            f"{timeout}s")
+
+    def watch(self, baseline: List[str], interval: float = 1.0,
+              stop: Optional[threading.Event] = None) -> List[str]:
+        """Block until membership differs from ``baseline``; returns the new
+        membership (the etcd watch loop, polled)."""
+        while stop is None or not stop.is_set():
+            time.sleep(interval)
+            try:
+                cur = self.members()
+            except OSError:
+                continue
+            if cur != baseline:
+                return cur
+        return baseline
+
+    # ---------------------------------------------------------- rendezvous
+    def publish_coordinator(self, addr: str, members: List[str]) -> int:
+        """Leader (lowest active member id) announces the JAX coordinator.
+        Each publish bumps a monotonic generation so a *restart with
+        unchanged membership* still produces a distinguishable value —
+        followers matching only on the member list could otherwise grab the
+        previous (dead) coordinator address. Returns the generation."""
+        key = f"elastic/{self.job_id}/coord"
+        raw = self.kv.get(key)
+        gen = (json.loads(raw)["gen"] + 1) if raw else 1
+        self.kv.put(key, json.dumps(
+            {"addr": addr, "members": members, "gen": gen}))
+        return gen
+
+    def wait_coordinator(self, members: List[str], min_gen: int = 1,
+                         timeout: float = 120.0) -> tuple:
+        """Followers poll until a coordinator is published whose member list
+        matches their view AND whose generation is >= ``min_gen`` (strictly
+        newer than any coordinator this follower already used). Returns
+        ``(addr, gen)``."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                raw = self.kv.get(f"elastic/{self.job_id}/coord")
+            except OSError:
+                raw = None  # transient KV hiccup
+            if raw:
+                data = json.loads(raw)
+                if data["members"] == members and data.get("gen", 0) >= min_gen:
+                    return data["addr"], data["gen"]
+            time.sleep(0.2)
+        raise TimeoutError("elastic: coordinator for current membership "
+                           "never published")

@@ -25,20 +25,38 @@ class _Handler(BaseHTTPRequestHandler):
     def _store(self) -> Dict[str, bytes]:
         return self.server.kv  # type: ignore[attr-defined]
 
+    def _purge(self) -> None:
+        """Drop expired lease keys (caller holds the lock)."""
+        now = time.monotonic()
+        expiry = self.server.expiry  # type: ignore[attr-defined]
+        for k in [k for k, t in expiry.items() if t <= now]:
+            expiry.pop(k, None)
+            self._store().pop(k, None)
+
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        ttl = self.headers.get("X-TTL")  # lease: expires unless re-PUT
         with self.server.lock:  # type: ignore[attr-defined]
             self._store()[self.path] = value
+            if ttl is not None:
+                self.server.expiry[self.path] = (  # type: ignore[attr-defined]
+                    time.monotonic() + float(ttl))
+            else:
+                self.server.expiry.pop(self.path, None)  # type: ignore[attr-defined]
         self.send_response(200)
         self.end_headers()
 
     def do_GET(self):
         with self.server.lock:  # type: ignore[attr-defined]
-            if self.path == "/":
+            self._purge()
+            if self.path == "/" or self.path.startswith("/?prefix="):
+                prefix = (self.path.split("=", 1)[1]
+                          if "=" in self.path else "")
                 body = json.dumps(
                     {k: v.decode("utf-8", "replace")
-                     for k, v in self._store().items()}).encode()
+                     for k, v in self._store().items()
+                     if k.lstrip("/").startswith(prefix)}).encode()
                 self.send_response(200)
                 self.end_headers()
                 self.wfile.write(body)
@@ -65,6 +83,7 @@ class KVServer:
     def __init__(self, port: int = 0, host: str = "0.0.0.0"):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.kv = {}          # type: ignore[attr-defined]
+        self._httpd.expiry = {}      # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -95,11 +114,22 @@ class KVClient:
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
 
-    def put(self, key: str, value: str) -> None:
+    def put(self, key: str, value: str, ttl: Optional[float] = None) -> None:
+        """``ttl``: lease seconds — the key vanishes unless re-PUT within
+        that window (etcd-lease analogue for elastic membership)."""
         req = urllib.request.Request(
             f"{self.endpoint}/{key.lstrip('/')}",
             data=value.encode(), method="PUT")
+        if ttl is not None:
+            req.add_header("X-TTL", str(ttl))
         urllib.request.urlopen(req, timeout=10).read()
+
+    def list(self, prefix: str = "") -> Dict[str, str]:
+        """Live keys under ``prefix`` (expired leases excluded)."""
+        with urllib.request.urlopen(
+                f"{self.endpoint}/?prefix={prefix.lstrip('/')}",
+                timeout=10) as r:
+            return {k.lstrip("/"): v for k, v in json.loads(r.read()).items()}
 
     def get(self, key: str) -> Optional[str]:
         try:

@@ -35,8 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m paddle_tpu.distributed.launch",
         description="paddle_tpu multi-process launcher")
     p.add_argument("--nnodes", type=str, default="1",
-                   help="node count (min:max range accepted; the job runs "
-                        "at min — elastic world resizing not yet supported)")
+                   help="node count; a min:max range enables ELASTIC mode: "
+                        "membership is lease-based via the KV store, node "
+                        "loss/arrival resizes the world between min and max "
+                        "and restarts workers (resume from AutoCheckpoint)")
+    p.add_argument("--elastic_ttl", type=float, default=6.0,
+                   help="elastic lease TTL seconds (heartbeat every ttl/3)")
     p.add_argument("--node_rank", type=int,
                    default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
     p.add_argument("--master", type=str,
@@ -92,10 +96,13 @@ def _worker_env(args, local_rank: int, world: int, rank: int,
 
 def launch(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    min_nodes = int(args.nnodes.split(":")[0])
+    parts = args.nnodes.split(":")
+    min_nodes = int(parts[0])
+    max_nodes = int(parts[1]) if len(parts) > 1 else min_nodes
+    elastic = max_nodes > min_nodes
     nproc = args.nproc_per_node
     world = min_nodes * nproc
-    if args.node_rank >= min_nodes:
+    if not elastic and args.node_rank >= min_nodes:
         raise ValueError(
             f"--node_rank {args.node_rank} out of range for --nnodes "
             f"{min_nodes}")
@@ -104,7 +111,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
     kv_server = None
     kv_endpoint = None
-    if min_nodes > 1:
+    if elastic or min_nodes > 1:
         # node 0 hosts the KV store; everyone rendezvous through it
         if args.node_rank == 0:
             port = (int(args.master.rsplit(":", 1)[1])
@@ -133,20 +140,20 @@ def launch(argv: Optional[List[str]] = None) -> int:
             kv.put(key, f"{host}:{_free_port()}")
         return kv.wait(key)
 
+    if elastic:
+        try:
+            return _launch_elastic(args, min_nodes, max_nodes, nproc,
+                                   kv_endpoint)
+        finally:
+            if kv_server:
+                kv_server.stop()
+
     attempt = 0
     coordinator = rendezvous(attempt)
     try:
         while True:
-            pod = Pod()
-            for local_rank in range(nproc):
-                rank = args.node_rank * nproc + local_rank
-                env = _worker_env(args, local_rank, world, rank, coordinator,
-                                  kv_endpoint)
-                log = (os.path.join(args.log_dir, f"worker.{rank}.log")
-                       if args.log_dir else None)
-                pod.add(Container(
-                    [sys.executable, "-u", args.script, *args.script_args],
-                    env, log))
+            pod = _build_pod(args, args.node_rank, world, nproc, coordinator,
+                             kv_endpoint)
             pod.deploy()
             try:
                 status = pod.join(watcher_interval=30.0)
@@ -169,6 +176,120 @@ def launch(argv: Optional[List[str]] = None) -> int:
     finally:
         if kv_server:
             kv_server.stop()
+
+
+def _build_pod(args, node_rank: int, world: int, nproc: int,
+               coordinator: str, kv_endpoint: Optional[str]) -> "Pod":
+    """Shared by static and elastic paths so worker spawning can't drift."""
+    pod = Pod()
+    for local_rank in range(nproc):
+        rank = node_rank * nproc + local_rank
+        env = _worker_env(args, local_rank, world, rank, coordinator,
+                          kv_endpoint)
+        log = (os.path.join(args.log_dir, f"worker.{rank}.log")
+               if args.log_dir else None)
+        pod.add(Container(
+            [sys.executable, "-u", args.script, *args.script_args],
+            env, log))
+    return pod
+
+
+def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
+                    kv_endpoint: str) -> int:
+    """Elastic supervision loop (``fleet/elastic/manager.py:127`` semantics
+    over KV leases): membership -> ranks -> pod; a change in the ACTIVE set
+    (first max_nodes members — later arrivals are spares) terminates the
+    pod and re-enters rendezvous at the new world size; workers resume from
+    AutoCheckpoint. Worker *failures* (not membership changes) count
+    against --max_restarts."""
+    import threading
+    import uuid
+
+    from .elastic import ElasticManager
+
+    node_id = f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    mgr = ElasticManager(kv_endpoint, args.job_id, node_id,
+                         ttl=args.elastic_ttl)
+    mgr.register()
+    restarts = 0
+    coord_gen = 0  # newest coordinator generation we have used
+    try:
+        while True:
+            members = mgr.wait_stable(min_nodes, max_nodes)
+            active = members[:max_nodes]
+            if node_id not in active:
+                if node_id not in members:
+                    raise RuntimeError("our own lease expired; clock stall?")
+                # spare: hold until the active set has an opening
+                print(f"[launch] standing by as spare "
+                      f"({len(members)} nodes registered)", flush=True)
+                while True:
+                    members = mgr.watch(members, interval=args.elastic_ttl / 3)
+                    if node_id in members[:max_nodes]:
+                        break
+                continue
+            node_rank = active.index(node_id)
+            world = len(active) * nproc
+            host = socket.gethostbyname(socket.gethostname())
+            if node_rank == 0:
+                coordinator = f"{host}:{_free_port()}"
+                coord_gen = mgr.publish_coordinator(coordinator, active)
+            else:
+                # gen must EXCEED the last one we used: a failure-restart
+                # with unchanged membership needs a fresh coordinator, not
+                # the dead one still in the KV
+                coordinator, coord_gen = mgr.wait_coordinator(
+                    active, min_gen=coord_gen + 1)
+            print(f"[launch] elastic world: {len(active)} nodes x {nproc} "
+                  f"procs (rank {node_rank})", flush=True)
+
+            pod = _build_pod(args, node_rank, world, nproc, coordinator,
+                             kv_endpoint)
+            pod.deploy()
+
+            # watch the ACTIVE set while the pod runs; on change, kill it
+            resized = threading.Event()
+            stop_watch = threading.Event()
+
+            def watch():
+                cur = members
+                while not stop_watch.is_set():
+                    cur = mgr.watch(cur, interval=args.elastic_ttl / 3.0,
+                                    stop=stop_watch)
+                    if stop_watch.is_set():
+                        return
+                    if cur[:max_nodes] != active:
+                        resized.set()
+                        pod.terminate()
+                        return
+                    # spare-only churn: keep watching, don't resize
+
+            watcher = threading.Thread(target=watch, daemon=True)
+            watcher.start()
+            try:
+                status = pod.join(watcher_interval=5.0)
+            finally:
+                stop_watch.set()
+                pod.terminate()
+            if resized.is_set():
+                print("[launch] membership changed; resizing", flush=True)
+                continue  # not a failure: re-rendezvous at new world
+            if status == 0:
+                print(f"[launch] job {args.job_id} finished", flush=True)
+                return 0
+            restarts += 1
+            if restarts > args.max_restarts:
+                print(f"[launch] job {args.job_id} FAILED (exit {status}) "
+                      f"after {restarts - 1} restarts", flush=True)
+                return status
+            print(f"[launch] worker failed (exit {status}); restart "
+                  f"{restarts}/{args.max_restarts}", flush=True)
+            # a worker failure is often the echo of a peer node dying (its
+            # collectives error first); wait one TTL so the dead lease has
+            # expired and wait_stable sees the true membership
+            time.sleep(args.elastic_ttl + 0.5)
+    finally:
+        mgr.leave()
 
 
 def main() -> None:

@@ -250,6 +250,11 @@ class _MultiprocessIterator:
             if isinstance(payload, _ShardDone):
                 self._active.discard(payload.worker_id)
                 payload = _HOLE
+            elif isinstance(payload, bytes):
+                # batches arrive pre-pickled (see worker.put_batch)
+                import pickle
+
+                payload = pickle.loads(payload)
             self._reorder[idx] = payload
 
     def __del__(self):

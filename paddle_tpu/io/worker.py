@@ -88,6 +88,19 @@ def worker_loop(dataset, collate_fn: Callable, index_queue, data_queue,
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
 
+        import pickle
+
+        def put_batch(task_id, batch):
+            # pre-pickle the batch OURSELVES: mp.Queue pickles in a feeder
+            # thread where errors are swallowed and the reply silently
+            # lost — the parent would hang forever. Pickling here makes an
+            # unpicklable batch a catchable, reportable exception. (The
+            # bytes payload re-pickles as a cheap memcpy.)
+            try:
+                data_queue.put((task_id, pickle.dumps(batch)))
+            except BaseException as e:
+                data_queue.put((task_id, _ExceptionWrapper(e)))
+
         it = iter(dataset) if iterable_mode else None
         exhausted = False
         while True:
@@ -111,7 +124,7 @@ def worker_loop(dataset, collate_fn: Callable, index_queue, data_queue,
                     continue
                 if batch and (len(batch) == batch_size or not drop_last):
                     try:
-                        data_queue.put((task_id, collate_fn(batch)))
+                        put_batch(task_id, collate_fn(batch))
                     except BaseException as e:
                         data_queue.put((task_id, _ExceptionWrapper(e)))
                 else:
@@ -121,7 +134,8 @@ def worker_loop(dataset, collate_fn: Callable, index_queue, data_queue,
                 try:
                     batch = collate_fn([dataset[i] for i in indices])
                 except BaseException as e:
-                    batch = _ExceptionWrapper(e)
-                data_queue.put((task_id, batch))
+                    data_queue.put((task_id, _ExceptionWrapper(e)))
+                    continue
+                put_batch(task_id, batch)
     except KeyboardInterrupt:
         pass

@@ -260,3 +260,21 @@ def test_unpicklable_worker_exception_still_propagates():
     dl = DataLoader(LocalBoomDataset(), batch_size=1, num_workers=2)
     with pytest.raises(RuntimeError, match="unpicklable boom"):
         list(dl)
+
+
+class UnpicklableBatchDataset(Dataset):
+    """collate output contains a lambda — unpicklable; must error loudly,
+    not hang the parent on a reply lost in the queue feeder thread."""
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.int64)
+
+
+def test_unpicklable_batch_errors_instead_of_hanging():
+    dl = DataLoader(UnpicklableBatchDataset(), batch_size=2, num_workers=1,
+                    collate_fn=lambda batch: (np.stack(batch), lambda: 1))
+    with pytest.raises(RuntimeError, match="pickle|Pickling"):
+        list(dl)
