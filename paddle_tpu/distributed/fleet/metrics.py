@@ -17,6 +17,7 @@ All functions accept numpy arrays or scalars and return numpy.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Optional
@@ -24,8 +25,6 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
-
-_builtin_sum, _builtin_max, _builtin_min = sum, max, min
 
 
 def _world() -> int:
@@ -95,7 +94,16 @@ def _kv_allreduce(value: np.ndarray, op: str,
     kv = KVClient(os.environ["PADDLE_KV_ENDPOINT"])
     world, rank = _world(), _rank()
     gen = os.environ.get("PADDLE_MASTER",
-                         os.environ.get("PADDLE_METRIC_GEN", "0"))
+                         os.environ.get("PADDLE_METRIC_GEN"))
+    if gen is None:
+        gen = "0"
+        if not _kv_seq:
+            logging.warning(
+                "fleet.metrics: neither PADDLE_MASTER nor PADDLE_METRIC_GEN "
+                "is set — the KV namespace is not incarnation-scoped, so a "
+                "restarted trainer within %ss may read the previous run's "
+                "leased metric keys. Run under paddle_tpu launch or set "
+                "PADDLE_METRIC_GEN uniquely per run.", int(_KV_KEY_TTL))
     gen = gen.replace("/", "_").replace(":", "_")
     seq = _kv_seq
     _kv_seq += 1

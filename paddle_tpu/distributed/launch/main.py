@@ -215,6 +215,12 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
     coord_gen = 0  # newest coordinator generation we have used
     try:
         while True:
+            if mgr.kv.get(f"elastic/{args.job_id}/done"):
+                # the job completed under another membership (we were a
+                # spare, or raced the leader's exit) — don't resurrect it
+                print(f"[launch] job {args.job_id} already finished",
+                      flush=True)
+                return 0
             members = mgr.wait_stable(min_nodes, max_nodes)
             active = members[:max_nodes]
             if node_id not in active:
@@ -276,7 +282,22 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
                 continue  # not a failure: re-rendezvous at new world
             if status == 0:
                 print(f"[launch] job {args.job_id} finished", flush=True)
+                if node_rank == 0:
+                    # completion marker: spares must not resurrect the job
+                    mgr.kv.put(f"elastic/{args.job_id}/done", "1")
                 return 0
+            # a worker failure is often the echo of a peer node dying: its
+            # collectives error within seconds, long before the dead lease
+            # expires (ttl). Wait one TTL and recheck membership BEFORE
+            # charging max_restarts — peer loss must resize, not fail.
+            time.sleep(args.elastic_ttl + 0.5)
+            try:
+                now_active = mgr.members()[:max_nodes]
+            except OSError:
+                now_active = active
+            if now_active != active:
+                print("[launch] membership changed; resizing", flush=True)
+                continue
             restarts += 1
             if restarts > args.max_restarts:
                 print(f"[launch] job {args.job_id} FAILED (exit {status}) "
@@ -284,10 +305,6 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
                 return status
             print(f"[launch] worker failed (exit {status}); restart "
                   f"{restarts}/{args.max_restarts}", flush=True)
-            # a worker failure is often the echo of a peer node dying (its
-            # collectives error first); wait one TTL so the dead lease has
-            # expired and wait_stable sees the true membership
-            time.sleep(args.elastic_ttl + 0.5)
     finally:
         mgr.leave()
 

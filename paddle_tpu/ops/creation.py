@@ -134,3 +134,41 @@ def tril_indices(row, col, offset=0):
 def triu_indices(row, col=None, offset=0):
     r, c = jnp.triu_indices(row, k=offset, m=col if col is not None else row)
     return jnp.stack([r, c])
+
+
+# ------------------------------------------------------ breadth additions
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(jnp.asarray(x), N=n, increasing=increasing)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    """Gaussian-filled tensor (reference ``gaussian``; the seeded-creation
+    flavor of ``normal``)."""
+    from . import random as _random
+
+    out = _random.normal(mean=mean, std=std, shape=shape,
+                         key=None if seed == 0 else jax.random.key(seed))
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return out
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure array repr (applies to numpy and jax reprs alike)."""
+    import numpy as np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)

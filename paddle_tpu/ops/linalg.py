@@ -176,3 +176,66 @@ def einsum(equation, *operands):
     """Reference implements its own einsum planner (``einsum.py``, 1,082 LoC);
     XLA's dot_general lowering makes jnp.einsum optimal on TPU directly."""
     return jnp.einsum(equation, *operands)
+
+
+# ------------------------------------------------------ breadth additions
+def lu(x, pivot=True, get_infos=False, name=None):
+    """Packed LU factorization with LAPACK-style 1-based pivots (reference
+    ``paddle.linalg.lu``)."""
+    import jax
+
+    lu_mat, piv, _ = jax.lax.linalg.lu(jnp.asarray(x))
+    piv = piv + 1  # LAPACK/paddle pivots are 1-based
+    if get_infos:
+        info = jnp.zeros(jnp.asarray(x).shape[:-2], jnp.int32)
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack ``lu()`` results into (P, L, U) (reference ``lu_unpack``)."""
+    x = jnp.asarray(x)
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(x[..., :, :k], -1) + jnp.eye(m, k, dtype=x.dtype)
+        U = jnp.triu(x[..., :k, :])
+    if unpack_pivots:
+        piv = jnp.asarray(y) - 1  # back to 0-based successive swaps
+        perm = jnp.broadcast_to(jnp.arange(m), piv.shape[:-1] + (m,))
+
+        def apply_swaps(perm_row, piv_row):
+            def body(i, p):
+                j = piv_row[i]
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+
+            import jax
+
+            return jax.lax.fori_loop(0, piv_row.shape[0], body, perm_row)
+
+        flat_perm = perm.reshape(-1, m)
+        flat_piv = jnp.asarray(piv).reshape(-1, piv.shape[-1])
+        import jax
+
+        out = jax.vmap(apply_swaps)(flat_perm, flat_piv)
+        perm = out.reshape(perm.shape)
+        P = jax.nn.one_hot(perm, m, dtype=x.dtype)
+        # rows permuted: P[..., i, perm[i]] = 1 gives P @ A = swapped rows;
+        # paddle returns P with A = P @ L @ U
+        P = jnp.swapaxes(P, -1, -2)
+    return P, L, U
+
+
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)

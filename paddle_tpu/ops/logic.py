@@ -88,3 +88,32 @@ def is_tensor(x):
     import jax
 
     return isinstance(x, jax.Array)
+
+
+# ------------------------------------------------------ breadth additions
+def is_complex(x, name=None):
+    return bool(jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x, name=None):
+    return bool(jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating))
+
+
+def is_integer(x, name=None):
+    return bool(jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer))
+
+
+def union1d(x, y, size=None, name=None):
+    """Sorted union (dynamic-shaped: eager by default; pass ``size`` to use
+    under jit, padded with the max element — jnp semantics)."""
+    return jnp.union1d(jnp.asarray(x), jnp.asarray(y), size=size)
+
+
+def intersect1d(x, y, assume_unique=False, size=None, name=None):
+    return jnp.intersect1d(jnp.asarray(x), jnp.asarray(y),
+                           assume_unique=assume_unique, size=size)
+
+
+def setdiff1d(x, y, assume_unique=False, size=None, name=None):
+    return jnp.setdiff1d(jnp.asarray(x), jnp.asarray(y),
+                         assume_unique=assume_unique, size=size)

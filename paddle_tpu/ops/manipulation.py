@@ -360,3 +360,179 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
     if jnp_mode == "constant":
         return jnp.pad(x, width, mode="constant", constant_values=value)
     return jnp.pad(x, width, mode=jnp_mode)
+
+
+# ------------------------------------------------------ breadth additions
+# (reference python/paddle/tensor/manipulation.py long tail)
+def unbind(x, axis=0, name=None):
+    """Split into a list of slices along ``axis`` (reference ``unbind``)."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def vsplit(x, num_or_indices, name=None):
+    return jnp.vsplit(jnp.asarray(x), num_or_indices)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return jnp.hsplit(jnp.asarray(x), num_or_indices)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return jnp.dsplit(jnp.asarray(x), num_or_indices)
+
+
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(jnp.asarray(x), axis=tuple(axis))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop: slice ``shape`` starting at ``offsets`` (reference
+    ``crop`` op; -1 in shape means "to the end")."""
+    x = jnp.asarray(x)
+    offsets = list(offsets) if offsets is not None else [0] * x.ndim
+    shape = list(shape) if shape is not None else list(x.shape)
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    return jax.lax.slice(x, offsets, [o + s for o, s in zip(offsets, shape)])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(jnp.asarray(x), offset=offset, axis1=axis1,
+                        axis2=axis2)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write ``y`` onto the (dim1, dim2) diagonal of ``x`` (reference
+    ``fill_diagonal_tensor``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y, x.dtype)
+    k = jnp.diagonal(x, offset=offset, axis1=dim1, axis2=dim2).shape[-1]
+    i = jnp.arange(k) + (0 if offset >= 0 else -offset)
+    j = jnp.arange(k) + (offset if offset >= 0 else 0)
+    # move dim1/dim2 to front, index, move back
+    moved = jnp.moveaxis(x, (dim1 % x.ndim, dim2 % x.ndim), (0, 1))
+    y_moved = jnp.moveaxis(y, -1, 0) if y.ndim else y
+    moved = moved.at[i, j].set(y_moved)
+    return jnp.moveaxis(moved, (0, 1), (dim1 % x.ndim, dim2 % x.ndim))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    return fill_diagonal_tensor(x, y, offset=offset, dim1=axis1, dim2=axis2)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Embed ``values`` at position ``index`` along ``axis``."""
+    x = jnp.asarray(x)
+    values = jnp.asarray(values, x.dtype)
+    idx = [slice_builtin(None)] * x.ndim  # `slice` is the paddle op here
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def index_fill(x, index, axis, value, name=None):
+    x = jnp.asarray(x)
+    idx = [slice_builtin(None)] * x.ndim  # `slice` is the paddle op here
+    idx[axis % x.ndim] = jnp.asarray(index)
+    return x.at[tuple(idx)].set(value)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-index gather (reference ``take``; ``mode`` clip/wrap —
+    'raise' clamps like clip under jit, matching paddle's kernel)."""
+    x = jnp.asarray(x).reshape(-1)
+    index = jnp.asarray(index)
+    if mode == "wrap":
+        index = index % x.shape[0]
+    else:  # raise/clip: no data-dependent errors under jit
+        index = jnp.clip(index, -x.shape[0], x.shape[0] - 1)
+    return x[index]
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis``: output gains a trailing [size] dim
+    (reference ``unfold`` / torch.Tensor.unfold semantics)."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    starts = jnp.arange(0, n - size + 1, step)
+    windows = starts[:, None] + jnp.arange(size)[None, :]  # [W, size]
+    out = jnp.take(x, windows.reshape(-1), axis=axis)
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [starts.shape[0], size]
+    out = out.reshape(shape)
+    # move the size dim to the end (paddle convention)
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view emulation: gathers the elements the strided view would
+    alias (XLA has no aliasing views, so this materializes)."""
+    x = jnp.asarray(x).reshape(-1)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return x[idx.reshape(-1)].reshape(tuple(shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    """Reshape (list/tuple) or bitcast (dtype string) view (reference
+    ``view``). Paddle's dtype-view scales the LAST dim by the itemsize
+    ratio; jax's bitcast instead appends/consumes a trailing dim, so the
+    result is reshaped back to paddle semantics."""
+    x = jnp.asarray(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(tuple(shape_or_dtype))
+    from ..framework.dtype import convert_dtype
+
+    dt = convert_dtype(shape_or_dtype)
+    in_size = jnp.dtype(x.dtype).itemsize
+    out_size = jnp.dtype(dt).itemsize
+    if out_size < in_size:  # bitcast appends (..., n, r) -> merge to (..., n*r)
+        y = jax.lax.bitcast_convert_type(x, dt)
+        return y.reshape(x.shape[:-1] + (x.shape[-1] * (in_size // out_size),))
+    if out_size > in_size:  # reshape so bitcast consumes the trailing r
+        r = out_size // in_size
+        if x.shape[-1] % r:
+            raise ValueError(
+                f"view: last dim {x.shape[-1]} not divisible by itemsize "
+                f"ratio {r}")
+        return jax.lax.bitcast_convert_type(
+            x.reshape(x.shape[:-1] + (x.shape[-1] // r, r)), dt)
+    return jax.lax.bitcast_convert_type(x, dt)
+
+
+def view_as(x, other, name=None):
+    return jnp.asarray(x).reshape(jnp.asarray(other).shape)
+
+
+def moveaxis_(x, source, destination, name=None):
+    return moveaxis(x, source, destination)
+
+
+def reshape_(x, shape, name=None):
+    return jnp.asarray(x).reshape(tuple(shape))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return flatten(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    return squeeze(x, axis=axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    return unsqueeze(x, axis)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return scatter(x, index, updates, overwrite=overwrite)
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign"):
+    return put_along_axis(arr, indices, values, axis, reduce=reduce)

@@ -409,3 +409,197 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
 
 def lerp(x, y, weight, name=None):
     return x + jnp.asarray(weight, jnp.asarray(x).dtype) * (y - x)
+
+
+# ------------------------------------------------------ breadth additions
+# (reference python/paddle/tensor/math.py — the long tail of the ~500-fn
+# tensor API; each is a direct XLA-fusable jnp mapping)
+def add_n(inputs, name=None):
+    """Sum a list of same-shape tensors (reference ``sum_op`` / add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        return jnp.asarray(inputs)
+    out = jnp.asarray(inputs[0])
+    for t in inputs[1:]:
+        out = out + jnp.asarray(t)
+    return out
+
+
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (0 where |x| == 0)."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, jnp.zeros_like(x), x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def frexp(x, name=None):
+    return jnp.frexp(x)
+
+
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y)
+
+
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+def xlogy(x, y, name=None):
+    return jax.scipy.special.xlogy(x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def increment(x, value=1.0, name=None):
+    x = jnp.asarray(x)
+    return x + jnp.asarray(value, x.dtype)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across candidate tensors: ``out[i] =
+    inputs[index[i]][i]`` (reference ``multiplex`` op)."""
+    stacked = jnp.stack([jnp.asarray(t) for t in inputs])  # [K, N, ...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Running log-sum-exp (numerically stable via associative scan)."""
+    x = jnp.asarray(x)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        x = x.astype(convert_dtype(dtype))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp the p-norm of every slice along ``axis`` to ``max_norm``."""
+    x = jnp.asarray(x)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=reduce_axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                       jnp.ones_like(norms))
+    return x * factor
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is None and dx is None:
+        dx = 1.0
+    return jnp.trapezoid(jnp.asarray(y), x=x, dx=dx if dx is not None else 1.0,
+                         axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = jnp.asarray(y)
+    n = y.shape[axis]
+    lo = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    hi = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = jax.lax.slice_in_dim(x, 1, n, axis=axis) - \
+            jax.lax.slice_in_dim(x, 0, n - 1, axis=axis)
+    else:
+        d = dx if dx is not None else 1.0
+    return jnp.cumsum((lo + hi) * d / 2.0, axis=axis)
+
+
+def floor_mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+def rank(x, name=None):
+    """Tensor of the input's ndim (reference ``rank``)."""
+    return jnp.asarray(jnp.asarray(x).ndim, jnp.int32)
+
+
+def shape(x, name=None):
+    """Shape as an int32 tensor (reference ``shape`` returns a tensor)."""
+    return jnp.asarray(jnp.asarray(x).shape, jnp.int32)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def polar(abs, angle, name=None):  # noqa: A002 - paddle names
+    return jnp.asarray(abs) * jnp.exp(1j * jnp.asarray(angle))
+
+
+# In-place variants. jax arrays are immutable, so these return the result
+# instead of mutating — under ``paddle_tpu.eager`` the Tensor wrapper
+# rebinds, giving reference-compatible ``x.add_(y)`` call sites.
+def _make_inplace(fn):
+    def op_(x, *args, **kwargs):
+        return fn(x, *args, **kwargs)
+
+    op_.__name__ = fn.__name__ + "_"
+    op_.__doc__ = (f"Out-of-place stand-in for paddle's in-place "
+                   f"``{fn.__name__}_`` (jax arrays are immutable).")
+    return op_
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+scale_ = _make_inplace(scale)
+remainder_ = _make_inplace(mod)
+floor_mod_ = _make_inplace(mod)
+lerp_ = _make_inplace(lerp)
+increment_ = _make_inplace(increment)
+nan_to_num_ = _make_inplace(nan_to_num)
+ceil_ = _make_inplace(ceil)
+exp_ = _make_inplace(exp)
+floor_ = _make_inplace(floor)
+round_ = _make_inplace(round)
+rsqrt_ = _make_inplace(rsqrt)
+sqrt_ = _make_inplace(sqrt)
+tanh_ = _make_inplace(tanh)
+reciprocal_ = _make_inplace(reciprocal)
+clip_ = _make_inplace(clip)
+erfinv_ = _make_inplace(erfinv)
+abs_ = _make_inplace(abs)
+sigmoid_ = _make_inplace(sigmoid)

@@ -113,3 +113,13 @@ def index_put(x, indices, value, accumulate=False, name=None):
     if accumulate:
         return x.at[idx].add(jnp.asarray(value, x.dtype))
     return x.at[idx].set(jnp.asarray(value, x.dtype))
+
+
+# ------------------------------------------------------ breadth additions
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(jnp.asarray(x), jnp.asarray(test_x),
+                    assume_unique=assume_unique, invert=invert)
+
+
+def digitize(x, bins, right=False, name=None):
+    return jnp.digitize(jnp.asarray(x), jnp.asarray(bins), right=right)

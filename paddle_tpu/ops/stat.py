@@ -32,3 +32,12 @@ def quantile(x, q, axis=None, keepdim=False, name=None):
 
 def nanquantile(x, q, axis=None, keepdim=False, name=None):
     return jnp.nanquantile(jnp.asarray(x), jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim)
+
+
+# ------------------------------------------------------ breadth additions
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """N-dimensional histogram (reference ``histogramdd``)."""
+    hist, edges = jnp.histogramdd(jnp.asarray(x), bins=bins, range=ranges,
+                                  density=density, weights=weights)
+    return hist, list(edges)

@@ -16,6 +16,8 @@ import time
 import numpy as np
 import pytest
 
+_builtin_min = min
+
 from paddle_tpu.distributed.launch import KVClient, KVServer
 from paddle_tpu.distributed.launch.elastic import ElasticManager
 
@@ -204,7 +206,16 @@ def test_elastic_kill_node_resumes_smaller_world(tmp_path):
     assert "membership changed; resizing" in out_a
     final = json.load(open(ckpt))
     assert final["step"] == 80 and final["world"] == 1
-    # resumed, not restarted: the step counter continued past the kill point
-    assert step_at_kill >= 1
+    # resumed, not restarted: every post-resize (world=1) trace must begin
+    # at or after the checkpointed kill-time step, never back at 1
+    resumed_starts = []
+    for trace in state.glob("trace.*.log"):
+        w1_steps = [int(line.split()[0]) for line in
+                    trace.read_text().splitlines() if line.endswith(" 1")]
+        if w1_steps:
+            resumed_starts.append(w1_steps[0])
+    assert resumed_starts, "no post-resize trace found"
+    assert _builtin_min(resumed_starts) >= step_at_kill, \
+        (resumed_starts, step_at_kill)
     worker_logs = list(logs_a.glob("worker.0.log"))
     assert worker_logs and "DONE 80 world 1" in worker_logs[0].read_text()

@@ -1,0 +1,279 @@
+"""OpTest coverage for the round-2 tensor-API breadth sweep (output parity
+vs numpy + gradient checks for the differentiable ones), mirroring the
+reference's per-op unittests under ``fluid/tests/unittests/test_*_op.py``."""
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.special
+
+import jax.numpy as jnp
+
+import paddle_tpu.ops as ops
+from op_test import check_grad, check_output
+
+RNG = np.random.default_rng(7)
+
+
+# ----------------------------------------------------------------- math
+def test_add_n():
+    xs = [RNG.normal(size=(3, 4)).astype(np.float32) for _ in range(3)]
+    check_output(ops.add_n, [xs], xs[0] + xs[1] + xs[2])
+
+
+def test_angle_polar_roundtrip():
+    mag = np.abs(RNG.normal(size=8)).astype(np.float32) + 0.1
+    ang = RNG.uniform(-3, 3, 8).astype(np.float32)
+    z = np.asarray(ops.polar(mag, ang))
+    np.testing.assert_allclose(np.asarray(ops.angle(z)), np.angle(z), rtol=1e-5)
+    np.testing.assert_allclose(np.abs(z), mag, rtol=1e-5)
+
+
+def test_sgn_real_and_complex():
+    x = np.asarray([-2.0, 0.0, 5.0], np.float32)
+    check_output(ops.sgn, [x], np.sign(x))
+    z = np.asarray([3 + 4j, 0j], np.complex64)
+    got = np.asarray(ops.sgn(z))
+    np.testing.assert_allclose(got, [0.6 + 0.8j, 0j], rtol=1e-5)
+
+
+def test_frexp_ldexp_roundtrip():
+    x = RNG.normal(size=16).astype(np.float32) * 100
+    m, e = ops.frexp(x)
+    np.testing.assert_allclose(np.asarray(ops.ldexp(m, e)), x, rtol=1e-6)
+
+
+def test_copysign_hypot_signbit():
+    x = RNG.normal(size=8).astype(np.float32)
+    y = RNG.normal(size=8).astype(np.float32)
+    check_output(ops.copysign, [x, y], np.copysign(x, y))
+    check_output(ops.hypot, [x, y], np.hypot(x, y))
+    check_output(ops.signbit, [x], np.signbit(x))
+
+
+def test_special_functions():
+    x = np.abs(RNG.normal(size=8)).astype(np.float32)
+    check_output(ops.sinc, [x], np.sinc(x))
+    check_output(ops.i0, [x], scipy.special.i0(x), rtol=1e-4)
+    check_output(ops.i1, [x], scipy.special.i1(x), rtol=1e-4)
+    y = np.abs(RNG.normal(size=8)).astype(np.float32) + 0.1
+    check_output(ops.xlogy, [x, y], scipy.special.xlogy(x, y), rtol=1e-4)
+    check_grad(ops.xlogy, [x, y], arg_idx=1)
+
+
+def test_nan_to_num():
+    x = np.asarray([np.nan, np.inf, -np.inf, 1.5], np.float32)
+    check_output(ops.nan_to_num, [x], np.nan_to_num(x))
+    got = np.asarray(ops.nan_to_num(x, nan=9.0, posinf=1.0, neginf=-1.0))
+    np.testing.assert_allclose(got, [9.0, 1.0, -1.0, 1.5])
+
+
+def test_increment_and_inplace_aliases():
+    x = np.asarray([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(np.asarray(ops.increment(x, 2.5)), [3.5, 4.5])
+    np.testing.assert_allclose(np.asarray(ops.add_(x, x)), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(ops.sqrt_(np.asarray([4.0], np.float32))), [2.0])
+    np.testing.assert_allclose(np.asarray(ops.clip_(x, 1.5, 1.8)), [1.5, 1.8])
+
+
+def test_multiplex():
+    a = np.arange(8, dtype=np.float32).reshape(4, 2)
+    b = a + 100
+    idx = np.asarray([0, 1, 1, 0])
+    got = np.asarray(ops.multiplex([a, b], idx))
+    expect = np.stack([a[0], b[1], b[2], a[3]])
+    np.testing.assert_allclose(got, expect)
+
+
+def test_logcumsumexp():
+    x = RNG.normal(size=(4, 5)).astype(np.float32)
+    expect = np.logaddexp.accumulate(x, axis=1)
+    check_output(lambda v: ops.logcumsumexp(v, axis=1), [x], expect, rtol=1e-4)
+    check_grad(lambda v: ops.logcumsumexp(v, axis=1), [x])
+
+
+def test_renorm():
+    x = RNG.normal(size=(3, 4)).astype(np.float32) * 5
+    out = np.asarray(ops.renorm(x, p=2.0, axis=0, max_norm=1.0))
+    norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+    # slices already under the cap are untouched
+    small = (x / np.linalg.norm(x.reshape(3, -1), axis=1, keepdims=True)
+             .reshape(3, 1) * 0.5)
+    np.testing.assert_allclose(
+        np.asarray(ops.renorm(small.astype(np.float32), 2.0, 0, 1.0)),
+        small, rtol=1e-5)
+
+
+def test_trapezoid_and_cumulative():
+    y = RNG.normal(size=(3, 8)).astype(np.float32)
+    x = np.sort(RNG.uniform(0, 10, 8)).astype(np.float32)
+    check_output(lambda v: ops.trapezoid(v, dx=0.5), [y],
+                 np.trapezoid(y, dx=0.5, axis=-1), rtol=1e-5)
+    check_output(lambda v: ops.cumulative_trapezoid(v, x=x), [y],
+                 scipy.integrate.cumulative_trapezoid(y, x=x, axis=-1),
+                 rtol=1e-4)
+
+
+def test_rank_shape_broadcast_shape():
+    x = np.zeros((2, 3, 4))
+    assert int(ops.rank(x)) == 3
+    np.testing.assert_array_equal(np.asarray(ops.shape(x)), [2, 3, 4])
+    assert ops.broadcast_shape([2, 1, 4], [3, 1]) == [2, 3, 4]
+
+
+# ---------------------------------------------------------------- linalg
+def test_lu_and_unpack_reconstruct():
+    a = RNG.normal(size=(5, 5)).astype(np.float32)
+    lu_mat, piv = ops.lu(a)
+    P, L, U = ops.lu_unpack(lu_mat, piv)
+    np.testing.assert_allclose(np.asarray(P) @ np.asarray(L) @ np.asarray(U),
+                               a, rtol=1e-4, atol=1e-4)
+    # get_infos flavor
+    _, _, info = ops.lu(a, get_infos=True)
+    assert int(info) == 0
+
+
+def test_tensordot():
+    a = RNG.normal(size=(3, 4, 5)).astype(np.float32)
+    b = RNG.normal(size=(4, 5, 6)).astype(np.float32)
+    check_output(lambda x, y: ops.tensordot(x, y, axes=2), [a, b],
+                 np.tensordot(a, b, axes=2), rtol=1e-4)
+    check_grad(lambda x, y: ops.tensordot(x, y, axes=2), [a, b])
+
+
+def test_cov_corrcoef():
+    x = RNG.normal(size=(4, 50)).astype(np.float32)
+    check_output(ops.cov, [x], np.cov(x), rtol=1e-4)
+    check_output(ops.corrcoef, [x], np.corrcoef(x), rtol=1e-4)
+
+
+# ----------------------------------------------------------- manipulation
+def test_unbind_vsplit_hsplit():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    parts = ops.unbind(x, axis=0)
+    assert len(parts) == 4 and parts[2].shape == (6,)
+    np.testing.assert_array_equal(np.asarray(parts[2]), x[2])
+    vs = ops.vsplit(x, 2)
+    np.testing.assert_array_equal(np.asarray(vs[1]), x[2:])
+    hs = ops.hsplit(x, 3)
+    np.testing.assert_array_equal(np.asarray(hs[0]), x[:, :2])
+
+
+def test_reverse_crop_diagonal():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    np.testing.assert_array_equal(np.asarray(ops.reverse(x, 1)), x[:, ::-1])
+    np.testing.assert_array_equal(
+        np.asarray(ops.crop(x, shape=[2, 3], offsets=[1, 2])), x[1:3, 2:5])
+    np.testing.assert_array_equal(
+        np.asarray(ops.crop(x, shape=[2, -1], offsets=[1, 2])), x[1:3, 2:])
+    np.testing.assert_array_equal(np.asarray(ops.diagonal(x, offset=1)),
+                                  np.diagonal(x, offset=1))
+
+
+def test_fill_diagonal_tensor_and_scatter():
+    x = np.zeros((4, 4), np.float32)
+    y = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+    got = np.asarray(ops.fill_diagonal_tensor(x, y))
+    np.testing.assert_array_equal(np.diagonal(got), y)
+    assert got.sum() == y.sum()
+    got2 = np.asarray(ops.diagonal_scatter(x, y[:3], offset=1))
+    np.testing.assert_array_equal(np.diagonal(got2, offset=1), y[:3])
+
+    base = np.zeros((3, 4), np.float32)
+    out = np.asarray(ops.select_scatter(base, np.ones(4, np.float32), 0, 1))
+    np.testing.assert_array_equal(out[1], np.ones(4))
+    assert out[0].sum() == out[2].sum() == 0
+
+    out = np.asarray(ops.index_fill(base, [0, 2], 0, 7.0))
+    assert (out[0] == 7).all() and (out[2] == 7).all() and (out[1] == 0).all()
+
+
+def test_take_modes():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_array_equal(np.asarray(ops.take(x, [0, 5, 11])),
+                                  [0, 5, 11])
+    np.testing.assert_array_equal(np.asarray(ops.take(x, [13], mode="wrap")),
+                                  [1])
+    np.testing.assert_array_equal(np.asarray(ops.take(x, [99], mode="raise")),
+                                  [11])  # clamped under jit semantics
+
+
+def test_unfold_as_strided_view():
+    x = np.arange(10, dtype=np.float32)
+    got = np.asarray(ops.unfold(x, 0, size=4, step=3))
+    np.testing.assert_array_equal(got, [[0, 1, 2, 3], [3, 4, 5, 6],
+                                        [6, 7, 8, 9]])
+    st = np.asarray(ops.as_strided(x, shape=[3, 2], stride=[3, 1], offset=1))
+    np.testing.assert_array_equal(st, [[1, 2], [4, 5], [7, 8]])
+    v = np.asarray(ops.view(x.reshape(2, 5), [5, 2]))
+    assert v.shape == (5, 2)
+    bits = np.asarray(ops.view(np.asarray([1.0], np.float32), "int32"))
+    assert bits.dtype == np.int32 and bits[0] == 0x3F800000
+    assert np.asarray(ops.view_as(x, np.zeros((5, 2)))).shape == (5, 2)
+
+
+# ------------------------------------------------------- sets / histogram
+def test_set_ops():
+    x = np.asarray([1, 2, 3, 4], np.int32)
+    y = np.asarray([3, 4, 5], np.int32)
+    np.testing.assert_array_equal(np.asarray(ops.union1d(x, y)),
+                                  [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(np.asarray(ops.intersect1d(x, y)), [3, 4])
+    np.testing.assert_array_equal(np.asarray(ops.setdiff1d(x, y)), [1, 2])
+    np.testing.assert_array_equal(np.asarray(ops.isin(x, y)),
+                                  [False, False, True, True])
+
+
+def test_digitize_histogramdd_vander():
+    x = RNG.uniform(0, 10, 20).astype(np.float32)
+    bins = np.asarray([2.0, 5.0, 8.0], np.float32)
+    check_output(lambda v: ops.digitize(v, bins), [x], np.digitize(x, bins))
+    pts = RNG.normal(size=(100, 2)).astype(np.float32)
+    hist, edges = ops.histogramdd(pts, bins=4)
+    ref_h, ref_e = np.histogramdd(pts, bins=4)
+    np.testing.assert_allclose(np.asarray(hist), ref_h)
+    assert len(edges) == 2
+    v = np.asarray([1.0, 2.0, 3.0], np.float32)
+    check_output(lambda a: ops.vander(a, n=3), [v], np.vander(v, 3))
+
+
+# ----------------------------------------------------------- predicates
+def test_type_predicates():
+    assert ops.is_floating_point(np.zeros(2, np.float32))
+    assert not ops.is_floating_point(np.zeros(2, np.int32))
+    assert ops.is_integer(np.zeros(2, np.int64))
+    assert ops.is_complex(np.zeros(2, np.complex64))
+    assert not ops.is_complex(np.zeros(2, np.float32))
+
+
+def test_gaussian_and_printoptions():
+    g = np.asarray(ops.gaussian((1000,), mean=2.0, std=0.5, seed=3))
+    assert abs(g.mean() - 2.0) < 0.1 and abs(g.std() - 0.5) < 0.1
+    ops.set_printoptions(precision=2)
+    try:
+        assert "0.33" in repr(np.asarray([1 / 3]))
+    finally:
+        np.set_printoptions(precision=8)
+
+
+def test_floor_mod_alias():
+    x = np.asarray([5.0, -5.0], np.float32)
+    check_output(lambda v: ops.floor_mod(v, 3.0), [x], np.mod(x, 3.0))
+
+
+def test_view_dtype_scales_last_dim():
+    """paddle view-dtype semantics: last dim scales by the itemsize ratio
+    (NOT jax bitcast's trailing-dim convention)."""
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    narrow = np.asarray(ops.view(x, "float16"))
+    assert narrow.shape == (2, 8)
+    wide = np.asarray(ops.view(narrow, "float32"))
+    assert wide.shape == (2, 4)
+    np.testing.assert_array_equal(wide, x)
+    with pytest.raises(ValueError, match="divisible"):
+        ops.view(np.zeros((2, 3), np.float32), "float64")
+
+
+def test_gaussian_dtype_forwarded():
+    g = ops.gaussian((4,), dtype="float16", seed=1)
+    assert jnp.asarray(g).dtype == jnp.float16
