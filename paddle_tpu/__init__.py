@@ -38,6 +38,8 @@ from . import sparse  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import vision  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -1,0 +1,11 @@
+"""paddle_tpu.audio — audio DSP, features, IO, datasets.
+
+Reference parity: ``python/paddle/audio`` (functional mel/window/dB
+toolkit, feature nn.Layers, wave backend, ESC50/TESS datasets).
+"""
+from . import backends, datasets, features, functional  # noqa: F401
+
+__all__ = ["backends", "datasets", "features", "functional", "load", "save",
+           "info"]
+
+from .backends.wave_backend import info, load, save  # noqa: F401,E402

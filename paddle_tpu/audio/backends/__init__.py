@@ -1,0 +1,22 @@
+"""Audio IO backends (reference ``audio/backends``). One backend: stdlib
+wave (16-bit PCM). ``list_available_backends``/``set_backend`` keep the
+reference's backend-registry API shape."""
+from . import wave_backend
+from .wave_backend import AudioInfo, info, load, save
+
+__all__ = ["info", "load", "save", "AudioInfo", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"only 'wave_backend' is available, got {backend_name!r}")

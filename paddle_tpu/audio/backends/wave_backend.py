@@ -1,0 +1,70 @@
+"""WAV file IO via the stdlib ``wave`` module.
+
+Reference parity: ``python/paddle/audio/backends/wave_backend.py`` —
+``load``/``save``/``info`` for 16-bit PCM WAV. numpy in/out (feature
+layers take arrays; files never touch the device path).
+"""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns ``(waveform, sample_rate)``; float32 in [-1, 1] when
+    ``normalize`` else the raw int16 samples."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        if width != 2:
+            raise ValueError(
+                f"only 16-bit PCM WAV is supported, got {width * 8}-bit")
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        data = np.frombuffer(f.readframes(n), dtype=np.int16)
+    data = data.reshape(-1, nch)
+    if normalize:
+        # 32767 divisor matches save()'s multiplier so a float round-trip is
+        # pure quantization error (<= 0.5/32767)
+        data = (data / 32767.0).astype(np.float32)
+    wav = data.T if channels_first else data
+    return wav, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         bits_per_sample: int = 16) -> None:
+    if bits_per_sample != 16:
+        raise ValueError("only 16-bit PCM WAV is supported")
+    src = np.asarray(src)
+    if src.ndim == 1:
+        src = src[None, :] if channels_first else src[:, None]
+    audio = src if not channels_first else src.T  # [frames, channels]
+    if audio.dtype.kind == "f":
+        audio = np.clip(audio, -1.0, 1.0)
+        audio = (audio * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(audio.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(np.ascontiguousarray(audio).tobytes())

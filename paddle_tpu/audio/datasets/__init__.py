@@ -1,0 +1,107 @@
+"""Audio datasets (reference ``python/paddle/audio/datasets``: ESC50, TESS).
+
+This environment has no network egress, so datasets load from a local
+``data_dir`` laid out like the published archives; the download step of the
+reference is replaced by a clear error pointing at the expected layout.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ..backends.wave_backend import load as load_wav
+from ..features.layers import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+
+__all__ = ["ESC50", "TESS", "AudioClassificationDataset"]
+
+_FEATURES = {None: None, "raw": None, "spectrogram": Spectrogram,
+             "melspectrogram": MelSpectrogram,
+             "logmelspectrogram": LogMelSpectrogram, "mfcc": MFCC}
+
+
+class AudioClassificationDataset(Dataset):
+    """(wav file, label) dataset with optional on-the-fly feature extraction
+    (reference ``audio/datasets/dataset.py``)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: Optional[str] = "raw", sample_rate: int = None,
+                 duration: Optional[float] = None, **feat_kwargs):
+        super().__init__()
+        if feat_type not in _FEATURES:
+            raise ValueError(f"feat_type must be one of {sorted(k for k in _FEATURES if k)}")
+        self.files = files
+        self.labels = labels
+        self.sample_rate = sample_rate
+        self.duration = duration
+        cls = _FEATURES[feat_type]
+        self._extractor = cls(**feat_kwargs) if cls else None
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, sr = load_wav(self.files[idx])
+        wav = wav[0]  # mono channel
+        if self.duration is not None:
+            n = int(self.duration * sr)
+            wav = np.pad(wav[:n], (0, max(0, n - wav.shape[0])))
+        if self._extractor is not None:
+            return np.asarray(self._extractor(wav)), self.labels[idx]
+        return wav, self.labels[idx]
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference ``esc50.py``). Expects the
+    extracted archive at ``data_dir`` (``meta/esc50.csv`` + ``audio/``)."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir: Optional[str] = None,
+                 **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "ESC50 needs data_dir pointing at the extracted ESC-50 "
+                "archive (containing meta/esc50.csv and audio/); automatic "
+                "download is unavailable in this environment")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                in_fold = int(row["fold"]) == split
+                if (mode == "dev") == in_fold:
+                    files.append(os.path.join(data_dir, "audio",
+                                              row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference ``tess.py``). Expects the extracted
+    archive at ``data_dir`` (per-emotion subdirectories of wavs)."""
+
+    _EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral",
+                 "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", data_dir: Optional[str] = None,
+                 **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "TESS needs data_dir pointing at the extracted TESS archive; "
+                "automatic download is unavailable in this environment")
+        files, labels = [], []
+        wavs = sorted(
+            os.path.join(r, f)
+            for r, _, fs in os.walk(data_dir) for f in fs
+            if f.lower().endswith(".wav"))
+        for i, path in enumerate(wavs):
+            fold = i % n_folds + 1
+            if (mode == "dev") == (fold == split):
+                emotion = os.path.basename(path).split("_")[-1][:-4].lower()
+                if emotion in self._EMOTIONS:
+                    files.append(path)
+                    labels.append(self._EMOTIONS.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

@@ -1,0 +1,3 @@
+from .layers import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

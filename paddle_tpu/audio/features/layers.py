@@ -1,0 +1,129 @@
+"""Audio feature extraction layers.
+
+Reference parity: ``python/paddle/audio/features/layers.py`` (Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC as nn.Layers over the audio
+functionals). TPU-native: the fbank/DCT matrices are layer buffers, the
+STFT rides :func:`paddle_tpu.signal.stft` — everything jit-compiles into
+one fused pipeline (frame → rfft → |.|^2 → matmul chains on the MXU).
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...signal import stft
+from ..functional.functional import (compute_fbank_matrix, create_dct,
+                                     power_to_db)
+from ..functional.window import get_window
+
+
+class Spectrogram(Layer):
+    """STFT magnitude/power spectrogram (reference ``Spectrogram``)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("power must be positive")
+        self.n_fft = n_fft
+        self.power = power
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        if self.win_length > n_fft:
+            raise ValueError(
+                f"win_length ({self.win_length}) cannot exceed n_fft "
+                f"({n_fft})")
+        self.center = center
+        self.pad_mode = pad_mode
+        # raw window; stft itself center-pads win_length < n_fft
+        self.register_buffer("fft_window", get_window(
+            window, self.win_length, fftbins=True, dtype=dtype))
+
+    def forward(self, x):
+        spec = stft(jnp.asarray(x), n_fft=self.n_fft,
+                    hop_length=self.hop_length, win_length=self.win_length,
+                    window=self.fft_window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram -> mel filterbank (reference ``MelSpectrogram``)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.register_buffer("fbank_matrix", compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype))
+
+    def forward(self, x):
+        spect = self._spectrogram(x)  # [..., n_bins, frames]
+        return jnp.matmul(self.fbank_matrix, spect)
+
+
+class LogMelSpectrogram(Layer):
+    """Mel spectrogram in dB (reference ``LogMelSpectrogram``)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self._melspectrogram(x), ref_value=self.ref_value,
+                           amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (reference ``MFCC``)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError("n_mfcc cannot exceed n_mels")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)  # [..., n_mels, frames]
+        return jnp.matmul(jnp.swapaxes(mel, -1, -2),
+                          self.dct_matrix).swapaxes(-1, -2)

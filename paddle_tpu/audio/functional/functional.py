@@ -1,0 +1,113 @@
+"""Audio DSP primitives.
+
+Reference parity: ``python/paddle/audio/functional/functional.py`` (mel
+scale conversions, filterbank construction, dB conversion, DCT basis).
+TPU-native: pure jnp — every function is jit-able and differentiable, and
+the constructed matrices (fbank, DCT) are constants XLA folds into the
+surrounding matmuls.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...framework.dtype import convert_dtype
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hertz -> mel (slaney by default, HTK formula with ``htk=True``)."""
+    freq = jnp.asarray(freq, jnp.float32) if not jnp.isscalar(freq) else freq
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + jnp.asarray(freq) / 700.0)
+    # slaney: linear below 1 kHz, log above
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (jnp.asarray(freq) - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(jnp.asarray(freq) >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(jnp.asarray(freq), 1e-10)
+                                           / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = jnp.asarray(mel)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    low = hz_to_mel(f_min, htk=htk)
+    high = hz_to_mel(f_max, htk=htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return mel_to_hz(mels, htk=htk).astype(convert_dtype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    return jnp.linspace(0.0, float(sr) / 2, n_fft // 2 + 1,
+                        dtype=convert_dtype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """Triangular mel filterbank [n_mels, n_fft//2 + 1] (reference
+    ``compute_fbank_matrix``)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft, dtype="float64")
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk, dtype="float64")
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]  # [n_mels+2, n_bins]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            jnp.sum(jnp.abs(weights) ** norm, axis=1,
+                    keepdims=True) ** (1.0 / norm), 1e-10)
+    return weights.astype(convert_dtype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Power spectrogram -> decibels with optional dynamic-range clamp."""
+    spect = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """DCT-II basis [n_mels, n_mfcc] (reference ``create_dct``)."""
+    n = jnp.arange(n_mels, dtype=jnp.float64)
+    k = jnp.arange(n_mfcc, dtype=jnp.float64)[None, :]
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis = basis * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                                  math.sqrt(2.0 / n_mels))
+    else:
+        basis = basis * 2.0
+    return basis.astype(convert_dtype(dtype))

@@ -1,0 +1,172 @@
+"""Window functions.
+
+Reference parity: ``python/paddle/audio/functional/window.py`` (registry of
+window generators behind ``get_window``). Same registry shape; bodies are
+jnp so windows fold into jitted feature pipelines.
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+
+from ...framework.dtype import convert_dtype
+
+_REGISTRY = {}
+
+
+def _register(fn):
+    _REGISTRY[fn.__name__.lstrip("_")] = fn
+    return fn
+
+
+def _extend(M: int, sym: bool) -> Tuple[int, bool]:
+    """Periodic windows compute M+1 symmetric points and drop the last."""
+    return (M, False) if sym else (M + 1, True)
+
+
+def _truncate(w, needed: bool):
+    return w if not needed else w[:-1]
+
+
+def _general_cosine(M: int, a, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    fac = jnp.linspace(-math.pi, math.pi, M)
+    w = jnp.zeros(M)
+    for k, coef in enumerate(a):
+        w = w + coef * jnp.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+@_register
+def _hamming(M: int, sym: bool = True):
+    return _general_cosine(M, [0.54, 0.46], sym)
+
+
+@_register
+def _hann(M: int, sym: bool = True):
+    return _general_cosine(M, [0.5, 0.5], sym)
+
+
+@_register
+def _blackman(M: int, sym: bool = True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+@_register
+def _nuttall(M: int, sym: bool = True):
+    return _general_cosine(M, [0.3635819, 0.4891775, 0.1365995, 0.0106411],
+                           sym)
+
+
+@_register
+def _cosine(M: int, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    w = jnp.sin(math.pi / M * (jnp.arange(M) + 0.5))
+    return _truncate(w, trunc)
+
+
+@_register
+def _triang(M: int, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = jnp.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = jnp.concatenate([w, w[-2::-1]])
+    return _truncate(w, trunc)
+
+
+@_register
+def _bohman(M: int, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    fac = jnp.abs(jnp.linspace(-1, 1, M)[1:-1])
+    w = (1 - fac) * jnp.cos(math.pi * fac) + 1.0 / math.pi * jnp.sin(
+        math.pi * fac)
+    w = jnp.concatenate([jnp.zeros(1), w, jnp.zeros(1)])
+    return _truncate(w, trunc)
+
+
+@_register
+def _gaussian(M: int, std: float, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M) - (M - 1.0) / 2
+    w = jnp.exp(-(n ** 2) / (2 * std * std))
+    return _truncate(w, trunc)
+
+
+@_register
+def _general_gaussian(M: int, p: float, sig: float, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M) - (M - 1.0) / 2
+    w = jnp.exp(-0.5 * jnp.abs(n / sig) ** (2 * p))
+    return _truncate(w, trunc)
+
+
+@_register
+def _exponential(M: int, center=None, tau: float = 1.0, sym: bool = True):
+    if sym and center is not None:
+        raise ValueError("center is not supported for symmetric windows")
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = jnp.arange(M)
+    w = jnp.exp(-jnp.abs(n - center) / tau)
+    return _truncate(w, trunc)
+
+
+@_register
+def _tukey(M: int, alpha: float = 0.5, sym: bool = True):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    if alpha <= 0:
+        return jnp.ones(M)
+    if alpha >= 1.0:
+        return _hann(M, sym=sym)
+    M, trunc = _extend(M, sym)
+    n = jnp.arange(M)
+    width = int(alpha * (M - 1) / 2.0)
+    n1, n2, n3 = n[:width + 1], n[width + 1:M - width - 1], n[M - width - 1:]
+    w1 = 0.5 * (1 + jnp.cos(math.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w2 = jnp.ones(n2.shape[0])
+    w3 = 0.5 * (1 + jnp.cos(math.pi * (-2.0 / alpha + 1 +
+                                       2.0 * n3 / alpha / (M - 1))))
+    return _truncate(jnp.concatenate([w1, w2, w3]), trunc)
+
+
+def get_window(window: Union[str, Tuple[str, float]], win_length: int,
+               fftbins: bool = True, dtype: str = "float64"):
+    """Window by name (or ``(name, param)``), reference ``get_window``.
+    ``fftbins=True`` gives the periodic variant used by STFT."""
+    sym = not fftbins
+    if isinstance(window, (tuple, list)):
+        name, *params = window
+    elif isinstance(window, str):
+        if window in ("gaussian", "exponential"):
+            raise ValueError(f"window {window!r} needs a parameter: pass "
+                             f"('{window}', value)")
+        name, params = window, []
+    else:
+        raise ValueError(f"unsupported window spec {window!r}")
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown window {name!r}; available: "
+                         f"{sorted(_REGISTRY)}")
+    w = _REGISTRY[name](win_length, *params, sym=sym)
+    return w.astype(convert_dtype(dtype))

@@ -215,7 +215,11 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
     coord_gen = 0  # newest coordinator generation we have used
     try:
         while True:
-            if mgr.kv.get(f"elastic/{args.job_id}/done"):
+            try:
+                done = mgr.kv.get(f"elastic/{args.job_id}/done")
+            except OSError:
+                done = None  # transient KV hiccup; proceed and retry later
+            if done:
                 # the job completed under another membership (we were a
                 # spare, or raced the leader's exit) — don't resurrect it
                 print(f"[launch] job {args.job_id} already finished",
@@ -283,8 +287,16 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
             if status == 0:
                 print(f"[launch] job {args.job_id} finished", flush=True)
                 if node_rank == 0:
-                    # completion marker: spares must not resurrect the job
-                    mgr.kv.put(f"elastic/{args.job_id}/done", "1")
+                    # completion marker so spares don't resurrect the job.
+                    # Leased (not permanent): it only needs to outlive the
+                    # spares' watch wakeup, and a permanent key would make a
+                    # REUSED job_id on a shared KV store return success
+                    # without running anything.
+                    try:
+                        mgr.kv.put(f"elastic/{args.job_id}/done", "1",
+                                   ttl=max(60.0, 10 * args.elastic_ttl))
+                    except OSError:
+                        pass
                 return 0
             # a worker failure is often the echo of a peer node dying: its
             # collectives error within seconds, long before the dead lease

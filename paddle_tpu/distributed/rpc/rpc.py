@@ -1,0 +1,250 @@
+"""RPC agent.
+
+Reference parity: ``python/paddle/distributed/rpc/rpc.py`` — ``init_rpc``
+rendezvous through a master store, every worker runs a service that
+executes submitted python callables, ``rpc_sync``/``rpc_async`` address
+workers by NAME, and ``shutdown`` barriers before teardown.
+
+TPU-native shape: the master store is the launch KV server
+(``kv_server.py``, the TCPStore analogue) and the per-worker service is a
+small threaded TCP server executing pickled ``(fn, args, kwargs)``. As in
+the reference (which pickles python functions over brpc), this trusts the
+cluster: only run it on networks where every peer is trusted.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..launch.kv_server import KVClient, KVServer
+
+_DEFAULT_RPC_TIMEOUT = 120.0
+# rendezvous/barrier keys are leased: a crashed incarnation's stale entries
+# must not satisfy the next rendezvous on a long-lived KV store forever
+_KEY_TTL = 600.0
+
+
+def _namespace() -> str:
+    """KV namespace scoped by job and pod incarnation (PADDLE_MASTER is
+    unique per pod generation and identical across its ranks — same trick
+    as fleet.metrics)."""
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    gen = os.environ.get("PADDLE_MASTER", "0")
+    gen = gen.replace("/", "_").replace(":", "_")
+    return f"rpc/{job}/{gen}"
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, object] = {
+    "server": None, "workers": None, "self": None, "kv": None,
+    "kv_server": None, "pool": None, "world": 0,
+}
+
+
+def _read_full(sock, n):
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(n - len(buf))
+        if not c:
+            raise ConnectionError("rpc peer closed")
+        buf += c
+    return buf
+
+
+class _Service(threading.Thread):
+    """Executes incoming ``(fn, args, kwargs)``; one thread per request.
+
+    The socket binds (fixing the advertised port) at construction, but the
+    accept loop only runs once ``start()`` is called — init_rpc starts it
+    AFTER the worker registry is populated, so a remote fn can never
+    observe half-initialized rpc state (early connects sit in the listen
+    backlog)."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            with conn:
+                (size,) = struct.unpack("<Q", _read_full(conn, 8))
+                fn, args, kwargs = pickle.loads(_read_full(conn, size))
+                try:
+                    result = (True, fn(*args, **kwargs))
+                except BaseException as e:  # ship the failure back
+                    result = (False, e)
+                try:
+                    payload = pickle.dumps(result)
+                except Exception as e:  # unpicklable result/exception
+                    payload = pickle.dumps((False, RuntimeError(repr(e))))
+                conn.sendall(struct.pack("<Q", len(payload)) + payload)
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's service and rendezvous with the others.
+
+    Reference ``init_rpc``: rank/world/master default from the launch env
+    (``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM``/``PADDLE_MASTER``);
+    rank 0 hosts the master store.
+    """
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", os.environ.get("PADDLE_KV_ENDPOINT"))
+    if master_endpoint is None:
+        raise ValueError("init_rpc needs master_endpoint (host:port)")
+
+    if rank == 0:
+        host, port = master_endpoint.rsplit(":", 1)
+        try:
+            _state["kv_server"] = KVServer(int(port)).start()
+        except OSError:
+            _state["kv_server"] = None  # an external store already serves
+    kv = KVClient(master_endpoint)
+    service = _Service()  # bound (port known) but NOT accepting yet
+    ip = socket.gethostbyname(socket.gethostname())
+    ns = _namespace()
+    kv.put(f"{ns}/worker/{rank}",
+           pickle.dumps(WorkerInfo(name, rank, ip, service.port)).hex(),
+           ttl=_KEY_TTL)
+    workers: Dict[str, WorkerInfo] = {}
+    deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+    for r in range(world_size):
+        raw = None
+        while raw is None:
+            raw = kv.get(f"{ns}/worker/{r}")
+            if raw is None:
+                if time.time() > deadline:
+                    service.stop()
+                    raise TimeoutError(f"rpc rendezvous: rank {r} missing")
+                time.sleep(0.1)
+        info = pickle.loads(bytes.fromhex(raw))
+        workers[info.name] = info
+    _state.update(server=service, workers=workers,
+                  self=next(w for w in workers.values() if w.rank == rank),
+                  kv=kv, pool=ThreadPoolExecutor(max_workers=16),
+                  world=world_size)
+    service.start()  # accept only now that state is fully visible
+
+
+def _invoke(to: str, fn, args, kwargs, timeout):
+    workers = _state["workers"]
+    if workers is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    if to not in workers:
+        raise ValueError(f"unknown rpc worker {to!r}; known: {sorted(workers)}")
+    info: WorkerInfo = workers[to]
+    payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})))
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or None) as conn:
+        conn.sendall(struct.pack("<Q", len(payload)) + payload)
+        (size,) = struct.unpack("<Q", _read_full(conn, 8))
+        ok, result = pickle.loads(_read_full(conn, size))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_RPC_TIMEOUT) -> Future:
+    """Non-blocking flavor; returns a Future (reference returns a
+    ``FutureWrapper`` with ``wait()`` — ``Future.result`` is the analogue,
+    and a ``wait`` alias is attached for ported scripts)."""
+    if _state["pool"] is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    fut = _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle API compat
+    return fut
+
+
+def _barrier(timeout=_DEFAULT_RPC_TIMEOUT):
+    kv: KVClient = _state["kv"]
+    me: WorkerInfo = _state["self"]
+    ns = _namespace()
+    kv.put(f"{ns}/barrier/{me.rank}", "1", ttl=_KEY_TTL)
+    deadline = time.time() + timeout
+    for r in range(_state["world"]):
+        while kv.get(f"{ns}/barrier/{r}") is None:
+            if time.time() > deadline:
+                raise TimeoutError("rpc shutdown barrier timed out")
+            time.sleep(0.05)
+
+
+def shutdown() -> None:
+    """Barrier (so no in-flight request loses its executor), then stop."""
+    if _state["workers"] is None:
+        return
+    _barrier()
+    time.sleep(0.2)  # grace for requests accepted during the barrier
+    _state["server"].stop()
+    _state["pool"].shutdown(wait=True)
+    # clear our keys so a fast re-init on the same store can't see them
+    ns = _namespace()
+    me: WorkerInfo = _state["self"]
+    try:
+        _state["kv"].delete(f"{ns}/worker/{me.rank}")
+        _state["kv"].delete(f"{ns}/barrier/{me.rank}")
+    except OSError:
+        pass
+    if _state["kv_server"] is not None:
+        _state["kv_server"].stop()
+    _state.update(server=None, workers=None, self=None, kv=None,
+                  kv_server=None, pool=None, world=0)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _state["self"]

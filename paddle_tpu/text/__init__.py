@@ -1,0 +1,10 @@
+"""paddle_tpu.text — text datasets + sequence decoding.
+
+Reference parity: ``python/paddle/text`` (dataset loaders and
+``viterbi_decode``/``ViterbiDecoder``).
+"""
+from .datasets import Conll05, Imdb, Imikolov, Movielens, UCIHousing
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
+           "viterbi_decode", "ViterbiDecoder"]

@@ -1,0 +1,219 @@
+"""Text datasets (reference ``python/paddle/text/datasets``: Imdb,
+Imikolov, Movielens, Conll05, UCIHousing).
+
+No network egress here, so each dataset parses the published archive from
+a local ``data_file`` path (the same formats the reference downloads); the
+error message states the expected file when missing.
+"""
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+import tarfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "Conll05"]
+
+
+def _require(data_file: Optional[str], what: str) -> str:
+    if not data_file or not os.path.exists(data_file):
+        raise RuntimeError(
+            f"{what} requires data_file pointing at the published archive "
+            f"(automatic download is unavailable in this environment); got "
+            f"{data_file!r}")
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference ``imdb.py``): parses aclImdb tar, builds
+    the frequency-sorted word dict, yields (ids, label)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        super().__init__()
+        data_file = _require(data_file, "Imdb")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        self._docs: List[List[str]] = []
+        self._labels: List[int] = []
+        freq: Dict[str, int] = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                words = re.sub(r"[^a-z0-9\s]", "", text).split()
+                self._docs.append(words)
+                self._labels.append(0 if m.group(1) == "pos" else 1)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        # frequency-sorted dict with cutoff (reference build_dict)
+        kept = sorted((w for w, c in freq.items() if c >= cutoff),
+                      key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+
+    def __len__(self):
+        return len(self._docs)
+
+    def __getitem__(self, idx):
+        unk = self.word_idx["<unk>"]
+        ids = np.asarray([self.word_idx.get(w, unk) for w in self._docs[idx]],
+                         np.int64)
+        return ids, np.int64(self._labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (reference ``imikolov.py``)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = 5, mode: str = "train", min_word_freq: int = 50):
+        super().__init__()
+        data_file = _require(data_file, "Imikolov")
+        name = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
+        freq: Dict[str, int] = {}
+        lines: List[List[str]] = []
+        with tarfile.open(data_file) as tf:
+            member = next(m for m in tf.getmembers()
+                          if m.name.endswith(name))
+            for line in tf.extractfile(member).read().decode().splitlines():
+                words = line.strip().split()
+                lines.append(words)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        kept = sorted((w for w, c in freq.items()
+                       if c >= min_word_freq and w != "<s>"),
+                      key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self._samples = []
+        for words in lines:
+            ids = [self.word_idx.get(w, unk)
+                   for w in ["<s>"] * (window_size - 1) + words + ["<e>"]
+                   if w in self.word_idx or w not in ("<s>", "<e>")]
+            if data_type == "NGRAM":
+                for i in range(window_size, len(ids) + 1):
+                    self._samples.append(
+                        np.asarray(ids[i - window_size:i], np.int64))
+            else:  # SEQ
+                if ids:
+                    self._samples.append(np.asarray(ids, np.int64))
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference ``uci_housing.py``): 13
+    features normalized feature-wise, 506 rows, 80/20 split."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        super().__init__()
+        data_file = _require(data_file, "UCIHousing")
+        raw = np.fromfile(data_file, sep=" ") if not data_file.endswith(".gz") \
+            else np.asarray(gzip.open(data_file).read().split(), float)
+        data = raw.reshape(-1, 14)
+        maxs, mins, avgs = data.max(0), data.min(0), data.mean(0)
+        feats = (data[:, :13] - avgs[:13]) / (maxs[:13] - mins[:13])
+        data = np.concatenate([feats, data[:, 13:]], axis=1)
+        split = int(len(data) * 0.8)
+        self.data = (data[:split] if mode == "train" else data[split:]
+                     ).astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:13], row[13:]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M rating prediction (reference ``movielens.py``)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0):
+        super().__init__()
+        data_file = _require(data_file, "Movielens")
+        users, movies, ratings = {}, {}, []
+        with tarfile.open(data_file) as tf:
+            base = os.path.dirname(tf.getmembers()[0].name).split("/")[0]
+
+            def read(name):
+                return tf.extractfile(f"{base}/{name}").read().decode(
+                    "ISO-8859-1").splitlines()
+
+            for line in read("users.dat"):
+                uid, gender, age, job, _ = line.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                  int(job))
+            for line in read("movies.dat"):
+                mid, title, genres = line.split("::")
+                movies[int(mid)] = (title, genres.split("|"))
+            rng = np.random.RandomState(rand_seed)
+            for line in read("ratings.dat"):
+                uid, mid, rating, _ = line.split("::")
+                is_test = rng.rand() < test_ratio
+                if is_test == (mode == "test"):
+                    ratings.append((int(uid), int(mid), float(rating)))
+        self._users, self._movies, self._ratings = users, movies, ratings
+
+    def __len__(self):
+        return len(self._ratings)
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self._ratings[idx]
+        gender, age, job = self._users[uid]
+        return (np.int64(uid), np.int64(gender), np.int64(age),
+                np.int64(job), np.int64(mid), np.float32(rating))
+
+
+class Conll05(Dataset):
+    """CoNLL-2005 SRL (reference ``conll05.py``): the test split is the
+    only publicly distributable portion; parses the published tgz."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 word_dict_file: Optional[str] = None,
+                 verb_dict_file: Optional[str] = None,
+                 target_dict_file: Optional[str] = None, mode: str = "test"):
+        super().__init__()
+        data_file = _require(data_file, "Conll05")
+        self._samples = []
+        with tarfile.open(data_file) as tf:
+            words_members = sorted(m.name for m in tf.getmembers()
+                                   if m.name.endswith(".words.gz"))
+            props_members = sorted(m.name for m in tf.getmembers()
+                                   if m.name.endswith(".props.gz"))
+            for wname, pname in zip(words_members, props_members):
+                wtext = gzip.decompress(tf.extractfile(wname).read()).decode()
+                ptext = gzip.decompress(tf.extractfile(pname).read()).decode()
+                sent, props = [], []
+                for wline, pline in zip(wtext.splitlines(),
+                                        ptext.splitlines()):
+                    wline, pline = wline.strip(), pline.strip()
+                    if not wline:
+                        if sent:
+                            self._samples.append((sent, props))
+                        sent, props = [], []
+                        continue
+                    sent.append(wline)
+                    props.append(pline.split())
+                if sent:
+                    self._samples.append((sent, props))
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        return self._samples[idx]

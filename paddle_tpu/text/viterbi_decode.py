@@ -1,0 +1,88 @@
+"""Viterbi decoding for sequence tagging.
+
+Reference parity: ``python/paddle/text/viterbi_decode.py`` (the
+``viterbi_decode`` C++ op + ``ViterbiDecoder`` layer). TPU-native: the
+forward max-product recursion and the backtrace are both ``lax.scan``s, so
+the whole decode jit-compiles (batch-parallel, no host loop); variable
+lengths are handled by masking, matching the kernel's semantics: positions
+beyond a sequence's length freeze the recursion and pad the path with 0.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Highest-scoring tag path per sequence.
+
+    Args: potentials [B, T, N] unary scores; transition_params [N, N];
+    lengths [B] int. With ``include_bos_eos_tag`` the last row/column of
+    the transition matrix acts as the BOS tag and the second-to-last as
+    EOS (reference kernel semantics).
+
+    Returns ``(scores [B], paths [B, max(lengths)] int64-compatible)``.
+    """
+    pot = jnp.asarray(potentials)
+    trans = jnp.asarray(transition_params)
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+    B, T, N = pot.shape
+
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[-1][None, :]
+
+    def fwd(carry, xt):
+        alpha, t = carry
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, from, to]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        new_alpha = jnp.max(scores, axis=1) + xt
+        live = (t < lengths)[:, None]
+        return (jnp.where(live, new_alpha, alpha), t + 1), best_prev
+
+    (alpha, _), history = lax.scan(
+        fwd, (alpha, jnp.int32(1)), jnp.swapaxes(pot[:, 1:], 0, 1))
+    # history[t-1]: best previous tag for each current tag at position t
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, -2][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+
+    def bwd(carry, inp):
+        tag, = carry
+        best_prev_t, t = inp  # position t in [T-1 .. 1]
+        emit = jnp.where(t <= lengths - 1, tag, 0)
+        prev = jnp.take_along_axis(best_prev_t, tag[:, None], 1)[:, 0]
+        tag = jnp.where(t <= lengths - 1, prev, tag)
+        return (tag,), emit
+
+    ts = jnp.arange(T - 1, 0, -1, dtype=jnp.int32)
+    (tag0,), emitted = lax.scan(
+        bwd, (last_tag,), (history[::-1], ts))
+    paths = jnp.concatenate([tag0[:, None],
+                             jnp.swapaxes(emitted, 0, 1)[:, ::-1]], axis=1)
+    if not isinstance(lengths, jax.core.Tracer):
+        paths = paths[:, :int(jnp.max(lengths))]
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper holding the transition matrix (reference
+    ``ViterbiDecoder``)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = jnp.asarray(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,233 @@
+"""Audio + text package tests (reference ``python/paddle/audio`` and
+``python/paddle/text`` coverage: functional parity vs scipy/librosa-style
+references, feature layer shapes/jit, WAV IO round-trip, viterbi vs brute
+force, dataset parsing from local archives)."""
+import io
+import itertools
+import os
+import tarfile
+import wave
+
+import numpy as np
+import pytest
+import scipy.signal
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import audio, text
+
+RNG = np.random.default_rng(11)
+
+
+# ------------------------------------------------------------- functional
+def test_mel_hz_roundtrip_both_flavors():
+    f = np.asarray([0.0, 440.0, 1000.0, 4000.0, 11025.0], np.float32)
+    for htk in (False, True):
+        mel = audio.functional.hz_to_mel(f, htk=htk)
+        back = np.asarray(audio.functional.mel_to_hz(mel, htk=htk))
+        np.testing.assert_allclose(back, f, rtol=1e-4, atol=1e-2)
+
+
+def test_fbank_matrix_properties():
+    fb = np.asarray(audio.functional.compute_fbank_matrix(
+        sr=16000, n_fft=512, n_mels=40))
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has support, triangles overlap neighbors
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_get_window_matches_scipy():
+    for name in ["hann", "hamming", "blackman", "nuttall", "triang",
+                 "bohman", "cosine"]:
+        for fftbins in (True, False):
+            got = np.asarray(audio.functional.get_window(name, 64,
+                                                         fftbins=fftbins))
+            ref = scipy.signal.get_window(name, 64, fftbins=fftbins)
+            np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7,
+                                       err_msg=f"{name} fftbins={fftbins}")
+    got = np.asarray(audio.functional.get_window(("gaussian", 7), 32))
+    ref = scipy.signal.get_window(("gaussian", 7), 32)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+    with pytest.raises(ValueError, match="needs a parameter"):
+        audio.functional.get_window("gaussian", 32)
+
+
+def test_power_to_db_matches_formula():
+    s = np.abs(RNG.normal(size=(8, 8))).astype(np.float32) ** 2
+    db = np.asarray(audio.functional.power_to_db(s, top_db=None))
+    np.testing.assert_allclose(db, 10 * np.log10(np.maximum(s, 1e-10)),
+                               rtol=1e-5)
+    clamped = np.asarray(audio.functional.power_to_db(s, top_db=20.0))
+    assert clamped.min() >= clamped.max() - 20.0 - 1e-5
+
+
+def test_create_dct_orthonormal():
+    d = np.asarray(audio.functional.create_dct(13, 40, norm="ortho"))
+    assert d.shape == (40, 13)
+    np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+
+# ---------------------------------------------------------------- features
+def test_feature_layers_shapes_and_jit():
+    wav = RNG.normal(size=16000).astype(np.float32)
+    spec = audio.features.Spectrogram(n_fft=512, hop_length=160)
+    s = np.asarray(spec(wav))
+    assert s.shape[0] == 257 and (s >= 0).all()
+    mel = audio.features.MelSpectrogram(sr=16000, n_fft=512, hop_length=160,
+                                        n_mels=64)
+    m = np.asarray(mel(wav))
+    assert m.shape[0] == 64 and m.shape[1] == s.shape[1]
+    mfcc = audio.features.MFCC(sr=16000, n_mfcc=20, n_fft=512,
+                               hop_length=160, n_mels=64)
+    c = np.asarray(mfcc(wav))
+    assert c.shape[0] == 20
+    # whole pipeline jit-compiles
+    jc = np.asarray(jax.jit(lambda w: mfcc(w))(wav))
+    np.testing.assert_allclose(jc, c, rtol=1e-4, atol=1e-4)
+
+
+def test_mel_layer_batched():
+    wavs = RNG.normal(size=(3, 8000)).astype(np.float32)
+    mel = audio.features.MelSpectrogram(sr=16000, n_fft=256, n_mels=32)
+    out = np.asarray(mel(wavs))
+    assert out.shape[0] == 3 and out.shape[1] == 32
+
+
+# -------------------------------------------------------------------- IO
+def test_wav_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "t.wav")
+    wav = (RNG.normal(size=(1, 4000)) * 0.3).astype(np.float32)
+    audio.save(path, wav, sample_rate=16000)
+    meta = audio.info(path)
+    assert meta.sample_rate == 16000 and meta.num_samples == 4000
+    assert meta.num_channels == 1 and meta.bits_per_sample == 16
+    loaded, sr = audio.load(path)
+    assert sr == 16000 and loaded.shape == (1, 4000)
+    # save clips to [-1, 1] (16-bit PCM range); beyond that it's pure
+    # quantization error
+    np.testing.assert_allclose(loaded, np.clip(wav, -1.0, 1.0),
+                               atol=1.0 / 32767)
+    # offset/num_frames
+    part, _ = audio.load(path, frame_offset=100, num_frames=50)
+    np.testing.assert_allclose(part, loaded[:, 100:150], atol=1e-7)
+
+
+def test_audio_dataset_from_wavs(tmp_path):
+    files, labels = [], []
+    for i in range(4):
+        p = str(tmp_path / f"{i}.wav")
+        audio.save(p, RNG.normal(size=(1, 2000)).astype(np.float32) * 0.1,
+                   sample_rate=8000)
+        files.append(p)
+        labels.append(i % 2)
+    ds = audio.datasets.AudioClassificationDataset(
+        files, labels, feat_type="melspectrogram", duration=0.25,
+        sr=8000, n_fft=256, n_mels=16)
+    feat, label = ds[1]
+    assert feat.shape[0] == 16 and label == 1
+    assert len(ds) == 4
+    with pytest.raises(RuntimeError, match="data_dir"):
+        audio.datasets.ESC50(data_dir=str(tmp_path / "missing"))
+
+
+# ---------------------------------------------------------------- viterbi
+def _brute_force_viterbi(pot, trans, length, include_bos_eos):
+    N = pot.shape[-1]
+    best_score, best_path = -np.inf, None
+    for path in itertools.product(range(N), repeat=length):
+        score = pot[0, path[0]]
+        if include_bos_eos:
+            score += trans[-1, path[0]]
+        for t in range(1, length):
+            score += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include_bos_eos:
+            score += trans[path[-1], -2]
+        if score > best_score:
+            best_score, best_path = score, path
+    return best_score, list(best_path)
+
+
+@pytest.mark.parametrize("include", [False, True])
+def test_viterbi_matches_brute_force(include):
+    B, T, N = 3, 5, 4
+    pot = RNG.normal(size=(B, T, N)).astype(np.float32)
+    trans = RNG.normal(size=(N, N)).astype(np.float32)
+    lengths = np.asarray([5, 3, 4])
+    scores, paths = text.viterbi_decode(pot, trans, lengths, include)
+    for b in range(B):
+        bs, bp = _brute_force_viterbi(pot[b], trans, lengths[b], include)
+        assert abs(float(scores[b]) - bs) < 1e-4, b
+        assert list(np.asarray(paths[b])[:lengths[b]]) == bp, b
+
+
+def test_viterbi_decoder_layer_jits():
+    B, T, N = 2, 6, 5
+    pot = jnp.asarray(RNG.normal(size=(B, T, N)).astype(np.float32))
+    trans = RNG.normal(size=(N, N)).astype(np.float32)
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    lengths = jnp.asarray([6, 6])
+    s1, p1 = dec(pot, lengths)
+    s2, p2 = jax.jit(lambda q, l: dec(q, l))(pot, lengths)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(p1),
+                                  np.asarray(p2)[:, :p1.shape[1]])
+
+
+# ---------------------------------------------------------------- datasets
+def _make_imdb_tar(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for split in ("train", "test"):
+            for sent, label in [("good great fine", "pos"),
+                                ("bad awful poor", "neg")]:
+                for i in range(3):
+                    data = f"{sent} sample {i}".encode()
+                    info = tarfile.TarInfo(f"aclImdb/{split}/{label}/{i}.txt")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+    path = str(tmp_path / "aclImdb.tgz")
+    open(path, "wb").write(buf.getvalue())
+    return path
+
+
+def test_imdb_dataset(tmp_path):
+    path = _make_imdb_tar(tmp_path)
+    ds = text.Imdb(data_file=path, mode="train", cutoff=1)
+    assert len(ds) == 6
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    assert "<unk>" in ds.word_idx and "sample" in ds.word_idx
+
+
+def test_uci_housing(tmp_path):
+    data = RNG.normal(size=(50, 14)).astype(np.float64)
+    path = str(tmp_path / "housing.data")
+    np.savetxt(path, data)
+    train = text.UCIHousing(data_file=path, mode="train")
+    test = text.UCIHousing(data_file=path, mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    with pytest.raises(RuntimeError, match="data_file"):
+        text.UCIHousing(data_file=None)
+
+
+def test_imikolov_ngram(tmp_path):
+    buf = io.BytesIO()
+    lines = "\n".join("the quick brown fox jumps" for _ in range(60))
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name in ("ptb.train.txt", "ptb.valid.txt"):
+            data = lines.encode()
+            info = tarfile.TarInfo(f"simple-examples/data/{name}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    path = str(tmp_path / "ptb.tgz")
+    open(path, "wb").write(buf.getvalue())
+    ds = text.Imikolov(data_file=path, data_type="NGRAM", window_size=3,
+                       mode="train", min_word_freq=50)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert gram.shape == (3,) and gram.dtype == np.int64
