@@ -63,6 +63,9 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
     if audio.dtype.kind == "f":
         audio = np.clip(audio, -1.0, 1.0)
         audio = (audio * 32767.0).astype(np.int16)
+    elif audio.dtype != np.int16:
+        # writing wider ints raw would corrupt the 2-byte-sample header
+        audio = np.clip(audio, -32768, 32767).astype(np.int16)
     with wave.open(filepath, "wb") as f:
         f.setnchannels(audio.shape[1])
         f.setsampwidth(2)

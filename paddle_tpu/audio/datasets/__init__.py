@@ -45,6 +45,11 @@ class AudioClassificationDataset(Dataset):
 
     def __getitem__(self, idx):
         wav, sr = load_wav(self.files[idx])
+        if self.sample_rate is not None and sr != self.sample_rate:
+            raise ValueError(
+                f"{self.files[idx]}: file sample rate {sr} != requested "
+                f"{self.sample_rate} (resampling is not implemented; "
+                f"preprocess offline or omit sample_rate)")
         wav = wav[0]  # mono channel
         if self.duration is not None:
             n = int(self.duration * sr)

@@ -81,6 +81,14 @@ def distributed_model(model, optimizer=None, loss_fn=None, inputs_fn=None, **kw)
     from ..shard import DistributedTrainStep
 
     strategy: DistributedStrategy = _fleet_state["strategy"] or DistributedStrategy()
+    if strategy.localsgd:
+        from ..parallel.localsgd import LocalSGDStep
+
+        cfg = strategy.localsgd_configs or {}
+        return LocalSGDStep(model, optimizer, loss_fn=loss_fn,
+                            mesh=get_mesh(),
+                            k_steps=int(cfg.get("k_steps", 4)),
+                            inputs_fn=inputs_fn)
     stage = strategy.sharding_stage
     if strategy.gradient_merge and "grad_accum_steps" not in kw:
         cfg = strategy.gradient_merge_configs or {}
@@ -91,10 +99,36 @@ def distributed_model(model, optimizer=None, loss_fn=None, inputs_fn=None, **kw)
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """Optimizer passes through — grad synchronization is GSPMD's job; ZeRO
-    sharding is applied by DistributedTrainStep via opt-state specs."""
+    """Mostly a pass-through — grad synchronization is GSPMD's job; ZeRO
+    sharding is applied by DistributedTrainStep via opt-state specs. The one
+    rewrite kept from the reference's meta-optimizer stack: ``strategy.lars``
+    wraps a Momentum optimizer into LarsMomentum (lars_optimizer.py)."""
     if strategy is not None:
         _fleet_state["strategy"] = strategy
+    strategy = _fleet_state["strategy"]
+    if strategy is not None and strategy.lars:
+        from ...optimizer import LarsMomentum, Momentum
+
+        if isinstance(optimizer, Momentum) and \
+                not isinstance(optimizer, LarsMomentum):
+            import logging
+
+            cfg = strategy.lars_configs or {}
+            if optimizer.use_nesterov or optimizer.weight_decay:
+                logging.getLogger(__name__).warning(
+                    "strategy.lars replaces Momentum's "
+                    "use_nesterov/weight_decay with LARS semantics "
+                    "(lars_weight_decay folds into the trust ratio)")
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer.momentum,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                epsilon=cfg.get("epsilon", 1e-8),
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay"),
+                parameters=optimizer._parameters,
+                grad_clip=optimizer.grad_clip)
     return optimizer
 
 

@@ -44,8 +44,15 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True  # no-op: XLA fuses
         self.nccl_comm_num = 1  # no-op
         self.lamb = False
+        # LARS (consumed: distributed_optimizer wraps Momentum into
+        # LarsMomentum with these knobs)
         self.lars = False
+        self.lars_configs: Dict[str, Any] = {
+            "lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+            "epsilon": 1e-8}
+        # LocalSGD (consumed: distributed_model returns a LocalSGDStep)
         self.localsgd = False
+        self.localsgd_configs: Dict[str, Any] = {"k_steps": 4}
         self.dgc = False
 
     @property

@@ -1,1 +1,2 @@
-from . import moe, mp_layers, pipeline, recompute, sequence_parallel  # noqa: F401
+from . import (localsgd, moe, mp_layers, pipeline, recompute,  # noqa: F401
+               sequence_parallel)

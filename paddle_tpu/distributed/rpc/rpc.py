@@ -203,36 +203,59 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
     return fut
 
 
+def _wait_keys(kv, keys, timeout, what):
+    deadline = time.time() + timeout
+    for key in keys:
+        while True:
+            try:
+                if kv.get(key) is not None:
+                    break
+            except OSError:
+                pass  # transient store hiccup; retry
+            if time.time() > deadline:
+                raise TimeoutError(f"rpc {what} timed out waiting {key}")
+            time.sleep(0.05)
+
+
 def _barrier(timeout=_DEFAULT_RPC_TIMEOUT):
     kv: KVClient = _state["kv"]
     me: WorkerInfo = _state["self"]
     ns = _namespace()
     kv.put(f"{ns}/barrier/{me.rank}", "1", ttl=_KEY_TTL)
-    deadline = time.time() + timeout
-    for r in range(_state["world"]):
-        while kv.get(f"{ns}/barrier/{r}") is None:
-            if time.time() > deadline:
-                raise TimeoutError("rpc shutdown barrier timed out")
-            time.sleep(0.05)
+    _wait_keys(kv, [f"{ns}/barrier/{r}" for r in range(_state["world"])],
+               timeout, "shutdown barrier")
 
 
 def shutdown() -> None:
-    """Barrier (so no in-flight request loses its executor), then stop."""
+    """Barrier (so no in-flight request loses its executor), then stop.
+
+    Two-phase: after the arrival barrier every rank posts a ``departed``
+    key; the store host (rank 0) keeps the KV server alive until ALL peers
+    have departed, so a peer descheduled mid-poll never sees the store
+    vanish under it. Keys are leased — nothing needs deleting for the TTL
+    to clean up, and deleting barrier keys early would strand slow pollers.
+    """
     if _state["workers"] is None:
         return
     _barrier()
     time.sleep(0.2)  # grace for requests accepted during the barrier
     _state["server"].stop()
     _state["pool"].shutdown(wait=True)
-    # clear our keys so a fast re-init on the same store can't see them
-    ns = _namespace()
+    kv: KVClient = _state["kv"]
     me: WorkerInfo = _state["self"]
+    ns = _namespace()
     try:
-        _state["kv"].delete(f"{ns}/worker/{me.rank}")
-        _state["kv"].delete(f"{ns}/barrier/{me.rank}")
+        kv.put(f"{ns}/departed/{me.rank}", "1", ttl=_KEY_TTL)
+        kv.delete(f"{ns}/worker/{me.rank}")
     except OSError:
         pass
     if _state["kv_server"] is not None:
+        try:
+            _wait_keys(kv, [f"{ns}/departed/{r}"
+                            for r in range(_state["world"])],
+                       _DEFAULT_RPC_TIMEOUT, "departure")
+        except TimeoutError:
+            pass  # a crashed peer shouldn't wedge the host's exit
         _state["kv_server"].stop()
     _state.update(server=None, workers=None, self=None, kv=None,
                   kv_server=None, pool=None, world=0)

@@ -41,13 +41,25 @@ class Config:
         self.device = "cpu"
 
     def set_cpu_math_library_num_threads(self, n: int):
-        pass
+        self._noop("set_cpu_math_library_num_threads",
+                   "XLA owns host threading")
 
     # ---- legacy switches accepted for compatibility ----------------------
+    @staticmethod
+    def _noop(switch: str, why: str) -> None:
+        """Honesty for accepted-and-ignored switches: one debug line says a
+        knob did nothing and why, instead of silently swallowing it."""
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "inference.Config.%s is a no-op on TPU (%s)", switch, why)
+
     def switch_ir_optim(self, flag: bool = True):
-        pass
+        self._noop("switch_ir_optim", "XLA always optimizes the program")
 
     def enable_memory_optim(self, flag: bool = True):
+        self._noop("enable_memory_optim",
+                   "XLA's buffer assignment is always on")
         self._memory_optim = flag
 
     def enable_tensorrt_engine(self, *a, **kw):

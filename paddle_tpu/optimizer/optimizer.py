@@ -432,3 +432,65 @@ class Lamb(Optimizer):
             new_p[k] = p - (lr * trust * r).astype(p.dtype)
             new_m[k], new_v[k] = m, v
         return new_p, {"moment1": new_m, "moment2": new_v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive rate scaling over momentum
+    (reference ``python/paddle/incubate/optimizer/lars_momentum.py`` and the
+    fleet ``lars`` meta-optimizer). Per-parameter trust ratio
+    ``lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)`` rescales the LR —
+    the large-batch recipe where Lamb's normalization is Adam-shaped and
+    LARS's is momentum-shaped."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-8, exclude_from_weight_decay=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+        self.exclude_from_weight_decay = exclude_from_weight_decay or []
+
+    def _init_slots(self, params):
+        return {"velocity": _tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)}
+
+    def _excluded(self, name) -> bool:
+        return any(frag in str(name) for frag in self.exclude_from_weight_decay)
+
+    def _apply(self, grads, state, params, lr):
+        def upd(p, g, v, excluded):
+            if g is None:
+                return p, v
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+            wd = 0.0 if excluded else self.lars_weight_decay
+            local_lr = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                self.lars_coeff * p_norm / (g_norm + wd * p_norm +
+                                            self.epsilon),
+                1.0)
+            v_new = self.momentum * v + lr * local_lr * (g32 + wd * p32)
+            return (p32 - v_new).astype(p.dtype), v_new
+
+        vel = state["velocity"]
+        if isinstance(params, dict):
+            # params are the framework's flat name->leaf dicts, so the
+            # exclude_from_weight_decay name fragments can be honored
+            out = {k: upd(params[k], grads.get(k), vel[k], self._excluded(k))
+                   for k in params}
+            new_params = {k: pv[0] for k, pv in out.items()}
+            new_v = {k: pv[1] for k, pv in out.items()}
+        else:
+            flat = _tree_map(lambda p, g, v: upd(p, g, v, False),
+                             params, grads, vel)
+            new_params = _tree_map(lambda pv: pv[0], flat,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+            new_v = _tree_map(lambda pv: pv[1], flat,
+                              is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"velocity": new_v}

@@ -92,13 +92,15 @@ class Imikolov(Dataset):
                        if c >= min_word_freq and w != "<s>"),
                       key=lambda w: (-freq[w], w))
         self.word_idx = {w: i for i, w in enumerate(kept)}
-        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        # boundary + unknown tokens always get ids (reference build_dict
+        # appends <s>/<e>/<unk>), so sentence-start/end n-grams survive
+        for tok in ("<s>", "<e>", "<unk>"):
+            self.word_idx.setdefault(tok, len(self.word_idx))
         unk = self.word_idx["<unk>"]
         self._samples = []
         for words in lines:
             ids = [self.word_idx.get(w, unk)
-                   for w in ["<s>"] * (window_size - 1) + words + ["<e>"]
-                   if w in self.word_idx or w not in ("<s>", "<e>")]
+                   for w in ["<s>"] * (window_size - 1) + words + ["<e>"]]
             if data_type == "NGRAM":
                 for i in range(window_size, len(ids) + 1):
                     self._samples.append(

