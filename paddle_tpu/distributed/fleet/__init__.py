@@ -128,6 +128,7 @@ def distributed_optimizer(optimizer, strategy=None):
                 exclude_from_weight_decay=cfg.get(
                     "exclude_from_weight_decay"),
                 parameters=optimizer._parameters,
+                multi_precision=optimizer.multi_precision,
                 grad_clip=optimizer.grad_clip)
     return optimizer
 

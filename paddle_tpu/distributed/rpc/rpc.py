@@ -32,13 +32,13 @@ _KEY_TTL = 600.0
 
 
 def _namespace() -> str:
-    """KV namespace scoped by job and pod incarnation (PADDLE_MASTER is
-    unique per pod generation and identical across its ranks — same trick
-    as fleet.metrics)."""
+    """KV namespace scoped by job, pod incarnation (PADDLE_MASTER is unique
+    per pod generation and identical across its ranks — same trick as
+    fleet.metrics), and the in-process init/shutdown cycle."""
     job = os.environ.get("PADDLE_JOB_ID", "default")
     gen = os.environ.get("PADDLE_MASTER", "0")
     gen = gen.replace("/", "_").replace(":", "_")
-    return f"rpc/{job}/{gen}"
+    return f"rpc/{job}/{gen}/c{_cycle}"
 
 
 @dataclass(frozen=True)
@@ -53,6 +53,10 @@ _state: Dict[str, object] = {
     "server": None, "workers": None, "self": None, "kv": None,
     "kv_server": None, "pool": None, "world": 0,
 }
+# init/shutdown cycle counter: namespaces each incarnation's KV keys so a
+# fast re-init never sees the previous cycle's rendezvous/barrier keys
+# (ranks run the same program, so their cycle counts stay aligned)
+_cycle = 0
 
 
 def _read_full(sock, n):
@@ -126,6 +130,8 @@ def init_rpc(name: str, rank: Optional[int] = None,
     (``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM``/``PADDLE_MASTER``);
     rank 0 hosts the master store.
     """
+    global _cycle
+    _cycle += 1
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
     world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
                   if world_size is None else world_size)

@@ -5,7 +5,7 @@ jvp/vjp/Jacobian/Hessian), ``asp/`` (2:4 structured sparsity),
 ``optimizer/`` (LookAhead, ModelAverage). The MoE layers live in
 ``paddle_tpu.distributed.parallel.moe`` (already first-class here).
 """
-from . import asp, autograd
+from . import asp, autograd, nn
 from .optimizer import LookAhead, ModelAverage
 
 __all__ = ["autograd", "asp", "LookAhead", "ModelAverage"]

@@ -1,0 +1,104 @@
+"""incubate.nn — "fused" transformer building blocks.
+
+Reference parity: ``python/paddle/incubate/nn/`` (FusedMultiHeadAttention,
+FusedFeedForward, FusedTransformerEncoderLayer, FusedMoELayer — python
+wrappers over hand-fused CUDA megakernels). TPU-native: XLA performs the
+same fusions automatically from the unfused graph, so these classes are
+API-compatible shells over the standard layers — kept so ported scripts
+importing ``paddle.incubate.nn`` run unchanged, with the same constructor
+signatures.
+"""
+from __future__ import annotations
+
+from ..nn import MultiHeadAttention, TransformerEncoderLayer
+from ..nn.layer import Layer
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMoELayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference fused-MHA SEMANTICS, not just attention: the fused op is
+    (pre-/post-)LayerNorm + attention + output dropout + residual add in
+    one kernel (``incubate/nn/layer/fused_transformer.py``), so the shell
+    must compute the same function — XLA re-fuses the chain anyway."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ..nn import Dropout, LayerNorm
+
+        self.attn = MultiHeadAttention(embed_dim, num_heads,
+                                       dropout=attn_dropout_rate,
+                                       kdim=kdim, vdim=vdim,
+                                       need_weights=need_weights)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.normalize_before = normalize_before
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        # the fused op computes qkv from ONE input (self-attention); the
+        # reference likewise requires key/value to be the query
+        residual = query
+        if self.normalize_before:
+            query = self.norm(query)
+        out = self.attn(query, query, query, attn_mask=attn_mask)
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """position-wise FFN (linear -> act -> dropout -> linear) matching the
+    reference's fused kernel signature."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ..nn import Dropout, LayerNorm, Linear
+
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout1 = Dropout(act_dropout_rate
+                                if act_dropout_rate is not None
+                                else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        self.activation = activation
+
+    def forward(self, src):
+        from ..nn import functional as F
+
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        act = getattr(F, self.activation)
+        src = self.linear2(self.dropout1(act(self.linear1(src))))
+        out = residual + self.dropout2(src)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(TransformerEncoderLayer):
+    """Reference fused encoder layer — same graph, XLA-fused."""
+
+
+def FusedMoELayer(*args, **kwargs):
+    """The reference's fused MoE — delegates to the EP-sharded MoELayer."""
+    from ..distributed.parallel.moe import MoELayer
+
+    return MoELayer(*args, **kwargs)
+

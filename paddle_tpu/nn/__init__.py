@@ -46,3 +46,4 @@ from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
+from . import utils  # noqa: F401  (weight/spectral norm, param transforms)

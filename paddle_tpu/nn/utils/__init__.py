@@ -1,0 +1,122 @@
+"""Parameter reparameterization utilities.
+
+Reference parity: ``python/paddle/nn/utils/`` (``weight_norm_hook.py``,
+``spectral_norm_hook.py``, ``transform_parameters.py``). TPU-native: the
+reparameterization runs in a forward-pre-hook; under ``functional_call``
+the hook sees traced ``weight_g``/``weight_v`` leaves, so the recompute
+jit-compiles into the step like any other op.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..layer import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except_dim(v, dim: Optional[int]):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim % v.ndim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: Optional[int] = 0):
+    """Reparameterize ``layer.<name>`` as ``g * v / ||v||`` (reference
+    ``weight_norm``): magnitude ``<name>_g`` and direction ``<name>_v``
+    train independently."""
+    if f"{name}_v" in layer._parameters:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.pop(name)
+    g = _norm_except_dim(jnp.asarray(w), dim)
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", jnp.asarray(w))
+
+    def hook(lyr, inputs):
+        v = getattr(lyr, f"{name}_v")
+        gg = getattr(lyr, f"{name}_g")
+        object.__setattr__(lyr, name,
+                           gg * v / (_norm_except_dim(v, dim) + 1e-12))
+        return None
+
+    helper = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_wn_state"):
+        object.__setattr__(layer, "_wn_state", {})
+    layer._wn_state[name] = {"dim": dim, "hook": helper}  # per-param entry
+    hook(layer, ())  # materialize eagerly so .weight reads work pre-forward
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Fold g/v back into a plain ``<name>`` parameter."""
+    state = getattr(layer, "_wn_state", {}).get(name)
+    if state is None:
+        raise ValueError(f"{name!r} has no weight norm to remove")
+    v = layer._parameters.pop(f"{name}_v")
+    g = layer._parameters.pop(f"{name}_g")
+    w = g * v / (_norm_except_dim(v, state["dim"]) + 1e-12)
+    state["hook"].remove()
+    del layer._wn_state[name]
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0):
+    """Divide ``layer.<name>`` by its largest singular value, estimated by
+    power iteration carried in ``<name>_u``/``<name>_v`` buffers (reference
+    ``spectral_norm``)."""
+    w = jnp.asarray(layer._parameters.pop(name))
+    layer.add_parameter(f"{name}_orig", w)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    key = jax.random.key(0)
+    ku, kv = jax.random.split(key)
+    layer.register_buffer(f"{name}_u", jax.random.normal(ku, (mat.shape[0],)))
+    layer.register_buffer(f"{name}_v", jax.random.normal(kv, (mat.shape[1],)))
+
+    def _l2(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    def hook(lyr, inputs):
+        w_orig = getattr(lyr, f"{name}_orig")
+        m = jnp.moveaxis(w_orig, dim, 0).reshape(w_orig.shape[dim], -1)
+        u = getattr(lyr, f"{name}_u")
+        v = getattr(lyr, f"{name}_v")
+        for _ in range(n_power_iterations):
+            v = _l2(m.T @ u)
+            u = _l2(m @ v)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        # persist the iteration (buffer update flows through functional_call)
+        lyr._buffers[f"{name}_u"] = u
+        lyr._buffers[f"{name}_v"] = v
+        sigma = u @ (m @ v)
+        object.__setattr__(lyr, name, w_orig / sigma)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list into one vector (reference
+    ``transform_parameters.py``)."""
+    return jnp.concatenate([jnp.asarray(p).reshape(-1) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Split ``vec`` back into arrays shaped like ``parameters``."""
+    out, off = [], 0
+    vec = jnp.asarray(vec)
+    for p in parameters:
+        a = jnp.asarray(p)
+        out.append(vec[off:off + a.size].reshape(a.shape))
+        off += a.size
+    return out

@@ -1,0 +1,124 @@
+"""nn.utils (weight/spectral norm, param transforms) + incubate.nn fused
+wrapper tests (reference ``python/paddle/nn/utils`` and
+``python/paddle/incubate/nn``)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.layer import buffer_state, functional_call, param_state
+from paddle_tpu.nn.utils import (parameters_to_vector, remove_weight_norm,
+                                 spectral_norm, vector_to_parameters,
+                                 weight_norm)
+
+RNG = np.random.default_rng(5)
+
+
+def test_weight_norm_preserves_function_and_reparametrizes():
+    lin = nn.Linear(6, 4)
+    x = jnp.asarray(RNG.normal(size=(3, 6)).astype(np.float32))
+    before = np.asarray(lin(x))
+    weight_norm(lin, "weight", dim=0)
+    ps = param_state(lin)
+    assert "weight_g" in ps and "weight_v" in ps and "weight" not in ps
+    np.testing.assert_allclose(np.asarray(lin(x)), before, rtol=1e-5,
+                               atol=1e-6)
+    # the reparameterization is differentiable through functional_call
+    def loss(p):
+        out, _ = functional_call(lin, p, {}, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(ps)
+    assert float(jnp.abs(g["weight_g"]).sum()) > 0
+    assert float(jnp.abs(g["weight_v"]).sum()) > 0
+    # scaling g scales the effective weight rows
+    lin2 = nn.Linear(6, 4)
+    weight_norm(lin2, "weight", dim=0)
+    ps2 = param_state(lin2)
+    ps2["weight_g"] = ps2["weight_g"] * 2.0
+    out_scaled, _ = functional_call(lin2, ps2, {}, x)
+    out_base = lin2(x)
+    np.testing.assert_allclose(np.asarray(out_scaled) -
+                               np.asarray(lin2.bias),
+                               2 * (np.asarray(out_base) -
+                                    np.asarray(lin2.bias)), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_remove_weight_norm_restores_plain_param():
+    lin = nn.Linear(5, 3)
+    x = jnp.asarray(RNG.normal(size=(2, 5)).astype(np.float32))
+    weight_norm(lin)
+    y = np.asarray(lin(x))
+    remove_weight_norm(lin)
+    ps = param_state(lin)
+    assert "weight" in ps and "weight_g" not in ps
+    np.testing.assert_allclose(np.asarray(lin(x)), y, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_unit_sigma():
+    lin = nn.Linear(8, 8)
+    spectral_norm(lin, "weight", n_power_iterations=3)
+    x = jnp.asarray(RNG.normal(size=(2, 8)).astype(np.float32))
+    for _ in range(10):  # power iteration converges through forwards
+        lin(x)
+    w = np.asarray(lin.weight)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05, sigma
+    ps = param_state(lin)
+    assert "weight_orig" in ps and "weight" not in ps
+
+
+def test_spectral_norm_buffer_updates_through_functional_call():
+    lin = nn.Linear(6, 6)
+    spectral_norm(lin)
+    ps, bs = param_state(lin), buffer_state(lin)
+    assert "weight_u" in bs
+    x = jnp.asarray(RNG.normal(size=(2, 6)).astype(np.float32))
+    _, new_bs = functional_call(lin, ps, bs, x)
+    assert not np.allclose(np.asarray(new_bs["weight_u"]),
+                           np.asarray(bs["weight_u"]))
+
+
+def test_parameters_to_vector_roundtrip():
+    params = [RNG.normal(size=(3, 4)).astype(np.float32),
+              RNG.normal(size=(7,)).astype(np.float32)]
+    vec = parameters_to_vector(params)
+    assert vec.shape == (19,)
+    back = vector_to_parameters(vec, params)
+    for a, b in zip(params, back):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_fused_wrappers_run():
+    from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                        FusedMultiHeadAttention,
+                                        FusedTransformerEncoderLayer)
+
+    x = jnp.asarray(RNG.normal(size=(2, 5, 16)).astype(np.float32))
+    mha = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0)
+    mha.eval()
+    assert mha(x, x, x).shape == (2, 5, 16)
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0, act_dropout_rate=0.0)
+    ffn.eval()
+    assert ffn(x).shape == (2, 5, 16)
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout=0.0,
+                                      attn_dropout=0.0, act_dropout=0.0)
+    enc.eval()
+    assert enc(x).shape == (2, 5, 16)
+
+
+def test_weight_norm_two_params_independent():
+    lin = nn.Linear(4, 3)
+    weight_norm(lin, "weight", dim=0)
+    weight_norm(lin, "bias", dim=None)
+    ps = param_state(lin)
+    assert {"weight_g", "weight_v", "bias_g", "bias_v"} <= set(ps)
+    remove_weight_norm(lin, "weight")  # must not clobber bias's hook
+    ps = param_state(lin)
+    assert "weight" in ps and "bias_g" in ps
+    remove_weight_norm(lin, "bias")
+    assert "bias" in param_state(lin)

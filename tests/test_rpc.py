@@ -69,3 +69,19 @@ def test_rpc_requires_init():
 
     with pytest.raises(RuntimeError, match="init_rpc"):
         rpc.rpc_sync("nobody", print)
+
+
+def test_rpc_reinit_cycles_single_process(tmp_path):
+    """init -> shutdown -> init -> shutdown on the same store must not see
+    the previous cycle's rendezvous/barrier keys."""
+    import socket
+
+    from paddle_tpu.distributed import rpc
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{s.getsockname()[1]}"
+    for cycle in range(2):
+        rpc.init_rpc(name="solo", rank=0, world_size=1, master_endpoint=ep)
+        assert rpc.rpc_sync("solo", int, args=(41 + cycle,)) == 41 + cycle
+        rpc.shutdown()

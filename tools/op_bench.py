@@ -111,8 +111,10 @@ def compare(baseline_path, threshold):
     base = json.load(open(baseline_path))
     cur = run()
     if cur["device"] != base.get("device"):
-        print(f"WARNING: baseline device {base.get('device')!r} != current "
-              f"{cur['device']!r}; timings are not comparable", flush=True)
+        print(f"SKIP: baseline device {base.get('device')!r} != current "
+              f"{cur['device']!r}; timings are not comparable — regenerate "
+              f"the baseline with --save on this machine", flush=True)
+        return 2  # distinct from regression (1): no comparable baseline
     failed = []
     for op, ms in cur["ms"].items():
         ref = base["ms"].get(op)
