@@ -22,6 +22,7 @@ from typing import Dict, Optional
 
 from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
                         make_lookup)
+from .pass_builder import PipelinedPassBuilder
 from .service import Communicator, PsClient, PsServer, launch_servers, shard_of
 from .table import MemorySparseTable, SSDSparseTable, SparseAccessorConfig
 
@@ -29,6 +30,7 @@ __all__ = [
     "SparseAccessorConfig", "MemorySparseTable", "SSDSparseTable",
     "SparseEmbedding", "StagedPull", "callbacks_supported", "make_lookup",
     "PsServer", "PsClient", "Communicator", "launch_servers", "shard_of",
+    "PipelinedPassBuilder",
     "PSContext", "get_ps_context",
 ]
 

@@ -29,6 +29,7 @@ _DEFAULT_RPC_TIMEOUT = 120.0
 # rendezvous/barrier keys are leased: a crashed incarnation's stale entries
 # must not satisfy the next rendezvous on a long-lived KV store forever
 _KEY_TTL = 600.0
+# init/shutdown cycle counter — see shutdown() for when it advances
 
 
 def _namespace() -> str:
@@ -53,9 +54,9 @@ _state: Dict[str, object] = {
     "server": None, "workers": None, "self": None, "kv": None,
     "kv_server": None, "pool": None, "world": 0,
 }
-# init/shutdown cycle counter: namespaces each incarnation's KV keys so a
-# fast re-init never sees the previous cycle's rendezvous/barrier keys
-# (ranks run the same program, so their cycle counts stay aligned)
+# namespaces each incarnation's KV keys so a fast re-init never sees the
+# previous cycle's rendezvous/barrier keys; advanced in shutdown() so a
+# retry after a FAILED init stays in the same namespace as its peers
 _cycle = 0
 
 
@@ -130,8 +131,6 @@ def init_rpc(name: str, rank: Optional[int] = None,
     (``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM``/``PADDLE_MASTER``);
     rank 0 hosts the master store.
     """
-    global _cycle
-    _cycle += 1
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
     world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
                   if world_size is None else world_size)
@@ -265,6 +264,11 @@ def shutdown() -> None:
         _state["kv_server"].stop()
     _state.update(server=None, workers=None, self=None, kv=None,
                   kv_server=None, pool=None, world=0)
+    # bump the cycle only on a COMPLETED shutdown: a rank retrying a failed
+    # init must land in the same namespace as its peers, and shutdown is
+    # collective (barriered), so all ranks advance together
+    global _cycle
+    _cycle += 1
 
 
 def get_worker_info(name: str) -> WorkerInfo:

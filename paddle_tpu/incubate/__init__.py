@@ -8,4 +8,4 @@ jvp/vjp/Jacobian/Hessian), ``asp/`` (2:4 structured sparsity),
 from . import asp, autograd, nn
 from .optimizer import LookAhead, ModelAverage
 
-__all__ = ["autograd", "asp", "LookAhead", "ModelAverage"]
+__all__ = ["autograd", "asp", "nn", "LookAhead", "ModelAverage"]

@@ -48,11 +48,14 @@ class FusedMultiHeadAttention(Layer):
         residual = query
         if self.normalize_before:
             query = self.norm(query)
-        out = self.attn(query, query, query, attn_mask=attn_mask)
+        out = self.attn(query, query, query, attn_mask=attn_mask,
+                        cache=cache)
+        if cache is not None:  # incremental decoding: (out, new_cache)
+            out, new_cache = out
         out = residual + self.dropout(out)
         if not self.normalize_before:
             out = self.norm(out)
-        return out
+        return (out, new_cache) if cache is not None else out
 
 
 class FusedFeedForward(Layer):
@@ -93,7 +96,20 @@ class FusedFeedForward(Layer):
 
 
 class FusedTransformerEncoderLayer(TransformerEncoderLayer):
-    """Reference fused encoder layer — same graph, XLA-fused."""
+    """Reference fused encoder layer — same graph, XLA-fused. Keeps the
+    reference's ``*_rate`` kwarg names (the base layer uses paddle.nn's
+    ``dropout``/``attn_dropout``/``act_dropout`` spelling)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(d_model, nhead, dim_feedforward,
+                         dropout=dropout_rate, activation=activation,
+                         attn_dropout=attn_dropout_rate,
+                         act_dropout=act_dropout_rate,
+                         normalize_before=normalize_before,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
 
 
 def FusedMoELayer(*args, **kwargs):

@@ -112,7 +112,13 @@ def parameters_to_vector(parameters, name=None):
 
 
 def vector_to_parameters(vec, parameters, name=None):
-    """Split ``vec`` back into arrays shaped like ``parameters``."""
+    """Split ``vec`` back into arrays shaped like ``parameters``.
+
+    DIFFERENCE from the reference: paddle writes the slices into the
+    parameter tensors in place; jax arrays are immutable, so this RETURNS
+    the new arrays — assign them back yourself (e.g. rebuild a state_dict
+    and ``layer.set_state_dict`` it). Discarding the return value does
+    nothing."""
     out, off = [], 0
     vec = jnp.asarray(vec)
     for p in parameters:

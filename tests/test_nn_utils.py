@@ -105,8 +105,9 @@ def test_fused_wrappers_run():
     ffn = FusedFeedForward(16, 32, dropout_rate=0.0, act_dropout_rate=0.0)
     ffn.eval()
     assert ffn(x).shape == (2, 5, 16)
-    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout=0.0,
-                                      attn_dropout=0.0, act_dropout=0.0)
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0,
+                                       act_dropout_rate=0.0)
     enc.eval()
     assert enc(x).shape == (2, 5, 16)
 

@@ -286,3 +286,123 @@ def test_int64_signs_through_slot_feed(tmp_path):
     signs, counts, labels = next(iter(ds))
     assert signs[101].dtype == np.int64
     np.testing.assert_array_equal(signs[101][0], [big, big + 2 ** 32])
+
+
+def test_pipelined_pass_builder_overlap_and_parity():
+    """PipelinedPassBuilder (PSGPUWrapper pre_build_thread analogue): the
+    prefetched pass equals a direct StagedPull, pushes land on the right
+    keys, and the build genuinely overlaps foreground work."""
+    import threading
+    import time
+
+    from paddle_tpu.distributed.ps import PipelinedPassBuilder
+
+    t = make_table("sgd")
+    rng = np.random.default_rng(0)
+    passes = [rng.integers(0, 500, (16, 3)) for _ in range(3)]
+
+    builder = PipelinedPassBuilder(t)
+    builder.prefetch(0, passes[0])
+    ref = MemorySparseTable(SparseAccessorConfig(
+        embed_dim=4, optimizer="sgd", learning_rate=0.1,
+        initial_range=0.01, seed=7))
+    ref_staged = StagedPull(ref)
+    ref_results = {0: ref_staged.pull(passes[0])}
+    for p in range(3):
+        if p + 1 < 3:
+            builder.prefetch(p + 1, passes[p + 1])
+            # builds are as-of build time (pre-update values, same
+            # staleness as the reference's pre_build_thread); join before
+            # pushing so the parity comparison is deterministic, and pull
+            # the mirror table at the matching point
+            builder._threads[p + 1].join()
+            ref_results[p + 1] = ref_staged.pull(passes[p + 1])
+        rows, inv, uniq = builder.get(p)
+        r_rows, r_inv, r_uniq = ref_results[p]
+        np.testing.assert_array_equal(uniq, r_uniq)
+        np.testing.assert_allclose(rows, r_rows, rtol=1e-6)
+        g = np.ones((uniq.size, 4), np.float32)
+        builder.push(p, g)
+        ref.push(r_uniq, g)
+        builder.end_pass(p)
+    np.testing.assert_allclose(t.pull(np.arange(500)),
+                               ref.pull(np.arange(500)), rtol=1e-6)
+
+    # overlap: a slow pull must not block the foreground between prefetch
+    # and get
+    class SlowTable(MemorySparseTable):
+        def pull(self, keys):
+            time.sleep(0.3)
+            return super().pull(keys)
+
+    slow = SlowTable(SparseAccessorConfig(embed_dim=4, optimizer="sgd"))
+    b2 = PipelinedPassBuilder(slow)
+    t0 = time.perf_counter()
+    b2.prefetch(0, np.arange(8))
+    foreground = time.perf_counter() - t0
+    assert foreground < 0.1, f"prefetch blocked {foreground:.2f}s"
+    rows, _, _ = b2.get(0)
+    assert rows.shape == (8, 4)
+
+
+def test_pass_builder_errors():
+    from paddle_tpu.distributed.ps import PipelinedPassBuilder
+
+    b = PipelinedPassBuilder(make_table())
+    with pytest.raises(KeyError, match="never prefetched"):
+        b.get(9)
+    with pytest.raises(KeyError, match="no pulled key set"):
+        b.push(9, np.zeros((1, 4), np.float32))
+
+
+def test_ssd_beyond_ram_working_set(tmp_path):
+    """Weak #5 (round 1): cycle a working set LARGER than what stays in RAM
+    through pass-based spill — every key's trained value must survive
+    eviction via the snapshot, across several passes."""
+    spill = str(tmp_path / "spill")
+    t = SSDSparseTable(spill, SparseAccessorConfig(
+        embed_dim=8, optimizer="sgd", learning_rate=1.0, seed=5),
+        cache_threshold=1e9)  # evict EVERYTHING at end_pass (tiny "RAM")
+    n, chunk = 5000, 1000
+    expected = {}
+    for p in range(5):  # each pass touches a different 1k-key chunk
+        t.begin_pass()
+        keys = np.arange(p * chunk, (p + 1) * chunk, dtype=np.int64)
+        t.pull(keys)
+        t.push(keys, np.full((chunk, 8), float(p + 1), np.float32))
+        for k in keys:
+            expected[int(k)] = None
+        vals = t.pull(keys)
+        t.end_pass()
+        assert len(t) == 0, "cache_threshold must evict all of RAM"
+        expected.update({int(k): vals[i] for i, k in enumerate(keys)})
+    # all 5k keys reload correctly from the spill file
+    t.begin_pass()
+    all_keys = np.arange(n, dtype=np.int64)
+    got = t.pull(all_keys)
+    for i, k in enumerate(all_keys):
+        np.testing.assert_allclose(got[i], expected[int(k)], rtol=1e-6,
+                                   err_msg=f"key {k}")
+    assert len(t) == n
+
+
+def test_pass_builder_ssd_no_data_loss(tmp_path):
+    """With an SSD table that evicts everything at end_pass, the builder
+    must warm-reload evicted keys (begin_pass inside the build) so trained
+    values survive across passes."""
+    from paddle_tpu.distributed.ps import PipelinedPassBuilder
+
+    t = SSDSparseTable(str(tmp_path / "spill"), SparseAccessorConfig(
+        embed_dim=4, optimizer="sgd", learning_rate=1.0, seed=3),
+        cache_threshold=1e9)
+    b = PipelinedPassBuilder(t)
+    ids = np.arange(10, dtype=np.int64)
+    b.prefetch(0, ids)
+    rows0, inv, uniq = b.get(0)
+    b.push(0, np.ones((uniq.size, 4), np.float32))
+    trained = t.pull(ids)
+    b.end_pass(0)  # spill + evict ALL
+    assert len(t) == 0
+    b.prefetch(1, ids)  # must reload, not re-init
+    rows1, _, _ = b.get(1)
+    np.testing.assert_allclose(rows1, trained, rtol=1e-6)
