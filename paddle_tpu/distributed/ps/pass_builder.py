@@ -101,7 +101,14 @@ class PipelinedPassBuilder:
         uniq = self._uniq.get(pass_id)
         if uniq is None:
             raise KeyError(f"pass {pass_id} has no pulled key set")
-        self.staged.push(uniq, row_grads)
+        with self._table_lock:
+            # warm-reload first: in the pipelined order an intervening
+            # end_pass may have evicted this pass's keys, and pushing into
+            # FindOrInit-re-initialized rows would permanently lose their
+            # trained snapshot values
+            if hasattr(self.table, "begin_pass"):
+                self.table.begin_pass()
+            self.staged.push(uniq, row_grads)
 
     def end_pass(self, pass_id: int) -> None:
         with self._lock:

@@ -29,7 +29,6 @@ _DEFAULT_RPC_TIMEOUT = 120.0
 # rendezvous/barrier keys are leased: a crashed incarnation's stale entries
 # must not satisfy the next rendezvous on a long-lived KV store forever
 _KEY_TTL = 600.0
-# init/shutdown cycle counter — see shutdown() for when it advances
 
 
 def _namespace() -> str:

@@ -2,10 +2,10 @@
 
 Reference parity: ``python/paddle/vision/`` (``models`` ResNet/VGG/
 MobileNet/LeNet..., ``transforms`` functional + compose pipeline,
-``datasets``). Models keep the reference's NCHW layout so ported
+``datasets``, ``ops`` detection/region ops). Models keep the reference's NCHW layout so ported
 checkpoints line up name-for-name (XLA lowers NCHW convs onto the MXU
 directly — see ``paddle_tpu.models.resnet``).
 """
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 
-__all__ = ["models", "transforms", "datasets"]
+__all__ = ["models", "transforms", "datasets", "ops"]

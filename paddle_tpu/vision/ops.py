@@ -1,0 +1,416 @@
+"""Detection / region ops.
+
+Reference parity: ``python/paddle/vision/ops.py`` (nms, box_coder,
+yolo_box, prior_box, roi_align, roi_pool, psroi_pool, deform_conv2d,
+read_file/decode_jpeg). TPU-native notes: the box math is pure jnp (XLA
+fuses it); the region poolers are gather+interpolation formulations (no
+scatter-heavy CUDA kernels to port); nms returns a dynamic-length index
+set, so it computes through jnp and materializes eagerly — inside jit use
+the fixed-shape ``nms_mask`` flavor.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["nms", "nms_mask", "box_coder", "yolo_box", "prior_box",
+           "roi_align", "roi_pool", "psroi_pool", "deform_conv2d",
+           "read_file", "decode_jpeg", "sequence_mask"]
+
+
+def _pairwise_iou(boxes):
+    """IoU matrix for [N, 4] (x1, y1, x2, y2) boxes."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms_mask(boxes, scores, iou_threshold: float = 0.3):
+    """Fixed-shape NMS: boolean keep-mask in SCORE order is computed with a
+    ``fori_loop`` greedy sweep — jit-safe (use this inside compiled code)."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    order = jnp.argsort(-scores)
+    iou = _pairwise_iou(boxes[order])
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        # suppressed if overlapping any higher-scoring KEPT box
+        over = (iou[:, i] > iou_threshold) & keep & (jnp.arange(n) < i)
+        return keep.at[i].set(~jnp.any(over))
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    # back to original indexing
+    keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None, name=None):
+    """Greedy NMS returning kept indices sorted by descending score
+    (reference ``nms``). Dynamic-length output -> eager; supports the
+    reference's categorical batched mode (suppression only within a
+    category) and ``top_k``."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    n = boxes.shape[0]
+    if scores is None:
+        scores = jnp.arange(n, 0, -1, dtype=jnp.float32)  # input order
+    scores = jnp.asarray(scores, jnp.float32)
+    if category_idxs is not None:
+        # offset boxes per category so cross-category IoU is 0 (the
+        # standard batched-nms trick)
+        cat = jnp.asarray(category_idxs)
+        offset = (cat.astype(jnp.float32) *
+                  (jnp.max(boxes) - jnp.min(boxes) + 1.0))[:, None]
+        keep = nms_mask(boxes + offset, scores, iou_threshold)
+    else:
+        keep = nms_mask(boxes, scores, iou_threshold)
+    idx = np.where(np.asarray(keep))[0]
+    idx = idx[np.argsort(-np.asarray(scores)[idx], kind="stable")]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return jnp.asarray(idx)  # default int dtype (x64 is globally off)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size", box_normalized: bool = True,
+              axis: int = 0, name=None):
+    """Encode/decode boxes against priors (reference ``box_coder``)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pb_w = pb[:, 2] - pb[:, 0] + norm
+    pb_h = pb[:, 3] - pb[:, 1] + norm
+    pb_x = pb[:, 0] + pb_w * 0.5
+    pb_y = pb[:, 1] + pb_h * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), jnp.float32)
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+        if var.ndim == 1:
+            var = var[None, :]
+    if code_type == "encode_center_size":
+        tb_w = tb[:, 2] - tb[:, 0] + norm
+        tb_h = tb[:, 3] - tb[:, 1] + norm
+        tb_x = tb[:, 0] + tb_w * 0.5
+        tb_y = tb[:, 1] + tb_h * 0.5
+        # [M priors, N targets] broadcast: reference encodes every target
+        # against every prior -> [N, M, 4]
+        out = jnp.stack([
+            (tb_x[:, None] - pb_x[None, :]) / pb_w[None, :],
+            (tb_y[:, None] - pb_y[None, :]) / pb_h[None, :],
+            jnp.log(jnp.abs(tb_w[:, None] / pb_w[None, :])),
+            jnp.log(jnp.abs(tb_h[:, None] / pb_h[None, :])),
+        ], axis=-1)
+        return out / var[None, :, :]
+    if code_type == "decode_center_size":
+        # tb: [N, M, 4] codes; priors broadcast along `axis`
+        exp = (None, slice(None)) if axis == 0 else (slice(None), None)
+        pbx, pby = pb_x[exp], pb_y[exp]
+        pbw, pbh = pb_w[exp], pb_h[exp]
+        v = var[exp[0], exp[1], :] if var.shape[0] > 1 else var[None, :, :]
+        tx = tb[..., 0] * v[..., 0] * pbw + pbx
+        ty = tb[..., 1] * v[..., 1] * pbh + pby
+        tw = jnp.exp(v[..., 2] * tb[..., 2]) * pbw
+        th = jnp.exp(v[..., 3] * tb[..., 3]) * pbh
+        return jnp.stack([tx - tw / 2, ty - th / 2,
+                          tx + tw / 2 - norm, ty + th / 2 - norm], axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float, downsample_ratio: int, clip_bbox: bool = True,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5, name=None):
+    """Decode a YOLOv3 head into boxes + scores (reference ``yolo_box``).
+    x: [N, C, H, W] with C = num_anchors * (5 + class_num)."""
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box iou_aware=False only (PP-YOLO's iou-aware channel "
+            "layout is not implemented)")
+    x = jnp.asarray(x, jnp.float32)
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[:, None]
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_y) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    conf = jnp.where(conf < conf_thresh, 0.0, conf)
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img = jnp.asarray(img_size, jnp.float32).reshape(n, 2)  # [h, w]
+    ih, iw = img[:, 0], img[:, 1]
+    x1 = (bx - bw / 2) * iw[:, None, None, None]
+    y1 = (by - bh / 2) * ih[:, None, None, None]
+    x2 = (bx + bw / 2) * iw[:, None, None, None]
+    y2 = (by + bh / 2) * ih[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, ih[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, iw[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, ih[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip: bool = False,
+              clip: bool = False, steps=(0.0, 0.0), offset: float = 0.5,
+              min_max_aspect_ratios_order: bool = False, name=None):
+    """SSD prior (anchor) boxes per feature-map cell (reference
+    ``prior_box``)."""
+    feat_h, feat_w = jnp.asarray(input).shape[2:]
+    img_h, img_w = jnp.asarray(image).shape[2:]
+    step_w = steps[0] or img_w / feat_w
+    step_h = steps[1] or img_h / feat_h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    whs = []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((float(np.sqrt(ms * mx)),) * 2)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((float(np.sqrt(ms * mx)),) * 2)
+    whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    boxes = jnp.stack([
+        (cxg[..., None] - whs[None, None, :, 0] / 2) / img_w,
+        (cyg[..., None] - whs[None, None, :, 1] / 2) / img_h,
+        (cxg[..., None] + whs[None, None, :, 0] / 2) / img_w,
+        (cyg[..., None] + whs[None, None, :, 1] / 2) / img_h,
+    ], axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+def _bilinear(x, ys, xs):
+    """Sample x [C, H, W] at float coords (ys, xs) [...]: bilinear, zero
+    padded outside."""
+    c, h, w = x.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+
+    def at(yi, xi):
+        valid = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        return x[:, yi, xi] * valid.astype(x.dtype)
+
+    return (at(y0, x0) * (1 - wy1) * (1 - wx1) +
+            at(y0, x0 + 1) * (1 - wy1) * wx1 +
+            at(y0 + 1, x0) * wy1 * (1 - wx1) +
+            at(y0 + 1, x0 + 1) * wy1 * wx1)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """RoIAlign (reference ``roi_align``): bilinear grid sampling + average
+    over samples per bin. x: [N, C, H, W]; boxes: [R, 4]; boxes_num: [N]."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+    # roi -> batch index mapping from boxes_num
+    batch_idx = jnp.repeat(jnp.arange(len(np.asarray(boxes_num))),
+                           np.asarray(boxes_num))
+
+    def one_roi(b, box):
+        x1, y1, x2, y2 = box * spatial_scale - off
+        rh = jnp.maximum((y2 - y1) / ph, 1e-6)
+        rw = jnp.maximum((x2 - x1) / pw, 1e-6)
+        # sample grid: sr x sr points per bin, centers at (k + 0.5)/sr
+        iy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        ys = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] + iy[None, :]) * rh
+        xs = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] + iy[None, :]) * rw
+        grid_y = jnp.broadcast_to(ys[:, None, :, None], (ph, pw, sr, sr))
+        grid_x = jnp.broadcast_to(xs[None, :, None, :], (ph, pw, sr, sr))
+        vals = _bilinear(x[b], grid_y.reshape(-1), grid_x.reshape(-1))
+        vals = vals.reshape(x.shape[1], ph, pw, sr * sr)
+        return vals.mean(-1)
+
+    return jax.vmap(one_roi)(batch_idx, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """RoIPool (reference ``roi_pool``): dense-sampled max per quantized
+    bin (sampling formulation — no data-dependent bin extents, so it
+    jit-compiles; matches the kernel up to sampling density)."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = 4  # samples per bin edge
+    batch_idx = jnp.repeat(jnp.arange(len(np.asarray(boxes_num))),
+                           np.asarray(boxes_num))
+
+    def one_roi(b, box):
+        x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0) / ph
+        rw = jnp.maximum(x2 - x1 + 1, 1.0) / pw
+        iy = jnp.arange(sr, dtype=jnp.float32) / sr
+        ys = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] + iy[None, :]) * rh
+        xs = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] + iy[None, :]) * rw
+        gy = jnp.broadcast_to(ys[:, None, :, None], (ph, pw, sr, sr))
+        gx = jnp.broadcast_to(xs[None, :, None, :], (ph, pw, sr, sr))
+        # nearest-sample max over the bin
+        yi = jnp.clip(jnp.floor(gy), 0, x.shape[2] - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.floor(gx), 0, x.shape[3] - 1).astype(jnp.int32)
+        vals = x[b][:, yi.reshape(-1), xi.reshape(-1)]
+        return vals.reshape(x.shape[1], ph, pw, sr * sr).max(-1)
+
+    return jax.vmap(one_roi)(batch_idx, boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference ``psroi_pool``): channel
+    block (i, j) feeds output bin (i, j); average pooling per bin."""
+    x = jnp.asarray(x, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    c = x.shape[1]
+    if c % (ph * pw):
+        raise ValueError(f"channels {c} must divide output {ph}x{pw}")
+    co = c // (ph * pw)
+    aligned = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                        sampling_ratio=2, aligned=False)  # [R, C, ph, pw]
+    r = aligned.shape[0]
+    # channel layout: [cout, ph, pw] blocks — bin (i, j) takes its block
+    blocks = aligned.reshape(r, co, ph, pw, ph, pw)
+    ii = jnp.arange(ph)
+    jj = jnp.arange(pw)
+    return blocks[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference ``deform_conv2d``): gather-sample
+    the input at offset positions, then a dense matmul — the gather+MXU
+    formulation of the CUDA kernel. x: [N, Cin, H, W]; offset:
+    [N, 2*dg*kh*kw, Ho, Wo]; mask (v2): [N, dg*kh*kw, Ho, Wo]."""
+    x = jnp.asarray(x, jnp.float32)
+    offset = jnp.asarray(offset, jnp.float32)
+    weight = jnp.asarray(weight, jnp.float32)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups/deformable_groups > 1")
+    n, cin, h, w = x.shape
+    cout, _, kh, kw = weight.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    padh, padw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    ho = (h + 2 * padh - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (w + 2 * padw - (dw * (kw - 1) + 1)) // sw + 1
+    base_y = (jnp.arange(ho) * sh - padh)[:, None, None] + \
+        (jnp.arange(kh) * dh)[None, :, None]              # [Ho, kh, 1]
+    base_x = (jnp.arange(wo) * sw - padw)[:, None, None] + \
+        (jnp.arange(kw) * dw)[None, :, None]              # [Wo, kw, 1]
+    off = offset.reshape(n, kh, kw, 2, ho, wo)
+    oy = off[:, :, :, 0]  # [N, kh, kw, Ho, Wo]
+    ox = off[:, :, :, 1]
+    # absolute sample coords [N, kh, kw, Ho, Wo]
+    ys = oy + base_y.transpose(1, 2, 0).reshape(1, kh, 1, ho, 1)
+    xs = ox + base_x.transpose(1, 2, 0).reshape(1, 1, kw, 1, wo)
+    if mask is not None:
+        m = jnp.asarray(mask, jnp.float32).reshape(n, kh, kw, ho, wo)
+    else:
+        m = jnp.ones((n, kh, kw, ho, wo), jnp.float32)
+
+    def sample_img(img, ys_i, xs_i, m_i):
+        vals = _bilinear(img, ys_i.reshape(-1), xs_i.reshape(-1))
+        return vals.reshape(cin, kh, kw, ho, wo) * m_i[None]
+
+    cols = jax.vmap(sample_img)(x, ys, xs, m)  # [N, Cin, kh, kw, Ho, Wo]
+    cols = cols.reshape(n, cin * kh * kw, ho * wo)
+    wmat = weight.reshape(cout, cin * kh * kw)
+    out = jnp.einsum("ok,nkp->nop", wmat, cols).reshape(n, cout, ho, wo)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)[None, :, None, None]
+    return out
+
+
+def read_file(filename: str, name=None):
+    """Raw file bytes as a uint8 tensor (reference ``read_file``)."""
+    with open(filename, "rb") as f:
+        return jnp.asarray(np.frombuffer(f.read(), np.uint8))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """Decode JPEG bytes to [C, H, W] uint8 via PIL (the host-side decode
+    the reference does with nvjpeg/CPU)."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="bool",
+                  name=None):
+    """[..., maxlen] mask of positions < length (reference
+    ``paddle.nn.functional.sequence_mask`` — the sequence-op family's
+    surviving member; LoD sequence ops collapse into masking on TPU)."""
+    from ..framework.dtype import convert_dtype
+
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    mask = jnp.arange(maxlen)[None, :] < lengths[..., None]
+    return mask.astype(convert_dtype(dtype))

@@ -370,8 +370,6 @@ def test_ssd_beyond_ram_working_set(tmp_path):
         keys = np.arange(p * chunk, (p + 1) * chunk, dtype=np.int64)
         t.pull(keys)
         t.push(keys, np.full((chunk, 8), float(p + 1), np.float32))
-        for k in keys:
-            expected[int(k)] = None
         vals = t.pull(keys)
         t.end_pass()
         assert len(t) == 0, "cache_threshold must evict all of RAM"
@@ -399,10 +397,15 @@ def test_pass_builder_ssd_no_data_loss(tmp_path):
     ids = np.arange(10, dtype=np.int64)
     b.prefetch(0, ids)
     rows0, inv, uniq = b.get(0)
+    # PIPELINED order: the next pass's build starts (and may finish)
+    # before the current pass ends
+    b.prefetch(1, ids)
+    b._threads[1].join()
     b.push(0, np.ones((uniq.size, 4), np.float32))
     trained = t.pull(ids)
-    b.end_pass(0)  # spill + evict ALL
+    b.end_pass(0)  # spill + evict ALL — including pass 1's pulled keys
     assert len(t) == 0
-    b.prefetch(1, ids)  # must reload, not re-init
-    rows1, _, _ = b.get(1)
-    np.testing.assert_allclose(rows1, trained, rtol=1e-6)
+    rows1, _, uniq1 = b.get(1)
+    # pass 1 pushes AFTER the eviction: must warm-reload, not re-init
+    b.push(1, np.ones((uniq1.size, 4), np.float32))
+    np.testing.assert_allclose(t.pull(ids), trained - 1.0, rtol=1e-6)

@@ -1,0 +1,196 @@
+"""Detection-op tests vs numpy reference implementations (reference
+``python/paddle/vision/ops.py`` semantics)."""
+import io
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.vision import ops
+
+RNG = np.random.default_rng(9)
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a_j = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / max(a_i + a_j - inter, 1e-10) > thresh:
+                suppressed[j] = True
+    return keep
+
+
+def test_nms_matches_reference_greedy():
+    boxes = RNG.uniform(0, 90, (40, 2)).astype(np.float32)
+    boxes = np.concatenate([boxes, boxes + RNG.uniform(5, 30, (40, 2))],
+                           axis=1).astype(np.float32)
+    scores = RNG.random(40).astype(np.float32)
+    got = list(np.asarray(ops.nms(boxes, 0.4, scores)))
+    want = _np_nms(boxes, scores, 0.4)
+    assert got == want
+
+
+def test_nms_categorical_and_topk():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]],
+                       np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    cats = np.asarray([0, 0, 1])
+    # same-category overlap suppressed; other category survives
+    kept = list(np.asarray(ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                                   categories=[0, 1])))
+    assert kept == [0, 2]
+    assert list(np.asarray(ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                                   categories=[0, 1], top_k=1))) == [0]
+
+
+def test_nms_mask_jit():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                       np.float32)
+    scores = np.asarray([0.5, 0.9, 0.1], np.float32)
+    keep = jax.jit(lambda b, s: ops.nms_mask(b, s, 0.5))(boxes, scores)
+    np.testing.assert_array_equal(np.asarray(keep), [False, True, True])
+
+
+def test_box_coder_roundtrip():
+    priors = RNG.uniform(0, 50, (6, 2)).astype(np.float32)
+    priors = np.concatenate([priors, priors + 10], axis=1)
+    targets = RNG.uniform(0, 50, (4, 2)).astype(np.float32)
+    targets = np.concatenate([targets, targets + 8], axis=1)
+    var = np.asarray([0.1, 0.1, 0.2, 0.2], np.float32)
+    codes = ops.box_coder(priors, var, targets, "encode_center_size")
+    assert codes.shape == (4, 6, 4)
+    decoded = ops.box_coder(priors, var, codes, "decode_center_size", axis=0)
+    # decoding the encoding of target t against prior p returns target t
+    for t in range(4):
+        np.testing.assert_allclose(np.asarray(decoded[t]),
+                                   np.tile(targets[t], (6, 1)), rtol=1e-4,
+                                   atol=1e-3)
+
+
+def test_yolo_box_shapes_and_range():
+    n, na, cls, h, w = 2, 3, 5, 4, 4
+    x = RNG.normal(size=(n, na * (5 + cls), h, w)).astype(np.float32)
+    img = np.asarray([[128, 128], [96, 64]], np.int32)
+    boxes, scores = ops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                 class_num=cls, conf_thresh=0.01,
+                                 downsample_ratio=32)
+    assert boxes.shape == (n, na * h * w, 4)
+    assert scores.shape == (n, na * h * w, cls)
+    b = np.asarray(boxes)
+    assert (b[0, :, [0, 2]] <= 127.0 + 1e-3).all() and (b >= -1e-3).all()
+
+
+def test_prior_box():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    boxes, var = ops.prior_box(feat, img, min_sizes=[16.0],
+                               aspect_ratios=[1.0, 2.0], flip=True)
+    assert boxes.shape[:2] == (4, 4) and boxes.shape[-1] == 4
+    assert var.shape == boxes.shape
+    c = np.asarray(boxes)[2, 2]
+    # centered anchors around cell (2,2) center = (40, 40)/64
+    centers = (c[:, :2] + c[:, 2:]) / 2
+    np.testing.assert_allclose(centers, 40.0 / 64, rtol=1e-5)
+
+
+def test_roi_align_constant_and_grad():
+    x = np.full((1, 2, 8, 8), 7.0, np.float32)
+    boxes = np.asarray([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = ops.roi_align(x, boxes, [1], output_size=2)
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(np.asarray(out), 7.0, rtol=1e-5)
+    # gradient flows to the input
+    g = jax.grad(lambda xx: ops.roi_align(xx, boxes, [1], 2).sum())(
+        jnp.asarray(x))
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_roi_align_linear_field_exact():
+    """On a bilinear field f(y, x) = x, averaged samples equal the bin
+    center's x — an analytically checkable case."""
+    h = w = 16
+    x = np.broadcast_to(np.arange(w, dtype=np.float32), (1, 1, h, w)).copy()
+    boxes = np.asarray([[2.0, 2.0, 10.0, 10.0]], np.float32)
+    out = np.asarray(ops.roi_align(x, boxes, [1], output_size=4,
+                                   aligned=False))
+    bin_w = 8.0 / 4
+    expect_x = 2.0 + (np.arange(4) + 0.5) * bin_w
+    np.testing.assert_allclose(out[0, 0, 0], expect_x, rtol=1e-5)
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 6, 6] = 9.0
+    out = np.asarray(ops.roi_pool(x, np.asarray([[0., 0., 7., 7.]],
+                                                np.float32), [1], 2))
+    assert out[0, 0, 0, 0] == 5.0 and out[0, 0, 1, 1] == 9.0
+
+
+def test_psroi_pool_channel_blocks():
+    r, co, ph, pw = 1, 2, 2, 2
+    c = co * ph * pw
+    x = RNG.normal(size=(1, c, 8, 8)).astype(np.float32)
+    out = ops.psroi_pool(x, np.asarray([[0., 0., 7., 7.]], np.float32),
+                         [1], (ph, pw))
+    assert out.shape == (r, co, ph, pw)
+    with pytest.raises(ValueError, match="divide"):
+        ops.psroi_pool(np.zeros((1, 3, 4, 4), np.float32),
+                       np.zeros((1, 4), np.float32), [1], 2)
+
+
+def test_deform_conv2d_zero_offsets_equals_conv():
+    """Zero offsets + all-ones mask reduce deform_conv2d to a plain conv."""
+    from jax import lax as jlax
+
+    x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    wgt = RNG.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out = ops.deform_conv2d(x, offset, wgt)
+    ref = jlax.conv_general_dilated(jnp.asarray(x), jnp.asarray(wgt),
+                                    (1, 1), "VALID")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-3,
+                               atol=1e-4)
+    # v2 mask halves the contribution
+    out_half = ops.deform_conv2d(x, offset, wgt,
+                                 mask=np.full((2, 9, 6, 6), 0.5, np.float32))
+    np.testing.assert_allclose(np.asarray(out_half), 0.5 * np.asarray(ref),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    arr = RNG.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+    p = str(tmp_path / "t.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    raw = ops.read_file(p)
+    assert raw.dtype == jnp.uint8
+    img = ops.decode_jpeg(raw, mode="rgb")
+    assert img.shape == (3, 16, 16)
+    assert abs(float(jnp.mean(img.astype(jnp.float32)))
+               - arr.mean()) < 10.0  # lossy
+
+
+def test_sequence_mask():
+    m = ops.sequence_mask(np.asarray([1, 3, 0]), maxlen=4)
+    np.testing.assert_array_equal(
+        np.asarray(m), [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+    m2 = ops.sequence_mask(np.asarray([2, 4]), dtype="float32")
+    assert m2.shape == (2, 4) and m2.dtype == jnp.float32
