@@ -50,6 +50,12 @@ def init(role_maker=None, is_collective: bool = True,
     mesh = init_mesh(shape)
     _fleet_state.update(strategy=strategy, hcg=HybridCommunicateGroup(mesh),
                         initialized=True)
+    # PS communicator mode (sync/async/geo), derived from
+    # a_sync/a_sync_configs the way the_one_ps.py does — applied
+    # UNCONDITIONALLY so a later plain init resets a prior async mode
+    from ..ps import get_ps_context
+
+    get_ps_context().configure_mode(strategy)
     return mesh
 
 

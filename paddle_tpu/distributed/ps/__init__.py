@@ -41,11 +41,51 @@ class PSContext:
     ``init_server``/``init_worker`` mirror ``fleet.init_server()`` /
     ``init_worker()``; with the local client they only manage the registry
     (no network to bring up).
+
+    ``configure_mode`` consumes ``DistributedStrategy.a_sync`` /
+    ``a_sync_configs`` (reference ``the_one_ps.py`` sync/async/geo mode
+    selection): tables served over a :class:`PsClient` get a
+    :class:`Communicator` in the matching send mode, and
+    :meth:`communicator_for` hands it out for the training loop's pushes.
     """
 
     def __init__(self):
         self._tables: Dict[str, MemorySparseTable] = {}
         self._running = False
+        self._mode = "sync"
+        self._geo_k = 4
+        self._comms: Dict[int, "Communicator"] = {}
+
+    def configure_mode(self, strategy) -> str:
+        """Derive the communicator mode from a DistributedStrategy
+        (``a_sync=False`` -> sync; ``a_sync=True`` -> async; with
+        ``a_sync_configs["k_steps"] > 0`` -> geo with that period).
+
+        Reconfiguring flushes and drops any cached communicators — they
+        carry the OLD mode/k_steps and must not be handed out again."""
+        cfg = getattr(strategy, "a_sync_configs", None) or {}
+        if getattr(strategy, "a_sync", False):
+            k = int(cfg.get("k_steps", 0))
+            mode = "geo" if k > 0 or cfg.get("geo") else "async"
+            geo_k = max(k, 1) if mode == "geo" else 4
+        else:
+            mode, geo_k = "sync", 4
+        if (mode, geo_k) != (self._mode, self._geo_k):
+            self._drop_communicators()
+        self._mode, self._geo_k = mode, geo_k
+        return self._mode
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def communicator_for(self, client) -> "Communicator":
+        """A (cached) Communicator over ``client`` in the configured mode."""
+        key = id(client)
+        if key not in self._comms:
+            self._comms[key] = Communicator(client, mode=self._mode,
+                                            k_steps=self._geo_k)
+        return self._comms[key]
 
     def create_table(self, name: str,
                      accessor: Optional[SparseAccessorConfig] = None,
@@ -74,8 +114,25 @@ class PSContext:
     def init_worker(self) -> None:
         self._running = True
 
+    def _drop_communicators(self) -> None:
+        """Flush and discard cached communicators; the FIRST flush failure
+        re-raises — a dead drain thread means pushes were lost, and
+        swallowing that would report a clean shutdown over lost gradients."""
+        comms, self._comms = list(self._comms.values()), {}
+        first_err = None
+        for comm in comms:
+            try:
+                comm.stop()  # flush pending async/geo pushes
+            except BaseException as e:
+                first_err = first_err or e
+        if first_err is not None:
+            raise first_err
+
     def stop_server(self) -> None:
-        self._running = False
+        try:
+            self._drop_communicators()
+        finally:
+            self._running = False
 
     def save_persistables(self, dirname: str) -> None:
         """``fleet.save_persistables`` analogue: one snapshot per table."""

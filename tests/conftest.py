@@ -44,3 +44,12 @@ def _seed_all():
     from paddle_tpu.distributed.mesh import set_mesh
 
     set_mesh(None)
+    # likewise the process-wide PS context: restore sync mode and drop any
+    # cached communicators (they may wrap clients a fixture already closed)
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.ps import get_ps_context
+
+    try:
+        get_ps_context().configure_mode(DistributedStrategy())
+    except Exception:
+        pass  # a dead communicator flush must not fail the NEXT test

@@ -173,3 +173,38 @@ def test_inproc_server_roundtrip():
     np.testing.assert_array_equal(client.pull(keys), local.pull(keys))
     client.close()
     srv.stop()
+
+
+def test_strategy_a_sync_selects_communicator_mode(cluster):
+    """strategy.a_sync / a_sync_configs drive the PS communicator mode
+    (reference the_one_ps.py mode selection)."""
+    import numpy as np
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.ps import get_ps_context
+
+    ctx = get_ps_context()
+    s = DistributedStrategy()
+    assert ctx.configure_mode(s) == "sync"
+    s.a_sync = True
+    s.a_sync_configs = {"k_steps": 0}
+    assert ctx.configure_mode(s) == "async"
+    s.a_sync_configs = {"k_steps": 8}
+    assert ctx.configure_mode(s) == "geo"
+    comm = ctx.communicator_for(cluster)
+    assert comm.mode == "geo" and comm.k_steps == 8
+    assert ctx.communicator_for(cluster) is comm  # cached
+    # pushes buffer for k steps then land
+    keys = np.arange(9900, 9904, dtype=np.int64)
+    base = cluster.pull(keys)
+    for _ in range(8):
+        comm.push(keys, np.ones((keys.size, DIM), np.float32))
+    np.testing.assert_allclose(cluster.pull(keys), base - 8.0, rtol=1e-6)
+    ctx.stop_server()  # flush + drop communicators
+    assert ctx.communicator_for(cluster) is not comm
+    # fleet.init wires it (mesh side effects reset by conftest)
+    s2 = DistributedStrategy()
+    s2.a_sync = True
+    fleet.init(strategy=s2)
+    assert ctx.mode == "async"
