@@ -52,6 +52,7 @@ from . import jit  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
 from . import eager  # noqa: F401  (Tensor.backward dygraph facade)
+from . import autograd  # noqa: F401  (PyLayer / hooks / backward)
 
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax

@@ -1,0 +1,42 @@
+"""``paddle.autograd`` facade.
+
+Reference parity: ``python/paddle/autograd/__init__.py`` — ``PyLayer`` /
+``PyLayerContext`` (``py_layer.py``), ``saved_tensors_hooks``
+(``saved_tensors_hooks.py``), and ``backward`` (``backward_mode.py``).
+
+TPU-native shape: the eager tape (``paddle_tpu.eager``) provides the
+engine; this module re-exports its user-extension points under the
+reference's import path. Functional transforms (jvp/vjp/Hessian, the
+reference's ``incubate/autograd``) live in :mod:`paddle_tpu.incubate`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..eager import (PyLayer, PyLayerContext, no_grad,  # noqa: F401
+                     saved_tensors_hooks)
+
+__all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks", "backward",
+           "no_grad"]
+
+
+def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
+             retain_graph: bool = False) -> None:
+    """Run backward from several roots at once (reference
+    ``python/paddle/autograd/backward_mode.py`` ``backward``): seeds each
+    root with the matching ``grad_tensors`` entry (ones if None) and
+    accumulates into leaf ``.grad``/layer stores."""
+    from ..eager import Tensor
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = list(grad_tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors must match tensors in length")
+    for i, (t, g) in enumerate(zip(tensors, grad_tensors)):
+        if not isinstance(t, Tensor):
+            raise TypeError("backward() roots must be eager Tensors")
+        # all but the last root retain the graph: later roots may share it
+        keep = retain_graph or i < len(tensors) - 1
+        t.backward(grad_tensor=g, retain_graph=keep)

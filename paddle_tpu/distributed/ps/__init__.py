@@ -18,6 +18,7 @@ TPU-native shape: tables are host-RAM C++ (:mod:`.table`). Two deployments:
 """
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional
 
 from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
@@ -54,7 +55,11 @@ class PSContext:
         self._running = False
         self._mode = "sync"
         self._geo_k = 4
-        self._comms: Dict[int, "Communicator"] = {}
+        # live communicators as weakrefs (for flush-on-reconfigure); the
+        # communicator itself is cached ON its client, so its lifetime is
+        # the client's — no registry entry can outlive or pin either one
+        self._comm_refs: list = []
+        self._comm_gen = 0
 
     def configure_mode(self, strategy) -> str:
         """Derive the communicator mode from a DistributedStrategy
@@ -80,12 +85,21 @@ class PSContext:
         return self._mode
 
     def communicator_for(self, client) -> "Communicator":
-        """A (cached) Communicator over ``client`` in the configured mode."""
-        key = id(client)
-        if key not in self._comms:
-            self._comms[key] = Communicator(client, mode=self._mode,
-                                            k_steps=self._geo_k)
-        return self._comms[key]
+        """A (cached) Communicator over ``client`` in the configured mode.
+
+        Cached on the client object itself (not an id-keyed registry:
+        CPython reuses ids after garbage collection, and a recycled id must
+        never hand out a Communicator bound to a dead client's sockets).
+        A generation counter invalidates caches when the mode changes."""
+        cached = getattr(client, "_ps_communicator", None)
+        if cached is not None:
+            comm, gen = cached
+            if gen == self._comm_gen:
+                return comm
+        comm = Communicator(client, mode=self._mode, k_steps=self._geo_k)
+        client._ps_communicator = (comm, self._comm_gen)
+        self._comm_refs.append(weakref.ref(comm))
+        return comm
 
     def create_table(self, name: str,
                      accessor: Optional[SparseAccessorConfig] = None,
@@ -115,12 +129,16 @@ class PSContext:
         self._running = True
 
     def _drop_communicators(self) -> None:
-        """Flush and discard cached communicators; the FIRST flush failure
-        re-raises — a dead drain thread means pushes were lost, and
+        """Flush and invalidate cached communicators; the FIRST flush
+        failure re-raises — a dead drain thread means pushes were lost, and
         swallowing that would report a clean shutdown over lost gradients."""
-        comms, self._comms = list(self._comms.values()), {}
+        refs, self._comm_refs = self._comm_refs, []
+        self._comm_gen += 1  # invalidate every client-side cache entry
         first_err = None
-        for comm in comms:
+        for ref in refs:
+            comm = ref()
+            if comm is None:
+                continue
             try:
                 comm.stop()  # flush pending async/geo pushes
             except BaseException as e:

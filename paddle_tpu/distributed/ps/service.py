@@ -59,9 +59,16 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 
 def shard_of(keys: np.ndarray, num_servers: int) -> np.ndarray:
     """Server index per key (client-side partitioning, brpc_ps_client.cc's
-    key->shard routing)."""
-    return (_splitmix64(np.asarray(keys, np.int64).view(np.uint64))
-            % np.uint64(num_servers)).astype(np.int64)
+    key->shard routing).
+
+    Routes on the UPPER 32 bits of the hash while the C++ table's internal
+    16-way sharding uses the full hash mod 16 (ps_table.cc shard_of): with a
+    shared low-bit router and power-of-two server counts, each server would
+    only ever see keys with hash ≡ s (mod num_servers), funnelling them into
+    a fraction of its internal shards and serializing behind shard mutexes.
+    """
+    return ((_splitmix64(np.asarray(keys, np.int64).view(np.uint64))
+             >> np.uint64(32)) % np.uint64(num_servers)).astype(np.int64)
 
 
 class PsServer:
@@ -438,7 +445,10 @@ def launch_servers(num_servers: int, embed_dim: int, optimizer: str = "adagrad",
             if not chunk:
                 fail(RuntimeError("PS server failed to start"))
             buf += chunk
-            for line in buf.decode(errors="replace").splitlines():
+            # only parse newline-terminated lines: read1 can split "PORT
+            # 12345\n" mid-number, and a truncated int would be a wrong port
+            complete, _, _ = buf.rpartition(b"\n")
+            for line in complete.decode(errors="replace").splitlines():
                 if line.startswith("PORT "):
                     endpoints.append(("127.0.0.1", int(line.split()[1])))
                     break

@@ -39,7 +39,8 @@ import numpy as np
 
 __all__ = [
     "Tensor", "to_tensor", "enable", "enabled", "no_grad", "grads_of",
-    "clear_grads", "apply_op",
+    "clear_grads", "apply_op", "PyLayer", "PyLayerContext",
+    "saved_tensors_hooks", "set_strict", "strict_enabled",
 ]
 
 _state = threading.local()
@@ -60,14 +61,57 @@ def no_grad():
         _state.grad_enabled = prev
 
 
-class _Node:
-    """One tape entry: a vjp closure + the tensors/param-sinks it feeds."""
+# Strict tape mode (default ON): converting a grad-requiring Tensor to a raw
+# numpy/jax array while recording silently detaches it from the tape — the
+# classic silent-wrong-grads bug (reference guards the analogous leak via
+# inplace-version checks, eager/tensor_wrapper.h). The guard raises instead;
+# convert deliberately with .detach()/.numpy() or under no_grad().
+_strict = [True]
 
-    __slots__ = ("vjp_fn", "parents", "out_treedef")
+
+def set_strict(flag: bool) -> bool:
+    """Toggle the Tensor→array leak guard; returns the previous value."""
+    prev, _strict[0] = _strict[0], bool(flag)
+    return prev
+
+
+def strict_enabled() -> bool:
+    return _strict[0]
+
+
+class _HookHandle:
+    """Removable handle returned by :meth:`Tensor.register_hook`."""
+
+    _next_id = [0]
+
+    def __init__(self, hooks: Dict[int, Callable]):
+        self._hooks = hooks
+        self._id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+
+    def remove(self) -> None:
+        self._hooks.pop(self._id, None)
+
+
+class _Node:
+    """One tape entry: a vjp closure + the tensors/param-sinks it feeds.
+
+    Multi-output ops share ONE node across their output tensors; backward
+    gathers the cotangents of every output and calls ``vjp_fn`` once with
+    the full tuple — the reference's single-``GradNode``-per-op contract
+    (a PyLayer backward must see all its output grads in one call)."""
+
+    __slots__ = ("vjp_fn", "parents", "outputs", "out_avals", "multi",
+                 "materialize")
 
     def __init__(self, vjp_fn, parents):
         self.vjp_fn = vjp_fn
         self.parents = parents  # list of Tensor | _ParamSink
+        self.outputs = []       # weakrefs to output Tensors (set by _wrap_out)
+        self.out_avals = []     # (shape, dtype) per output, for zero cts
+        self.multi = False      # True when the op returned a tuple/list
+        self.materialize = True  # zero-fill missing output cts (jax vjp
+        # closures need full tuples; PyLayer manages its own per ctx)
 
 
 class _ParamSink:
@@ -96,7 +140,8 @@ class Tensor:
     ops that depend on a grad-requiring input produce grad-requiring
     outputs)."""
 
-    __slots__ = ("_data", "stop_gradient", "grad", "_node")
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_hooks",
+                 "__weakref__")
 
     def __init__(self, data, stop_gradient: bool = True, _node: Optional[_Node] = None):
         self._data = data if isinstance(data, jax.Array) else jnp.asarray(data)
@@ -129,6 +174,65 @@ class Tensor:
 
     def detach(self) -> "Tensor":
         return Tensor(self._data, stop_gradient=True)
+
+    def register_hook(self, hook: Callable) -> "_HookHandle":
+        """Register ``hook(grad) -> grad | None`` fired when this tensor's
+        gradient is computed during ``backward()``; a non-None return
+        replaces the gradient both for ``.grad`` and for further backprop
+        (reference ``Tensor.register_hook``). Returns a removable handle."""
+        if not _requires_grad(self):
+            raise RuntimeError(
+                "cannot register a backward hook on a tensor that stops "
+                "gradient (set stop_gradient=False first)")
+        hooks = getattr(self, "_hooks", None)
+        if hooks is None:
+            hooks = {}
+            self._hooks = hooks
+        handle = _HookHandle(hooks)
+        hooks[handle._id] = hook
+        return handle
+
+    def _run_hooks(self, ct):
+        hooks = getattr(self, "_hooks", None)
+        if not hooks:
+            return ct
+        for hook in list(hooks.values()):
+            r = hook(Tensor(ct, stop_gradient=True))
+            if r is not None:
+                ct = _unwrap(r)
+        return ct
+
+    # -- raw-array conversion (strict-mode leak guard) --------------------
+    def _guard_convert(self):
+        if _strict[0] and _grad_enabled() and _requires_grad(self):
+            raise RuntimeError(
+                "converting a grad-requiring eager Tensor to a raw array "
+                "would silently detach it from the autograd tape; call "
+                ".detach() / .numpy() explicitly or convert under "
+                "eager.no_grad() (or eager.set_strict(False))")
+
+    def __array__(self, dtype=None):
+        self._guard_convert()
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __jax_array__(self):
+        self._guard_convert()
+        return self._data
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        """numpy ufuncs on Tensors (``np.exp(t)``, ``arr + t``): every
+        Tensor input passes the leak guard (grad-requiring ones raise
+        under strict mode — the result would be a detached ndarray),
+        data tensors compute through numpy and return an ndarray."""
+        arrays = []
+        for x in inputs:
+            if isinstance(x, Tensor):
+                x._guard_convert()
+                arrays.append(np.asarray(x._data))
+            else:
+                arrays.append(x)
+        return getattr(ufunc, method)(*arrays, **kwargs)
 
     def clone(self) -> "Tensor":
         return apply_op(lambda x: x * 1, self)
@@ -168,27 +272,50 @@ class Tensor:
         seed = (jnp.ones_like(self._data) if grad_tensor is None
                 else jnp.asarray(getattr(grad_tensor, "_data", grad_tensor)))
 
-        # topo order over the Tensor graph
-        order: List[Tensor] = []
+        # topo order over tape NODES (a multi-output op is one node whose
+        # vjp runs once with all of its outputs' cotangents)
+        order: List[_Node] = []
         seen = set()
 
-        def visit(t: "Tensor"):
-            if id(t) in seen or t._node is None:
+        def visit(node: _Node):
+            if id(node) in seen:
                 return
-            seen.add(id(t))
-            for p in t._node.parents:
-                if isinstance(p, Tensor):
-                    visit(p)
-            order.append(t)
+            seen.add(id(node))
+            for p in node.parents:
+                if isinstance(p, Tensor) and p._node is not None:
+                    visit(p._node)
+            order.append(node)
 
-        visit(self)
+        if self._node is not None:
+            visit(self._node)
         cotangents: Dict[int, Any] = {id(self): seed}
-        for t in reversed(order):
-            ct = cotangents.pop(id(t), None)
-            if ct is None:
+        leaves: Dict[int, "Tensor"] = {}
+        for node in reversed(order):
+            outs = [(r() if r is not None else None) for r in node.outputs]
+            cts, any_ct = [], False
+            for tout, aval in zip(outs, node.out_avals):
+                ct = cotangents.pop(id(tout), None) if tout is not None else None
+                if ct is not None:
+                    any_ct = True
+                    # hooks fire once per tensor with the FULLY accumulated
+                    # grad (all consumer contributions merged)
+                    ct = tout._run_hooks(ct)
+                    if tout is not self and not tout.stop_gradient:
+                        tout.grad = (ct if tout.grad is None
+                                     else tout.grad + ct)
+                cts.append(ct)
+            if not any_ct:
                 continue
-            parent_cts = t._node.vjp_fn(ct)
-            for p, pct in zip(t._node.parents, parent_cts):
+            if node.multi:
+                full = tuple(
+                    (jnp.zeros(a[0], a[1])
+                     if ct is None and a is not None and node.materialize
+                     else ct)
+                    for ct, a in zip(cts, node.out_avals))
+                parent_cts = node.vjp_fn(full)
+            else:
+                parent_cts = node.vjp_fn(cts[0])
+            for p, pct in zip(node.parents, parent_cts):
                 if pct is None:
                     continue
                 if isinstance(p, _ParamSink):
@@ -197,10 +324,20 @@ class Tensor:
                     if p._node is not None:
                         cur = cotangents.get(id(p))
                         cotangents[id(p)] = pct if cur is None else cur + pct
-                    if not p.stop_gradient:
-                        p.grad = pct if p.grad is None else p.grad + pct
+                    elif not p.stop_gradient:
+                        cur = cotangents.get(id(p))
+                        cotangents[id(p)] = pct if cur is None else cur + pct
+                        leaves[id(p)] = p
             if not retain_graph:
-                t._node = None
+                for tout in outs:
+                    if tout is not None:
+                        tout._node = None
+        for pid, p in leaves.items():
+            ct = cotangents.pop(pid, None)
+            if ct is None:
+                continue
+            ct = p._run_hooks(ct)
+            p.grad = ct if p.grad is None else p.grad + ct
 
     # ---------------------------------------------------------- operators
     def _binop(self, other, fn):
@@ -347,28 +484,36 @@ def apply_op(fn: Callable, *args, **kwargs) -> Any:
 
 
 def _wrap_out(out, node):
+    import weakref
+
     if isinstance(out, (tuple, list)):
-        # multi-output: each element shares the node; backward seeds zeros
-        # for the siblings of the tensor actually differentiated
-        return type(out)(_wrap_single(o, node, out, i) for i, o in enumerate(out))
-    return _wrap_single(out, node, None, None)
-
-
-def _wrap_single(o, node, siblings, idx):
-    if not hasattr(o, "ndim"):
-        return o
+        if node is None:
+            return type(out)(Tensor(o) if hasattr(o, "ndim") else o
+                             for o in out)
+        # multi-output: every element shares the node; backward collects
+        # all elements' cotangents and calls the vjp ONCE
+        node.multi = True
+        wrapped = []
+        for o in out:
+            if hasattr(o, "ndim"):
+                t = Tensor(o, stop_gradient=False, _node=node)
+                node.outputs.append(weakref.ref(t))
+                node.out_avals.append((o.shape, o.dtype))
+                wrapped.append(t)
+            else:
+                # non-array element: no cotangent slot
+                node.outputs.append(None)
+                node.out_avals.append(None)
+                wrapped.append(o)
+        return type(out)(wrapped)
+    if not hasattr(out, "ndim"):
+        return out
     if node is None:
-        return Tensor(o)
-    if siblings is None:
-        return Tensor(o, stop_gradient=False, _node=node)
-
-    # wrap element of a tuple output: vjp expects the full tuple cotangent
-    def elem_vjp(ct, _vjp=node.vjp_fn, _idx=idx, _sib=siblings):
-        full = tuple(ct if j == _idx else jnp.zeros_like(s)
-                     for j, s in enumerate(_sib))
-        return _vjp(full)
-
-    return Tensor(o, stop_gradient=False, _node=_Node(elem_vjp, node.parents))
+        return Tensor(out)
+    t = Tensor(out, stop_gradient=False, _node=node)
+    node.outputs.append(weakref.ref(t))
+    node.out_avals.append((out.shape, out.dtype))
+    return t
 
 
 # --------------------------------------------------------- layer integration
@@ -535,3 +680,7 @@ def _wrap_module(mod):
             setattr(mod, name, make(fn))
         except (AttributeError, TypeError):
             pass
+
+
+from .py_layer import (PyLayer, PyLayerContext,  # noqa: E402
+                       saved_tensors_hooks)

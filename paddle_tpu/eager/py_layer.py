@@ -1,0 +1,168 @@
+"""User-defined autograd ops for the eager tape: ``PyLayer``.
+
+Reference parity: ``python/paddle/autograd/py_layer.py`` (``PyLayer`` with
+``forward(ctx, ...)`` / ``backward(ctx, ...)`` staticmethods, ``ctx.
+save_for_backward``/``saved_tensor``, ``mark_non_differentiable``,
+``set_materialize_grads``) and ``python/paddle/autograd/
+saved_tensors_hooks.py`` (pack/unpack hooks over saved tensors).
+
+TPU-native shape: the eager engine records one tape node per op whose
+"grad node" is a ``jax.vjp`` closure (see ``eager/__init__.py``); a
+``PyLayer.apply`` records one node whose closure is the user's
+``backward`` instead. ``forward`` runs under ``no_grad`` — the custom
+backward *replaces* the traced one, exactly the reference's graph-cut
+semantics. Inside jit, prefer ``jax.custom_vjp`` (this class is the
+dygraph ergonomics layer over the same idea).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from . import Tensor, _Node, _unwrap, _wrap_out, _requires_grad, \
+    _grad_enabled, no_grad
+
+__all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks"]
+
+_hooks_state = threading.local()
+
+
+def _current_pack_unpack():
+    stack = getattr(_hooks_state, "stack", None)
+    return stack[-1] if stack else (None, None)
+
+
+class saved_tensors_hooks:
+    """Register a pack/unpack hook pair applied to every tensor stashed by
+    ``ctx.save_for_backward`` while the context is active (reference
+    ``paddle.autograd.saved_tensors_hooks``): ``pack_hook(tensor) ->
+    anything`` runs at save time (offload to host/disk, quantize, ...);
+    ``unpack_hook(packed) -> tensor`` runs when backward retrieves it.
+    """
+
+    def __init__(self, pack_hook: Callable, unpack_hook: Callable):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        if not hasattr(_hooks_state, "stack"):
+            _hooks_state.stack = []
+        _hooks_state.stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_state.stack.pop()
+        return False
+
+
+class PyLayerContext:
+    """Forward/backward bridge object (the reference's ``PyLayerContext``):
+    holds saved tensors plus any attributes the user assigns."""
+
+    def __init__(self):
+        self._saved: List[Tuple[Any, Optional[Callable]]] = []
+        self._non_differentiable: List[int] = []  # ids of marked outputs
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors) -> None:
+        """Stash tensors for ``backward``; pack hooks (if a
+        ``saved_tensors_hooks`` scope is active) run here."""
+        pack, unpack = _current_pack_unpack()
+        for t in tensors:
+            if pack is not None:
+                self._saved.append((pack(t), unpack))
+            else:
+                self._saved.append((t, None))
+
+    def saved_tensor(self):
+        """Retrieve saved tensors (unpack hooks run here), as a list —
+        matching the reference's ``ctx.saved_tensor()``."""
+        out = []
+        for packed, unpack in self._saved:
+            out.append(unpack(packed) if unpack is not None else packed)
+        return out
+
+    def mark_non_differentiable(self, *tensors) -> None:
+        """Declare some forward outputs non-differentiable: their incoming
+        cotangents are dropped before ``backward`` is called."""
+        self._non_differentiable.extend(id(_unwrap(t)) for t in tensors)
+
+    def set_materialize_grads(self, value: bool) -> None:
+        """If False, outputs that received no gradient pass ``None`` to
+        ``backward`` instead of a zeros tensor."""
+        self._materialize_grads = bool(value)
+
+
+class PyLayer:
+    """Custom autograd op: subclass, define ``forward(ctx, *args)`` and
+    ``backward(ctx, *grads)`` staticmethods, call ``YourOp.apply(...)``.
+
+    ``backward`` must return one gradient per *Tensor* positional input of
+    ``forward``, in order (grads for inputs with ``stop_gradient=True`` are
+    discarded). Matches ``python/paddle/autograd/py_layer.py`` semantics.
+    """
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args, **kwargs):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement forward(ctx, ...)")
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement backward(ctx, ...)")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        # forward runs outside the tape: the user's backward replaces
+        # whatever ops forward executes (the graph-cut PyLayer contract)
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _grad_enabled() and any(
+            _requires_grad(a) for a in tensor_args)
+        if not needs_grad:
+            return _wrap_out(_unwrap_tree(out), None)
+
+        multi = isinstance(out, (tuple, list))
+        outs = [_unwrap(o) for o in out] if multi else [_unwrap(out)]
+        out_ids = [id(o) for o in outs]
+
+        def vjp_fn(ct):
+            cts = list(ct) if isinstance(ct, (tuple, list)) else [ct]
+            if len(cts) != len(outs):
+                raise RuntimeError(
+                    f"PyLayer backward got {len(cts)} output grads for "
+                    f"{len(outs)} outputs")
+            grads_in = []
+            for g, oid, o in zip(cts, out_ids, outs):
+                if oid in ctx._non_differentiable:
+                    g = None  # positional slot kept, grad dropped
+                elif g is None and ctx._materialize_grads:
+                    g = jnp.zeros_like(o)
+                grads_in.append(None if g is None
+                                else Tensor(g, stop_gradient=True))
+            with no_grad():
+                res = cls.backward(ctx, *grads_in)
+            res = res if isinstance(res, (tuple, list)) else (res,)
+            if len(res) != len(tensor_args):
+                raise RuntimeError(
+                    f"PyLayer backward returned {len(res)} gradients but "
+                    f"forward has {len(tensor_args)} Tensor inputs")
+            return tuple(None if r is None else _unwrap(r) for r in res)
+
+        node = _Node(vjp_fn, tensor_args)
+        # the tape must NOT zero-fill missing output grads: ctx's
+        # set_materialize_grads decides that inside vjp_fn itself
+        node.materialize = False
+        return _wrap_out(_unwrap_tree(out), node)
+
+
+def _unwrap_tree(out):
+    if isinstance(out, (tuple, list)):
+        return type(out)(_unwrap(o) for o in out)
+    return _unwrap(out)

@@ -49,6 +49,13 @@ int32_t pt_table_dim(void* h);
 
 namespace {
 
+// Largest body we will buffer for one request. Bounds the allocation a
+// single malformed/hostile frame can force (a bogus u32 length of ~4 GiB
+// would otherwise be handed straight to resize() and bad_alloc the server).
+// 256 MiB covers any sane batch: push of n keys costs n*(8 + 4*dim) bytes,
+// so even dim=512 allows ~130k keys per request.
+constexpr uint32_t kMaxFrameLen = 256u << 20;
+
 enum Op : uint8_t {
   kPull = 1,
   kPush = 2,
@@ -187,6 +194,12 @@ class PsServer {
       uint32_t len;
       std::memcpy(&len, hdr, 4);
       uint8_t op = static_cast<uint8_t>(hdr[4]);
+      if (len > kMaxFrameLen) {
+        // reply, then close: the oversized body is still in flight, so the
+        // stream cannot be re-synchronized without reading it all
+        SendReply(fd, -11, nullptr, 0);
+        break;
+      }
       body.resize(len);
       if (len && !ReadFull(fd, body.data(), len)) break;
       if (!Dispatch(fd, op, body.data(), len)) break;

@@ -285,24 +285,40 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
-    sr = 4  # samples per bin edge
+    # Samples per bin edge scale with the worst-case bin extent for an RoI
+    # covering the whole feature map (H/ph cells tall): spacing <= 1 cell
+    # means every integer cell of such a bin is hit, so the max is exact —
+    # not just a 4x4 subsample that can miss the true max in wide bins.
+    # RoIs extending beyond the map clip to the border (as the reference's
+    # quantized kernel effectively does).
+    sr_y = max(4, -(-x.shape[2] // ph))
+    sr_x = max(4, -(-x.shape[3] // pw))
     batch_idx = jnp.repeat(jnp.arange(len(np.asarray(boxes_num))),
                            np.asarray(boxes_num))
 
     def one_roi(b, box):
         x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+        # clip to the map BEFORE computing bin extents: out-of-bounds boxes
+        # would otherwise make bins wider than the sample budget assumes
+        # (spacing > 1 cell skips in-bounds rows) — and the reference's
+        # quantized kernel clamps bin coordinates into the map anyway
+        x1 = jnp.clip(x1, 0, x.shape[3] - 1)
+        x2 = jnp.clip(x2, 0, x.shape[3] - 1)
+        y1 = jnp.clip(y1, 0, x.shape[2] - 1)
+        y2 = jnp.clip(y2, 0, x.shape[2] - 1)
         rh = jnp.maximum(y2 - y1 + 1, 1.0) / ph
         rw = jnp.maximum(x2 - x1 + 1, 1.0) / pw
-        iy = jnp.arange(sr, dtype=jnp.float32) / sr
+        iy = jnp.arange(sr_y, dtype=jnp.float32) / sr_y
+        ix = jnp.arange(sr_x, dtype=jnp.float32) / sr_x
         ys = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] + iy[None, :]) * rh
-        xs = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] + iy[None, :]) * rw
-        gy = jnp.broadcast_to(ys[:, None, :, None], (ph, pw, sr, sr))
-        gx = jnp.broadcast_to(xs[None, :, None, :], (ph, pw, sr, sr))
+        xs = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] + ix[None, :]) * rw
+        gy = jnp.broadcast_to(ys[:, None, :, None], (ph, pw, sr_y, sr_x))
+        gx = jnp.broadcast_to(xs[None, :, None, :], (ph, pw, sr_y, sr_x))
         # nearest-sample max over the bin
         yi = jnp.clip(jnp.floor(gy), 0, x.shape[2] - 1).astype(jnp.int32)
         xi = jnp.clip(jnp.floor(gx), 0, x.shape[3] - 1).astype(jnp.int32)
         vals = x[b][:, yi.reshape(-1), xi.reshape(-1)]
-        return vals.reshape(x.shape[1], ph, pw, sr * sr).max(-1)
+        return vals.reshape(x.shape[1], ph, pw, sr_y * sr_x).max(-1)
 
     return jax.vmap(one_roi)(batch_idx, boxes)
 

@@ -156,3 +156,236 @@ def test_ops_method_delegation():
     assert isinstance(out, eager.Tensor)
     out.sum().backward()
     np.testing.assert_allclose(np.asarray(x.grad), np.exp(x.numpy()), rtol=1e-5)
+
+
+# ---------------------------------------------------------------- PyLayer
+def test_pylayer_custom_backward():
+    """Reference py_layer.py shape: forward saves activations, backward
+    computes the custom grad (tanh' = 1 - tanh^2 written by hand)."""
+
+    class CusTanh(eager.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = x.tanh()
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * (1 - y * y)
+
+    x = eager.to_tensor([0.3, -1.2, 2.0], stop_gradient=False)
+    out = CusTanh.apply(x)
+    assert isinstance(out, eager.Tensor)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad),
+                               1 - np.tanh(x.numpy()) ** 2, rtol=1e-5)
+
+
+def test_pylayer_scaled_backward_and_ctx_attrs():
+    """A deliberately WRONG custom grad proves the user's backward really
+    replaces the traced one; ctx carries arbitrary attributes + kwargs."""
+
+    class ScaleGrad(eager.PyLayer):
+        @staticmethod
+        def forward(ctx, x, factor=10.0):
+            ctx.factor = factor
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * ctx.factor
+
+    x = eager.to_tensor([1.0, 2.0], stop_gradient=False)
+    ScaleGrad.apply(x, factor=7.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [7.0, 7.0])  # not 2.0
+
+
+def test_pylayer_multi_input_output():
+    """Multi-output PyLayer: backward is invoked exactly ONCE with ALL
+    output grads (the reference single-GradNode contract), not once per
+    consumed output with zero-filled siblings."""
+    calls = []
+
+    class Swap(eager.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            return b * 2, a * 3
+
+        @staticmethod
+        def backward(ctx, da, db):
+            calls.append((da.numpy().copy(), db.numpy().copy()))
+            # forward: out0 = 2b, out1 = 3a -> d_a = 3*db, d_b = 2*da
+            return db * 3, da * 2
+
+    a = eager.to_tensor([1.0], stop_gradient=False)
+    b = eager.to_tensor([1.0], stop_gradient=False)
+    o0, o1 = Swap.apply(a, b)
+    (o0 * 5 + o1 * 7).backward()
+    assert len(calls) == 1  # one joint call, da=5, db=7 together
+    np.testing.assert_allclose(calls[0][0], [5.0])
+    np.testing.assert_allclose(calls[0][1], [7.0])
+    np.testing.assert_allclose(np.asarray(a.grad), [21.0])  # 3*7
+    np.testing.assert_allclose(np.asarray(b.grad), [10.0])  # 2*5
+
+    # a partially-consumed output still yields one call; the unconsumed
+    # output's grad materializes as zeros (default materialize_grads)
+    calls.clear()
+    o0, o1 = Swap.apply(a, b)
+    o0.sum().backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0][1], [0.0])
+
+
+def test_pylayer_training_loop():
+    """PyLayer composes with layers/optimizer in a paddle-shaped loop."""
+
+    class Square(eager.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2 * x
+
+    pt.seed(3)
+    fc = nn.Linear(4, 4)
+    opt = SGD(learning_rate=0.05, parameters=fc)
+    xs = np.random.default_rng(0).standard_normal((5, 2, 4)).astype(np.float32)
+    losses = []
+    for x in xs:
+        out = Square.apply(fc(eager.to_tensor(x)))
+        loss = out.mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_pylayer_backward_arity_check():
+    class Bad(eager.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            return a + b
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy  # should be 2 grads
+
+    a = eager.to_tensor([1.0], stop_gradient=False)
+    b = eager.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError, match="grad"):
+        Bad.apply(a, b).backward()
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    packed, unpacked = [], []
+
+    def pack(t):
+        packed.append(t)
+        return ("wrapped", t)
+
+    def unpack(p):
+        unpacked.append(p)
+        assert p[0] == "wrapped"
+        return p[1]
+
+    class Identity(eager.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 1
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * jnp.ones_like(x.numpy())
+
+    x = eager.to_tensor([5.0], stop_gradient=False)
+    with eager.saved_tensors_hooks(pack, unpack):
+        out = Identity.apply(x)
+    out.backward()
+    assert len(packed) == 1 and len(unpacked) == 1
+
+
+# ------------------------------------------------------------------ hooks
+def test_register_hook_observes_and_modifies():
+    x = eager.to_tensor([1.0, 2.0], stop_gradient=False)
+    seen = []
+    h = x.register_hook(lambda g: (seen.append(g.numpy().copy()), g * 2)[1])
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])  # raw grad observed
+    np.testing.assert_allclose(np.asarray(x.grad), [6.0, 6.0])  # doubled
+    h.remove()
+    x.clear_grad()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [3.0, 3.0])  # back to raw
+    assert len(seen) == 1  # removed hook did not fire again
+
+
+def test_register_hook_fires_once_with_accumulated_grad():
+    """Diamond: hook on an interior tensor sees the FULL accumulated grad
+    exactly once (reference hook timing)."""
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    calls = []
+    y.register_hook(lambda g: calls.append(g.numpy().copy()))
+    z = y * 3 + y  # two consumers of y
+    z.backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [4.0])  # 3 + 1
+    np.testing.assert_allclose(np.asarray(x.grad), [8.0])
+
+
+def test_register_hook_modified_grad_flows_upstream():
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    y = x * 5
+    y.register_hook(lambda g: g * 0)  # kill the gradient mid-flow
+    (y * 2).backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [0.0])
+
+
+def test_register_hook_requires_grad():
+    t = eager.to_tensor([1.0])  # stop_gradient=True
+    with pytest.raises(RuntimeError, match="stop"):
+        t.register_hook(lambda g: g)
+
+
+# ------------------------------------------------------------ strict mode
+def test_strict_mode_blocks_silent_detach():
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    y = x * 2  # grad-requiring, on tape
+    with pytest.raises(RuntimeError, match="detach"):
+        np.asarray(y)
+    with pytest.raises(RuntimeError, match="detach"):
+        jnp.asarray(y)
+    # explicit escapes work
+    assert float(y.detach().numpy()[0]) == 2.0
+    assert float(y.numpy()[0]) == 2.0
+    with eager.no_grad():
+        assert float(np.asarray(y)[0]) == 2.0  # deliberate, non-recording
+    # plain data tensors convert freely
+    t = eager.to_tensor([3.0])
+    assert float(np.asarray(t)[0]) == 3.0
+    # and the guard is toggleable
+    prev = eager.set_strict(False)
+    try:
+        assert float(np.asarray(y)[0]) == 2.0
+    finally:
+        eager.set_strict(prev)
+
+
+def test_autograd_facade_backward():
+    from paddle_tpu import autograd
+
+    assert autograd.PyLayer is eager.PyLayer
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    autograd.backward([y1, y2])
+    np.testing.assert_allclose(np.asarray(x.grad), [5.0])

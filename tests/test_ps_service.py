@@ -45,6 +45,24 @@ def test_shard_of_matches_cpp_router():
         0xE220A8397B1DCDAF)
 
 
+@pytest.mark.parametrize("num_servers", [2, 4, 16])
+def test_server_routing_decorrelated_from_table_shards(num_servers):
+    """Keys routed to ONE server must still spread over the table's 16
+    internal splitmix64-mod-16 shards: server routing uses the hash's upper
+    bits precisely so power-of-two server counts don't funnel each server's
+    keys into hash ≡ s (mod 16) residues (which at 16 servers would pile
+    every key onto a single internal shard mutex)."""
+    from paddle_tpu.distributed.ps.service import _splitmix64
+    keys = np.arange(200_000, dtype=np.int64)
+    sid = shard_of(keys, num_servers)
+    mine = keys[sid == 0]
+    internal = _splitmix64(mine.view(np.uint64)) % np.uint64(16)
+    counts = np.bincount(internal.astype(np.int64), minlength=16)
+    # every internal shard populated, none dominating
+    assert (counts > 0).all()
+    assert counts.max() < 4 * counts.mean()
+
+
 def test_pull_parity_with_local_table(cluster):
     """Deterministic per-(seed, key) init means the distributed pull matches
     a local table with the same accessor, regardless of which server owns
