@@ -22,11 +22,14 @@ __all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks", "backward",
 
 def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
              retain_graph: bool = False) -> None:
-    """Run backward from several roots at once (reference
-    ``python/paddle/autograd/backward_mode.py`` ``backward``): seeds each
-    root with the matching ``grad_tensors`` entry (ones if None) and
-    accumulates into leaf ``.grad``/layer stores."""
-    from ..eager import Tensor
+    """Run backward from several roots in ONE joint pass (reference
+    ``python/paddle/autograd/backward_mode.py`` ``backward``): all seeds
+    are planted before traversal, so a tensor reachable from several roots
+    sees its fully accumulated gradient (hooks fire once, vjps run once) —
+    not the partial per-root gradients a sequential emulation would give."""
+    import jax.numpy as jnp
+
+    from ..eager import Tensor, run_backward
 
     tensors = list(tensors)
     if grad_tensors is None:
@@ -34,9 +37,11 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
     grad_tensors = list(grad_tensors)
     if len(grad_tensors) != len(tensors):
         raise ValueError("grad_tensors must match tensors in length")
-    for i, (t, g) in enumerate(zip(tensors, grad_tensors)):
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
         if not isinstance(t, Tensor):
             raise TypeError("backward() roots must be eager Tensors")
-        # all but the last root retain the graph: later roots may share it
-        keep = retain_graph or i < len(tensors) - 1
-        t.backward(grad_tensor=g, retain_graph=keep)
+        seed = (jnp.ones_like(t._data) if g is None
+                else jnp.asarray(getattr(g, "_data", g)))
+        roots.append((t, seed))
+    run_backward(roots, retain_graph=retain_graph)

@@ -23,6 +23,8 @@ from typing import Dict, Optional
 
 from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
                         make_lookup)
+from .graph import (DistGraphClient, GraphDataGenerator, GraphServer,
+                    GraphTable, launch_graph_servers)
 from .pass_builder import PipelinedPassBuilder
 from .service import Communicator, PsClient, PsServer, launch_servers, shard_of
 from .table import MemorySparseTable, SSDSparseTable, SparseAccessorConfig
@@ -31,7 +33,8 @@ __all__ = [
     "SparseAccessorConfig", "MemorySparseTable", "SSDSparseTable",
     "SparseEmbedding", "StagedPull", "callbacks_supported", "make_lookup",
     "PsServer", "PsClient", "Communicator", "launch_servers", "shard_of",
-    "PipelinedPassBuilder",
+    "GraphTable", "GraphServer", "DistGraphClient", "GraphDataGenerator",
+    "launch_graph_servers", "PipelinedPassBuilder",
     "PSContext", "get_ps_context",
 ]
 

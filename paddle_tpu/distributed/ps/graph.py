@@ -16,7 +16,8 @@ shape strategy), so the jitted model never recompiles.
 """
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+import struct
+from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -80,13 +81,65 @@ class GraphTable:
 
     def random_walk(self, starts, walk_len: int, seed: int = 0) -> np.ndarray:
         """Fixed-length uniform random walks; [n, walk_len] int64, padded -1
-        after dead ends (start node excluded)."""
+        after dead ends (start node excluded). Each hop is deterministic in
+        (seed, walk row, step, node) so the sharded client's hop-by-hop walk
+        reproduces this exactly."""
         assert self._built, "call build() first"
         starts = np.ascontiguousarray(np.asarray(starts).reshape(-1), np.int64)
         out = np.empty((starts.size, walk_len), np.int64)
         self._lib.pt_graph_random_walk(
             self._h, native.as_i64_ptr(starts), starts.size, walk_len, seed,
             native.as_i64_ptr(out))
+        return out
+
+    def walk_step(self, nodes, idxs, step: int, seed: int = 0) -> np.ndarray:
+        """One walk hop per node: ``next[i] = hop(nodes[i])`` chosen
+        deterministically from ``(seed, idxs[i], step, nodes[i])``; -1 for
+        sinks/unknown/negative inputs."""
+        nodes = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.int64)
+        idxs = np.ascontiguousarray(np.asarray(idxs).reshape(-1), np.int64)
+        out = np.empty(nodes.size, np.int64)
+        self._lib.pt_graph_walk_step(
+            self._h, native.as_i64_ptr(nodes), native.as_i64_ptr(idxs),
+            nodes.size, int(step), seed, native.as_i64_ptr(out))
+        return out
+
+    # -- node features (GpuPsCommGraphFea, gpu_graph_node.h:326) ----------
+    def set_features(self, keys, feats) -> None:
+        """Attach a float feature vector to each node (first call fixes the
+        feature dim)."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        feats = np.ascontiguousarray(
+            np.asarray(feats, np.float32).reshape(keys.size, -1))
+        rc = self._lib.pt_graph_set_features(
+            self._h, native.as_i64_ptr(keys), native.as_f32_ptr(feats),
+            keys.size, feats.shape[1])
+        if rc != 0:
+            raise ValueError(
+                f"feature dim {feats.shape[1]} != table dim {self.feature_dim}")
+
+    @property
+    def feature_dim(self) -> int:
+        return int(self._lib.pt_graph_feature_dim(self._h))
+
+    def sample_with_features(self, nodes, sample_size: int,
+                             replace: bool = False, seed: int = 0):
+        """Neighbor sample with features attached (graph_neighbor_sample_v3
+        analogue); see :func:`_sample_with_features`."""
+        return _sample_with_features(self, nodes, sample_size, replace, seed)
+
+    def get_features(self, keys) -> np.ndarray:
+        """[n, dim] float32 features; zero-filled for nodes without any."""
+        dim = self.feature_dim
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        if dim == 0:
+            return np.zeros((keys.size, 0), np.float32)
+        out = np.empty((keys.size, dim), np.float32)
+        rc = self._lib.pt_graph_get_features(
+            self._h, native.as_i64_ptr(keys), keys.size, dim,
+            native.as_f32_ptr(out))
+        if rc != 0:
+            raise ValueError("feature dim mismatch")
         return out
 
     def __del__(self):
@@ -96,6 +149,297 @@ class GraphTable:
                 self._lib.pt_graph_destroy(h)
             except Exception:
                 pass
+
+
+def _sample_with_features(store, nodes, sample_size: int, replace: bool,
+                          seed: int):
+    """Neighbor sample + feature gather in one call (the reference's
+    ``graph_neighbor_sample_v3``: samples arrive with their
+    ``GpuPsCommGraphFea`` payloads). Returns ``(neighbors [n,k], counts [n],
+    feats [n,k,dim])`` with zero features on padding."""
+    nb, cnt = store.sample_neighbors(nodes, sample_size, replace=replace,
+                                     seed=seed)
+    dim = store.feature_dim
+    flat = nb.reshape(-1)
+    feats = np.zeros((flat.size, dim), np.float32)
+    valid = np.where(flat >= 0)[0]
+    if valid.size and dim:
+        feats[valid] = store.get_features(flat[valid])
+    return nb, cnt, feats.reshape(nb.shape[0], sample_size, dim)
+
+
+class GraphServer:
+    """One graph shard served over TCP (in-proc flavor for tests; real
+    deployments run ``python -m paddle_tpu.distributed.ps.graph_server``).
+
+    The multi-host half of the reference's graph engine: GraphBrpcServer
+    (``ps/service/graph_brpc_server.cc``) dispatching into its
+    CommonGraphTable shard. Ingest (add_edges/build/set_features) is phased
+    before serving reads, matching the reference's pass-based build."""
+
+    def __init__(self, port: int = 0, table: Optional[GraphTable] = None):
+        self.table = table or GraphTable()
+        self._lib = native.get_lib()
+        self._h = self._lib.pt_graph_server_start(self.table._h, int(port))
+        if not self._h:
+            raise OSError(f"failed to bind graph server on port {port}")
+
+    @property
+    def port(self) -> int:
+        return int(self._lib.pt_graph_server_port(self._h))
+
+    def wait(self) -> None:
+        self._lib.pt_graph_server_wait(self._h)
+
+    def stop(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.pt_graph_server_stop(h)
+            self._lib.pt_graph_server_destroy(h)
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+# graph service opcodes (native/src/graph_service.cc)
+_GOP_ADD_EDGES = 1
+_GOP_BUILD = 2
+_GOP_NUM_NODES = 3
+_GOP_NUM_EDGES = 4
+_GOP_NODE_IDS = 5
+_GOP_DEGREE = 6
+_GOP_SAMPLE = 7
+_GOP_WALK_STEP = 8
+_GOP_SET_FEAT = 9
+_GOP_GET_FEAT = 10
+_GOP_FEAT_DIM = 11
+_GOP_STOP = 12
+_GOP_CLEAR_EDGES = 13
+
+
+class DistGraphClient:
+    """Sharded graph client: the :class:`GraphTable` interface over N graph
+    servers, nodes partitioned by ``shard_of`` (a node's adjacency and
+    features live wholly on its owner shard).
+
+    Parity contract with the single-host store (tested in test_graph.py):
+
+    - ``sample_neighbors`` routes each query node to its owner; the owner
+      holds that node's full CSR row in the same order the single-host
+      store would, and sampling is deterministic per (seed, node) — so
+      results are bit-identical.
+    - ``random_walk`` steps hop-by-hop: at step t the frontier is grouped
+      by owner shard, each owner picks the next neighbor deterministically
+      from (seed, walk row, step, node) — the HeterComm per-hop key
+      exchange (``graph_gpu_ps_table.h:128-134``) restated client-side.
+      Bit-identical to the single-host walk.
+    - ``set_features``/``get_features`` route by owner.
+
+    Edges are buffered client-side and partitioned at :meth:`build` (both
+    directions for ``symmetric=True``, forward before reverse, preserving
+    the single-host CSR row order).
+    """
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]]):
+        from .service import _Conn
+        import threading
+
+        if not endpoints:
+            raise ValueError("need at least one graph endpoint")
+        self.endpoints = list(endpoints)
+        self._conns = [_Conn(h, p) for h, p in self.endpoints]
+        self._locks = [threading.Lock() for _ in self._conns]
+        self._src_buf: list = []
+        self._dst_buf: list = []
+        self._built = False
+
+    def _shard_of(self, keys: np.ndarray) -> np.ndarray:
+        from .service import shard_of
+
+        return shard_of(keys, len(self._conns))
+
+    def _request(self, s: int, op: int, body: bytes = b"") -> bytes:
+        with self._locks[s]:
+            return self._conns[s].request(op, body)
+
+    # -- ingest ------------------------------------------------------------
+    def add_edges(self, src, dst) -> None:
+        src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.int64)
+        dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.int64)
+        assert src.size == dst.size
+        self._src_buf.append(src)
+        self._dst_buf.append(dst)
+        self._built = False
+
+    def build(self, symmetric: bool = False) -> None:
+        src = (np.concatenate(self._src_buf) if self._src_buf
+               else np.empty(0, np.int64))
+        dst = (np.concatenate(self._dst_buf) if self._dst_buf
+               else np.empty(0, np.int64))
+        if symmetric:
+            # forward stream first, then the reversed stream — the order the
+            # single-host Build(symmetric) appends them, so each owner's CSR
+            # rows match
+            src, dst = (np.concatenate([src, dst]), np.concatenate([dst, src]))
+        owner = self._shard_of(src)
+        for s in range(len(self._conns)):
+            sel = owner == s
+            ss, dd = src[sel], dst[sel]
+            body = struct.pack("<I", ss.size) + ss.tobytes() + dd.tobytes()
+            # clear first: the client re-sends its FULL buffer each build
+            self._request(s, _GOP_CLEAR_EDGES)
+            self._request(s, _GOP_ADD_EDGES, body)
+            self._request(s, _GOP_BUILD, struct.pack("<B", 0))
+        self._built = True
+
+    # -- control plane -----------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return int(self.node_ids().size)
+
+    @property
+    def num_edges(self) -> int:
+        return sum(
+            struct.unpack("<q", self._request(s, _GOP_NUM_EDGES))[0]
+            for s in range(len(self._conns)))
+
+    def node_ids(self) -> np.ndarray:
+        parts = [np.frombuffer(self._request(s, _GOP_NODE_IDS), np.int64)
+                 for s in range(len(self._conns))]
+        # endpoints of cross-shard edges are interned on both sides; the
+        # global node set is the union
+        return np.unique(np.concatenate(parts)) if parts else \
+            np.empty(0, np.int64)
+
+    def degree(self, key: int) -> int:
+        s = int(self._shard_of(np.asarray([key], np.int64))[0])
+        return struct.unpack(
+            "<q", self._request(s, _GOP_DEGREE, struct.pack("<q", int(key))))[0]
+
+    # -- data plane --------------------------------------------------------
+    def sample_neighbors(self, nodes, sample_size: int, replace: bool = False,
+                         seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        assert self._built, "call build() first"
+        nodes = np.ascontiguousarray(np.asarray(nodes).reshape(-1), np.int64)
+        out = np.empty((nodes.size, sample_size), np.int64)
+        counts = np.empty(nodes.size, np.int32)
+        owner = self._shard_of(nodes)
+        for s in range(len(self._conns)):
+            sel = np.where(owner == s)[0]
+            if sel.size == 0:
+                continue
+            part = nodes[sel]
+            body = (struct.pack("<IiBQ", part.size, sample_size,
+                                1 if replace else 0, seed) + part.tobytes())
+            payload = self._request(s, _GOP_SAMPLE, body)
+            nb = np.frombuffer(payload[:part.size * sample_size * 8],
+                               np.int64).reshape(part.size, sample_size)
+            ct = np.frombuffer(payload[part.size * sample_size * 8:], np.int32)
+            out[sel] = nb
+            counts[sel] = ct
+        return out, counts
+
+    def random_walk(self, starts, walk_len: int, seed: int = 0) -> np.ndarray:
+        """Client-driven distributed walk: one cross-shard hop per step."""
+        assert self._built, "call build() first"
+        starts = np.ascontiguousarray(np.asarray(starts).reshape(-1), np.int64)
+        n = starts.size
+        out = np.full((n, walk_len), -1, np.int64)
+        cur = starts.copy()
+        rows = np.arange(n, dtype=np.int64)
+        for step in range(walk_len):
+            active = np.where(cur >= 0)[0]
+            if active.size == 0:
+                break
+            nxt = np.full(active.size, -1, np.int64)
+            owner = self._shard_of(cur[active])
+            for s in range(len(self._conns)):
+                sel = np.where(owner == s)[0]
+                if sel.size == 0:
+                    continue
+                part = cur[active[sel]]
+                idxs = rows[active[sel]]
+                body = (struct.pack("<IiQ", part.size, step, seed)
+                        + part.tobytes() + idxs.tobytes())
+                payload = self._request(s, _GOP_WALK_STEP, body)
+                nxt[sel] = np.frombuffer(payload, np.int64)
+            out[active, step] = nxt
+            new_cur = np.full(n, -1, np.int64)
+            new_cur[active] = nxt
+            cur = new_cur
+        return out
+
+    # -- features ----------------------------------------------------------
+    def set_features(self, keys, feats) -> None:
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        feats = np.ascontiguousarray(
+            np.asarray(feats, np.float32).reshape(keys.size, -1))
+        dim = feats.shape[1]
+        owner = self._shard_of(keys)
+        for s in range(len(self._conns)):
+            sel = owner == s
+            if not sel.any():
+                continue
+            kk, ff = keys[sel], feats[sel]
+            body = (struct.pack("<Ii", kk.size, dim) + kk.tobytes()
+                    + ff.tobytes())
+            self._request(s, _GOP_SET_FEAT, body)
+
+    @property
+    def feature_dim(self) -> int:
+        dims = [struct.unpack("<i", self._request(s, _GOP_FEAT_DIM))[0]
+                for s in range(len(self._conns))]
+        return max(dims) if dims else 0
+
+    def sample_with_features(self, nodes, sample_size: int,
+                             replace: bool = False, seed: int = 0):
+        """Neighbor sample with features attached (graph_neighbor_sample_v3
+        analogue); see :func:`_sample_with_features`."""
+        return _sample_with_features(self, nodes, sample_size, replace, seed)
+
+    def get_features(self, keys) -> np.ndarray:
+        dim = self.feature_dim
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        if dim == 0:
+            return np.zeros((keys.size, 0), np.float32)
+        out = np.zeros((keys.size, dim), np.float32)
+        owner = self._shard_of(keys)
+        for s in range(len(self._conns)):
+            sel = np.where(owner == s)[0]
+            if sel.size == 0:
+                continue
+            kk = keys[sel]
+            body = struct.pack("<Ii", kk.size, dim) + kk.tobytes()
+            payload = self._request(s, _GOP_GET_FEAT, body)
+            out[sel] = np.frombuffer(payload, np.float32).reshape(kk.size, dim)
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop_servers(self) -> None:
+        for s in range(len(self._conns)):
+            try:
+                self._request(s, _GOP_STOP)
+            except (IOError, ConnectionError):
+                pass  # server exits as it acks
+
+    def close(self) -> None:
+        for conn in self._conns:
+            conn.close()
+
+
+def launch_graph_servers(num_servers: int, timeout: float = 30.0):
+    """Spawn graph-shard server subprocesses on ephemeral ports; returns
+    ``(procs, endpoints)`` via the PORT-line handshake."""
+    import sys
+
+    from .service import launch_port_subprocesses
+
+    argv = [sys.executable, "-m", "paddle_tpu.distributed.ps.graph_server",
+            "--port", "0"]
+    return launch_port_subprocesses([argv] * num_servers, timeout=timeout)
 
 
 class GraphDataGenerator:

@@ -414,15 +414,19 @@ def launch_servers(num_servers: int, embed_dim: int, optimizer: str = "adagrad",
     once bound (the rendezvous handshake — the reference publishes endpoints
     through gloo/etcd instead).
     """
+    argv = [sys.executable, "-m", "paddle_tpu.distributed.ps.server",
+            "--port", "0", "--embed-dim", str(embed_dim),
+            "--optimizer", optimizer, "--lr", str(learning_rate),
+            "--seed", str(seed)]
+    return launch_port_subprocesses([argv] * num_servers, timeout=timeout)
+
+
+def launch_port_subprocesses(argvs, timeout: float = 30.0):
+    """Spawn one subprocess per argv; each must print ``PORT <p>`` on stdout
+    once its server socket is bound. Returns ``(procs, endpoints)``."""
     procs, endpoints = [], []
-    for s in range(num_servers):
-        p = subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.distributed.ps.server",
-             "--port", "0", "--embed-dim", str(embed_dim),
-             "--optimizer", optimizer, "--lr", str(learning_rate),
-             "--seed", str(seed)],
-            stdout=subprocess.PIPE)
-        procs.append(p)
+    for argv in argvs:
+        procs.append(subprocess.Popen(argv, stdout=subprocess.PIPE))
     deadline = time.time() + timeout
 
     def fail(exc):

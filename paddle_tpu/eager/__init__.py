@@ -39,7 +39,7 @@ import numpy as np
 
 __all__ = [
     "Tensor", "to_tensor", "enable", "enabled", "no_grad", "grads_of",
-    "clear_grads", "apply_op", "PyLayer", "PyLayerContext",
+    "clear_grads", "apply_op", "run_backward", "PyLayer", "PyLayerContext",
     "saved_tensors_hooks", "set_strict", "strict_enabled",
 ]
 
@@ -271,73 +271,7 @@ class Tensor:
             raise RuntimeError("backward() on a tensor with no grad history")
         seed = (jnp.ones_like(self._data) if grad_tensor is None
                 else jnp.asarray(getattr(grad_tensor, "_data", grad_tensor)))
-
-        # topo order over tape NODES (a multi-output op is one node whose
-        # vjp runs once with all of its outputs' cotangents)
-        order: List[_Node] = []
-        seen = set()
-
-        def visit(node: _Node):
-            if id(node) in seen:
-                return
-            seen.add(id(node))
-            for p in node.parents:
-                if isinstance(p, Tensor) and p._node is not None:
-                    visit(p._node)
-            order.append(node)
-
-        if self._node is not None:
-            visit(self._node)
-        cotangents: Dict[int, Any] = {id(self): seed}
-        leaves: Dict[int, "Tensor"] = {}
-        for node in reversed(order):
-            outs = [(r() if r is not None else None) for r in node.outputs]
-            cts, any_ct = [], False
-            for tout, aval in zip(outs, node.out_avals):
-                ct = cotangents.pop(id(tout), None) if tout is not None else None
-                if ct is not None:
-                    any_ct = True
-                    # hooks fire once per tensor with the FULLY accumulated
-                    # grad (all consumer contributions merged)
-                    ct = tout._run_hooks(ct)
-                    if tout is not self and not tout.stop_gradient:
-                        tout.grad = (ct if tout.grad is None
-                                     else tout.grad + ct)
-                cts.append(ct)
-            if not any_ct:
-                continue
-            if node.multi:
-                full = tuple(
-                    (jnp.zeros(a[0], a[1])
-                     if ct is None and a is not None and node.materialize
-                     else ct)
-                    for ct, a in zip(cts, node.out_avals))
-                parent_cts = node.vjp_fn(full)
-            else:
-                parent_cts = node.vjp_fn(cts[0])
-            for p, pct in zip(node.parents, parent_cts):
-                if pct is None:
-                    continue
-                if isinstance(p, _ParamSink):
-                    p.deposit(pct)
-                elif isinstance(p, Tensor):
-                    if p._node is not None:
-                        cur = cotangents.get(id(p))
-                        cotangents[id(p)] = pct if cur is None else cur + pct
-                    elif not p.stop_gradient:
-                        cur = cotangents.get(id(p))
-                        cotangents[id(p)] = pct if cur is None else cur + pct
-                        leaves[id(p)] = p
-            if not retain_graph:
-                for tout in outs:
-                    if tout is not None:
-                        tout._node = None
-        for pid, p in leaves.items():
-            ct = cotangents.pop(pid, None)
-            if ct is None:
-                continue
-            ct = p._run_hooks(ct)
-            p.grad = ct if p.grad is None else p.grad + ct
+        run_backward([(self, seed)], retain_graph=retain_graph)
 
     # ---------------------------------------------------------- operators
     def _binop(self, other, fn):
@@ -437,6 +371,90 @@ class Tensor:
             return apply_op(fn, self, *args, **kwargs)
 
         return method
+
+
+def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
+    """Joint reverse pass from one or more roots (reference
+    ``egr::RunBackward``): all seeds are planted up front, so a tensor
+    reachable from several roots accumulates its FULL cotangent before its
+    hooks fire and its vjp runs once — the multi-root semantics
+    ``paddle.autograd.backward`` promises (sequential per-root passes would
+    fire hooks with partial gradients).
+
+    Roots themselves do not receive ``.grad`` (they are seeded, not
+    computed); every other non-stop-gradient tensor does.
+    """
+    # topo order over tape NODES (a multi-output op is one node whose vjp
+    # runs once with all of its outputs' cotangents)
+    order: List[_Node] = []
+    seen = set()
+
+    def visit(node: _Node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for p in node.parents:
+            if isinstance(p, Tensor) and p._node is not None:
+                visit(p._node)
+        order.append(node)
+
+    cotangents: Dict[int, Any] = {}
+    root_ids = set()
+    for t, seed in roots_and_seeds:
+        if t._node is not None:
+            visit(t._node)
+        root_ids.add(id(t))
+        cur = cotangents.get(id(t))
+        cotangents[id(t)] = seed if cur is None else cur + seed
+    leaves: Dict[int, "Tensor"] = {}
+    for node in reversed(order):
+        outs = [(r() if r is not None else None) for r in node.outputs]
+        cts, any_ct = [], False
+        for tout, aval in zip(outs, node.out_avals):
+            ct = cotangents.pop(id(tout), None) if tout is not None else None
+            if ct is not None:
+                any_ct = True
+                # hooks fire once per tensor with the FULLY accumulated
+                # grad (all consumer + root contributions merged)
+                ct = tout._run_hooks(ct)
+                if id(tout) not in root_ids and not tout.stop_gradient:
+                    tout.grad = (ct if tout.grad is None
+                                 else tout.grad + ct)
+            cts.append(ct)
+        if not any_ct:
+            continue
+        if node.multi:
+            full = tuple(
+                (jnp.zeros(a[0], a[1])
+                 if ct is None and a is not None and node.materialize
+                 else ct)
+                for ct, a in zip(cts, node.out_avals))
+            parent_cts = node.vjp_fn(full)
+        else:
+            parent_cts = node.vjp_fn(cts[0])
+        for p, pct in zip(node.parents, parent_cts):
+            if pct is None:
+                continue
+            if isinstance(p, _ParamSink):
+                p.deposit(pct)
+            elif isinstance(p, Tensor):
+                if p._node is not None:
+                    cur = cotangents.get(id(p))
+                    cotangents[id(p)] = pct if cur is None else cur + pct
+                elif not p.stop_gradient:
+                    cur = cotangents.get(id(p))
+                    cotangents[id(p)] = pct if cur is None else cur + pct
+                    leaves[id(p)] = p
+        if not retain_graph:
+            for tout in outs:
+                if tout is not None:
+                    tout._node = None
+    for pid, p in leaves.items():
+        ct = cotangents.pop(pid, None)
+        if ct is None:
+            continue
+        ct = p._run_hooks(ct)
+        p.grad = ct if p.grad is None else p.grad + ct
 
 
 def _unwrap(x):

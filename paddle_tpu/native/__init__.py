@@ -110,6 +110,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_graph_create.argtypes = []
     lib.pt_graph_destroy.argtypes = [c.c_void_p]
     lib.pt_graph_add_edges.argtypes = [c.c_void_p, i64p, i64p, c.c_int64]
+    lib.pt_graph_clear_edges.argtypes = [c.c_void_p]
     lib.pt_graph_build.argtypes = [c.c_void_p, c.c_int32]
     lib.pt_graph_num_nodes.restype = c.c_int64
     lib.pt_graph_num_nodes.argtypes = [c.c_void_p]
@@ -124,6 +125,24 @@ def _declare(lib: ctypes.CDLL) -> None:
         i32p]
     lib.pt_graph_random_walk.argtypes = [
         c.c_void_p, i64p, c.c_int64, c.c_int32, c.c_uint64, i64p]
+    lib.pt_graph_walk_step.argtypes = [
+        c.c_void_p, i64p, i64p, c.c_int64, c.c_int32, c.c_uint64, i64p]
+    lib.pt_graph_set_features.restype = c.c_int32
+    lib.pt_graph_set_features.argtypes = [
+        c.c_void_p, i64p, f32p, c.c_int64, c.c_int32]
+    lib.pt_graph_get_features.restype = c.c_int32
+    lib.pt_graph_get_features.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_int32, f32p]
+    lib.pt_graph_feature_dim.restype = c.c_int32
+    lib.pt_graph_feature_dim.argtypes = [c.c_void_p]
+
+    lib.pt_graph_server_start.restype = c.c_void_p
+    lib.pt_graph_server_start.argtypes = [c.c_void_p, c.c_int32]
+    lib.pt_graph_server_port.restype = c.c_int32
+    lib.pt_graph_server_port.argtypes = [c.c_void_p]
+    lib.pt_graph_server_stop.argtypes = [c.c_void_p]
+    lib.pt_graph_server_wait.argtypes = [c.c_void_p]
+    lib.pt_graph_server_destroy.argtypes = [c.c_void_p]
 
     lib.pt_feed_create.restype = c.c_void_p
     lib.pt_feed_create.argtypes = [i64p, c.c_int64]

@@ -18,6 +18,8 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <shared_mutex>
 #include <unordered_map>
 #include <vector>
 
@@ -27,15 +29,31 @@ namespace {
 
 class GraphStore {
  public:
-  // Edge ingestion happens pre-Build into COO buffers.
+  // Edge ingestion happens pre-Build into COO buffers. Ingest ops take
+  // the adjacency lock exclusively; read ops share it — two clients of one
+  // server (one rebuilding, one sampling) must never race a CSR free.
   void AddEdges(const int64_t* src, const int64_t* dst, int64_t n) {
+    std::unique_lock<std::shared_mutex> g(adj_mu_);
     coo_src_.insert(coo_src_.end(), src, src + n);
     coo_dst_.insert(coo_dst_.end(), dst, dst + n);
+  }
+
+  // Drop the COO buffer (and derived CSR): the sharded client re-sends its
+  // full edge buffer on every build, so servers must start clean.
+  void ClearEdges() {
+    std::unique_lock<std::shared_mutex> g(adj_mu_);
+    coo_src_.clear();
+    coo_dst_.clear();
+    id_of_.clear();
+    ids_.clear();
+    row_ptr_.clear();
+    col_.clear();
   }
 
   // Rebuildable: the COO edge list is retained, so add_edges -> build ->
   // add_edges -> build accumulates (the CSR is derived state).
   void Build(bool symmetric) {
+    std::unique_lock<std::shared_mutex> g(adj_mu_);
     const size_t n = coo_src_.size();
     // Dense remap.
     id_of_.clear();
@@ -71,16 +89,24 @@ class GraphStore {
     }
   }
 
-  int64_t NumNodes() const { return static_cast<int64_t>(ids_.size()); }
-  int64_t NumEdges() const { return static_cast<int64_t>(col_.size()); }
+  int64_t NumNodes() const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
+    return static_cast<int64_t>(ids_.size());
+  }
+  int64_t NumEdges() const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
+    return static_cast<int64_t>(col_.size());
+  }
 
   int64_t NodeIds(int64_t* out, int64_t cap) const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
     int64_t w = std::min<int64_t>(cap, static_cast<int64_t>(ids_.size()));
     std::memcpy(out, ids_.data(), sizeof(int64_t) * w);
     return w;
   }
 
   int64_t Degree(int64_t key) const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
     auto it = id_of_.find(key);
     if (it == id_of_.end()) return 0;
     return row_ptr_[it->second + 1] - row_ptr_[it->second];
@@ -93,6 +119,7 @@ class GraphStore {
   void SampleNeighbors(const int64_t* nodes, int64_t n, int32_t k,
                        int32_t replace, uint64_t seed, int64_t* out,
                        int32_t* counts) const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
     ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
       for (size_t i = lo; i < hi; ++i) {
         int64_t* row = out + i * k;
@@ -130,36 +157,117 @@ class GraphStore {
     }, 64);
   }
 
+  // One walk hop for (node, walk-row, step): the next neighbor, chosen
+  // deterministically from (seed, walk_idx, step, node). Determinism per
+  // hop is what makes the SHARDED store's client-driven walk (route each
+  // frontier node to its owner shard, hop, repeat) bit-identical to the
+  // single-host walk below — the HeterComm per-hop key-exchange pattern
+  // (graph_gpu_ps_table.h:128-134) restated host-side. Returns -1 for
+  // unknown nodes and sinks.
+  int64_t WalkHop(int64_t node, uint64_t walk_idx, uint64_t step,
+                  uint64_t seed) const {
+    auto it = id_of_.find(node);
+    if (it == id_of_.end()) return -1;
+    const int64_t beg = row_ptr_[it->second], end = row_ptr_[it->second + 1];
+    const int64_t deg = end - beg;
+    if (deg == 0) return -1;
+    uint64_t h = ptn::splitmix64(
+        ptn::splitmix64(seed) ^ ptn::splitmix64((walk_idx << 20) ^ step) ^
+        ptn::splitmix64(static_cast<uint64_t>(node)));
+    return ids_[col_[beg + static_cast<int64_t>(h % static_cast<uint64_t>(deg))]];
+  }
+
+  // Batched single hop: next[i] = WalkHop(nodes[i], idxs[i], step, seed).
+  void WalkStep(const int64_t* nodes, const int64_t* idxs, int64_t n,
+                int32_t step, uint64_t seed, int64_t* next) const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        next[i] = nodes[i] < 0 ? -1
+                               : WalkHop(nodes[i],
+                                         static_cast<uint64_t>(idxs[i]),
+                                         static_cast<uint64_t>(step), seed);
+      }
+    }, 64);
+  }
+
   // Random walks of fixed length from each start; out[n * walk_len] holds the
   // visited nodes (start excluded), padded -1 after a dead end — the
-  // FillWalkBuf/GraphDoWalkKernel analogue.
+  // FillWalkBuf/GraphDoWalkKernel analogue. Composed of WalkHop so a
+  // sharded client stepping hop-by-hop reproduces it exactly.
   void RandomWalk(const int64_t* starts, int64_t n, int32_t walk_len,
                   uint64_t seed, int64_t* out) const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
     ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
       for (size_t i = lo; i < hi; ++i) {
         int64_t* row = out + i * walk_len;
         std::fill(row, row + walk_len, int64_t{-1});
-        auto it = id_of_.find(starts[i]);
-        if (it == id_of_.end()) continue;
-        int32_t cur = it->second;
-        ptn::XorShift128 rng(ptn::splitmix64(seed + i) ^
-                             ptn::splitmix64(static_cast<uint64_t>(starts[i])));
+        int64_t cur = starts[i];
         for (int32_t step = 0; step < walk_len; ++step) {
-          const int64_t beg = row_ptr_[cur], end = row_ptr_[cur + 1];
-          if (beg == end) break;
-          cur = col_[beg + static_cast<int64_t>(rng.bounded(end - beg))];
-          row[step] = ids_[cur];
+          cur = WalkHop(cur, static_cast<uint64_t>(i),
+                        static_cast<uint64_t>(step), seed);
+          if (cur < 0) break;
+          row[step] = cur;
         }
       }
     }, 64);
   }
 
+  // -- node feature table (GpuPsCommGraphFea analogue, gpu_graph_node.h:326:
+  // per-node float payloads carried next to the adjacency) ----------------
+  int32_t SetFeatures(const int64_t* keys, const float* vals, int64_t n,
+                      int32_t dim) {
+    std::unique_lock<std::shared_mutex> g(feat_mu_);
+    if (feat_dim_ == 0) feat_dim_ = dim;
+    if (dim != feat_dim_) return -1;
+    for (int64_t i = 0; i < n; ++i) {
+      auto it = feat_of_.find(keys[i]);
+      size_t off;
+      if (it == feat_of_.end()) {
+        off = feat_data_.size();
+        feat_of_.emplace(keys[i], off);
+        feat_data_.resize(off + dim);
+      } else {
+        off = it->second;
+      }
+      std::memcpy(feat_data_.data() + off, vals + i * dim,
+                  sizeof(float) * dim);
+    }
+    return 0;
+  }
+
+  int32_t FeatureDim() const { return feat_dim_; }
+
+  // Gather features; missing nodes zero-filled (the reference's slot-miss
+  // default). dim must match the configured dim.
+  int32_t GetFeatures(const int64_t* keys, int64_t n, int32_t dim,
+                      float* out) const {
+    std::shared_lock<std::shared_mutex> g(feat_mu_);
+    if (feat_dim_ != 0 && dim != feat_dim_) return -1;
+    std::memset(out, 0, sizeof(float) * static_cast<size_t>(n) * dim);
+    if (feat_dim_ == 0) return 0;
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        auto it = feat_of_.find(keys[i]);
+        if (it == feat_of_.end()) continue;
+        std::memcpy(out + i * dim, feat_data_.data() + it->second,
+                    sizeof(float) * dim);
+      }
+    }, 256);
+    return 0;
+  }
+
  private:
+  mutable std::shared_mutex adj_mu_;  // ingest exclusive, reads shared
   std::vector<int64_t> coo_src_, coo_dst_;
   std::unordered_map<int64_t, int32_t> id_of_;
   std::vector<int64_t> ids_;       // dense idx -> original id
   std::vector<int64_t> row_ptr_;   // CSR offsets
   std::vector<int32_t> col_;       // CSR neighbor dense indices
+  mutable std::shared_mutex feat_mu_;  // writers exclusive, readers shared
+  int32_t feat_dim_ = 0;
+  std::unordered_map<int64_t, size_t> feat_of_;  // key -> offset
+  std::vector<float> feat_data_;
 };
 
 }  // namespace
@@ -172,6 +280,10 @@ void pt_graph_destroy(void* h) { delete static_cast<GraphStore*>(h); }
 void pt_graph_add_edges(void* h, const int64_t* src, const int64_t* dst,
                         int64_t n) {
   static_cast<GraphStore*>(h)->AddEdges(src, dst, n);
+}
+
+void pt_graph_clear_edges(void* h) {
+  static_cast<GraphStore*>(h)->ClearEdges();
 }
 
 void pt_graph_build(void* h, int32_t symmetric) {
@@ -201,5 +313,24 @@ void pt_graph_sample_neighbors(void* h, const int64_t* nodes, int64_t n,
 void pt_graph_random_walk(void* h, const int64_t* starts, int64_t n,
                           int32_t walk_len, uint64_t seed, int64_t* out) {
   static_cast<GraphStore*>(h)->RandomWalk(starts, n, walk_len, seed, out);
+}
+
+void pt_graph_walk_step(void* h, const int64_t* nodes, const int64_t* idxs,
+                        int64_t n, int32_t step, uint64_t seed, int64_t* next) {
+  static_cast<GraphStore*>(h)->WalkStep(nodes, idxs, n, step, seed, next);
+}
+
+int32_t pt_graph_set_features(void* h, const int64_t* keys, const float* vals,
+                              int64_t n, int32_t dim) {
+  return static_cast<GraphStore*>(h)->SetFeatures(keys, vals, n, dim);
+}
+
+int32_t pt_graph_get_features(void* h, const int64_t* keys, int64_t n,
+                              int32_t dim, float* out) {
+  return static_cast<GraphStore*>(h)->GetFeatures(keys, n, dim, out);
+}
+
+int32_t pt_graph_feature_dim(void* h) {
+  return static_cast<GraphStore*>(h)->FeatureDim();
 }
 }

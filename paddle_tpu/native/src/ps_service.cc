@@ -9,19 +9,12 @@
 // ONE table instance (a shard of the global key space); clients partition
 // keys by hash across servers (HeterComm shard-by-hash restated host-side).
 //
-// Frame format (little-endian, x86/ARM hosts):
-//   request:  [u32 body_len][u8 op][body ...]
-//   reply:    [i32 status][u32 body_len][body ...]   status<0 => error
+// Framing and connection lifecycle live in net.h (shared with the graph
+// service, graph_service.cc).
 //
 // Ops: PULL keys->rows, PUSH keys+grads, SIZE, KEYS, SAVE, LOAD(merge flag),
 // SHRINK, SET_LR, BARRIER(world) — the worker-sync primitive the reference
 // routes through its Gloo/brpc barrier — and STOP.
-
-#include <arpa/inet.h>
-#include <netinet/in.h>
-#include <netinet/tcp.h>
-#include <sys/socket.h>
-#include <unistd.h>
 
 #include <atomic>
 #include <condition_variable>
@@ -30,8 +23,9 @@
 #include <memory>
 #include <mutex>
 #include <string>
-#include <thread>
 #include <vector>
+
+#include "net.h"
 
 extern "C" {
 // table C API (ps_table.cc)
@@ -49,13 +43,6 @@ int32_t pt_table_dim(void* h);
 
 namespace {
 
-// Largest body we will buffer for one request. Bounds the allocation a
-// single malformed/hostile frame can force (a bogus u32 length of ~4 GiB
-// would otherwise be handed straight to resize() and bad_alloc the server).
-// 256 MiB covers any sane batch: push of n keys costs n*(8 + 4*dim) bytes,
-// so even dim=512 allows ~130k keys per request.
-constexpr uint32_t kMaxFrameLen = 256u << 20;
-
 enum Op : uint8_t {
   kPull = 1,
   kPush = 2,
@@ -69,260 +56,123 @@ enum Op : uint8_t {
   kStop = 10,
 };
 
-bool ReadFull(int fd, void* buf, size_t n) {
-  char* p = static_cast<char*>(buf);
-  while (n > 0) {
-    ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) return false;
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
-}
+// The PS server = a FramedServer dispatching into one table, plus barrier
+// state (the only op needing cross-connection coordination).
+struct PsServer {
+  void* table = nullptr;
+  ptn::FramedServer* srv = nullptr;
+  // own stopping flag (not srv->stopping()): the dispatch lambda can run
+  // before Start() returns and assigns srv
+  std::atomic<bool> stopping{false};
+  std::mutex barrier_mu;
+  std::condition_variable barrier_cv;
+  uint64_t barrier_gen = 0;
+  uint32_t barrier_count = 0;
 
-bool WriteFull(int fd, const void* buf, size_t n) {
-  const char* p = static_cast<const char*>(buf);
-  while (n > 0) {
-    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (r <= 0) return false;
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
-}
-
-bool SendReply(int fd, int32_t status, const void* body, uint32_t len) {
-  char hdr[8];
-  std::memcpy(hdr, &status, 4);
-  std::memcpy(hdr + 4, &len, 4);
-  if (!WriteFull(fd, hdr, 8)) return false;
-  return len == 0 || WriteFull(fd, body, len);
-}
-
-class PsServer {
- public:
-  PsServer(void* table, int listen_fd, int port)
-      : table_(table), listen_fd_(listen_fd), port_(port) {
-    accept_thread_ = std::thread([this] { AcceptLoop(); });
-  }
-
-  int port() const { return port_; }
-
-  void Stop() {
-    bool expected = false;
-    if (!stopping_.compare_exchange_strong(expected, true)) {
-      // another thread (e.g. the detached kStop handler) is stopping; wait
-      // for it so stop-then-destroy can't free the server under its feet
-      Wait();
-      return;
-    }
-    ::shutdown(listen_fd_, SHUT_RDWR);
-    ::close(listen_fd_);
-    {
-      // only fds of still-running workers: a finished worker has already
-      // closed its fd and the number may have been recycled by the OS
-      std::lock_guard<std::mutex> g(conn_mu_);
-      for (auto& w : workers_) {
-        if (!w->done.load()) ::shutdown(w->fd, SHUT_RDWR);
-      }
-    }
-    // release any barrier waiters so their threads can exit
-    {
-      std::lock_guard<std::mutex> g(barrier_mu_);
-      barrier_gen_++;
-      barrier_count_ = 0;
-    }
-    barrier_cv_.notify_all();
-    if (accept_thread_.joinable()) accept_thread_.join();
-    std::vector<std::unique_ptr<Worker>> workers;
-    {
-      std::lock_guard<std::mutex> g(conn_mu_);
-      workers.swap(workers_);
-    }
-    for (auto& w : workers) {
-      if (w->thread.joinable()) w->thread.join();
-    }
-    std::lock_guard<std::mutex> g(stopped_mu_);
-    stopped_ = true;
-    stopped_cv_.notify_all();
-  }
-
-  void Wait() {
-    std::unique_lock<std::mutex> l(stopped_mu_);
-    stopped_cv_.wait(l, [this] { return stopped_; });
-  }
-
-  ~PsServer() { Stop(); }
-
- private:
-  struct Worker {
-    std::thread thread;
-    std::atomic<bool> done{false};
-    int fd = -1;
-  };
-
-  void AcceptLoop() {
-    while (!stopping_.load()) {
-      int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) break;
-      int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      std::lock_guard<std::mutex> g(conn_mu_);
-      // reap finished workers so short-lived connections (barriers) don't
-      // accumulate dead thread objects for the life of the server
-      for (auto it = workers_.begin(); it != workers_.end();) {
-        if ((*it)->done.load()) {
-          if ((*it)->thread.joinable()) (*it)->thread.join();
-          it = workers_.erase(it);
-        } else {
-          ++it;
-        }
-      }
-      workers_.emplace_back(new Worker);
-      Worker* w = workers_.back().get();
-      w->fd = fd;
-      w->thread = std::thread([this, w] { Serve(w); });
-    }
-  }
-
-  void Serve(Worker* w) {
-    const int fd = w->fd;
-    std::vector<char> body;
-    while (!stopping_.load()) {
-      char hdr[5];
-      if (!ReadFull(fd, hdr, 5)) break;
-      uint32_t len;
-      std::memcpy(&len, hdr, 4);
-      uint8_t op = static_cast<uint8_t>(hdr[4]);
-      if (len > kMaxFrameLen) {
-        // reply, then close: the oversized body is still in flight, so the
-        // stream cannot be re-synchronized without reading it all
-        SendReply(fd, -11, nullptr, 0);
-        break;
-      }
-      body.resize(len);
-      if (len && !ReadFull(fd, body.data(), len)) break;
-      if (!Dispatch(fd, op, body.data(), len)) break;
-    }
-    // done BEFORE close: Stop() only shutdown()s fds of workers with
-    // done == false, so it can never hit a recycled fd number
-    w->done.store(true);
-    ::close(fd);
-  }
-
-  bool Dispatch(int fd, uint8_t op, const char* body, uint32_t len) {
-    const int32_t dim = pt_table_dim(table_);
+  int Dispatch(int fd, uint8_t op, const char* body, uint32_t len) {
+    using ptn::SendReply;
+    const int32_t dim = pt_table_dim(table);
     // All size arithmetic in uint64 and every fixed-width field checked
-    // against len BEFORE the memcpy: a malformed or hostile frame must get
-    // an error reply, never an out-of-bounds read.
+    // against len BEFORE the memcpy; replies larger than the frame cap are
+    // rejected up front (their u32 length field would otherwise truncate
+    // and desync the stream).
     switch (op) {
       case kPull: {
-        if (len < 4) return SendReply(fd, -10, nullptr, 0);
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         uint32_t n;
         std::memcpy(&n, body, 4);
-        if (static_cast<uint64_t>(len) != 4 + static_cast<uint64_t>(n) * 8)
-          return SendReply(fd, -10, nullptr, 0);
+        if (static_cast<uint64_t>(len) != 4 + static_cast<uint64_t>(n) * 8 ||
+            static_cast<uint64_t>(n) * dim * 4 > ptn::kMaxFrameLen)
+          return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         const int64_t* keys = reinterpret_cast<const int64_t*>(body + 4);
         std::vector<float> rows(static_cast<size_t>(n) * dim);
-        pt_table_pull(table_, keys, n, rows.data());
+        pt_table_pull(table, keys, n, rows.data());
         return SendReply(fd, 0, rows.data(),
-                         static_cast<uint32_t>(rows.size() * 4));
+                         static_cast<uint32_t>(rows.size() * 4))
+                   ? 0
+                   : 1;
       }
       case kPush: {
-        if (len < 4) return SendReply(fd, -10, nullptr, 0);
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         uint32_t n;
         std::memcpy(&n, body, 4);
         if (static_cast<uint64_t>(len) !=
             4 + static_cast<uint64_t>(n) * 8 +
                 static_cast<uint64_t>(n) * dim * 4)
-          return SendReply(fd, -10, nullptr, 0);
+          return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         const int64_t* keys = reinterpret_cast<const int64_t*>(body + 4);
         const float* grads = reinterpret_cast<const float*>(body + 4 + n * 8);
-        pt_table_push(table_, keys, grads, n);
-        return SendReply(fd, 0, nullptr, 0);
+        pt_table_push(table, keys, grads, n);
+        return SendReply(fd, 0, nullptr, 0) ? 0 : 1;
       }
       case kSize: {
-        int64_t sz = pt_table_size(table_);
-        return SendReply(fd, 0, &sz, 8);
+        int64_t sz = pt_table_size(table);
+        return SendReply(fd, 0, &sz, 8) ? 0 : 1;
       }
       case kKeys: {
-        int64_t cap = pt_table_size(table_);
+        int64_t cap = pt_table_size(table);
+        if (static_cast<uint64_t>(cap) * 8 > ptn::kMaxFrameLen)
+          return SendReply(fd, -11, nullptr, 0) ? 0 : 1;
         std::vector<int64_t> keys(static_cast<size_t>(cap));
-        int64_t w = pt_table_keys(table_, keys.data(), cap);
-        return SendReply(fd, 0, keys.data(), static_cast<uint32_t>(w * 8));
+        int64_t w = pt_table_keys(table, keys.data(), cap);
+        return SendReply(fd, 0, keys.data(), static_cast<uint32_t>(w * 8))
+                   ? 0
+                   : 1;
       }
       case kSave: {
         std::string path(body, len);
-        int32_t rc = pt_table_save(table_, path.c_str());
-        return SendReply(fd, rc, nullptr, 0);
+        int32_t rc = pt_table_save(table, path.c_str());
+        return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
       }
       case kLoad: {
-        if (len < 1) return SendReply(fd, -10, nullptr, 0);
+        if (len < 1) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         bool merge = body[0] != 0;
         std::string path(body + 1, len - 1);
-        int32_t rc = merge ? pt_table_load_merge(table_, path.c_str())
-                           : pt_table_load(table_, path.c_str());
-        return SendReply(fd, rc, nullptr, 0);
+        int32_t rc = merge ? pt_table_load_merge(table, path.c_str())
+                           : pt_table_load(table, path.c_str());
+        return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
       }
       case kShrink: {
-        if (len < 4) return SendReply(fd, -10, nullptr, 0);
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         float thr;
         std::memcpy(&thr, body, 4);
-        int64_t dropped = pt_table_shrink(table_, thr);
-        return SendReply(fd, 0, &dropped, 8);
+        int64_t dropped = pt_table_shrink(table, thr);
+        return SendReply(fd, 0, &dropped, 8) ? 0 : 1;
       }
       case kSetLr: {
-        if (len < 4) return SendReply(fd, -10, nullptr, 0);
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         float lr;
         std::memcpy(&lr, body, 4);
-        pt_table_set_lr(table_, lr);
-        return SendReply(fd, 0, nullptr, 0);
+        pt_table_set_lr(table, lr);
+        return SendReply(fd, 0, nullptr, 0) ? 0 : 1;
       }
       case kBarrier: {
-        if (len < 4) return SendReply(fd, -10, nullptr, 0);
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
         uint32_t world;
         std::memcpy(&world, body, 4);
         {
-          std::unique_lock<std::mutex> l(barrier_mu_);
-          uint64_t my_gen = barrier_gen_;
-          if (++barrier_count_ >= world) {
-            barrier_count_ = 0;
-            barrier_gen_++;
-            barrier_cv_.notify_all();
+          std::unique_lock<std::mutex> l(barrier_mu);
+          uint64_t my_gen = barrier_gen;
+          if (++barrier_count >= world) {
+            barrier_count = 0;
+            barrier_gen++;
+            barrier_cv.notify_all();
           } else {
-            barrier_cv_.wait(l, [&] {
-              return barrier_gen_ != my_gen || stopping_.load();
+            barrier_cv.wait(l, [&] {
+              return barrier_gen != my_gen || stopping.load();
             });
           }
         }
-        return SendReply(fd, stopping_.load() ? -1 : 0, nullptr, 0);
+        return SendReply(fd, stopping.load() ? -1 : 0, nullptr, 0) ? 0 : 1;
       }
       case kStop: {
         SendReply(fd, 0, nullptr, 0);
-        // detach: Stop() joins worker threads; calling it from a worker
-        // would self-join, so hand off.
-        std::thread([this] { Stop(); }).detach();
-        return false;
+        return 2;  // FramedServer shuts down after this reply
       }
       default:
-        return SendReply(fd, -127, nullptr, 0);
+        return SendReply(fd, -127, nullptr, 0) ? 0 : 1;
     }
   }
-
-  void* table_;
-  int listen_fd_;
-  int port_;
-  std::atomic<bool> stopping_{false};
-  std::thread accept_thread_;
-  std::mutex conn_mu_;
-  std::vector<std::unique_ptr<Worker>> workers_;
-  std::mutex barrier_mu_;
-  std::condition_variable barrier_cv_;
-  uint64_t barrier_gen_ = 0;
-  uint32_t barrier_count_ = 0;
-  std::mutex stopped_mu_;
-  std::condition_variable stopped_cv_;
-  bool stopped_ = false;
 };
 
 }  // namespace
@@ -331,30 +181,40 @@ extern "C" {
 
 // Start serving `table` on `port` (0 = ephemeral). Returns handle or null.
 void* pt_ps_server_start(void* table, int32_t port) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return nullptr;
-  int one = 1;
-  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
-      ::listen(fd, 128) < 0) {
-    ::close(fd);
+  auto* ps = new PsServer();
+  ps->table = table;
+  ps->srv = ptn::FramedServer::Start(
+      port,
+      [ps](int fd, uint8_t op, const char* body, uint32_t len) {
+        return ps->Dispatch(fd, op, body, len);
+      },
+      [ps] {
+        // release barrier waiters so Stop()'s worker join can't deadlock
+        ps->stopping.store(true);
+        std::lock_guard<std::mutex> g(ps->barrier_mu);
+        ps->barrier_gen++;
+        ps->barrier_count = 0;
+        ps->barrier_cv.notify_all();
+      });
+  if (!ps->srv) {
+    delete ps;
     return nullptr;
   }
-  socklen_t alen = sizeof(addr);
-  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
-  return new PsServer(table, fd, ntohs(addr.sin_port));
+  return ps;
 }
 
-int32_t pt_ps_server_port(void* h) { return static_cast<PsServer*>(h)->port(); }
+int32_t pt_ps_server_port(void* h) {
+  return static_cast<PsServer*>(h)->srv->port();
+}
 
-void pt_ps_server_stop(void* h) { static_cast<PsServer*>(h)->Stop(); }
+void pt_ps_server_stop(void* h) { static_cast<PsServer*>(h)->srv->Stop(); }
 
 // Block until the server stops (subprocess entrypoint main loop).
-void pt_ps_server_wait(void* h) { static_cast<PsServer*>(h)->Wait(); }
+void pt_ps_server_wait(void* h) { static_cast<PsServer*>(h)->srv->Wait(); }
 
-void pt_ps_server_destroy(void* h) { delete static_cast<PsServer*>(h); }
+void pt_ps_server_destroy(void* h) {
+  auto* ps = static_cast<PsServer*>(h);
+  delete ps->srv;
+  delete ps;
+}
 }

@@ -287,12 +287,16 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     ph, pw = output_size
     # Samples per bin edge scale with the worst-case bin extent for an RoI
     # covering the whole feature map (H/ph cells tall): spacing <= 1 cell
-    # means every integer cell of such a bin is hit, so the max is exact —
-    # not just a 4x4 subsample that can miss the true max in wide bins.
-    # RoIs extending beyond the map clip to the border (as the reference's
-    # quantized kernel effectively does).
-    sr_y = max(4, -(-x.shape[2] // ph))
-    sr_x = max(4, -(-x.shape[3] // pw))
+    # hits every integer cell of such a bin, making the max exact. The
+    # budget is CAPPED (default 8/edge) because the gather materializes
+    # R*C*ph*pw*sr_y*sr_x samples — an uncapped whole-map budget on a large
+    # map would explode memory for every RoI, however small. Bins wider
+    # than the cap are approximated at cap density (still >= the reference
+    # deviation of the old fixed 4x4 grid); pass a larger cap if RoIs near
+    # the full map size need exact maxes.
+    cap = 8
+    sr_y = max(4, min(cap, -(-x.shape[2] // ph)))
+    sr_x = max(4, min(cap, -(-x.shape[3] // pw)))
     batch_idx = jnp.repeat(jnp.arange(len(np.asarray(boxes_num))),
                            np.asarray(boxes_num))
 

@@ -389,3 +389,21 @@ def test_autograd_facade_backward():
     y2 = x * 3
     autograd.backward([y1, y2])
     np.testing.assert_allclose(np.asarray(x.grad), [5.0])
+
+
+def test_autograd_backward_joint_hooks():
+    """Multi-root backward is ONE joint pass: a hook on a tensor shared by
+    both roots fires once with the accumulated grad (3+5), not per root
+    with partials."""
+    from paddle_tpu import autograd
+
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    z = x * 2
+    calls = []
+    z.register_hook(lambda g: calls.append(g.numpy().copy()))
+    y1 = z * 3
+    y2 = z * 5
+    autograd.backward([y1, y2], grad_tensors=[None, jnp.asarray([2.0])])
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [13.0])  # 3*1 + 5*2 at once
+    np.testing.assert_allclose(np.asarray(x.grad), [26.0])

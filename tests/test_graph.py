@@ -7,8 +7,11 @@ sets — SURVEY.md §4).
 """
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
-from paddle_tpu.distributed.ps.graph import GraphDataGenerator, GraphTable
+from paddle_tpu.distributed.ps.graph import (DistGraphClient,
+                                             GraphDataGenerator, GraphServer,
+                                             GraphTable, launch_graph_servers)
 from paddle_tpu import geometric as G
 
 
@@ -85,6 +88,148 @@ def test_graph_data_generator_static_shapes():
     # epochs reshuffle
     b2 = list(gen)
     assert not np.array_equal(batches[0][0], b2[0][0])
+
+
+# ------------------------------------------------- node features (local)
+def test_node_features_roundtrip():
+    g = toy_graph()
+    g.set_features([0, 2], [[1.0, 2.0], [3.0, 4.0]])
+    assert g.feature_dim == 2
+    out = g.get_features([2, 0, 99])
+    np.testing.assert_allclose(out, [[3, 4], [1, 2], [0, 0]])  # missing -> 0
+    with pytest.raises(ValueError):
+        g.set_features([1], [[1.0, 2.0, 3.0]])  # dim mismatch
+
+
+def test_walk_step_composes_to_random_walk():
+    """random_walk == repeated walk_step (the distributed-walk invariant)."""
+    g = toy_graph(symmetric=True)
+    starts = np.asarray([0, 1, 2, 3], np.int64)
+    walks = g.random_walk(starts, walk_len=5, seed=9)
+    cur = starts.copy()
+    rows = np.arange(starts.size)
+    for step in range(5):
+        cur = g.walk_step(cur, rows, step, seed=9)
+        np.testing.assert_array_equal(cur, walks[:, step])
+
+
+# ------------------------------------- sharded multi-host graph engine
+@pytest.fixture(scope="module")
+def graph_cluster():
+    """Two graph-shard server subprocesses + a connected client (the
+    reference's TestDistBase subprocess-cluster pattern, SURVEY §4)."""
+    procs, endpoints = launch_graph_servers(2)
+    client = DistGraphClient(endpoints)
+    yield client
+    client.stop_servers()
+    client.close()
+    for p in procs:
+        p.wait(timeout=10)
+
+
+def random_coo(n_nodes=120, n_edges=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_nodes, n_edges).astype(np.int64),
+            rng.integers(0, n_nodes, n_edges).astype(np.int64))
+
+
+def test_dist_graph_parity_with_single_host(graph_cluster):
+    """The sharded store is observationally identical to the single-host
+    store: same node set, per-node degrees, bit-identical neighbor samples
+    and hop-by-hop random walks (each node's adjacency lives wholly on its
+    owner shard, and sampling/hopping is deterministic per node)."""
+    src, dst = random_coo()
+    local = GraphTable()
+    local.add_edges(src, dst)
+    local.build(symmetric=True)
+
+    graph_cluster.add_edges(src, dst)
+    graph_cluster.build(symmetric=True)
+
+    assert graph_cluster.num_nodes == local.num_nodes
+    assert graph_cluster.num_edges == local.num_edges
+    np.testing.assert_array_equal(graph_cluster.node_ids(),
+                                  np.sort(local.node_ids()))
+    for k in [0, 5, 77, 119]:
+        assert graph_cluster.degree(k) == local.degree(k)
+
+    nodes = np.asarray([0, 3, 50, 111, 999], np.int64)  # 999 unknown
+    nb_d, ct_d = graph_cluster.sample_neighbors(nodes, 8, seed=5)
+    nb_l, ct_l = local.sample_neighbors(nodes, 8, seed=5)
+    np.testing.assert_array_equal(nb_d, nb_l)
+    np.testing.assert_array_equal(ct_d, ct_l)
+
+    starts = np.arange(40, dtype=np.int64)
+    np.testing.assert_array_equal(graph_cluster.random_walk(starts, 6, seed=3),
+                                  local.random_walk(starts, 6, seed=3))
+
+
+def test_dist_graph_features(graph_cluster):
+    """Features route to each node's owner shard and come back verbatim;
+    missing nodes zero-fill — GpuPsCommGraphFea payload semantics."""
+    rng = np.random.default_rng(7)
+    keys = np.arange(0, 120, dtype=np.int64)
+    feats = rng.normal(size=(120, 16)).astype(np.float32)
+    graph_cluster.set_features(keys, feats)
+    assert graph_cluster.feature_dim == 16
+    got = graph_cluster.get_features(keys[::-1])
+    np.testing.assert_array_equal(got, feats[::-1])
+    # a miss zero-fills, hits around it unaffected
+    got = graph_cluster.get_features([5, 100000, 6])
+    np.testing.assert_array_equal(got[0], feats[5])
+    np.testing.assert_array_equal(got[1], np.zeros(16, np.float32))
+    np.testing.assert_array_equal(got[2], feats[6])
+
+
+def test_sample_with_features_local_and_dist(graph_cluster):
+    """graph_neighbor_sample_v3 analogue: samples arrive with feature
+    payloads; padding rows carry zero features. Dist == local."""
+    src, dst = random_coo()
+    local = GraphTable()
+    local.add_edges(src, dst)
+    local.build(symmetric=True)
+    rng = np.random.default_rng(7)
+    keys = np.arange(0, 120, dtype=np.int64)
+    feats = rng.normal(size=(120, 16)).astype(np.float32)
+    local.set_features(keys, feats)  # cluster already has these (same rng)
+
+    nodes = np.asarray([0, 7, 999], np.int64)
+    nb_l, ct_l, f_l = local.sample_with_features(nodes, 4, seed=2)
+    nb_d, ct_d, f_d = graph_cluster.sample_with_features(nodes, 4, seed=2)
+    np.testing.assert_array_equal(nb_l, nb_d)
+    np.testing.assert_array_equal(f_l, f_d)
+    assert f_l.shape == (3, 4, 16)
+    np.testing.assert_array_equal(f_l[2], np.zeros((4, 16)))  # unknown node
+    for i in range(2):
+        for j in range(4):
+            if nb_l[i, j] >= 0:
+                np.testing.assert_array_equal(f_l[i, j], feats[nb_l[i, j]])
+
+
+def test_dist_graph_feeds_deepwalk_generator(graph_cluster):
+    """GraphDataGenerator runs unchanged over the sharded client (the
+    PGLBox walk-based feed over the distributed engine)."""
+    gen = GraphDataGenerator(graph_cluster, batch_size=32, walk_len=4,
+                             window=2, num_neg=3, seed=1)
+    batches = list(gen)
+    assert len(batches) >= 5
+    ids = set(graph_cluster.node_ids().tolist())
+    for c, x, neg in batches[:3]:
+        assert c.shape == (32,) and x.shape == (32,) and neg.shape == (32, 3)
+        assert set(c.tolist()) <= ids and set(x.tolist()) <= ids
+
+
+def test_inproc_graph_server_roundtrip():
+    """GraphServer can host in-process (single-host multi-shard tests)."""
+    srv = GraphServer()
+    client = DistGraphClient([("127.0.0.1", srv.port)])
+    client.add_edges([0, 0, 1], [1, 2, 2])
+    client.build()
+    assert client.num_nodes == 3 and client.num_edges == 3
+    nb, ct = client.sample_neighbors([0], 4)
+    assert set(nb[0][nb[0] >= 0].tolist()) == {1, 2} and ct[0] == 2
+    client.close()
+    srv.stop()
 
 
 # ------------------------------------------------------------- geometric
