@@ -41,6 +41,8 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
     for t, g in zip(tensors, grad_tensors):
         if not isinstance(t, Tensor):
             raise TypeError("backward() roots must be eager Tensors")
+        if t._node is None and t.stop_gradient:
+            raise RuntimeError("backward() on a tensor with no grad history")
         seed = (jnp.ones_like(t._data) if g is None
                 else jnp.asarray(getattr(g, "_data", g)))
         roots.append((t, seed))

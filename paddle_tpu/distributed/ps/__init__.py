@@ -26,11 +26,14 @@ from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
 from .graph import (DistGraphClient, GraphDataGenerator, GraphServer,
                     GraphTable, launch_graph_servers)
 from .pass_builder import PipelinedPassBuilder
-from .service import Communicator, PsClient, PsServer, launch_servers, shard_of
-from .table import MemorySparseTable, SSDSparseTable, SparseAccessorConfig
+from .service import (Communicator, PsClient, PsRpcError, PsServer,
+                      launch_servers, shard_of)
+from .table import (MemoryDenseTable, MemorySparseTable, SSDSparseTable,
+                    SparseAccessorConfig)
 
 __all__ = [
-    "SparseAccessorConfig", "MemorySparseTable", "SSDSparseTable",
+    "SparseAccessorConfig", "MemorySparseTable", "MemoryDenseTable",
+    "SSDSparseTable", "PsRpcError",
     "SparseEmbedding", "StagedPull", "callbacks_supported", "make_lookup",
     "PsServer", "PsClient", "Communicator", "launch_servers", "shard_of",
     "GraphTable", "GraphServer", "DistGraphClient", "GraphDataGenerator",
@@ -117,6 +120,15 @@ class PSContext:
             table = MemorySparseTable(accessor)
         self._tables[name] = table
         return table
+
+    def create_slot_tables(self, slot_dims: Dict[str, int],
+                           **accessor_kw) -> Dict[str, MemorySparseTable]:
+        """One table per feature slot with its own embedding dim — the
+        per-slot-dimension capability of the reference's ``CtrDymfAccessor``
+        (dynamic-dim embeddings), expressed as table-per-slot: each slot
+        keeps its own accessor, LR, and shrink policy."""
+        return {name: self.create_table(name, embed_dim=dim, **accessor_kw)
+                for name, dim in slot_dims.items()}
 
     def get_table(self, name: str) -> MemorySparseTable:
         return self._tables[name]

@@ -29,6 +29,7 @@ def main(argv=None) -> int:
         port=args.port)
     if args.load:
         srv.table.load(args.load)
+        srv.load_dense(args.load)  # dense sidecar (absent is fine)
     print(f"PORT {srv.port}", flush=True)
     srv.wait()
     return 0

@@ -45,6 +45,17 @@ _OP_SET_LR = 7
 _OP_BARRIER = 8
 _OP_KEYS = 9
 _OP_STOP = 10
+_OP_PUSH_RAW = 11
+_OP_PUSH_SHOW_CLICK = 12
+_OP_DENSE_INIT = 13
+_OP_DENSE_PULL = 14
+_OP_DENSE_PUSH = 15
+_OP_DENSE_SET = 16
+
+
+class PsRpcError(RuntimeError):
+    """Server replied with an error status (application error — NOT a
+    transport failure, so the client does not retry it)."""
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
@@ -93,6 +104,13 @@ class PsServer:
     def wait(self) -> None:
         self._lib.pt_ps_server_wait(self._h)
 
+    def load_dense(self, path: str) -> None:
+        """Restore the dense sidecar saved next to ``path`` (server
+        restart flow); a missing sidecar is fine."""
+        rc = self._lib.pt_ps_server_load_dense(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"dense sidecar restore failed ({rc}): {path}")
+
     def stop(self) -> None:
         h, self._h = self._h, None
         if h:
@@ -119,7 +137,7 @@ class _Conn:
         status, blen = struct.unpack("<iI", hdr)
         payload = self._read(blen) if blen else b""
         if status != 0:
-            raise IOError(f"PS rpc op={op} failed with status {status}")
+            raise PsRpcError(f"PS rpc op={op} failed with status {status}")
         return payload
 
     def _read(self, n: int) -> bytes:
@@ -143,19 +161,56 @@ class PsClient:
     """Sharded-table client: same interface as :class:`MemorySparseTable`,
     keys routed to ``endpoints[shard_of(key)]``. Thread-safe (one lock per
     server connection, so concurrent requests to different shards overlap —
-    the brpc client's per-channel concurrency)."""
+    the brpc client's per-channel concurrency).
 
-    def __init__(self, endpoints: Sequence[Tuple[str, int]], embed_dim: int):
+    Transport failures reconnect and retry with exponential backoff (the
+    reference's ``brpc_ps_client.cc`` retry loop): a server that dies and
+    comes back on the same endpoint resumes serving this client without a
+    restart. Semantics are at-least-once — a PUSH whose reply was lost may
+    be applied twice after retry, the same tolerance the reference's async
+    SGD accepts. Application errors (:class:`PsRpcError`) never retry.
+    """
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]], embed_dim: int,
+                 retries: int = 4, retry_delay: float = 0.25):
         if not endpoints:
             raise ValueError("need at least one PS endpoint")
         self.endpoints = list(endpoints)
         self.embed_dim = int(embed_dim)
-        self._conns = [_Conn(h, p) for h, p in self.endpoints]
+        self.retries = int(retries)
+        self.retry_delay = float(retry_delay)
+        self._conns: List[Optional[_Conn]] = [
+            _Conn(h, p) for h, p in self.endpoints]
         self._locks = [threading.Lock() for _ in self._conns]
         # persistent fan-out pool: pull+push run every training step, so
         # per-call thread spawn/teardown would be pure hot-path overhead
         self._pool = (ThreadPoolExecutor(max_workers=len(self._conns))
                       if len(self._conns) > 1 else None)
+        self._dense_len = 0
+        self._dense_bounds: Optional[np.ndarray] = None
+
+    def _request(self, s: int, op: int, body: bytes = b"") -> bytes:
+        """One RPC to server ``s`` with reconnect + backoff on transport
+        errors. PsRpcError (status<0 reply) passes through unretried."""
+        delay = self.retry_delay
+        for attempt in range(self.retries + 1):
+            try:
+                with self._locks[s]:
+                    if self._conns[s] is None:
+                        self._conns[s] = _Conn(*self.endpoints[s])
+                    return self._conns[s].request(op, body)
+            except PsRpcError:
+                raise
+            except (ConnectionError, socket.timeout, OSError):
+                with self._locks[s]:
+                    if self._conns[s] is not None:
+                        self._conns[s].close()
+                        self._conns[s] = None
+                if attempt == self.retries:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        raise ConnectionError("unreachable")  # pragma: no cover
 
     # -- partitioned data plane -------------------------------------------
     def _scatter(self, keys: np.ndarray):
@@ -176,8 +231,7 @@ class PsClient:
             if part.size == 0:
                 return
             body = struct.pack("<I", part.size) + part.tobytes()
-            with self._locks[s]:
-                payload = self._conns[s].request(_OP_PULL, body)
+            payload = self._request(s, _OP_PULL, body)
             rows = np.frombuffer(payload, np.float32).reshape(
                 part.size, self.embed_dim)
             out[order[offs[s]:offs[s + 1]]] = rows
@@ -186,20 +240,46 @@ class PsClient:
         return out
 
     def push(self, keys, grads) -> None:
+        self._push_rows(keys, grads, _OP_PUSH)
+
+    def push_raw(self, keys, deltas) -> None:
+        """Additively merge parameter deltas, bypassing the optimizer rule
+        (the geo communicator's delta merge)."""
+        self._push_rows(keys, deltas, _OP_PUSH_RAW)
+
+    def _push_rows(self, keys, rows, op: int) -> None:
         keys, sid, order, sorted_keys, counts = self._scatter(keys)
-        grads = np.ascontiguousarray(
-            np.asarray(grads, np.float32).reshape(keys.size, self.embed_dim))
-        sorted_grads = grads[order]
+        rows = np.ascontiguousarray(
+            np.asarray(rows, np.float32).reshape(keys.size, self.embed_dim))
+        sorted_rows = rows[order]
         offs = np.concatenate([[0], np.cumsum(counts)])
 
         def one(s):
             part = sorted_keys[offs[s]:offs[s + 1]]
             if part.size == 0:
                 return
-            g = sorted_grads[offs[s]:offs[s + 1]]
+            g = sorted_rows[offs[s]:offs[s + 1]]
             body = struct.pack("<I", part.size) + part.tobytes() + g.tobytes()
-            with self._locks[s]:
-                self._conns[s].request(_OP_PUSH, body)
+            self._request(s, op, body)
+
+        self._fanout(one)
+
+    def push_show_click(self, keys, shows, clicks) -> None:
+        """Accumulate CTR usage statistics on each key's owner server."""
+        keys, sid, order, sorted_keys, counts = self._scatter(keys)
+        sc = np.empty((keys.size, 2), np.float32)
+        sc[:, 0] = np.asarray(shows, np.float32).reshape(-1)
+        sc[:, 1] = np.asarray(clicks, np.float32).reshape(-1)
+        sorted_sc = sc[order]
+        offs = np.concatenate([[0], np.cumsum(counts)])
+
+        def one(s):
+            part = sorted_keys[offs[s]:offs[s + 1]]
+            if part.size == 0:
+                return
+            g = np.ascontiguousarray(sorted_sc[offs[s]:offs[s + 1]])
+            body = struct.pack("<I", part.size) + part.tobytes() + g.tobytes()
+            self._request(s, _OP_PUSH_SHOW_CLICK, body)
 
         self._fanout(one)
 
@@ -212,46 +292,95 @@ class PsClient:
         for f in futures:
             f.result()  # re-raises the first shard failure
 
+    # -- dense parameter plane (MemoryDenseTable over the wire) -----------
+    def dense_init(self, length: int, optimizer: str = "sgd",
+                   learning_rate: float = 0.05) -> None:
+        """Create (idempotently) the dense parameter vector, split in
+        contiguous blocks across servers — the reference's dense-table
+        sharding. Must run before the other ``dense_*`` calls."""
+        from .table import _DENSE_OPTIMIZERS
+
+        self._dense_len = int(length)
+        bounds = np.linspace(0, length, len(self._conns) + 1).astype(np.int64)
+        self._dense_bounds = bounds
+        opt = _DENSE_OPTIMIZERS[optimizer]
+        for s in range(len(self._conns)):
+            blk = int(bounds[s + 1] - bounds[s])
+            body = struct.pack("<qif", blk, opt, float(learning_rate))
+            self._request(s, _OP_DENSE_INIT, body)
+
+    def _block(self, s: int):
+        return int(self._dense_bounds[s]), int(self._dense_bounds[s + 1])
+
+    def dense_pull(self) -> np.ndarray:
+        out = np.empty(self._dense_len, np.float32)
+
+        def one(s):
+            lo, hi = self._block(s)
+            if hi == lo:
+                return
+            body = struct.pack("<qq", 0, hi - lo)
+            out[lo:hi] = np.frombuffer(
+                self._request(s, _OP_DENSE_PULL, body), np.float32)
+
+        self._fanout(one)
+        return out
+
+    def dense_push(self, grads: np.ndarray) -> None:
+        self._dense_scatter(grads, _OP_DENSE_PUSH)
+
+    def dense_set(self, values: np.ndarray) -> None:
+        self._dense_scatter(values, _OP_DENSE_SET)
+
+    def _dense_scatter(self, arr: np.ndarray, op: int) -> None:
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32).reshape(-1))
+        assert arr.size == self._dense_len
+
+        def one(s):
+            lo, hi = self._block(s)
+            if hi == lo:
+                return
+            body = struct.pack("<qq", 0, hi - lo) + \
+                np.ascontiguousarray(arr[lo:hi]).tobytes()
+            self._request(s, op, body)
+
+        self._fanout(one)
+
     # -- control plane (all servers) --------------------------------------
     def __len__(self) -> int:
         total = 0
-        for s, conn in enumerate(self._conns):
-            with self._locks[s]:
-                total += struct.unpack("<q", conn.request(_OP_SIZE))[0]
+        for s in range(len(self._conns)):
+            total += struct.unpack("<q", self._request(s, _OP_SIZE))[0]
         return total
 
     def keys(self) -> np.ndarray:
         parts = []
-        for s, conn in enumerate(self._conns):
-            with self._locks[s]:
-                parts.append(np.frombuffer(conn.request(_OP_KEYS), np.int64))
+        for s in range(len(self._conns)):
+            parts.append(np.frombuffer(self._request(s, _OP_KEYS), np.int64))
         return np.concatenate(parts) if parts else np.empty(0, np.int64)
 
     def shrink(self, threshold: float = 1.0) -> int:
         dropped = 0
-        for s, conn in enumerate(self._conns):
+        for s in range(len(self._conns)):
             body = struct.pack("<f", float(threshold))
-            with self._locks[s]:
-                dropped += struct.unpack("<q", conn.request(_OP_SHRINK, body))[0]
+            dropped += struct.unpack(
+                "<q", self._request(s, _OP_SHRINK, body))[0]
         return dropped
 
     def set_learning_rate(self, lr: float) -> None:
-        for s, conn in enumerate(self._conns):
-            with self._locks[s]:
-                conn.request(_OP_SET_LR, struct.pack("<f", float(lr)))
+        for s in range(len(self._conns)):
+            self._request(s, _OP_SET_LR, struct.pack("<f", float(lr)))
 
     def save(self, path: str) -> None:
         """Each server snapshots its shard to ``<path>.shard<i>``."""
-        for s, conn in enumerate(self._conns):
-            with self._locks[s]:
-                conn.request(_OP_SAVE, f"{path}.shard{s}".encode())
+        for s in range(len(self._conns)):
+            self._request(s, _OP_SAVE, f"{path}.shard{s}".encode())
 
     def load(self, path: str, merge: bool = False) -> None:
-        for s, conn in enumerate(self._conns):
+        for s in range(len(self._conns)):
             body = struct.pack("<B", 1 if merge else 0) + \
                 f"{path}.shard{s}".encode()
-            with self._locks[s]:
-                conn.request(_OP_LOAD, body)
+            self._request(s, _OP_LOAD, body)
 
     def barrier(self, world: int, timeout: Optional[float] = 600.0) -> None:
         """Block until ``world`` clients reach the barrier (server 0
@@ -267,18 +396,21 @@ class PsClient:
             conn.close()
 
     def stop_servers(self) -> None:
-        for s, conn in enumerate(self._conns):
+        for s in range(len(self._conns)):
             try:
                 with self._locks[s]:
-                    conn.request(_OP_STOP)
-            except (IOError, ConnectionError):
+                    if self._conns[s] is None:
+                        self._conns[s] = _Conn(*self.endpoints[s])
+                    self._conns[s].request(_OP_STOP)
+            except (PsRpcError, OSError):
                 pass  # server exits as it acks; a dropped ack is fine
 
     def close(self) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         for conn in self._conns:
-            conn.close()
+            if conn is not None:
+                conn.close()
 
 
 def _merge_grads(keys: np.ndarray, grads: np.ndarray):
@@ -297,22 +429,29 @@ class Communicator:
       - ``"sync"``: ``push`` sends immediately (blocking), one RPC per call.
       - ``"async"``: ``push`` enqueues; a background thread drains the queue,
         merging duplicate keys per batch (``AsyncCommunicator::Start``).
-      - ``"geo"``: pushes accumulate locally and are sent merged every
-        ``k_steps`` calls (``GeoCommunicator``'s delta-train trick — the lag
-        is the price of hiding push latency entirely).
+      - ``"geo"``: the DELTA-TRAIN trick (``GeoCommunicator``,
+        ``communicator.h:596``): gradients apply to a local SGD shadow copy
+        immediately (lr = ``geo_lr``); every ``k_steps`` pushes, the
+        parameter deltas (shadow − base) are shipped and merged additively
+        on the server (``push_raw``), then the shadow re-bases on the fresh
+        server values — so other workers' deltas fold in. Training sees
+        zero push latency; the cost is k steps of parameter lag.
 
     ``flush()`` drains everything (end of epoch / before save/eval).
     """
 
     def __init__(self, client: PsClient, mode: str = "async",
-                 k_steps: int = 4, max_queue: int = 64):
+                 k_steps: int = 4, max_queue: int = 64, geo_lr: float = 1.0):
         if mode not in ("sync", "async", "geo"):
             raise ValueError(f"unknown communicator mode {mode!r}")
         self.client = client
         self.mode = mode
         self.k_steps = int(k_steps)
+        self.geo_lr = float(geo_lr)
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
-        self._geo_buf: List[Tuple[np.ndarray, np.ndarray]] = []
+        # geo state: key -> [base_row, shadow_row]
+        self._geo_base: Dict[int, np.ndarray] = {}
+        self._geo_shadow: Dict[int, np.ndarray] = {}
         self._geo_count = 0
         self._err: Optional[BaseException] = None
         self._running = mode == "async"
@@ -331,21 +470,55 @@ class Communicator:
             self.client.push(keys, grads)
         elif self.mode == "async":
             self._queue.put((keys, grads))
-        else:  # geo
-            self._geo_buf.append((keys, grads))
+        else:  # geo: local apply now, deltas shipped every k steps
+            self._geo_apply(keys, grads)
             self._geo_count += 1
             if self._geo_count >= self.k_steps:
                 self._send_geo()
 
+    def pull(self, keys) -> np.ndarray:
+        """Geo-aware pull: in geo mode, locally-trained shadow rows win over
+        (lagged) server rows, so the worker trains on its own freshest
+        parameters — the reference's local-first lookup."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        rows = self.client.pull(keys)
+        if self.mode == "geo":
+            for i, k in enumerate(keys.tolist()):
+                sh = self._geo_shadow.get(k)
+                if sh is not None:
+                    rows[i] = sh
+        return rows
+
+    def _geo_apply(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        # first touch pulls base rows from the server in one batch
+        fresh = [k for k in np.unique(keys).tolist()
+                 if k not in self._geo_base]
+        if fresh:
+            rows = self.client.pull(np.asarray(fresh, np.int64))
+            for k, r in zip(fresh, rows):
+                self._geo_base[k] = r.copy()
+                self._geo_shadow[k] = r.copy()
+        for i, k in enumerate(keys.tolist()):
+            self._geo_shadow[k] -= self.geo_lr * grads[i]
+
     def _send_geo(self) -> None:
-        if not self._geo_buf:
-            return
-        keys = np.concatenate([k for k, _ in self._geo_buf])
-        grads = np.concatenate([g for _, g in self._geo_buf])
-        self._geo_buf.clear()
+        """Ship deltas for the keys touched this window, then EVICT the
+        whole local state: per-window cost and worker memory stay bounded
+        by the window's working set, not the epoch's (a CTR epoch touches
+        millions of distinct keys). The next window's first touch re-pulls
+        fresh server rows — which by then include this worker's deltas and
+        everyone else's."""
         self._geo_count = 0
-        uniq, merged = _merge_grads(keys, grads)
-        self.client.push(uniq, merged)
+        if not self._geo_shadow:
+            return
+        keys = np.asarray(list(self._geo_shadow.keys()), np.int64)
+        deltas = np.stack([self._geo_shadow[k] - self._geo_base[k]
+                           for k in keys.tolist()])
+        moved = np.abs(deltas).max(axis=1) > 0
+        if moved.any():
+            self.client.push_raw(keys[moved], deltas[moved])
+        self._geo_base.clear()
+        self._geo_shadow.clear()
 
     def _drain(self) -> None:
         while self._running or not self._queue.empty():

@@ -34,6 +34,11 @@ class SparseAccessorConfig:
     epsilon: float = 1e-8
     seed: int = 0
     num_shards: int = 16
+    # ShowClickScore coefficients: shrink evicts keys whose decayed
+    # show_coeff*show + click_coeff*click falls below threshold
+    # (CtrCommonAccessor show_coeff/click_coeff).
+    show_coeff: float = 1.0
+    click_coeff: float = 1.0
 
     def __post_init__(self):
         if self.optimizer not in _OPTIMIZERS:
@@ -59,6 +64,9 @@ class MemorySparseTable:
             a.embed_dim, _OPTIMIZERS[a.optimizer], a.learning_rate,
             a.initial_range, a.beta1, a.beta2, a.epsilon, a.seed,
             a.num_shards)
+        if (a.show_coeff, a.click_coeff) != (1.0, 1.0):
+            self._lib.pt_table_set_score_coeffs(
+                self._h, a.show_coeff, a.click_coeff)
 
     @property
     def embed_dim(self) -> int:
@@ -77,6 +85,26 @@ class MemorySparseTable:
             np.asarray(grads, np.float32).reshape(keys.size, self.embed_dim))
         self._lib.pt_table_push(self._h, native.as_i64_ptr(keys),
                                 native.as_f32_ptr(grads), keys.size)
+
+    def push_raw(self, keys, deltas) -> None:
+        """Add raw deltas to embeddings, bypassing the optimizer rule — the
+        geo communicator's additive delta merge (GeoCommunicator)."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(keys.size, self.embed_dim))
+        self._lib.pt_table_push_raw(self._h, native.as_i64_ptr(keys),
+                                    native.as_f32_ptr(deltas), keys.size)
+
+    def push_show_click(self, keys, shows, clicks) -> None:
+        """Accumulate CTR usage stats per key (CtrCommonAccessor shows the
+        reference pushing these alongside gradients)."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1), np.int64)
+        sc = np.empty((keys.size, 2), np.float32)
+        sc[:, 0] = np.asarray(shows, np.float32).reshape(-1)
+        sc[:, 1] = np.asarray(clicks, np.float32).reshape(-1)
+        self._lib.pt_table_push_show_click(
+            self._h, native.as_i64_ptr(keys),
+            native.as_f32_ptr(np.ascontiguousarray(sc)), keys.size)
 
     def set_learning_rate(self, lr: float) -> None:
         self._lib.pt_table_set_lr(self._h, float(lr))
@@ -117,6 +145,74 @@ class MemorySparseTable:
         if h and native is not None:  # interpreter teardown safety
             try:
                 self._lib.pt_table_destroy(h)
+            except Exception:
+                pass
+
+
+_DENSE_OPTIMIZERS = {"sgd": 0, "adagrad": 1, "sum": 3}
+
+
+class MemoryDenseTable:
+    """Dense parameter vector with a server-side update rule — the
+    reference's ``MemoryDenseTable`` (``table/memory_dense_table.cc``),
+    which holds the model's dense weights on PS servers in async/geo
+    modes. Optimizers: ``sgd``, ``adagrad``, ``sum`` (raw accumulate)."""
+
+    def __init__(self, length: int, optimizer: str = "sgd",
+                 learning_rate: float = 0.05, epsilon: float = 1e-8):
+        if optimizer not in _DENSE_OPTIMIZERS:
+            raise ValueError(
+                f"optimizer must be one of {sorted(_DENSE_OPTIMIZERS)}")
+        self.optimizer = optimizer
+        self._lib = native.get_lib()
+        self._h = self._lib.pt_dense_create(
+            int(length), _DENSE_OPTIMIZERS[optimizer], learning_rate, epsilon)
+
+    def __len__(self) -> int:
+        return int(self._lib.pt_dense_len(self._h))
+
+    def pull(self, offset: int = 0, length: int = -1) -> np.ndarray:
+        n = len(self) - offset if length < 0 else length
+        out = np.empty(n, np.float32)
+        rc = self._lib.pt_dense_get(self._h, int(offset), n,
+                                    native.as_f32_ptr(out))
+        if rc != 0:
+            raise IndexError(f"dense pull out of range ({rc})")
+        return out
+
+    def set(self, values, offset: int = 0) -> None:
+        values = np.ascontiguousarray(
+            np.asarray(values, np.float32).reshape(-1))
+        rc = self._lib.pt_dense_set(self._h, int(offset), values.size,
+                                    native.as_f32_ptr(values))
+        if rc != 0:
+            raise IndexError(f"dense set out of range ({rc})")
+
+    def push(self, grads, offset: int = 0) -> None:
+        grads = np.ascontiguousarray(np.asarray(grads, np.float32).reshape(-1))
+        rc = self._lib.pt_dense_push(self._h, int(offset), grads.size,
+                                     native.as_f32_ptr(grads))
+        if rc != 0:
+            raise IndexError(f"dense push out of range ({rc})")
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._lib.pt_dense_set_lr(self._h, float(lr))
+
+    def save(self, path: str) -> None:
+        rc = self._lib.pt_dense_save(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"dense save failed ({rc})")
+
+    def load(self, path: str) -> None:
+        rc = self._lib.pt_dense_load(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"dense load failed ({rc})")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and native is not None:
+            try:
+                self._lib.pt_dense_destroy(h)
             except Exception:
                 pass
 

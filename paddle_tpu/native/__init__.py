@@ -98,6 +98,27 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_table_dim.restype = c.c_int32
     lib.pt_table_dim.argtypes = [c.c_void_p]
 
+    lib.pt_table_push_raw.argtypes = [c.c_void_p, i64p, f32p, c.c_int64]
+    lib.pt_table_push_show_click.argtypes = [c.c_void_p, i64p, f32p, c.c_int64]
+    lib.pt_table_set_score_coeffs.argtypes = [c.c_void_p, c.c_float, c.c_float]
+
+    lib.pt_dense_create.restype = c.c_void_p
+    lib.pt_dense_create.argtypes = [c.c_int64, c.c_int32, c.c_float, c.c_float]
+    lib.pt_dense_destroy.argtypes = [c.c_void_p]
+    lib.pt_dense_len.restype = c.c_int64
+    lib.pt_dense_len.argtypes = [c.c_void_p]
+    lib.pt_dense_set_lr.argtypes = [c.c_void_p, c.c_float]
+    lib.pt_dense_get.restype = c.c_int32
+    lib.pt_dense_get.argtypes = [c.c_void_p, c.c_int64, c.c_int64, f32p]
+    lib.pt_dense_set.restype = c.c_int32
+    lib.pt_dense_set.argtypes = [c.c_void_p, c.c_int64, c.c_int64, f32p]
+    lib.pt_dense_push.restype = c.c_int32
+    lib.pt_dense_push.argtypes = [c.c_void_p, c.c_int64, c.c_int64, f32p]
+    lib.pt_dense_save.restype = c.c_int32
+    lib.pt_dense_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_dense_load.restype = c.c_int32
+    lib.pt_dense_load.argtypes = [c.c_void_p, c.c_char_p]
+
     lib.pt_ps_server_start.restype = c.c_void_p
     lib.pt_ps_server_start.argtypes = [c.c_void_p, c.c_int32]
     lib.pt_ps_server_port.restype = c.c_int32
@@ -105,6 +126,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_ps_server_stop.argtypes = [c.c_void_p]
     lib.pt_ps_server_wait.argtypes = [c.c_void_p]
     lib.pt_ps_server_destroy.argtypes = [c.c_void_p]
+    lib.pt_ps_server_load_dense.restype = c.c_int32
+    lib.pt_ps_server_load_dense.argtypes = [c.c_void_p, c.c_char_p]
 
     lib.pt_graph_create.restype = c.c_void_p
     lib.pt_graph_create.argtypes = []

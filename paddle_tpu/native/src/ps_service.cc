@@ -19,6 +19,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -31,6 +32,21 @@ extern "C" {
 // table C API (ps_table.cc)
 void pt_table_pull(void* h, const int64_t* keys, int64_t n, float* out);
 void pt_table_push(void* h, const int64_t* keys, const float* grads, int64_t n);
+void pt_table_push_raw(void* h, const int64_t* keys, const float* deltas,
+                       int64_t n);
+void pt_table_push_show_click(void* h, const int64_t* keys, const float* sc,
+                              int64_t n);
+void* pt_dense_create(int64_t len, int32_t optimizer, float lr, float eps);
+void* pt_dense_create_from_file(const char* path);
+int32_t pt_dense_optimizer(void* h);
+void pt_dense_destroy(void* h);
+int64_t pt_dense_len(void* h);
+void pt_dense_set_lr(void* h, float lr);
+int32_t pt_dense_get(void* h, int64_t off, int64_t n, float* out);
+int32_t pt_dense_set(void* h, int64_t off, int64_t n, const float* vals);
+int32_t pt_dense_push(void* h, int64_t off, int64_t n, const float* grad);
+int32_t pt_dense_save(void* h, const char* path);
+int32_t pt_dense_load(void* h, const char* path);
 int64_t pt_table_size(void* h);
 int64_t pt_table_keys(void* h, int64_t* out, int64_t cap);
 int64_t pt_table_shrink(void* h, float threshold);
@@ -54,12 +70,26 @@ enum Op : uint8_t {
   kBarrier = 8,
   kKeys = 9,
   kStop = 10,
+  kPushRaw = 11,        // add deltas bypassing the rule (geo delta merge)
+  kPushShowClick = 12,  // accumulate CTR usage stats
+  kDenseInit = 13,      // [i64 len][i32 opt][f32 lr] — lazy dense table
+  kDensePull = 14,      // [i64 off][i64 n] -> floats
+  kDensePush = 15,      // [i64 off][i64 n][grads]
+  kDenseSet = 16,       // [i64 off][i64 n][vals]
 };
 
 // The PS server = a FramedServer dispatching into one table, plus barrier
 // state (the only op needing cross-connection coordination).
 struct PsServer {
   void* table = nullptr;
+  // Lazy MemoryDenseTable block (kDenseInit / snapshot restore). Atomic:
+  // connection threads read it unlocked; dense_mu serializes creation.
+  // Once set it is never swapped (resize -> error), so a loaded pointer
+  // stays valid for the server's lifetime.
+  std::atomic<void*> dense{nullptr};
+  std::mutex dense_mu;
+
+  void* DenseOrNull() { return dense.load(std::memory_order_acquire); }
   ptn::FramedServer* srv = nullptr;
   // own stopping flag (not srv->stopping()): the dispatch lambda can run
   // before Start() returns and assigns srv
@@ -68,6 +98,26 @@ struct PsServer {
   std::condition_variable barrier_cv;
   uint64_t barrier_gen = 0;
   uint32_t barrier_count = 0;
+
+  // Restore (or refresh) the dense block from `<path>.dense`. Creates the
+  // table from the sidecar's own header when none exists yet (server
+  // restart before any client dense_init). Absent sidecar is fine.
+  int32_t LoadDenseSidecar(const std::string& path) {
+    const std::string side = path + ".dense";
+    std::lock_guard<std::mutex> g(dense_mu);
+    void* d = dense.load(std::memory_order_relaxed);
+    if (d) {
+      int32_t drc = pt_dense_load(d, side.c_str());
+      return (drc == 0 || drc == -1) ? 0 : drc;  // -1 = file absent
+    }
+    FILE* probe = std::fopen(side.c_str(), "rb");
+    if (!probe) return 0;
+    std::fclose(probe);
+    void* fresh = pt_dense_create_from_file(side.c_str());
+    if (!fresh) return -16;
+    dense.store(fresh, std::memory_order_release);
+    return 0;
+  }
 
   int Dispatch(int fd, uint8_t op, const char* body, uint32_t len) {
     using ptn::SendReply;
@@ -122,6 +172,8 @@ struct PsServer {
       case kSave: {
         std::string path(body, len);
         int32_t rc = pt_table_save(table, path.c_str());
+        void* d = DenseOrNull();
+        if (rc == 0 && d) rc = pt_dense_save(d, (path + ".dense").c_str());
         return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
       }
       case kLoad: {
@@ -130,6 +182,7 @@ struct PsServer {
         std::string path(body + 1, len - 1);
         int32_t rc = merge ? pt_table_load_merge(table, path.c_str())
                            : pt_table_load(table, path.c_str());
+        if (rc == 0) rc = LoadDenseSidecar(path);
         return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
       }
       case kShrink: {
@@ -164,6 +217,103 @@ struct PsServer {
           }
         }
         return SendReply(fd, stopping.load() ? -1 : 0, nullptr, 0) ? 0 : 1;
+      }
+      case kPushRaw: {
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        uint32_t n;
+        std::memcpy(&n, body, 4);
+        if (static_cast<uint64_t>(len) !=
+            4 + static_cast<uint64_t>(n) * 8 +
+                static_cast<uint64_t>(n) * dim * 4)
+          return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        const int64_t* keys = reinterpret_cast<const int64_t*>(body + 4);
+        const float* deltas = reinterpret_cast<const float*>(body + 4 + n * 8);
+        pt_table_push_raw(table, keys, deltas, n);
+        return SendReply(fd, 0, nullptr, 0) ? 0 : 1;
+      }
+      case kPushShowClick: {
+        if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        uint32_t n;
+        std::memcpy(&n, body, 4);
+        if (static_cast<uint64_t>(len) !=
+            4 + static_cast<uint64_t>(n) * 8 + static_cast<uint64_t>(n) * 8)
+          return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        const int64_t* keys = reinterpret_cast<const int64_t*>(body + 4);
+        const float* sc = reinterpret_cast<const float*>(body + 4 + n * 8);
+        pt_table_push_show_click(table, keys, sc, n);
+        return SendReply(fd, 0, nullptr, 0) ? 0 : 1;
+      }
+      case kDenseInit: {
+        if (len < 16) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        int64_t dlen;
+        int32_t opt;
+        float lr;
+        std::memcpy(&dlen, body, 8);
+        std::memcpy(&opt, body + 8, 4);
+        std::memcpy(&lr, body + 12, 4);
+        if (dlen < 0) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        int32_t rc = 0;
+        {
+          std::lock_guard<std::mutex> g(dense_mu);
+          void* d = dense.load(std::memory_order_relaxed);
+          if (!d) {
+            dense.store(pt_dense_create(dlen, opt, lr, 1e-8f),
+                        std::memory_order_release);
+          } else if (pt_dense_len(d) != dlen) {
+            // never swap a live table under concurrent dense ops; a
+            // resize needs a fresh server
+            rc = -14;
+          } else if (pt_dense_optimizer(d) != opt) {
+            // a misconfigured worker must hear about the divergence, not
+            // have its grads silently applied under another rule
+            rc = -15;
+          }
+          // matching re-init (reconnecting client) keeps existing values
+        }
+        return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
+      }
+      case kDensePull: {
+        if (len < 16) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        int64_t off, n;
+        std::memcpy(&off, body, 8);
+        std::memcpy(&n, body + 8, 8);
+        void* d = DenseOrNull();
+        if (n < 0 || static_cast<uint64_t>(n) * 4 > ptn::kMaxFrameLen || !d)
+          return SendReply(fd, -12, nullptr, 0) ? 0 : 1;
+        std::vector<float> out(static_cast<size_t>(n));
+        if (pt_dense_get(d, off, n, out.data()) != 0)
+          return SendReply(fd, -13, nullptr, 0) ? 0 : 1;
+        return SendReply(fd, 0, out.data(), static_cast<uint32_t>(n * 4))
+                   ? 0
+                   : 1;
+      }
+      case kDensePush: {
+        if (len < 16) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        int64_t off, n;
+        std::memcpy(&off, body, 8);
+        std::memcpy(&n, body + 8, 8);
+        void* d = DenseOrNull();
+        if (n < 0 ||
+            static_cast<uint64_t>(len) != 16 + static_cast<uint64_t>(n) * 4 ||
+            !d)
+          return SendReply(fd, -12, nullptr, 0) ? 0 : 1;
+        const float* g = reinterpret_cast<const float*>(body + 16);
+        int32_t rc = pt_dense_push(d, off, n, g);
+        return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
+      }
+      case kDenseSet: {
+        if (len < 16) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+        int64_t off, n;
+        std::memcpy(&off, body, 8);
+        std::memcpy(&n, body + 8, 8);
+        void* d = DenseOrNull();
+        if (n < 0 ||
+            static_cast<uint64_t>(len) != 16 + static_cast<uint64_t>(n) * 4 ||
+            !d)
+          return SendReply(fd, -12, nullptr, 0) ? 0 : 1;
+        const float* vals = reinterpret_cast<const float*>(body + 16);
+        int32_t rc = pt_dense_set(d, off, n, vals);
+        return SendReply(fd, rc, nullptr, 0) ? 0 : 1;
       }
       case kStop: {
         SendReply(fd, 0, nullptr, 0);
@@ -215,6 +365,12 @@ void pt_ps_server_wait(void* h) { static_cast<PsServer*>(h)->srv->Wait(); }
 void pt_ps_server_destroy(void* h) {
   auto* ps = static_cast<PsServer*>(h);
   delete ps->srv;
+  if (void* d = ps->dense.load()) pt_dense_destroy(d);
   delete ps;
+}
+
+// Restore the dense sidecar for `path` (server restart with --load).
+int32_t pt_ps_server_load_dense(void* h, const char* path) {
+  return static_cast<PsServer*>(h)->LoadDenseSidecar(path);
 }
 }

@@ -9,12 +9,13 @@
 // pull/push; the chip sees dense gathered minibatch embeddings via JAX
 // callbacks (see python/paddle_tpu/distributed/ps/).
 //
-// Value layout per key: [show, click?no — slot counters kept minimal]
+// Value layout per key:
 //   embedding: dim floats
 //   optimizer state appended: SGD none | AdaGrad dim (g2sum) |
 //   Adam 2*dim + 2 (m, v, beta1^t, beta2^t)
-// plus one float of usage counter ("show") for shrink(), mirroring the CTR
-// accessors (table/ctr_common_accessor.h).
+// plus two usage floats [show, click] feeding shrink()'s decayed
+// ShowClickScore, mirroring the CTR accessors
+// (table/ctr_common_accessor.h: Show/Click/ShowClickScore).
 
 #include <atomic>
 #include <cmath>
@@ -46,6 +47,10 @@ struct TableConfig {
   float eps = 1e-8f;
   uint64_t seed = 0;
   int32_t num_shards = 16;
+  // Shrink score = show_coeff*show + click_coeff*click — the CTR
+  // accessor's ShowClickScore (table/ctr_common_accessor.h).
+  float show_coeff = 1.0f;
+  float click_coeff = 1.0f;
 };
 
 struct Shard {
@@ -63,13 +68,19 @@ class SparseTable {
 
   void SetLr(float lr) { cfg_.lr = lr; }
 
+  void SetScoreCoeffs(float show_coeff, float click_coeff) {
+    cfg_.show_coeff = show_coeff;
+    cfg_.click_coeff = click_coeff;
+  }
+
   int32_t value_width() const {
+    // +2 = [show, click]; Adam appends [beta1^t, beta2^t] after them.
     switch (cfg_.optimizer) {
-      case kSGD: return cfg_.dim + 1;
-      case kAdaGrad: return 2 * cfg_.dim + 1;
-      case kAdam: return 3 * cfg_.dim + 3;
+      case kSGD: return cfg_.dim + 2;
+      case kAdaGrad: return 2 * cfg_.dim + 2;
+      case kAdam: return 3 * cfg_.dim + 4;
     }
-    return cfg_.dim + 1;
+    return cfg_.dim + 2;
   }
 
   size_t shard_of(int64_t key) const {
@@ -88,7 +99,7 @@ class SparseTable {
         std::lock_guard<std::mutex> g(sh.mu);
         float* v = FindOrInit(sh, key);
         std::memcpy(out + i * cfg_.dim, v, sizeof(float) * cfg_.dim);
-        v[usage_offset()] += 1.0f;  // show counter
+        v[show_offset()] += 1.0f;
       }
     }, 256);
   }
@@ -103,6 +114,38 @@ class SparseTable {
         std::lock_guard<std::mutex> g(sh.mu);
         float* v = FindOrInit(sh, key);
         ApplyRule(v, grads + i * cfg_.dim);
+      }
+    }, 256);
+  }
+
+  // Add raw deltas to embeddings, bypassing the optimizer rule — the geo
+  // communicator ships locally-trained parameter deltas, which servers
+  // merge additively (GeoCommunicator, communicator.h:596).
+  void PushRaw(const int64_t* keys, const float* deltas, int64_t n) {
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        int64_t key = keys[i];
+        Shard& sh = shards_[shard_of(key)];
+        std::lock_guard<std::mutex> g(sh.mu);
+        float* v = FindOrInit(sh, key);
+        const float* d = deltas + i * cfg_.dim;
+        for (int32_t j = 0; j < cfg_.dim; ++j) v[j] += d[j];
+      }
+    }, 256);
+  }
+
+  // Accumulate CTR usage statistics: sc[2*i] shows, sc[2*i+1] clicks per
+  // key (the reference pushes these alongside gradients; here they ride a
+  // dedicated op).
+  void PushShowClick(const int64_t* keys, const float* sc, int64_t n) {
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      for (size_t i = lo; i < hi; ++i) {
+        int64_t key = keys[i];
+        Shard& sh = shards_[shard_of(key)];
+        std::lock_guard<std::mutex> g(sh.mu);
+        float* v = FindOrInit(sh, key);
+        v[show_offset()] += sc[2 * i];
+        v[show_offset() + 1] += sc[2 * i + 1];
       }
     }, 256);
   }
@@ -132,8 +175,9 @@ class SparseTable {
     return w;
   }
 
-  // Drop keys whose usage counter < threshold; counters halve each call
-  // (decayed shrink, cf. MemorySparseTable::Shrink).
+  // Drop keys whose decayed ShowClickScore < threshold; both counters
+  // halve each call (cf. MemorySparseTable::Shrink + CtrCommonAccessor's
+  // show/click decay).
   int64_t Shrink(float threshold) {
     std::atomic<int64_t> dropped{0};
     ptn::parallel_for(shards_.size(), [&](size_t lo, size_t hi) {
@@ -146,11 +190,14 @@ class SparseTable {
         const int32_t w = value_width();
         for (auto& kv : sh.index) {
           float* v = sh.values.data() + static_cast<size_t>(kv.second) * w;
-          if (v[usage_offset()] >= threshold) {
+          const float score = cfg_.show_coeff * v[show_offset()] +
+                              cfg_.click_coeff * v[show_offset() + 1];
+          if (score >= threshold) {
             uint32_t idx = static_cast<uint32_t>(keep.size());
             keep.emplace(kv.first, idx);
             values.insert(values.end(), v, v + w);
-            values[static_cast<size_t>(idx) * w + usage_offset()] *= 0.5f;
+            values[static_cast<size_t>(idx) * w + show_offset()] *= 0.5f;
+            values[static_cast<size_t>(idx) * w + show_offset() + 1] *= 0.5f;
           } else {
             dropped.fetch_add(1, std::memory_order_relaxed);
           }
@@ -244,7 +291,8 @@ class SparseTable {
   }
 
  private:
-  int32_t usage_offset() const { return value_width() - 1 - (cfg_.optimizer == kAdam ? 2 : 0); }
+  // [show, click] sit at the tail, before Adam's [beta1^t, beta2^t].
+  int32_t show_offset() const { return value_width() - 2 - (cfg_.optimizer == kAdam ? 2 : 0); }
 
   // Adam scalar state lives at the tail: [beta1^t, beta2^t].
   float* FindOrInit(Shard& sh, int64_t key) {
@@ -307,9 +355,191 @@ class SparseTable {
   mutable std::vector<Shard> shards_;
 };
 
+// Dense parameter table: one contiguous float vector with a server-side
+// update rule — the reference's MemoryDenseTable
+// (paddle/fluid/distributed/ps/table/memory_dense_table.cc), which holds
+// the model's dense weights on PS servers in async/geo modes. Sharding
+// across servers is client-side (contiguous blocks), so each server's
+// table is just its block. Rules: sum (raw accumulate), sgd, adagrad —
+// the step-free subset (Adam's bias correction needs a coherent global
+// step, which blockwise pushes don't have).
+class DenseTable {
+ public:
+  DenseTable(int64_t len, int32_t optimizer, float lr, float eps)
+      : optimizer_(optimizer), lr_(lr), eps_(eps), values_(len, 0.0f) {
+    if (optimizer_ == kAdaGrad) g2sum_.assign(len, 0.0f);
+  }
+
+  int64_t len() const { return static_cast<int64_t>(values_.size()); }
+  void SetLr(float lr) {
+    std::lock_guard<std::mutex> g(mu_);
+    lr_ = lr;
+  }
+
+  int32_t Get(int64_t off, int64_t n, float* out) const {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!InRange(off, n)) return -1;
+    std::memcpy(out, values_.data() + off, sizeof(float) * n);
+    return 0;
+  }
+
+  int32_t Set(int64_t off, int64_t n, const float* vals) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!InRange(off, n)) return -1;
+    std::memcpy(values_.data() + off, vals, sizeof(float) * n);
+    return 0;
+  }
+
+  int32_t Push(int64_t off, int64_t n, const float* grad) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!InRange(off, n)) return -1;
+    float* v = values_.data() + off;
+    switch (optimizer_) {
+      case kSGD:
+        for (int64_t i = 0; i < n; ++i) v[i] -= lr_ * grad[i];
+        break;
+      case kAdaGrad: {
+        float* g2 = g2sum_.data() + off;
+        for (int64_t i = 0; i < n; ++i) {
+          g2[i] += grad[i] * grad[i];
+          v[i] -= lr_ * grad[i] / (std::sqrt(g2[i]) + eps_);
+        }
+        break;
+      }
+      default:  // sum: raw accumulate (geo deltas / summary stats)
+        for (int64_t i = 0; i < n; ++i) v[i] += grad[i];
+        break;
+    }
+    return 0;
+  }
+
+  int32_t Save(const char* path) const {
+    std::lock_guard<std::mutex> g(mu_);
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return -1;
+    const uint64_t magic = 0x5054444e53453032ULL;  // "PTDNSE02"
+    uint64_t n = values_.size();
+    std::fwrite(&magic, sizeof(magic), 1, f);
+    std::fwrite(&n, sizeof(n), 1, f);
+    std::fwrite(&optimizer_, sizeof(optimizer_), 1, f);
+    std::fwrite(&lr_, sizeof(lr_), 1, f);
+    std::fwrite(values_.data(), sizeof(float), values_.size(), f);
+    uint8_t has_g2 = g2sum_.empty() ? 0 : 1;
+    std::fwrite(&has_g2, 1, 1, f);
+    if (has_g2) std::fwrite(g2sum_.data(), sizeof(float), g2sum_.size(), f);
+    std::fclose(f);
+    return 0;
+  }
+
+  int32_t Load(const char* path) {
+    std::lock_guard<std::mutex> g(mu_);
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    uint64_t magic = 0, n = 0;
+    int32_t opt = 0;
+    float lr = 0;
+    if (std::fread(&magic, sizeof(magic), 1, f) != 1 ||
+        magic != 0x5054444e53453032ULL ||
+        std::fread(&n, sizeof(n), 1, f) != 1 || n != values_.size() ||
+        std::fread(&opt, sizeof(opt), 1, f) != 1 ||
+        std::fread(&lr, sizeof(lr), 1, f) != 1) {
+      std::fclose(f);
+      return -2;
+    }
+    if (std::fread(values_.data(), sizeof(float), n, f) != n) {
+      std::fclose(f);
+      return -3;
+    }
+    uint8_t has_g2 = 0;
+    if (std::fread(&has_g2, 1, 1, f) == 1 && has_g2 && !g2sum_.empty()) {
+      if (std::fread(g2sum_.data(), sizeof(float), n, f) != n) {
+        std::fclose(f);
+        return -3;
+      }
+    }
+    std::fclose(f);
+    return 0;
+  }
+
+ private:
+  // Overflow-proof range check: n > len() - off avoids the signed
+  // overflow of off + n for wire-supplied offsets.
+  bool InRange(int64_t off, int64_t n) const {
+    return off >= 0 && n >= 0 && off <= len() && n <= len() - off;
+  }
+
+  int32_t optimizer_;
+  float lr_;
+  float eps_;
+  std::vector<float> values_;
+  std::vector<float> g2sum_;
+  mutable std::mutex mu_;
+
+ public:
+  int32_t optimizer() const { return optimizer_; }
+  float lr() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return lr_;
+  }
+};
+
 }  // namespace
 
 extern "C" {
+
+void* pt_dense_create(int64_t len, int32_t optimizer, float lr, float eps) {
+  return new DenseTable(len, optimizer, lr, eps);
+}
+
+// Reconstruct a dense table from its snapshot alone (the restarting
+// server's path: the sidecar stores len/optimizer/lr, so no client
+// dense_init is needed before restore). Returns null on failure.
+void* pt_dense_create_from_file(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  uint64_t magic = 0, n = 0;
+  int32_t opt = 0;
+  float lr = 0;
+  if (std::fread(&magic, sizeof(magic), 1, f) != 1 ||
+      magic != 0x5054444e53453032ULL ||
+      std::fread(&n, sizeof(n), 1, f) != 1 ||
+      std::fread(&opt, sizeof(opt), 1, f) != 1 ||
+      std::fread(&lr, sizeof(lr), 1, f) != 1) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+  auto* t = new DenseTable(static_cast<int64_t>(n), opt, lr, 1e-8f);
+  if (t->Load(path) != 0) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+int32_t pt_dense_optimizer(void* h) {
+  return static_cast<DenseTable*>(h)->optimizer();
+}
+void pt_dense_destroy(void* h) { delete static_cast<DenseTable*>(h); }
+int64_t pt_dense_len(void* h) { return static_cast<DenseTable*>(h)->len(); }
+void pt_dense_set_lr(void* h, float lr) {
+  static_cast<DenseTable*>(h)->SetLr(lr);
+}
+int32_t pt_dense_get(void* h, int64_t off, int64_t n, float* out) {
+  return static_cast<DenseTable*>(h)->Get(off, n, out);
+}
+int32_t pt_dense_set(void* h, int64_t off, int64_t n, const float* vals) {
+  return static_cast<DenseTable*>(h)->Set(off, n, vals);
+}
+int32_t pt_dense_push(void* h, int64_t off, int64_t n, const float* grad) {
+  return static_cast<DenseTable*>(h)->Push(off, n, grad);
+}
+int32_t pt_dense_save(void* h, const char* path) {
+  return static_cast<DenseTable*>(h)->Save(path);
+}
+int32_t pt_dense_load(void* h, const char* path) {
+  return static_cast<DenseTable*>(h)->Load(path);
+}
 
 void* pt_table_create(int32_t dim, int32_t optimizer, float lr,
                       float initial_range, float beta1, float beta2, float eps,
@@ -327,6 +557,11 @@ void* pt_table_create(int32_t dim, int32_t optimizer, float lr,
   return new SparseTable(cfg);
 }
 
+// ShowClickScore coefficients (CtrCommonAccessor show_coeff/click_coeff).
+void pt_table_set_score_coeffs(void* h, float show_coeff, float click_coeff) {
+  static_cast<SparseTable*>(h)->SetScoreCoeffs(show_coeff, click_coeff);
+}
+
 void pt_table_destroy(void* h) { delete static_cast<SparseTable*>(h); }
 
 void pt_table_pull(void* h, const int64_t* keys, int64_t n, float* out) {
@@ -335,6 +570,16 @@ void pt_table_pull(void* h, const int64_t* keys, int64_t n, float* out) {
 
 void pt_table_push(void* h, const int64_t* keys, const float* grads, int64_t n) {
   static_cast<SparseTable*>(h)->Push(keys, grads, n);
+}
+
+void pt_table_push_raw(void* h, const int64_t* keys, const float* deltas,
+                       int64_t n) {
+  static_cast<SparseTable*>(h)->PushRaw(keys, deltas, n);
+}
+
+void pt_table_push_show_click(void* h, const int64_t* keys, const float* sc,
+                              int64_t n) {
+  static_cast<SparseTable*>(h)->PushShowClick(keys, sc, n);
 }
 
 int64_t pt_table_size(void* h) { return static_cast<SparseTable*>(h)->Size(); }

@@ -276,7 +276,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
-             name=None):
+             name=None, max_samples_per_bin: int = 8):
     """RoIPool (reference ``roi_pool``): dense-sampled max per quantized
     bin (sampling formulation — no data-dependent bin extents, so it
     jit-compiles; matches the kernel up to sampling density)."""
@@ -288,13 +288,13 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     # Samples per bin edge scale with the worst-case bin extent for an RoI
     # covering the whole feature map (H/ph cells tall): spacing <= 1 cell
     # hits every integer cell of such a bin, making the max exact. The
-    # budget is CAPPED (default 8/edge) because the gather materializes
-    # R*C*ph*pw*sr_y*sr_x samples — an uncapped whole-map budget on a large
-    # map would explode memory for every RoI, however small. Bins wider
-    # than the cap are approximated at cap density (still >= the reference
-    # deviation of the old fixed 4x4 grid); pass a larger cap if RoIs near
-    # the full map size need exact maxes.
-    cap = 8
+    # budget is CAPPED (max_samples_per_bin per edge, default 8) because
+    # the gather materializes R*C*ph*pw*sr_y*sr_x samples — an uncapped
+    # whole-map budget on a large map would explode memory for every RoI,
+    # however small. Bins wider than the cap are approximated at cap
+    # density; raise max_samples_per_bin when RoIs near the full map size
+    # need exact maxes.
+    cap = int(max_samples_per_bin)
     sr_y = max(4, min(cap, -(-x.shape[2] // ph)))
     sr_x = max(4, min(cap, -(-x.shape[3] // pw)))
     batch_idx = jnp.repeat(jnp.arange(len(np.asarray(boxes_num))),
