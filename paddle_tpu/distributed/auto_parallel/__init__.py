@@ -24,9 +24,12 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..mesh import get_mesh, init_mesh
 from .planner import CostModel, Planner, plan_mesh
+from .tuner import (ParallelTuner, TunedPlan, calibrate_cluster,
+                    measure_ici)
 from .engine import Engine
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "Planner",
+           "ParallelTuner", "TunedPlan", "calibrate_cluster", "measure_ici",
            "CostModel", "plan_mesh"]
 
 

@@ -189,11 +189,15 @@ class PsClient:
         self._dense_len = 0
         self._dense_bounds: Optional[np.ndarray] = None
 
-    def _request(self, s: int, op: int, body: bytes = b"") -> bytes:
+    def _request(self, s: int, op: int, body: bytes = b"",
+                 retry: bool = True) -> bytes:
         """One RPC to server ``s`` with reconnect + backoff on transport
-        errors. PsRpcError (status<0 reply) passes through unretried."""
+        errors. PsRpcError (status<0 reply) passes through unretried.
+        ``retry=False`` for non-idempotent control ops (shrink): a lost
+        reply must surface instead of silently re-applying the op."""
         delay = self.retry_delay
-        for attempt in range(self.retries + 1):
+        retries = self.retries if retry else 0
+        for attempt in range(retries + 1):
             try:
                 with self._locks[s]:
                     if self._conns[s] is None:
@@ -206,7 +210,7 @@ class PsClient:
                     if self._conns[s] is not None:
                         self._conns[s].close()
                         self._conns[s] = None
-                if attempt == self.retries:
+                if attempt == retries:
                     raise
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
@@ -363,8 +367,10 @@ class PsClient:
         dropped = 0
         for s in range(len(self._conns)):
             body = struct.pack("<f", float(threshold))
+            # no retry: shrink decays counters/evicts — re-applying on a
+            # lost reply would decay twice
             dropped += struct.unpack(
-                "<q", self._request(s, _OP_SHRINK, body))[0]
+                "<q", self._request(s, _OP_SHRINK, body, retry=False))[0]
         return dropped
 
     def set_learning_rate(self, lr: float) -> None:

@@ -161,7 +161,7 @@ class DistributedTrainStep:
 
         batch_spec = PartitionSpec(tuple(a for a in batch_axes if a in self.mesh.shape) or None)
         self._batch_sharding = NamedSharding(self.mesh, batch_spec)
-        self._base_key = framework_random.next_key()
+        self._base_key = jax.block_until_ready(framework_random.next_key())
         self._count = 0
         self._rng_streams = DEFAULT_RNG_STREAMS
         # gradient merge (reference gradient_merge_optimizer.py): accumulator
@@ -203,12 +203,15 @@ class DistributedTrainStep:
                 out[slot] = val
         return out
 
-    def _step(self, params, buffers, opt_state, accum, batch, key,
+    def _step(self, params, buffers, opt_state, accum, batch, key, count,
               with_check=False, do_update=True):
         from ..framework.jit import (accumulate_grads, finite_guard,
                                      merge_accumulated, split_rng_streams)
 
-        rngs = split_rng_streams(key, self._rng_streams)
+        # fold_in inside the program: a lazy key input trips the
+        # TPU-tunnel slow path (see framework/jit.py _step)
+        rngs = split_rng_streams(jax.random.fold_in(key, count),
+                                 self._rng_streams)
 
         def compute_loss(p):
             # keep params at their declared shardings inside the traced fn
@@ -246,7 +249,7 @@ class DistributedTrainStep:
         batch = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
             if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
-        key = jax.random.fold_in(self._base_key, self._count)
+        count = np.uint32(self._count)
         self._count += 1
         do_update = (self.grad_accum_steps <= 1
                      or self._count % self.grad_accum_steps == 0)
@@ -255,12 +258,13 @@ class DistributedTrainStep:
                 loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
                     self._checked_compiled()(self.params, self.buffers,
                                              self.opt_state, self._grad_accum,
-                                             batch, key)
+                                             batch, self._base_key, count)
                 raise_if_bad_step(ok, loss)
                 return loss
             loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
                 self._compiled(self.params, self.buffers, self.opt_state,
-                               self._grad_accum, batch, key, do_update=do_update)
+                               self._grad_accum, batch, self._base_key, count,
+                               do_update=do_update)
         return loss
 
     def sync_to_model(self):

@@ -240,6 +240,49 @@ class Tensor:
     def clear_grad(self):
         self.grad = None
 
+    # -- in-place variants (reference fill_/zero_ Tensor methods). JAX
+    # arrays are immutable, so "in-place" means swapping the wrapped
+    # buffer; only allowed off the tape (paddle similarly forbids inplace
+    # on grad-tracked leaves).
+    def _inplace_guard(self, opname: str):
+        if _grad_enabled() and _requires_grad(self):
+            raise RuntimeError(
+                f"{opname} on a grad-requiring tensor would invalidate the "
+                "tape; detach() first or run under eager.no_grad()")
+
+    def fill_(self, value) -> "Tensor":
+        self._inplace_guard("fill_")
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self) -> "Tensor":
+        return self.fill_(0)
+
+    def fill_diagonal_(self, value, offset: int = 0, wrap: bool = False) -> "Tensor":
+        self._inplace_guard("fill_diagonal_")
+        h, w = self._data.shape[-2], self._data.shape[-1]
+        # diagonal length differs for rectangular matrices by offset sign
+        if offset >= 0:
+            n = max(0, min(h, w - offset))
+            r0, c0 = 0, offset
+        else:
+            n = max(0, min(h + offset, w))
+            r0, c0 = -offset, 0
+        rows = list(range(r0, r0 + n))
+        cols = list(range(c0, c0 + n))
+        if wrap and h > w and offset == 0:
+            # tall matrices restart the diagonal every w+1 rows
+            r = w + 1
+            while r + 0 < h:
+                k = min(w, h - r)
+                rows += list(range(r, r + k))
+                cols += list(range(0, k))
+                r += w + 1
+        if rows:
+            self._data = self._data.at[..., jnp.asarray(rows),
+                                       jnp.asarray(cols)].set(value)
+        return self
+
     def astype(self, dtype) -> "Tensor":
         from ..framework.dtype import convert_dtype
 

@@ -157,7 +157,9 @@ class TrainStep:
         self.buffers = jax.tree.map(lambda x: jnp.array(x, copy=True), buffer_state(model))
         self.opt_state = optimizer.init(self.params)
         self._rng_streams = tuple(rng_streams)
-        self._base_key = framework_random.next_key()
+        # materialized once: a lazy key input would trip the tunnel
+        # slow path documented in _step
+        self._base_key = jax.block_until_ready(framework_random.next_key())
         self._count = 0
         self.grad_accum_steps = int(grad_accum_steps)
         self.grad_accum_avg = grad_accum_avg
@@ -174,9 +176,15 @@ class TrainStep:
         self._compiled_checked = None
         self._donate_argnums = donate_argnums
 
-    def _step(self, params, buffers, opt_state, accum, batch, key,
+    def _step(self, params, buffers, opt_state, accum, batch, key, count,
               with_check=False, do_update=True):
-        rngs = split_rng_streams(key, self._rng_streams)
+        # fold_in runs INSIDE the compiled step: computing the per-step key
+        # as a separate tiny dispatch and feeding its (lazy) result into
+        # this call knocks the TPU-tunnel runtime off its fast path —
+        # measured 1.68s vs 0.12s per ResNet-50 step. `count` arrives as a
+        # host numpy scalar, so every input is already materialized.
+        rngs = split_rng_streams(jax.random.fold_in(key, count),
+                                 self._rng_streams)
 
         def compute_loss(p):
             inputs = self.inputs_fn(batch)
@@ -210,9 +218,11 @@ class TrainStep:
         return self._compiled_checked
 
     def __call__(self, batch):
+        import numpy as np
+
         from . import flags
 
-        key = jax.random.fold_in(self._base_key, self._count)
+        count = np.uint32(self._count)
         self._count += 1
         do_update = (self.grad_accum_steps <= 1
                      or self._count % self.grad_accum_steps == 0)
@@ -220,12 +230,13 @@ class TrainStep:
             loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
                 self._checked_compiled()(self.params, self.buffers,
                                          self.opt_state, self._grad_accum,
-                                         batch, key)
+                                         batch, self._base_key, count)
             raise_if_bad_step(ok, loss)
             return loss
         loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
             self._compiled(self.params, self.buffers, self.opt_state,
-                           self._grad_accum, batch, key, do_update=do_update)
+                           self._grad_accum, batch, self._base_key, count,
+                           do_update=do_update)
         return loss
 
     # ----------------------------------------------------------- state sync

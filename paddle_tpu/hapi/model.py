@@ -45,12 +45,15 @@ class _HapiTrainStep(TrainStep):
     """TrainStep variant that also returns the model outputs (for train-time
     metric updates, as the reference's ``DynamicGraphAdapter.train_batch``)."""
 
-    def _step(self, params, buffers, opt_state, accum, batch, key,
+    def _step(self, params, buffers, opt_state, accum, batch, key, count,
               with_check=False, do_update=True):
         from ..framework.jit import (accumulate_grads, finite_guard,
                                      merge_accumulated, split_rng_streams)
 
-        rngs = split_rng_streams(key, self._rng_streams)
+        # fold_in inside the program: a lazy key input trips the
+        # TPU-tunnel slow path (see framework/jit.py _step)
+        rngs = split_rng_streams(jax.random.fold_in(key, count),
+                                 self._rng_streams)
 
         def compute_loss(p):
             inputs = self.inputs_fn(batch)
@@ -81,7 +84,7 @@ class _HapiTrainStep(TrainStep):
         from ..framework import flags
         from ..framework.jit import raise_if_bad_step
 
-        key = jax.random.fold_in(self._base_key, self._count)
+        count = np.uint32(self._count)
         self._count += 1
         do_update = (self.grad_accum_steps <= 1
                      or self._count % self.grad_accum_steps == 0)
@@ -89,12 +92,13 @@ class _HapiTrainStep(TrainStep):
             loss, out, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
                 self._checked_compiled()(self.params, self.buffers,
                                          self.opt_state, self._grad_accum,
-                                         batch, key)
+                                         batch, self._base_key, count)
             raise_if_bad_step(ok, loss)
             return loss, out
         loss, out, self.params, self.buffers, self.opt_state, self._grad_accum = \
             self._compiled(self.params, self.buffers, self.opt_state,
-                           self._grad_accum, batch, key, do_update=do_update)
+                           self._grad_accum, batch, self._base_key, count,
+                           do_update=do_update)
         return loss, out
 
 

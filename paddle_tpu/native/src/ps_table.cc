@@ -213,7 +213,7 @@ class SparseTable {
   int32_t Save(const char* path) const {
     FILE* f = std::fopen(path, "wb");
     if (!f) return -1;
-    const uint64_t magic = 0x5054424c45303146ULL;  // "PTBLE01F"
+    const uint64_t magic = 0x5054424c45303246ULL;  // "PTBLE02F" (02: +click in value layout)
     const int32_t w = value_width();
     // Hold ALL shard locks for the duration so the header count matches the
     // rows written even with pushes in flight (consistent snapshot).
@@ -248,7 +248,7 @@ class SparseTable {
     int32_t w = 0;
     uint64_t count = 0;
     if (std::fread(&magic, sizeof(magic), 1, f) != 1 ||
-        magic != 0x5054424c45303146ULL ||
+        magic != 0x5054424c45303246ULL ||
         std::fread(&w, sizeof(w), 1, f) != 1 || w != value_width() ||
         std::fread(&count, sizeof(count), 1, f) != 1) {
       std::fclose(f);

@@ -7,6 +7,8 @@ XLA folds them into dot_general dimension numbers.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -239,3 +241,32 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 def corrcoef(x, rowvar=True, name=None):
     return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances between row batches (reference ``cdist``).
+    For p=2 the matmul formulation keeps the FLOPs on the MXU."""
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        x2 = jnp.sum(x * x, -1)[..., :, None]
+        y2 = jnp.sum(y * y, -1)[..., None, :]
+        d2 = x2 + y2 - 2.0 * jnp.matmul(x, jnp.swapaxes(y, -1, -2))
+        return jnp.sqrt(jnp.maximum(d2, 0.0))
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == float("inf"):
+        return jnp.max(diff, -1)
+    if p == 0:
+        return jnp.sum(diff != 0, -1).astype(x.dtype)
+    return jnp.sum(diff ** p, -1) ** (1.0 / p)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of one row batch (reference ``pdist``):
+    the upper-triangle (i<j) entries of ``cdist(x, x)``."""
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[0]
+    full = cdist(x, x, p=p)
+    iu = np.triu_indices(n, k=1)
+    return full[iu]

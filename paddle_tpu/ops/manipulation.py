@@ -5,6 +5,8 @@ All shape arguments must be static under ``jit`` — XLA compiles per shape.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -536,3 +538,128 @@ def scatter_(x, index, updates, overwrite=True, name=None):
 
 def put_along_axis_(arr, indices, values, axis, reduce="assign"):
     return put_along_axis(arr, indices, values, axis, reduce=reduce)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one axis into the given shape (reference ``unflatten``);
+    one -1 entry is inferred."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    shape = list(shape)
+    if shape.count(-1) > 1:
+        raise ValueError("unflatten shape can have at most one -1")
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = x.shape[axis] // known
+    return x.reshape(x.shape[:axis] + tuple(shape) + x.shape[axis + 1:])
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of ``x`` with consecutive elements of
+    ``value`` (reference ``masked_scatter``). Static-shape jnp: positions
+    index into the flattened value buffer by mask prefix-count."""
+    x = jnp.asarray(x)
+    mask = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape)
+    vals = jnp.asarray(value).reshape(-1).astype(x.dtype)
+    # k-th True (row-major) takes vals[k]
+    order = jnp.cumsum(mask.reshape(-1)) - 1
+    take = vals[jnp.clip(order, 0, vals.size - 1)].reshape(x.shape)
+    return jnp.where(mask, take, x)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Embed ``value`` into the strided slice of ``x`` (reference
+    ``slice_scatter``)."""
+    x = jnp.asarray(x)
+    # builtins.slice: this module's paddle `slice` op shadows the builtin
+    idx = [_builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[int(ax)] = _builtins.slice(int(st), int(en), int(sd))
+    return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))
+
+
+def column_stack(x, name=None):
+    return jnp.column_stack([jnp.asarray(t) for t in x])
+
+
+def row_stack(x, name=None):
+    return jnp.vstack([jnp.asarray(t) for t in x])
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Split into (possibly uneven) sections like numpy ``array_split``
+    (reference ``tensor_split``)."""
+    x = jnp.asarray(x)
+    return jnp.array_split(x, num_or_indices, axis=axis)
+
+
+def atleast_1d(*inputs, name=None):
+    out = [jnp.atleast_1d(jnp.asarray(t)) for t in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [jnp.atleast_2d(jnp.asarray(t)) for t in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [jnp.atleast_3d(jnp.asarray(t)) for t in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from 2-D inputs (reference ``block_diag``)."""
+    mats = [jnp.atleast_2d(jnp.asarray(t)) for t in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(m)
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (reference ``cartesian_prod``)."""
+    arrs = [jnp.asarray(t).reshape(-1) for t in x]
+    grids = jnp.meshgrid(*arrs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed the last axis as a diagonal plane of a new matrix pair of
+    axes (reference ``diag_embed``)."""
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(int(offset))
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i - min(int(offset), 0)
+    c = i + max(int(offset), 0)
+    out = base.at[..., r, c].set(x)
+    dim1 = dim1 % out.ndim
+    dim2 = dim2 % out.ndim
+    perm = [d for d in range(out.ndim) if d not in (out.ndim - 2, out.ndim - 1)]
+    # place the two new axes at dim1/dim2
+    lo, hi = sorted((dim1, dim2))
+    src = (out.ndim - 2, out.ndim - 1) if dim1 < dim2 else \
+        (out.ndim - 1, out.ndim - 2)
+    perm.insert(lo, src[0])
+    perm.insert(hi, src[1])
+    return jnp.transpose(out, np.argsort(perm))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor (reference ``combinations``)."""
+    import itertools
+
+    x = jnp.asarray(x).reshape(-1)
+    n = x.shape[0]
+    picker = (itertools.combinations_with_replacement if with_replacement
+              else itertools.combinations)
+    idx = np.asarray(list(picker(range(n), int(r))), np.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, int(r)), x.dtype)
+    return x[idx]

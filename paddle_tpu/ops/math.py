@@ -603,3 +603,43 @@ clip_ = _make_inplace(clip)
 erfinv_ = _make_inplace(erfinv)
 abs_ = _make_inplace(abs)
 sigmoid_ = _make_inplace(sigmoid)
+
+
+def gammaln(x, name=None):
+    return jax.scipy.special.gammaln(jnp.asarray(x, jnp.float32))
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (reference args order:
+    input x is the shape param)."""
+    return jax.scipy.special.gammainc(jnp.asarray(x, jnp.float32),
+                                      jnp.asarray(y, jnp.float32))
+
+
+def gammaincc(x, y, name=None):
+    return jax.scipy.special.gammaincc(jnp.asarray(x, jnp.float32),
+                                       jnp.asarray(y, jnp.float32))
+
+
+def multigammaln(x, p, name=None):
+    return jax.scipy.special.multigammaln(jnp.asarray(x, jnp.float32), int(p))
+
+
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(int(n), jnp.asarray(x, jnp.float32))
+
+
+def nextafter(x, y, name=None):
+    return jnp.nextafter(jnp.asarray(x), jnp.asarray(y))
+
+
+def isposinf(x, name=None):
+    return jnp.isposinf(jnp.asarray(x))
+
+
+def isneginf(x, name=None):
+    return jnp.isneginf(jnp.asarray(x))
+
+
+def isreal(x, name=None):
+    return jnp.isreal(jnp.asarray(x))

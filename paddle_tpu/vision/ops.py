@@ -20,7 +20,8 @@ from jax import lax
 
 __all__ = ["nms", "nms_mask", "box_coder", "yolo_box", "prior_box",
            "roi_align", "roi_pool", "psroi_pool", "deform_conv2d",
-           "read_file", "decode_jpeg", "sequence_mask"]
+           "read_file", "decode_jpeg", "sequence_mask", "matrix_nms",
+           "distribute_fpn_proposals", "generate_proposals", "yolo_loss"]
 
 
 def _pairwise_iou(boxes):
@@ -434,3 +435,299 @@ def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="bool",
         maxlen = int(jnp.max(lengths))
     mask = jnp.arange(maxlen)[None, :] < lengths[..., None]
     return mask.astype(convert_dtype(dtype))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference
+    ``distribute_fpn_proposals``, python/paddle/vision/ops.py:1288):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)) clipped to
+    [min_level, max_level]. Dynamic-length per-level outputs -> eager.
+
+    Returns ``(multi_rois, restore_ind, rois_num_per_level)`` where
+    ``restore_ind`` re-concatenates level outputs back to input order.
+    """
+    rois = np.asarray(fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0.0))
+    level = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+    multi_rois, per_level, order = [], [], []
+    for lv in range(min_level, max_level + 1):
+        idx = np.where(level == lv)[0]
+        multi_rois.append(jnp.asarray(rois[idx]))
+        per_level.append(idx.size)
+        order.append(idx)
+    order = np.concatenate(order) if order else np.empty(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.size)
+    return multi_rois, jnp.asarray(restore), jnp.asarray(per_level)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference ``matrix_nms``, vision/ops.py:2428; SOLOv2):
+    instead of hard suppression, each box's score decays by the IoU it has
+    with every higher-scored box of its class, normalized by how much THAT
+    box was itself overlapped — one IoU matrix, no sequential loop.
+
+    bboxes: [N, M, 4]; scores: [N, C, M]. Returns (out [R, 6], rois_num
+    and/or index per the flags); out rows are [label, score, x1, y1, x2, y2].
+    """
+    # whole routine in host numpy: this is an inherently eager op (dynamic
+    # output length) and per-class device round-trips would dominate
+    bboxes = np.asarray(bboxes, np.float32)
+    scores = np.asarray(scores, np.float32)
+    n, c, m = scores.shape
+    outs, idxs, counts = [], [], []
+
+    def np_iou(box):
+        area = (np.maximum(box[:, 2] - box[:, 0], 0)
+                * np.maximum(box[:, 3] - box[:, 1], 0))
+        lt = np.maximum(box[:, None, :2], box[None, :, :2])
+        rb = np.minimum(box[:, None, 2:], box[None, :, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+
+    for b in range(n):
+        rows = []
+        ridx = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = scores[b, cls]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            # top nms_top_k by score
+            order = keep[np.argsort(-sc[keep], kind="stable")]
+            order = order[:nms_top_k]
+            box = bboxes[b][order]
+            s = sc[order]
+            iou = np_iou(box)
+            k = order.size
+            tri = np.tril(iou, k=-1)  # iou with higher-scored (earlier) boxes
+            max_iou_of_higher = np.max(tri, axis=1)  # per box
+            # decay_ij = f(iou_ij) / f(max overlap of the suppressor j)
+            if use_gaussian:
+                decay = np.exp(-(tri ** 2 - max_iou_of_higher[None, :] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - tri) / (1 - max_iou_of_higher[None, :] + 1e-10)
+            decay = np.where(np.tril(np.ones((k, k), bool), k=-1),
+                             decay, 1.0)
+            factor = np.min(decay, axis=1)
+            new_s = s * factor
+            rows.append(np.column_stack([
+                np.full(k, cls, np.float32), new_s, box]))
+            ridx.append(order)
+        if rows:
+            allr = np.concatenate(rows)
+            alli = np.concatenate(ridx)
+            sel = np.where(allr[:, 1] > post_threshold)[0]
+            sel = sel[np.argsort(-allr[sel, 1], kind="stable")][:keep_top_k]
+            outs.append(allr[sel])
+            idxs.append(alli[sel] + b * m)
+            counts.append(sel.size)
+        else:
+            outs.append(np.zeros((0, 6), np.float32))
+            idxs.append(np.zeros(0, np.int64))
+            counts.append(0)
+    out = jnp.asarray(np.concatenate(outs))
+    result = [out]
+    if return_index:
+        result.append(jnp.asarray(np.concatenate(idxs)))
+    if return_rois_num:
+        result.append(jnp.asarray(np.asarray(counts, np.int32)))
+    return tuple(result) if len(result) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference ``generate_proposals``,
+    vision/ops.py:2239): decode anchor deltas, clip to the image, drop tiny
+    boxes, take top pre_nms_top_n by score, NMS, keep post_nms_top_n.
+
+    scores: [N, A, H, W]; bbox_deltas: [N, 4*A, H, W]; anchors/variances:
+    [H*W*A, 4]. Returns (rpn_rois [R, 4], rpn_roi_probs [R, 1][, rois_num]).
+    """
+    scores = np.asarray(scores, np.float32)
+    deltas = np.asarray(bbox_deltas, np.float32)
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 4)
+    variances = np.asarray(variances, np.float32).reshape(-1, 4)
+    img_size = np.asarray(img_size, np.float32).reshape(-1, 2)
+    n, a, h, w = scores.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_probs, counts = [], [], []
+    for b in range(n):
+        sc = scores[b].transpose(1, 2, 0).reshape(-1)       # [H*W*A]
+        dl = deltas[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc, kind="stable")[:pre_nms_top_n]
+        sc, dl = sc[order], dl[order]
+        an, var = anchors[order], variances[order]
+        # decode (encode_center_size inverse, the RPN convention)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        ax = an[:, 0] + aw * 0.5
+        ay = an[:, 1] + ah * 0.5
+        cx = var[:, 0] * dl[:, 0] * aw + ax
+        cy = var[:, 1] * dl[:, 1] * ah + ay
+        bw = np.exp(np.minimum(var[:, 2] * dl[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(var[:, 3] * dl[:, 3], 10.0)) * ah
+        box = np.column_stack([cx - bw / 2, cy - bh / 2,
+                               cx + bw / 2 - off, cy + bh / 2 - off])
+        ih, iw = img_size[b]
+        box[:, 0::2] = np.clip(box[:, 0::2], 0, iw - off)
+        box[:, 1::2] = np.clip(box[:, 1::2], 0, ih - off)
+        ok = ((box[:, 2] - box[:, 0] + off >= min_size) &
+              (box[:, 3] - box[:, 1] + off >= min_size))
+        box, sc = box[ok], sc[ok]
+        if box.shape[0]:
+            keep = np.asarray(nms_mask(box, sc, nms_thresh))
+            sel = np.where(keep)[0]
+            sel = sel[np.argsort(-sc[sel], kind="stable")][:post_nms_top_n]
+            box, sc = box[sel], sc[sel]
+        all_rois.append(box)
+        all_probs.append(sc[:, None])
+        counts.append(box.shape[0])
+    rois = jnp.asarray(np.concatenate(all_rois))
+    probs = jnp.asarray(np.concatenate(all_probs))
+    if return_rois_num:
+        return rois, probs, jnp.asarray(np.asarray(counts, np.int32))
+    return rois, probs
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference ``yolo_loss``): responsible-anchor matching
+    by best whole-image IoU, objectness BCE with an ignore band, box
+    regression (xy BCE + wh L1, scaled by 2 - w*h), and class BCE.
+
+    x: [N, A*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h, normalized to
+    the image); gt_label: [N, B]. Returns per-image loss [N].
+    Vectorized jnp throughout — one fused XLA program, no loops over boxes.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gt_label = jnp.asarray(gt_label, jnp.int32)
+    n, c, h, w = x.shape
+    na = len(anchor_mask)
+    all_an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = all_an[jnp.asarray(anchor_mask)]
+    input_size = downsample_ratio * h
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    pred_xy = jax.nn.sigmoid(x[:, :, 0:2]) * scale_x_y - (scale_x_y - 1) / 2
+    pred_wh = x[:, :, 2:4]
+    pred_obj = x[:, :, 4]
+    pred_cls = x[:, :, 5:]
+    nb = gt_box.shape[1]
+    valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0)  # [N, B]
+
+    # responsible anchor: best IoU of gt wh vs ALL anchors (shape-only IoU)
+    gwh = gt_box[:, :, 2:4] * input_size  # pixels
+    inter = (jnp.minimum(gwh[:, :, None, 0], all_an[None, None, :, 0]) *
+             jnp.minimum(gwh[:, :, None, 1], all_an[None, None, :, 1]))
+    union = (gwh[:, :, 0] * gwh[:, :, 1])[:, :, None] + \
+        (all_an[:, 0] * all_an[:, 1])[None, None, :] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [N, B]
+    # map to the mask slot (or -1 if this level is not responsible)
+    mask_arr = jnp.asarray(anchor_mask)
+    slot = jnp.argmax(best_anchor[..., None] == mask_arr[None, None, :], -1)
+    responsible = valid & jnp.any(
+        best_anchor[..., None] == mask_arr[None, None, :], -1)
+
+    gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    tx = gt_box[:, :, 0] * w - gi
+    ty = gt_box[:, :, 1] * h - gj
+    tw = jnp.log(jnp.maximum(gwh[:, :, 0] / jnp.maximum(an[slot][:, :, 0],
+                                                        1e-8), 1e-8))
+    th = jnp.log(jnp.maximum(gwh[:, :, 1] / jnp.maximum(an[slot][:, :, 1],
+                                                        1e-8), 1e-8))
+    box_scale = 2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]
+    score = (jnp.asarray(gt_score, jnp.float32) if gt_score is not None
+             else jnp.ones((n, nb), jnp.float32))
+
+    # scatter gt targets onto the grid (padding slots scatter nothing)
+    def scatter(weight, vals, default):
+        tgt = jnp.full((n, na, h, w), default, jnp.float32)
+        wgt = jnp.zeros((n, na, h, w), jnp.float32)
+        bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
+        sel = responsible
+        tgt = tgt.at[bidx, slot, gj, gi].set(
+            jnp.where(sel, vals, default), mode="drop")
+        wgt = wgt.at[bidx, slot, gj, gi].set(
+            jnp.where(sel, score * weight, 0.0), mode="drop")
+        return tgt, wgt
+
+    one = jnp.ones((n, nb), jnp.float32)
+    txg, wxy = scatter(box_scale, tx, 0.0)
+    tyg, _ = scatter(box_scale, ty, 0.0)
+    twg, _ = scatter(box_scale, tw, 0.0)
+    thg, _ = scatter(box_scale, th, 0.0)
+    tobj, wobj = scatter(one, one, 0.0)
+    has_obj = wobj > 0
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    # xy/wh regression on responsible cells only
+    loss_xy = wxy * (bce(x[:, :, 0], txg) + bce(x[:, :, 1], tyg))
+    loss_wh = wxy * (jnp.abs(pred_wh[:, :, 0] - twg) +
+                     jnp.abs(pred_wh[:, :, 1] - thg))
+
+    # objectness: positives get BCE to 1; negatives whose best pred-gt IoU
+    # exceeds ignore_thresh are ignored (the reference's ignore band)
+    grid_x = (jnp.arange(w, dtype=jnp.float32)[None, :] + pred_xy[:, :, 0]) / w
+    grid_y = (jnp.arange(h, dtype=jnp.float32)[:, None] + pred_xy[:, :, 1]) / h
+    pw_ = jnp.exp(jnp.clip(pred_wh[:, :, 0], -10, 10)) * \
+        an[None, :, 0, None, None] / input_size
+    ph_ = jnp.exp(jnp.clip(pred_wh[:, :, 1], -10, 10)) * \
+        an[None, :, 1, None, None] / input_size
+    px1, py1 = grid_x - pw_ / 2, grid_y - ph_ / 2
+    px2, py2 = grid_x + pw_ / 2, grid_y + ph_ / 2
+    gx1 = gt_box[:, :, 0] - gt_box[:, :, 2] / 2
+    gy1 = gt_box[:, :, 1] - gt_box[:, :, 3] / 2
+    gx2 = gt_box[:, :, 0] + gt_box[:, :, 2] / 2
+    gy2 = gt_box[:, :, 1] + gt_box[:, :, 3] / 2
+    # IoU of every pred cell vs every gt: [N, A, H, W, B]
+    ix1 = jnp.maximum(px1[..., None], gx1[:, None, None, None, :])
+    iy1 = jnp.maximum(py1[..., None], gy1[:, None, None, None, :])
+    ix2 = jnp.minimum(px2[..., None], gx2[:, None, None, None, :])
+    iy2 = jnp.minimum(py2[..., None], gy2[:, None, None, None, :])
+    iw_ = jnp.maximum(ix2 - ix1, 0)
+    ih_ = jnp.maximum(iy2 - iy1, 0)
+    inter_p = iw_ * ih_
+    area_p = (px2 - px1) * (py2 - py1)
+    area_g = ((gx2 - gx1) * (gy2 - gy1))[:, None, None, None, :]
+    iou_p = inter_p / jnp.maximum(area_p[..., None] + area_g - inter_p, 1e-10)
+    iou_p = jnp.where(valid[:, None, None, None, :], iou_p, 0.0)
+    best_iou = jnp.max(iou_p, -1)
+    noobj_mask = (~has_obj) & (best_iou < ignore_thresh)
+    loss_obj = jnp.where(has_obj, wobj * bce(pred_obj, 1.0), 0.0) + \
+        jnp.where(noobj_mask, bce(pred_obj, 0.0), 0.0)
+
+    # classification on responsible cells
+    smooth = 1.0 / class_num if (use_label_smooth and class_num > 1) else 0.0
+    onehot = jax.nn.one_hot(gt_label, class_num)
+    onehot = onehot * (1.0 - smooth) + smooth * (1.0 / class_num)
+    tcls = jnp.zeros((n, na, h, w, class_num), jnp.float32)
+    bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
+    tcls = tcls.at[bidx, slot, gj, gi].set(
+        jnp.where(responsible[..., None], onehot, 0.0), mode="drop")
+    loss_cls = has_obj[..., None] * bce(jnp.moveaxis(pred_cls, 2, -1), tcls)
+
+    per_img = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +
+               loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return per_img

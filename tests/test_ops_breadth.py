@@ -277,3 +277,91 @@ def test_view_dtype_scales_last_dim():
 def test_gaussian_dtype_forwarded():
     g = ops.gaussian((4,), dtype="float16", seed=1)
     assert jnp.asarray(g).dtype == jnp.float16
+
+
+def test_r3_manipulation_additions():
+    """Round-3 long-tail: unflatten/masked_scatter/slice_scatter/stacks/
+    tensor_split/atleast/block_diag/cartesian_prod/diag_embed/combinations."""
+    import numpy as np
+
+    import paddle_tpu.ops as ops
+
+    assert ops.unflatten(np.zeros((2, 12)), 1, [3, -1]).shape == (2, 3, 4)
+    np.testing.assert_allclose(
+        ops.masked_scatter(np.zeros(5), np.array([1, 0, 1, 0, 1], bool),
+                           np.array([1., 2., 3.])), [1, 0, 2, 0, 3])
+    out = ops.slice_scatter(np.zeros((4, 4)), np.ones((2, 4)),
+                            [0], [1], [3], [1])
+    np.testing.assert_allclose(np.asarray(out)[:, 0], [0, 1, 1, 0])
+    assert ops.column_stack([np.arange(3), np.arange(3)]).shape == (3, 2)
+    assert ops.row_stack([np.arange(3), np.arange(3)]).shape == (2, 3)
+    parts = ops.tensor_split(np.arange(10), 3)
+    assert [p.shape[0] for p in parts] == [4, 3, 3]
+    assert ops.atleast_1d(np.float32(3)).shape == (1,)
+    assert ops.atleast_2d(np.arange(3)).shape == (1, 3)
+    assert ops.atleast_3d(np.arange(3)).shape == (1, 3, 1)
+    bd = ops.block_diag([np.ones((2, 2)), 2 * np.ones((1, 3))])
+    assert bd.shape == (3, 5) and float(bd[2, 2]) == 2
+    cp = ops.cartesian_prod([np.arange(2), np.arange(3)])
+    assert cp.shape == (6, 2)
+    np.testing.assert_allclose(ops.diag_embed(np.array([1., 2., 3.])),
+                               np.diag([1, 2, 3]))
+    assert ops.diag_embed(np.ones((2, 3)), offset=1).shape == (2, 4, 4)
+    assert ops.combinations(np.arange(4), 2).shape == (6, 2)
+    assert ops.combinations(np.arange(3), 2, with_replacement=True).shape \
+        == (6, 2)
+
+
+def test_r3_math_additions():
+    import numpy as np
+    from scipy import special as ss
+
+    import paddle_tpu.ops as ops
+
+    np.testing.assert_allclose(ops.gammaln(np.array([3.0, 5.5])),
+                               ss.gammaln([3.0, 5.5]), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ops.gammainc(np.array([2.0]), np.array([1.0])),
+                               ss.gammainc(2.0, 1.0), rtol=1e-5)
+    np.testing.assert_allclose(ops.gammaincc(np.array([2.0]), np.array([1.0])),
+                               ss.gammaincc(2.0, 1.0), rtol=1e-5)
+    np.testing.assert_allclose(ops.multigammaln(np.array([5.0]), 2),
+                               ss.multigammaln(5.0, 2), rtol=1e-4)
+    np.testing.assert_allclose(ops.polygamma(np.array([2.0]), 1),
+                               ss.polygamma(1, 2.0), rtol=1e-4)
+    assert float(ops.nextafter(np.float32(1.0), np.float32(2.0))) > 1.0
+    assert bool(ops.isposinf(np.array(np.inf)))
+    assert bool(ops.isneginf(np.array(-np.inf)))
+    assert bool(ops.isreal(np.array(1.0)))
+
+
+def test_r3_distance_ops():
+    import numpy as np
+    from scipy.spatial.distance import cdist as sp_cdist, pdist as sp_pdist
+
+    import paddle_tpu.ops as ops
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 3)).astype(np.float32)
+    y = rng.normal(size=(4, 3)).astype(np.float32)
+    np.testing.assert_allclose(ops.cdist(x, y), sp_cdist(x, y), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(ops.cdist(x, y, p=1.0), sp_cdist(x, y, "minkowski", p=1),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ops.pdist(x), sp_pdist(x), rtol=1e-4, atol=1e-5)
+
+
+def test_r3_eager_inplace_variants():
+    import numpy as np
+    import pytest
+
+    from paddle_tpu import eager
+
+    t = eager.to_tensor(np.ones((3, 3)))
+    assert float(t.fill_(2.0).numpy()[0, 0]) == 2.0
+    assert float(t.zero_().numpy().sum()) == 0.0
+    t.fill_diagonal_(7.0)
+    np.testing.assert_allclose(np.diag(t.numpy()), [7, 7, 7])
+    x = eager.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError, match="tape"):
+        y.fill_(0.0)

@@ -194,3 +194,90 @@ def test_sequence_mask():
         np.asarray(m), [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
     m2 = ops.sequence_mask(np.asarray([2, 4]), dtype="float32")
     assert m2.shape == (2, 4) and m2.dtype == jnp.float32
+
+
+# ------------------------------------------- detection remainder (r3)
+def test_distribute_fpn_proposals_levels_and_restore():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 300, 300],
+                     [5, 5, 40, 40]], np.float32)
+    multi, restore, num = ops.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    assert len(multi) == 4  # levels 2..5
+    assert sum(int(x) for x in num) == 4
+    # small boxes land on low levels, big on high
+    assert np.asarray(multi[0]).shape[0] >= 1  # level 2 got the 10x10
+    flat = np.concatenate([np.asarray(m) for m in multi])
+    np.testing.assert_allclose(flat[np.asarray(restore)], rois)
+
+
+def test_matrix_nms_decay_ordering():
+    """Top box keeps its score; its overlaps decay; distinct boxes barely
+    decay (SOLOv2 matrix suppression semantics)."""
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out, idx, cnt = ops.matrix_nms(boxes, scores, 0.1, 0.0, 10, 10,
+                                 return_index=True)
+    out = np.asarray(out)
+    assert int(cnt[0]) == 3 and out.shape[1] == 6
+    assert out[0, 1] == pytest.approx(0.9)  # undecayed top
+    overlapped = out[np.asarray(idx) % 3 == 1][0]
+    distinct = out[np.asarray(idx) % 3 == 2][0]
+    assert overlapped[1] < 0.8 * 0.7  # strongly decayed
+    assert distinct[1] > 0.69  # nearly untouched
+    # gaussian flavor also runs + post_threshold filters
+    out2 = ops.matrix_nms(boxes, scores, 0.1, 0.5, 10, 10, use_gaussian=True,
+                        return_rois_num=False)
+    assert np.asarray(out2).shape[0] <= 3
+
+
+def test_generate_proposals_pipeline():
+    rng = np.random.default_rng(0)
+    H = W = 8
+    A = 3
+    base = rng.uniform(0, 48, (H * W * A, 2)).astype(np.float32)
+    anchors = np.column_stack([base, base + rng.uniform(4, 16, base.shape)])
+    var = np.full((H * W * A, 4), 1.0, np.float32)
+    scores = rng.normal(size=(2, A, H, W)).astype(np.float32)
+    deltas = rng.normal(size=(2, 4 * A, H, W)).astype(np.float32) * 0.1
+    rois, probs, rn = ops.generate_proposals(
+        scores, deltas, [[64, 64], [64, 64]], anchors, var,
+        pre_nms_top_n=64, post_nms_top_n=8, return_rois_num=True)
+    rois = np.asarray(rois)
+    assert rois.shape[1] == 4
+    assert all(int(x) <= 8 for x in rn)
+    # clipped to the image and probs sorted descending per image
+    assert rois.min() >= 0 and rois.max() <= 64
+    p0 = np.asarray(probs)[:int(rn[0]), 0]
+    assert (np.diff(p0) <= 1e-6).all()
+
+
+def test_yolo_loss_matching_and_grads():
+    """Responsible-cell construction: loss decreases when predictions move
+    toward the target; grads flow; ignore band suppresses high-IoU
+    negatives from the objectness loss."""
+    import jax
+
+    anchors = [10, 13, 16, 30, 33, 23]
+    kw = dict(anchors=anchors, anchor_mask=[0, 1, 2], class_num=4,
+              ignore_thresh=0.7, downsample_ratio=8)
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=(1, 3 * 9, 8, 8)) * 0.01).astype(np.float32)
+    gt = np.zeros((1, 3, 4), np.float32)
+    gt[0, 0] = [0.5, 0.5, 0.25, 0.25]
+    lbl = np.zeros((1, 3), np.int64)
+    lbl[0, 0] = 2
+
+    loss0 = float(ops.yolo_loss(x, gt, lbl, **kw)[0])
+    assert np.isfinite(loss0)
+    # gradient descent on the head input should reduce the loss
+    fn = lambda xx: ops.yolo_loss(xx, gt, lbl, **kw).sum()
+    g = jax.grad(fn)(x)
+    x1 = x - 0.5 * np.asarray(g)
+    for _ in range(20):
+        x1 = x1 - 0.5 * np.asarray(jax.grad(fn)(x1))
+    assert float(ops.yolo_loss(x1, gt, lbl, **kw)[0]) < loss0 * 0.8
+    # gt_score weighting scales the positive terms
+    half = ops.yolo_loss(x, gt, lbl, gt_score=np.full((1, 3), 0.5, np.float32),
+                       **kw)
+    assert float(half[0]) < loss0
