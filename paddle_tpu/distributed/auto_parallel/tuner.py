@@ -192,7 +192,7 @@ class ParallelTuner:
             micro_act = tokens_per_group / max(self.micro_batches, 1) \
                 * m.hidden_size * m.bytes_per_param / sp
             comm_time += 2 * (pp - 1) * self.micro_batches * micro_act \
-                / c.ici_bandwidth / max(self.micro_batches, 1)
+                / c.ici_bandwidth
 
         # ---- memory per chip
         param_bytes = m.n_params * m.bytes_per_param

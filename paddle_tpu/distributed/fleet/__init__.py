@@ -100,18 +100,52 @@ def distributed_model(model, optimizer=None, loss_fn=None, inputs_fn=None, **kw)
         cfg = strategy.gradient_merge_configs or {}
         kw["grad_accum_steps"] = int(cfg.get("k_steps", 1))
         kw["grad_accum_avg"] = bool(cfg.get("avg", True))
+    if strategy.fp16_allreduce and "grad_transform" not in kw:
+        # reference fp16_allreduce_optimizer: grads cross the wire in fp16.
+        # Under GSPMD the reduction is implicit, so the numerically
+        # equivalent move is casting grads to fp16 and back before the
+        # update — same precision loss the reference accepts for half the
+        # reduction bytes.
+        import jax
+        import jax.numpy as jnp
+
+        kw["grad_transform"] = lambda grads: jax.tree.map(
+            lambda g: g.astype(jnp.float16).astype(g.dtype)
+            if g is not None else None, grads)
     return DistributedTrainStep(model, optimizer, loss_fn=loss_fn, inputs_fn=inputs_fn,
                                 mesh=get_mesh(), sharding_stage=stage, **kw)
 
 
 def distributed_optimizer(optimizer, strategy=None):
     """Mostly a pass-through — grad synchronization is GSPMD's job; ZeRO
-    sharding is applied by DistributedTrainStep via opt-state specs. The one
-    rewrite kept from the reference's meta-optimizer stack: ``strategy.lars``
-    wraps a Momentum optimizer into LarsMomentum (lars_optimizer.py)."""
+    sharding is applied by DistributedTrainStep via opt-state specs. The
+    rewrites kept from the reference's meta-optimizer stack:
+    ``strategy.lars`` wraps Momentum into LarsMomentum (lars_optimizer.py)
+    and ``strategy.dgc`` wraps it into DGCMomentum (dgc_optimizer.py —
+    residual-corrected top-k gradient compression)."""
     if strategy is not None:
         _fleet_state["strategy"] = strategy
     strategy = _fleet_state["strategy"]
+    if strategy is not None and strategy.dgc:
+        from ...optimizer import DGCMomentum, Momentum
+
+        if isinstance(optimizer, Momentum) and \
+                not isinstance(optimizer, DGCMomentum):
+            import logging
+
+            cfg = strategy.dgc_configs or {}
+            if optimizer.use_nesterov or optimizer.weight_decay:
+                logging.getLogger(__name__).warning(
+                    "strategy.dgc replaces Momentum's use_nesterov/"
+                    "weight_decay: DGCMomentum applies neither")
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer.momentum,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]),
+                grad_clip=optimizer.grad_clip,
+                multi_precision=optimizer.multi_precision)
     if strategy is not None and strategy.lars:
         from ...optimizer import LarsMomentum, Momentum
 

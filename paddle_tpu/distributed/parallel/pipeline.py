@@ -160,9 +160,10 @@ class PipelineStagedModule(Layer):
         copies of the template (identical initial weights, torch-deepcopy
         semantics).
 
-        Limitation: blocks must be buffer-free (pure params). Buffer updates
-        inside pipelined blocks (BatchNorm stats etc.) are not threaded
-        through the stacked representation."""
+        Blocks MAY hold buffers (BatchNorm running stats etc.): buffers are
+        stacked on the same pp-sharded leading axis as params and threaded
+        through the schedule — each microbatch's update lands in sequence,
+        like the reference's per-microbatch BN updates."""
         super().__init__()
         # the template executes with stacked slices swapped in — its own
         # params must NOT register (they'd be dead weights), so bypass
@@ -172,11 +173,6 @@ class PipelineStagedModule(Layer):
         self.num_micro = num_micro
         self.remat = remat
         self.num_virtual_stages = int(num_virtual_stages)
-        if list(block_fn_layer.named_buffers()):
-            raise ValueError(
-                "PipelineStagedModule blocks must not hold buffers (running "
-                "stats are not threaded through the stacked pipeline); use "
-                "LayerNorm-style stateless layers inside pipeline stages")
         import copy
 
         if block_factory is not None:
@@ -200,39 +196,69 @@ class PipelineStagedModule(Layer):
             self.add_parameter(path, v)
             self.set_param_sharding(path, ("pp",) + (None,) * (v.ndim - 1))
         self._stacked_keys = list(stacked.keys())
+        # buffers stack exactly like params (rows in self._order)
+        buf_states = [buffer_state(b) for b in blocks]
+        self._stacked_buf_keys = list(buf_states[0].keys())
+        for k in self._stacked_buf_keys:
+            path = f"stackedbuf__{k.replace('.', '__')}"
+            self.register_buffer(path, jnp.stack(
+                [buf_states[i][k] for i in self._order]))
 
     def _stacked(self):
         return {k: self._parameters[f"stacked__{k.replace('.', '__')}"]
                 for k in self._stacked_keys}
 
-    def _apply_block(self, layer_params: Dict[str, Any], x):
+    def _stacked_bufs(self):
+        return {k: self._buffers[f"stackedbuf__{k.replace('.', '__')}"]
+                for k in self._stacked_buf_keys}
+
+    def _write_stacked_bufs(self, bufs: Dict[str, Any]) -> None:
+        for k, v in bufs.items():
+            self._buffers[f"stackedbuf__{k.replace('.', '__')}"] = v
+
+    def _apply_block(self, layer_params: Dict[str, Any],
+                     layer_bufs: Dict[str, Any], x):
+        """Run one block; returns (out, new_layer_bufs)."""
         tmpl = self.template
 
-        def run(p, xx):
-            out, _ = functional_call(tmpl, p, {}, xx)
-            return out
+        def run(p, b, xx):
+            return functional_call(tmpl, p, b, xx)
 
         if self.remat:
             run = jax.checkpoint(run)
-        return run(layer_params, x)
+        return run(layer_params, layer_bufs, x)
 
     def forward(self, x):
         mesh = require_mesh() if _has_pp() else None
         stacked = self._stacked()
+        bufs = self._stacked_bufs()
         if mesh is None or mesh.shape.get("pp", 1) == 1:
             # plain sequential scan over layers, in GLOBAL stage order
+            reordered = self._order != sorted(self._order)
             inv = np.argsort(self._order)
-            ordered = {k: v[jnp.asarray(inv)] if self._order != sorted(self._order) else v
+            ordered = {k: v[jnp.asarray(inv)] if reordered else v
                        for k, v in stacked.items()}
+            ordered_b = {k: v[jnp.asarray(inv)] if reordered else v
+                         for k, v in bufs.items()}
 
-            def body(h, layer_params):
-                return self._apply_block(layer_params, h), None
+            def body(h, layer_state):
+                lp, lb = layer_state
+                out, new_b = self._apply_block(lp, lb, h)
+                return out, new_b
 
-            out, _ = lax.scan(body, x, ordered)
+            out, new_bufs = lax.scan(body, x, (ordered, ordered_b))
+            if self._stacked_buf_keys:
+                if reordered:
+                    fwd = jnp.asarray(self._order)
+                    new_bufs = {k: v[fwd] for k, v in new_bufs.items()}
+                self._write_stacked_bufs(new_bufs)
             return out
-        return _pipeline_spmd(stacked, x, self._apply_block, mesh,
-                              self.num_micro, self.num_layers,
-                              self.num_virtual_stages)
+        out, new_bufs = _pipeline_spmd(stacked, bufs, x, self._apply_block,
+                                       mesh, self.num_micro, self.num_layers,
+                                       self.num_virtual_stages)
+        if self._stacked_buf_keys:
+            self._write_stacked_bufs(new_bufs)
+        return out
 
 
 def _has_pp():
@@ -249,14 +275,20 @@ def _pp_size() -> int:
     return m.shape.get("pp", 1) if m is not None else 1
 
 
-def _pipeline_spmd(stacked_params, x, apply_block, mesh, num_micro, num_layers,
-                   v=1):
+def _pipeline_spmd(stacked_params, stacked_bufs, x, apply_block, mesh,
+                   num_micro, num_layers, v=1):
     """Interleaved ring schedule over the "pp" mesh axis.
 
     Microbatches run in depth-first bursts of ``pp``: within a burst's scan,
     tick t advances every in-flight microbatch one ring hop; device d
     processes its local chunk ``(t - d) // pp`` (0 when v == 1). Outputs
-    appear on the last device after ``v*pp`` hops."""
+    appear on the last device after ``v*pp`` hops.
+
+    Buffers (BN running stats) ride alongside: each VALID tick's block run
+    threads its layer-row buffers and writes them back; warmup/drain ticks
+    (garbage activations in the bubble) keep the old buffer rows, so stats
+    never see padding. Returns ``(out, new_stacked_bufs)``.
+    """
     pp = mesh.shape["pp"]
     assert num_layers % (pp * v) == 0, \
         f"pp*virtual ({pp}*{v}) must divide num_layers ({num_layers})"
@@ -270,74 +302,95 @@ def _pipeline_spmd(stacked_params, x, apply_block, mesh, num_micro, num_layers,
 
     param_specs = {k: P("pp", *([None] * (val.ndim - 1)))
                    for k, val in stacked_params.items()}
-    in_specs = (param_specs, P(*([None] * (x_mb.ndim))))
-    out_specs = P(*([None] * x_mb.ndim))
+    buf_specs = {k: P("pp", *([None] * (val.ndim - 1)))
+                 for k, val in stacked_bufs.items()}
+    in_specs = (param_specs, buf_specs, P(*([None] * (x_mb.ndim))))
+    out_specs = (P(*([None] * x_mb.ndim)), buf_specs)
 
-    def local(stage_params, mb_inputs):
+    def local(stage_params, stage_bufs, mb_inputs):
         # stage_params leaves: [v*lpc, ...] local rows; mb_inputs: [M, mb, ...]
         d = lax.axis_index("pp")
         perm = [(i, (i + 1) % pp) for i in range(pp)]
         total_hops = v * pp
 
-        def run_chunk(chunk_idx, h):
+        def run_chunk(chunk_idx, h, bufs, valid):
             # local rows for this chunk: [chunk_idx*lpc, (chunk_idx+1)*lpc)
-            def body(hh, i):
+            def body(carry, i):
+                hh, bufs = carry
+                row = chunk_idx * lpc + i
                 lp = jax.tree.map(
                     lambda a: lax.dynamic_index_in_dim(
-                        a, chunk_idx * lpc + i, axis=0, keepdims=False),
-                    stage_params)
-                return apply_block(lp, hh), None
+                        a, row, axis=0, keepdims=False), stage_params)
+                lb = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, row, axis=0, keepdims=False), bufs)
+                out, new_lb = apply_block(lp, lb, hh)
+                # bubble ticks must not pollute running stats
+                bufs = jax.tree.map(
+                    lambda a, nb, ob: lax.dynamic_update_index_in_dim(
+                        a, jnp.where(valid, nb, ob), row, axis=0),
+                    bufs, new_lb, lb)
+                return (out, bufs), None
 
-            out, _ = lax.scan(body, h, jnp.arange(lpc))
-            return out
+            (out, bufs), _ = lax.scan(body, (h, bufs), jnp.arange(lpc))
+            return out, bufs
 
         zero = jnp.zeros(mb_inputs.shape[1:], mb_inputs.dtype)
         outputs0 = jnp.zeros_like(mb_inputs)
 
-        def burst(outputs, b0, burst_size):
+        def burst(carry, b0, burst_size):
             """One depth-first burst of ``burst_size`` (<= pp) microbatches
             starting at global microbatch b0."""
             n_ticks = total_hops + burst_size - 1
 
             def tick(carry, t):
-                incoming, outputs = carry
+                incoming, outputs, bufs = carry
                 # device 0 feeds fresh microbatch t (chunk 0) while t < size
                 feed_idx = jnp.clip(b0 + t, 0, num_micro - 1)
                 first_in = lax.dynamic_index_in_dim(mb_inputs, feed_idx, axis=0,
                                                     keepdims=False)
                 fresh = (d == 0) & (t < burst_size)
                 h = jnp.where(fresh, first_in, incoming)
-                # chunk this device runs at tick t
+                # chunk this device runs at tick t; the activation it holds
+                # is a real microbatch only inside the schedule window
                 c = jnp.clip((t - d) // pp, 0, v - 1) if v > 1 else 0
-                y = run_chunk(c, h) if v > 1 else run_chunk(0, h)
+                # device d holds microbatch m = (t-d) - chunk*pp; real iff
+                # m is inside this burst and the chunk index is in range
+                if v == 1:
+                    valid = (t >= d) & (t - d < burst_size)
+                else:
+                    valid = ((t >= d) & ((t - d) % pp < burst_size)
+                             & ((t - d) // pp < v))
+                y, bufs = run_chunk(c, h, bufs, valid)
                 # last device at its last chunk emits microbatch t-(total_hops-1)
                 out_m = jnp.clip(b0 + t - (total_hops - 1), 0, num_micro - 1)
-                valid = (d == pp - 1) & (t >= total_hops - 1)
+                emit = (d == pp - 1) & (t >= total_hops - 1)
                 cur = lax.dynamic_index_in_dim(outputs, out_m, axis=0, keepdims=False)
-                upd = jnp.where(valid, y, cur)
+                upd = jnp.where(emit, y, cur)
                 outputs = lax.dynamic_update_index_in_dim(outputs, upd, out_m, axis=0)
                 nxt = lax.ppermute(y, "pp", perm)
-                return (nxt, outputs), None
+                return (nxt, outputs, bufs), None
 
-            (_, outputs), _ = lax.scan(tick, (zero, outputs), jnp.arange(n_ticks))
-            return outputs
+            carry, _ = lax.scan(tick, carry, jnp.arange(n_ticks))
+            return carry
 
         # v == 1: the continuous schedule is conflict-free, one burst of all
         # microbatches (bubble pp-1 total). v > 1: depth-first bursts of pp.
         step = num_micro if v == 1 else pp
-        outputs = outputs0
+        carry = (zero, outputs0, stage_bufs)
         for b0 in range(0, num_micro, step):
-            outputs = burst(outputs, b0, min(step, num_micro - b0))
+            carry = burst(carry, b0, min(step, num_micro - b0))
+        _, outputs, bufs = carry
 
         # every rank returns its buffer; only the last rank's is real.
         # psum after masking replicates the result (out_specs replicated).
         outputs = jnp.where(d == pp - 1, outputs, jnp.zeros_like(outputs))
-        return lax.psum(outputs, "pp")
+        return lax.psum(outputs, "pp"), bufs
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_vma=False)
-    out_mb = fn(stacked_params, x_mb)
-    return out_mb.reshape(B, *out_mb.shape[2:])
+    out_mb, new_bufs = fn(stacked_params, stacked_bufs, x_mb)
+    return out_mb.reshape(B, *out_mb.shape[2:]), new_bufs
 
 
 # ------------------------------------------------ heterogeneous stage path
@@ -348,30 +401,72 @@ class HeterogeneousPipeline(Layer):
     Reference parity: ``PipelineLayer`` supports non-uniform stages because
     each process builds only its own sublayers. In SPMD there is one
     program, so every stage's computation is compiled into a ``lax.switch``
-    and each device executes only its branch at runtime. Parameters of all
-    stages live on all pp ranks (replicated over "pp") — acceptable for
-    moderate models; use PipelineStagedModule for the homogeneous bulk.
+    and each device executes only its branch at runtime.
 
-    Stages must map [mb, ...] -> [mb, ...] with a fixed activation shape.
+    Parameter placement: each stage's param pytree is raveled into one flat
+    vector, padded to the longest stage, and the [pp, maxlen] stack is
+    sharded over "pp" — so a rank holds ONLY its own stage's weights (plus
+    padding), not pp replicas of everything. Optimizer state shards the
+    same way. ``stage_state_dicts()`` unravels back to per-stage pytrees
+    for checkpoint interchange.
+
+    Stages must map [mb, ...] -> [mb, ...] with a fixed activation shape,
+    be buffer-free, and share one floating param dtype (the ravel).
     """
 
     def __init__(self, stages: Sequence[Layer], num_micro: int = 1, remat: bool = True):
         super().__init__()
-        from ...nn.layers.containers import LayerList
+        from jax.flatten_util import ravel_pytree
 
-        self.stages = LayerList(list(stages))
-        self.num_micro = num_micro
-        self.remat = remat
-        for l in self.stages:
+        stages = list(stages)
+        for l in stages:
             if list(l.named_buffers()):
                 raise ValueError("pipeline stages must be buffer-free")
+        # stage layers execute with raveled slices swapped in — their own
+        # params must NOT register as this Layer's children
+        object.__setattr__(self, "_stage_layers", stages)
+        self.num_micro = num_micro
+        self.remat = remat
+        flats, unravels = [], []
+        for l in stages:
+            f, u = ravel_pytree(param_state(l))
+            flats.append(f)
+            unravels.append(u)
+        dtypes = {f.dtype for f in flats}
+        if len(dtypes) > 1:
+            raise ValueError(
+                f"heterogeneous stages must share one param dtype, got "
+                f"{sorted(map(str, dtypes))}")
+        self._stage_lens = [int(f.size) for f in flats]
+        object.__setattr__(self, "_unravels", unravels)
+        maxlen = max(self._stage_lens)
+        stacked = jnp.stack([
+            jnp.pad(f, (0, maxlen - f.size)) for f in flats])
+        self.add_parameter("stages_flat", stacked)
+        self.set_param_sharding("stages_flat", ("pp", None))
+
+    @property
+    def num_stages(self) -> int:
+        return len(self._stage_layers)
+
+    def _stage_params(self, flat_row, i):
+        return self._unravels[i](flat_row[:self._stage_lens[i]])
+
+    def stage_state_dicts(self):
+        """Per-stage param pytrees unraveled from the sharded stack (for
+        checkpoint interchange with per-process deployments)."""
+        flat = self._parameters["stages_flat"]
+        return [self._stage_params(flat[i], i)
+                for i in range(self.num_stages)]
 
     def forward(self, x):
         mesh = require_mesh() if _has_pp() else None
-        stages = list(self.stages)
+        stages = self._stage_layers
+        flat = self._parameters["stages_flat"]
         if mesh is None or mesh.shape.get("pp", 1) == 1:
-            for l in stages:
-                x = l(x)
+            for i, l in enumerate(stages):
+                p = self._stage_params(flat[i], i)
+                x, _ = functional_call(l, p, {}, x)
             return x
         pp = mesh.shape["pp"]
         if len(stages) != pp:
@@ -381,32 +476,30 @@ class HeterogeneousPipeline(Layer):
         assert B % num_micro == 0
         mb = B // num_micro
         x_mb = x.reshape(num_micro, mb, *x.shape[1:])
-
-        params = [param_state(l) for l in stages]
-        bufs = [buffer_state(l) for l in stages]
         remat = self.remat
 
         def make_branch(i):
-            def branch(all_params, h):
-                def run(p, hh):
-                    out, _ = functional_call(stages[i], p, bufs[i], hh)
+            def branch(flat_local, h):
+                def run(fl, hh):
+                    p = self._stage_params(fl, i)
+                    out, _ = functional_call(stages[i], p, {}, hh)
                     return out
 
                 if remat:
                     run = jax.checkpoint(run)
-                return run(all_params[i], h)
+                return run(flat_local, h)
 
             return branch
 
         branches = [make_branch(i) for i in range(pp)]
 
-        # params replicated over pp (heterogeneous pytrees can't shard on a
-        # stacked axis); other mesh axes still apply through GSPMD outside
-        in_specs = (P(), P(*([None] * x_mb.ndim)))
+        # flat param stack sharded over pp: each rank sees ONLY its row
+        in_specs = (P("pp", None), P(*([None] * x_mb.ndim)))
         out_specs = P(*([None] * x_mb.ndim))
 
-        def local(all_params, mb_inputs):
+        def local(flat_stack, mb_inputs):
             d = lax.axis_index("pp")
+            flat_local = flat_stack[0]  # this rank's [maxlen] row
             perm = [(i, (i + 1) % pp) for i in range(pp)]
             n_ticks = num_micro + pp - 1
             zero = jnp.zeros(mb_inputs.shape[1:], mb_inputs.dtype)
@@ -418,7 +511,7 @@ class HeterogeneousPipeline(Layer):
                 first_in = lax.dynamic_index_in_dim(mb_inputs, feed_idx, axis=0,
                                                     keepdims=False)
                 h = jnp.where(d == 0, first_in, incoming)
-                y = lax.switch(d, branches, all_params, h)
+                y = lax.switch(d, branches, flat_local, h)
                 out_idx = jnp.clip(t - (pp - 1), 0, num_micro - 1)
                 valid = (d == pp - 1) & (t >= pp - 1)
                 cur = lax.dynamic_index_in_dim(outputs, out_idx, axis=0, keepdims=False)
@@ -433,7 +526,7 @@ class HeterogeneousPipeline(Layer):
 
         fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
-        out_mb = fn(params, x_mb)
+        out_mb = fn(flat, x_mb)
         return out_mb.reshape(B, *out_mb.shape[2:])
 
 
@@ -465,6 +558,16 @@ class PipelineLayer(Layer):
         self.pre = LayerList([built[i] for i in head_idx])
         self.post = LayerList([built[i] for i in tail_idx])
         self._loss_fn = loss_fn
+        # Shard pre/post (embedding/head) weights over the pp axis instead of
+        # replicating them on every pp rank: GSPMD partitions the gather/
+        # matmul and inserts the collective, so their HBM and compute scale
+        # with pp (Megatron vocab-parallel restated on the pp axis). Only
+        # large unannotated matrices opt in; TP-annotated params keep theirs.
+        pp = _pp_size()
+        if pp > 1:
+            for seg in (self.pre, self.post):
+                for sub in seg:
+                    self._shard_over_pp(sub, pp)
         if block_idxs:
             template = built[block_idxs[0]]
             # per-block initializer draws when the template came from a
@@ -477,6 +580,18 @@ class PipelineLayer(Layer):
                 num_virtual_stages=num_virtual_pipeline_stages or 1)
         else:
             self.blocks = None
+
+    @staticmethod
+    def _shard_over_pp(layer: Layer, pp: int, min_elems: int = 1 << 16) -> None:
+        """Annotate a layer tree's big unannotated matrices to shard dim 0
+        over "pp" (recursing into sublayers)."""
+        for name, p in layer._parameters.items():
+            if (name not in layer._param_shardings and p is not None
+                    and p.ndim >= 2 and p.size >= min_elems
+                    and p.shape[0] % pp == 0):
+                layer.set_param_sharding(name, ("pp",) + (None,) * (p.ndim - 1))
+        for sub in layer._sub_layers.values():
+            PipelineLayer._shard_over_pp(sub, pp, min_elems)
 
     def forward(self, x):
         for l in self.pre:

@@ -23,6 +23,7 @@ from typing import Dict, Optional
 
 from .embedding import (SparseEmbedding, StagedPull, callbacks_supported,
                         make_lookup)
+from .coordinator import (ClientInfoAttr, Coordinator, FLClient, FLStrategy)
 from .graph import (DistGraphClient, GraphDataGenerator, GraphServer,
                     GraphTable, launch_graph_servers)
 from .pass_builder import PipelinedPassBuilder
@@ -36,6 +37,7 @@ __all__ = [
     "SSDSparseTable", "PsRpcError",
     "SparseEmbedding", "StagedPull", "callbacks_supported", "make_lookup",
     "PsServer", "PsClient", "Communicator", "launch_servers", "shard_of",
+    "ClientInfoAttr", "Coordinator", "FLClient", "FLStrategy",
     "GraphTable", "GraphServer", "DistGraphClient", "GraphDataGenerator",
     "launch_graph_servers", "PipelinedPassBuilder",
     "PSContext", "get_ps_context",

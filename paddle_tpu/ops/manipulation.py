@@ -561,6 +561,15 @@ def masked_scatter(x, mask, value, name=None):
     x = jnp.asarray(x)
     mask = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape)
     vals = jnp.asarray(value).reshape(-1).astype(x.dtype)
+    try:  # eager check (skipped under tracing): reference errors on too
+        # few value elements rather than silently reusing the last one
+        needed = int(np.asarray(mask).sum())
+        if needed > vals.size:
+            raise ValueError(
+                f"masked_scatter: mask selects {needed} elements but value "
+                f"has only {vals.size}")
+    except (TypeError, jax.errors.TracerArrayConversionError):
+        pass
     # k-th True (row-major) takes vals[k]
     order = jnp.cumsum(mask.reshape(-1)) - 1
     take = vals[jnp.clip(order, 0, vals.size - 1)].reshape(x.shape)
@@ -648,7 +657,7 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
         (out.ndim - 1, out.ndim - 2)
     perm.insert(lo, src[0])
     perm.insert(hi, src[1])
-    return jnp.transpose(out, np.argsort(perm))
+    return jnp.transpose(out, perm)
 
 
 def combinations(x, r=2, with_replacement=False, name=None):

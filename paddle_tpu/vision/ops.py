@@ -648,6 +648,9 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
     gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
     gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    # padding / non-responsible rows must scatter NOWHERE: route them to an
+    # out-of-bounds cell so mode="drop" discards the write (otherwise they
+    # all land on [b, 0, 0, 0] and clobber a real target there)
     tx = gt_box[:, :, 0] * w - gi
     ty = gt_box[:, :, 1] * h - gj
     tw = jnp.log(jnp.maximum(gwh[:, :, 0] / jnp.maximum(an[slot][:, :, 0],
@@ -658,16 +661,16 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     score = (jnp.asarray(gt_score, jnp.float32) if gt_score is not None
              else jnp.ones((n, nb), jnp.float32))
 
-    # scatter gt targets onto the grid (padding slots scatter nothing)
+    # scatter gt targets onto the grid; non-responsible rows get an OOB
+    # row index so mode="drop" discards them entirely
+    gj_s = jnp.where(responsible, gj, h)
+    bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
+
     def scatter(weight, vals, default):
         tgt = jnp.full((n, na, h, w), default, jnp.float32)
         wgt = jnp.zeros((n, na, h, w), jnp.float32)
-        bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
-        sel = responsible
-        tgt = tgt.at[bidx, slot, gj, gi].set(
-            jnp.where(sel, vals, default), mode="drop")
-        wgt = wgt.at[bidx, slot, gj, gi].set(
-            jnp.where(sel, score * weight, 0.0), mode="drop")
+        tgt = tgt.at[bidx, slot, gj_s, gi].set(vals, mode="drop")
+        wgt = wgt.at[bidx, slot, gj_s, gi].set(score * weight, mode="drop")
         return tgt, wgt
 
     one = jnp.ones((n, nb), jnp.float32)
@@ -723,9 +726,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     onehot = jax.nn.one_hot(gt_label, class_num)
     onehot = onehot * (1.0 - smooth) + smooth * (1.0 / class_num)
     tcls = jnp.zeros((n, na, h, w, class_num), jnp.float32)
-    bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
-    tcls = tcls.at[bidx, slot, gj, gi].set(
-        jnp.where(responsible[..., None], onehot, 0.0), mode="drop")
+    tcls = tcls.at[bidx, slot, gj_s, gi].set(onehot, mode="drop")
     loss_cls = has_obj[..., None] * bce(jnp.moveaxis(pred_cls, 2, -1), tcls)
 
     per_img = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +

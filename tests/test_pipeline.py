@@ -216,3 +216,133 @@ def test_pipeline_memory_bounded():
             mems[M] = analysis.temp_size_in_bytes
         set_mesh(None)
     assert mems[8] < 2 * mems[2], mems
+
+
+# ------------------------------------------- round-3 pipeline upgrades
+class BNBlock(nn.Layer):
+    """A pipelined block WITH buffers (BatchNorm running stats)."""
+
+    def __init__(self, width=16):
+        super().__init__()
+        self.fc = nn.Linear(width, width)
+        self.bn = nn.BatchNorm1D(width)
+
+    def forward(self, x):
+        return x + 0.1 * F.tanh(self.bn(self.fc(x)))
+
+
+def test_pipeline_batchnorm_blocks_parity():
+    """BN stages pipeline now: outputs AND updated running stats match the
+    sequential path bit-for-bit (num_micro=1 so batch stats agree)."""
+    pt.seed(7)
+    m = init_mesh(pp=4)
+    set_mesh(None)
+    pipe = PipelineStagedModule(BNBlock(), num_layers=4, num_micro=1,
+                                remat=True, block_factory=lambda: BNBlock())
+    x = pt.randn([8, 16])
+
+    from paddle_tpu.nn import buffer_state
+
+    bufs0 = {k: np.asarray(v).copy() for k, v in buffer_state(pipe).items()}
+    ref = np.asarray(pipe(x))
+    bufs_seq = {k: np.asarray(v).copy() for k, v in buffer_state(pipe).items()}
+    # stats moved in the sequential run
+    assert any(not np.allclose(bufs0[k], bufs_seq[k]) for k in bufs0)
+
+    # reset buffers, run pipelined, compare output + stats
+    for k, v in bufs0.items():
+        pipe._set_by_path(k, jnp.asarray(v))
+    with mesh_scope(m):
+        out = np.asarray(pipe(x))
+    bufs_pp = {k: np.asarray(v) for k, v in buffer_state(pipe).items()}
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    for k in bufs_seq:
+        np.testing.assert_allclose(bufs_pp[k], bufs_seq[k], rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_pipeline_batchnorm_multi_micro_updates_once_per_microbatch():
+    """With num_micro>1 each microbatch's BN update lands (momentum applied
+    num_micro times), and bubble ticks never pollute the stats."""
+    pt.seed(3)
+    m = init_mesh(pp=2)
+    set_mesh(None)
+    pipe = PipelineStagedModule(BNBlock(), num_layers=2, num_micro=4,
+                                remat=False, block_factory=lambda: BNBlock())
+    x = pt.randn([8, 16])
+    from paddle_tpu.nn import buffer_state, functional_call as fc, param_state
+
+    params = param_state(pipe)
+    bufs = buffer_state(pipe)
+    # reference: run the 4 microbatches sequentially through the pp=1 path
+    ref_bufs = dict(bufs)
+    for i in range(4):
+        _, ref_bufs = fc(pipe, params, ref_bufs, x[i * 2:(i + 1) * 2])
+    with mesh_scope(m):
+        _, pp_bufs = fc(pipe, params, bufs, x)
+    for k in ref_bufs:
+        np.testing.assert_allclose(np.asarray(pp_bufs[k]),
+                                   np.asarray(ref_bufs[k]), rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_heterogeneous_pipeline_shards_params_over_pp():
+    """Per-stage params live in ONE [pp, maxlen] stack sharded over pp —
+    a rank holds its own stage (+padding), not pp replicas of everything."""
+    pt.seed(11)
+    m = init_mesh(pp=4)
+    set_mesh(None)
+    stages = [nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 16)),
+              nn.Sequential(nn.Linear(16, 16)),
+              nn.Sequential(nn.Linear(16, 48), nn.ReLU(), nn.Linear(48, 16)),
+              nn.Sequential(nn.Linear(16, 16))]
+    pipe = HeterogeneousPipeline(stages, num_micro=2, remat=False)
+    params = param_state(pipe)
+    assert list(params) == ["stages_flat"]
+    lens = pipe._stage_lens
+    assert params["stages_flat"].shape == (4, max(lens))
+    assert dict(pipe.named_param_shardings())["stages_flat"] == ("pp", None)
+
+    x = pt.randn([4, 16])
+    ref = np.asarray(pipe(x))  # sequential path
+    with mesh_scope(m):
+        out = np.asarray(pipe(x))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    # checkpoint interchange: unraveled per-stage pytrees match originals
+    sds = pipe.stage_state_dicts()
+    np.testing.assert_allclose(np.asarray(sds[0]["0.weight"]),
+                               np.asarray(param_state(stages[0])["0.weight"]))
+
+    # grads flow into the single stack
+    def loss(p):
+        with mesh_scope(m):
+            o, _ = functional_call(pipe, p, {}, x)
+        return jnp.mean(o * o)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["stages_flat"]).sum()) > 0
+
+
+def test_pipeline_layer_shards_pre_post_over_pp():
+    """PipelineLayer annotates big embedding/head matrices to shard over
+    the pp axis instead of replicating them on every pp rank."""
+    pt.seed(2)
+    m = init_mesh(pp=4)
+    with mesh_scope(m):
+        pipe = PipelineLayer([
+            LayerDesc(nn.Embedding, 1024, 64),
+            LayerDesc(Block, 64), LayerDesc(Block, 64),
+            LayerDesc(Block, 64), LayerDesc(Block, 64),
+            LayerDesc(nn.Linear, 64, 1024),
+        ], num_micro=2)
+    shardings = dict(pipe.named_param_shardings())
+    emb = [k for k in shardings if k.startswith("pre") and "weight" in k]
+    head = [k for k in shardings if k.startswith("post") and "weight" in k]
+    assert emb and shardings[emb[0]][0] == "pp"
+    assert head and shardings[head[0]][0] == "pp"
+    # and it still computes correctly under the mesh
+    x = np.random.default_rng(0).integers(0, 1024, (4, 8))
+    with mesh_scope(m):
+        out = pipe(jnp.asarray(x))
+    assert out.shape == (4, 8, 1024)

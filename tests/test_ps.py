@@ -409,3 +409,66 @@ def test_pass_builder_ssd_no_data_loss(tmp_path):
     # pass 1 pushes AFTER the eviction: must warm-reload, not re-init
     b.push(1, np.ones((uniq1.size, 4), np.float32))
     np.testing.assert_allclose(t.pull(ids), trained - 1.0, rtol=1e-6)
+
+
+# ---------------------------------------------- FL coordinator (round 3)
+def test_fl_coordinator_round_loop():
+    """Reference ps/coordinator.py flow: clients push ClientInfoAttr, the
+    coordinator's selector publishes per-client FLStrategy, clients pull
+    their decision; final round FINISHes everyone."""
+    import threading
+
+    from paddle_tpu.distributed.ps import (ClientInfoAttr, Coordinator,
+                                           FLClient, FLStrategy)
+    from paddle_tpu.distributed.ps.coordinator import ClientSelector
+
+    coord = Coordinator(selector=ClientSelector(max_rounds=2))
+    try:
+        clients = [FLClient(f"c{i}", coord.endpoint) for i in range(3)]
+        results = {}
+
+        def client_loop(c):
+            for r in range(2):
+                c.push_client_info(r, ClientInfoAttr(
+                    loss=1.0 / (r + 1), num_samples=64))
+                results[(c.client_id, r)] = c.pull_fl_strategy(r, timeout=30)
+
+        ts = [threading.Thread(target=client_loop, args=(c,)) for c in clients]
+        for t in ts:
+            t.start()
+        rounds = coord.run(num_clients=3, timeout=30)
+        for t in ts:
+            t.join(timeout=30)
+        assert rounds == 2
+        assert all(results[(f"c{i}", 0)].action == FLStrategy.JOIN
+                   for i in range(3))
+        assert all(results[(f"c{i}", 1)].action == FLStrategy.FINISH
+                   for i in range(3))
+    finally:
+        coord.stop()
+
+
+def test_fl_coordinator_custom_selector():
+    """Loss-aware selection: only the worst-loss half JOINs."""
+    from paddle_tpu.distributed.ps import ClientInfoAttr, Coordinator, FLClient
+    from paddle_tpu.distributed.ps.coordinator import (ClientSelector,
+                                                       FLStrategy)
+
+    def pick_worst(round_idx, states):
+        ranked = sorted(states, key=lambda c: -(states[c].loss or 0))
+        join = set(ranked[:len(ranked) // 2])
+        return {c: FLStrategy(FLStrategy.JOIN if c in join
+                              else FLStrategy.WAIT) for c in states}
+
+    coord = Coordinator(selector=ClientSelector(select_fn=pick_worst))
+    try:
+        cs = [FLClient(f"c{i}", coord.endpoint) for i in range(4)]
+        for i, c in enumerate(cs):
+            c.push_client_info(0, ClientInfoAttr(loss=float(i)))
+        coord.run_round(0, num_clients=4, timeout=30)
+        acts = {c.client_id: c.pull_fl_strategy(0, timeout=30).action
+                for c in cs}
+        assert acts["c3"] == "JOIN" and acts["c2"] == "JOIN"
+        assert acts["c0"] == "WAIT" and acts["c1"] == "WAIT"
+    finally:
+        coord.stop()
