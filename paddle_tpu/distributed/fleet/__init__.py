@@ -144,6 +144,7 @@ def distributed_optimizer(optimizer, strategy=None):
                 rampup_begin_step=cfg.get("rampup_begin_step", 0),
                 rampup_step=cfg.get("rampup_step", 1),
                 sparsity=cfg.get("sparsity", [0.999]),
+                parameters=optimizer._parameters,
                 grad_clip=optimizer.grad_clip,
                 multi_precision=optimizer.multi_precision)
     if strategy is not None and strategy.lars:

@@ -53,6 +53,17 @@ _COLLAPSED: Dict[str, str] = {
     "qat_configs": "use paddle_tpu.quantization directly",
     "auto": "use auto_parallel.Engine / ParallelTuner",
     "elastic": "elastic membership lives in launch.elastic",
+    "asp": "apply incubate.asp pruning masks explicitly",
+    "tensor_parallel": "declare mp_degree in hybrid_configs instead",
+    "tensor_parallel_configs": "declare mp_degree in hybrid_configs instead",
+    "is_fl_ps_mode": "drive distributed.ps.coordinator explicitly",
+    "with_coordinator": "drive distributed.ps.coordinator explicitly",
+}
+
+# accepted as fields but raising when ENABLED: not implemented, and
+# pretending otherwise would silently train without the feature
+_UNSUPPORTED_WHEN_TRUE = {
+    "adaptive_localsgd": "use localsgd with an explicit k_steps schedule",
 }
 
 
@@ -67,12 +78,9 @@ class DistributedStrategy:
         "find_unused_parameters",
         "lamb", "lamb_configs", "lars", "lars_configs",
         "localsgd", "localsgd_configs",
-        "adaptive_localsgd", "adaptive_localsgd_configs",
+        "adaptive_localsgd_configs",
         "dgc", "dgc_configs",
         "fp16_allreduce",
-        "asp",
-        "tensor_parallel", "tensor_parallel_configs",
-        "is_fl_ps_mode", "with_coordinator",
         "mode",
     }
 
@@ -114,7 +122,6 @@ class DistributedStrategy:
         # LocalSGD (consumed: distributed_model returns a LocalSGDStep)
         self.localsgd = False
         self.localsgd_configs: Dict[str, Any] = {"k_steps": 4}
-        self.adaptive_localsgd = False
         self.adaptive_localsgd_configs: Dict[str, Any] = {"init_k_steps": 1}
         # deep gradient compression (consumed: distributed_optimizer wraps
         # Momentum into DGCMomentum — top-k sparsified, residual-corrected)
@@ -125,11 +132,6 @@ class DistributedStrategy:
         # cast grads to fp16 for the reduction, restore after (consumed:
         # distributed_model installs the cast as a grad transform)
         self.fp16_allreduce = False
-        self.asp = False
-        self.tensor_parallel = False
-        self.tensor_parallel_configs: Dict[str, Any] = {}
-        self.is_fl_ps_mode = False
-        self.with_coordinator = False
         self.mode = "collective"
 
     @property
@@ -139,8 +141,12 @@ class DistributedStrategy:
         return int(self.sharding_configs.get("stage", 1))
 
     def __setattr__(self, name, value):
+        if name in _UNSUPPORTED_WHEN_TRUE and value:
+            raise NotImplementedError(
+                f"strategy.{name} is not implemented: "
+                f"{_UNSUPPORTED_WHEN_TRUE[name]}")
         if name.startswith("_") or name in self._CONSUMED \
-                or name in _COLLAPSED:
+                or name in _COLLAPSED or name in _UNSUPPORTED_WHEN_TRUE:
             object.__setattr__(self, name, value)
             return
         raise AttributeError(

@@ -96,9 +96,11 @@ class Coordinator:
     """
 
     def __init__(self, endpoint: Optional[str] = None,
-                 selector: Optional[ClientSelector] = None):
+                 selector: Optional[ClientSelector] = None,
+                 strategy_ttl: float = 600.0):
         self._server = None
         self._last_strategies: Dict[str, FLStrategy] = {}
+        self.strategy_ttl = float(strategy_ttl)
         if endpoint is None:
             self._server = KVServer()
             self._server.start()
@@ -124,7 +126,13 @@ class Coordinator:
                   for k, v in found.items()}
         strategies = self.selector.select(round_idx, states)
         for cid, strat in strategies.items():
-            self.kv.put(f"fl/strategy/{round_idx}/{cid}", strat.to_json())
+            # TTL so strategy keys can never satisfy a FUTURE session's
+            # wait on a long-lived shared KV endpoint
+            self.kv.put(f"fl/strategy/{round_idx}/{cid}", strat.to_json(),
+                        ttl=self.strategy_ttl)
+        # state keys are consumed: delete so a rerun can't read stale info
+        for k in found:
+            self.kv.delete(k)
         self._last_strategies = strategies
         return states
 
@@ -133,6 +141,11 @@ class Coordinator:
         """Drive rounds until the selector FINISHes everyone; returns the
         number of rounds run."""
         rounds = max_rounds or self.selector.max_rounds
+        # NOTE: no auto-reset — clients may legitimately have pushed round-0
+        # states already. Staleness is prevented structurally: state keys
+        # are deleted when consumed and strategy keys carry a TTL. Call
+        # reset() explicitly when recovering a crashed session on a shared
+        # endpoint.
         for r in range(rounds):
             self.run_round(r, num_clients, timeout=timeout)
             # act on the SAME decisions run_round published: re-invoking a
@@ -142,6 +155,12 @@ class Coordinator:
                    for s in self._last_strategies.values()):
                 return r + 1
         return rounds
+
+    def reset(self) -> None:
+        """Purge every fl/ key (stale states/strategies from a previous
+        session sharing this KV endpoint)."""
+        for k in self.kv.list("fl/"):
+            self.kv.delete(k)
 
     def stop(self) -> None:
         if self._server is not None:

@@ -148,3 +148,72 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def build_capi(verbose: bool = False) -> str:
+    """Compile the C inference API (``native/capi/infer_capi.cc``) into
+    ``libpaddle_tpu_infer.so`` — the non-Python serving surface (reference
+    ``paddle/fluid/inference/capi_exp/``; see ``infer_capi.h`` for why the
+    runtime embeds CPython on this image). Idempotent, mtime-cached, safe
+    across processes (same file-lock discipline as the main native lib).
+    Returns the library path."""
+    import fcntl
+    import os
+    import subprocess
+    import sysconfig
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    capi = os.path.join(here, "native", "capi")
+    src = os.path.join(capi, "infer_capi.cc")
+    lib = os.path.join(capi, "libpaddle_tpu_infer.so")
+
+    def fresh():
+        if not os.path.exists(lib):
+            return False
+        newest = max(os.path.getmtime(os.path.join(capi, f))
+                     for f in os.listdir(capi) if f.endswith((".cc", ".h")))
+        return os.path.getmtime(lib) >= newest
+
+    if fresh():
+        return lib
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION")
+    with open(lib + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if fresh():  # another process built it meanwhile
+                return lib
+            tmp = f"{lib}.tmp.{os.getpid()}"
+            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                   f"-I{inc}", "-o", tmp, src,
+                   f"-L{libdir}", f"-lpython{pyver}", "-ldl", "-lm"]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"capi build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+            os.replace(tmp, lib)
+            if verbose:
+                print(f"built {lib}")
+            return lib
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+def build_demo(verbose: bool = False) -> str:
+    """Compile ``tools/infer_demo.c`` (the plain-C consumer) with cc;
+    returns the executable path."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    src = os.path.join(repo, "tools", "infer_demo.c")
+    exe = os.path.join(repo, "tools", "infer_demo")
+    if os.path.exists(exe) and os.path.getmtime(exe) >= os.path.getmtime(src):
+        return exe
+    proc = subprocess.run(["cc", "-O2", "-o", exe, src, "-ldl"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"demo build failed:\n{proc.stderr}")
+    return exe

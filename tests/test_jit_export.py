@@ -177,3 +177,45 @@ def test_save_multi_input_and_example_arrays(tmp_path):
     pt.jit.save(net, path, input_spec=[a, b])  # concrete example arrays
     out = np.asarray(pt.jit.load(path)(a, b))
     np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+# --------------------------------------- non-Python serving consumer (r3)
+def test_c_api_consumer_matches_python_predictor(tmp_path):
+    """The plain-C demo (tools/infer_demo.c, dlopen'ing the C inference
+    API) reproduces the Python Predictor's outputs on a jit.save artifact —
+    the capi_exp-style non-Python serving path, demonstrated end to end."""
+    import os
+    import subprocess
+    import sys
+
+    from paddle_tpu.inference import build_capi, build_demo
+    from paddle_tpu.jit import save as jit_save
+
+    pt.seed(4)
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "cmodel")
+    jit_save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+    ref = create_predictor(Config(prefix)).run([x])[0]
+
+    lib = build_capi()
+    demo = build_demo()
+    inp = tmp_path / "input.bin"
+    inp.write_bytes(x.tobytes())
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.getcwd()] + [p for p in sys.path if "site-packages" in p])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [demo, lib, prefix, str(inp), "2", "8"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    assert lines[0].split() == ["shape", "2", "4"]
+    got = np.asarray([float(v) for v in lines[1:]], np.float32).reshape(2, 4)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5, atol=1e-6)
