@@ -7,7 +7,7 @@ module-level tables below):
 
 - ``_CONSUMED``  — drives behavior here (mesh axes, ZeRO stage, AMP,
   recompute, gradient merge, pipeline, PS modes, LARS/LAMB, LocalSGD, DGC,
-  fp16_allreduce, ASP, qat, find_unused_parameters, fl/with_coordinator).
+  fp16_allreduce, find_unused_parameters).
 - ``_COLLAPSED`` — meaningful in the reference's NCCL/brpc/cuDNN runtime
   but satisfied BY CONSTRUCTION under XLA/TPU (the compiler fuses, schedules
   streams, and routes collectives hierarchically over ICI); accepted and
@@ -156,9 +156,12 @@ class DistributedStrategy:
             f"ignore it.")
 
     def __getattr__(self, name):
-        # collapsed knobs read back their default-ish falsy value
+        # collapsed knobs read back their default-ish falsy value;
+        # unsupported knobs read False (only truthy WRITES raise)
         if name in _COLLAPSED:
             return None
+        if name in _UNSUPPORTED_WHEN_TRUE:
+            return False
         raise AttributeError(name)
 
     @staticmethod

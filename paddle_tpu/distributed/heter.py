@@ -1,0 +1,127 @@
+"""Heterogeneous CPU↔TPU stage pipeline.
+
+Reference parity: ``HeterPipelineTrainer`` (``paddle/fluid/framework/
+trainer.h:345``) + ``HeterSectionWorker`` (``device_worker.h:708``) — the
+sparse/embedding stage of a CTR model runs on cheap CPU ranks while the
+dense stage runs on accelerator ranks, stages connected by
+``HeterClient``/``HeterServer`` RPC (``distributed/ps/service/
+heter_client.h:83``, ``heter_server.h:578``) with section queues
+pipelining micro-batches across the boundary.
+
+TPU-native shape: the CPU stage (PS embedding pulls, slot combining,
+feature preprocessing) is host python/numpy; the dense stage is one
+compiled TrainStep on the chip. :class:`HeterPipelineTrainer` pipelines
+them — stage boundaries are a prefetch queue, and the CPU stage executes
+either on local threads (one-host deployment, the reference's in-process
+section queues) or on remote *heter workers* addressed by name over the
+existing RPC agent (multi-host split, the HeterClient/HeterServer role).
+The TPU step for batch N overlaps the CPU stage for batches N+1..N+depth.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+__all__ = ["HeterPipelineTrainer"]
+
+
+class _LocalExecutor:
+    """Run the CPU stage on a local thread pool (in-process section
+    workers)."""
+
+    def __init__(self, cpu_stage: Callable, num_workers: int):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.cpu_stage = cpu_stage
+        self.pool = ThreadPoolExecutor(max_workers=num_workers)
+
+    def submit(self, batch):
+        return self.pool.submit(self.cpu_stage, batch)
+
+    def stop(self):
+        self.pool.shutdown(wait=False)
+
+
+class _RpcExecutor:
+    """Run the CPU stage on remote heter workers via the RPC agent
+    (HeterClient role): requests round-robin across worker names."""
+
+    def __init__(self, cpu_stage: Callable, workers: Sequence[str]):
+        self.cpu_stage = cpu_stage
+        self.workers = list(workers)
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def submit(self, batch):
+        from .rpc import rpc_async
+
+        with self._lock:
+            w = self.workers[self._next % len(self.workers)]
+            self._next += 1
+        return rpc_async(w, self.cpu_stage, args=(batch,))
+
+    def stop(self):
+        pass  # rpc lifetime belongs to init_rpc/shutdown
+
+
+class HeterPipelineTrainer:
+    """Two-stage pipelined trainer: ``cpu_stage(batch) -> staged`` on host
+    CPU (threads or remote heter workers), ``tpu_step(staged) -> loss`` on
+    the chip, overlapped with ``prefetch_depth`` batches in flight.
+
+    ``run(batches)`` drives a whole epoch and returns the losses;
+    ``train_from_iterable`` is the generator flavor. Ordering is preserved
+    (results apply in submission order), so loss curves are bit-identical
+    to the unpipelined loop — only wall-clock changes.
+
+    Multi-host: start heter workers with ``init_rpc`` (each registers its
+    worker name), pass their names as ``heter_workers``; the CPU stage
+    then executes remotely, exactly the HeterPipelineTrainer split where
+    sparse pulls live next to the PS and only dense tensors cross to the
+    TPU host.
+    """
+
+    def __init__(self, cpu_stage: Callable[[Any], Any],
+                 tpu_step: Callable[[Any], Any],
+                 prefetch_depth: int = 2,
+                 heter_workers: Optional[Sequence[str]] = None,
+                 num_cpu_threads: int = 2):
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
+        self.cpu_stage = cpu_stage
+        self.tpu_step = tpu_step
+        self.prefetch_depth = int(prefetch_depth)
+        if heter_workers:
+            self._exec = _RpcExecutor(cpu_stage, heter_workers)
+        else:
+            self._exec = _LocalExecutor(cpu_stage, num_cpu_threads)
+
+    def run(self, batches: Iterable[Any]) -> list:
+        return list(self.train_from_iterable(batches))
+
+    def train_from_iterable(self, batches: Iterable[Any]):
+        """Yield ``tpu_step`` results in batch order while the CPU stage
+        runs ahead."""
+        it = iter(batches)
+        inflight: "queue.Queue" = queue.Queue()
+        exhausted = False
+        # prime the pipeline
+        for _ in range(self.prefetch_depth):
+            try:
+                inflight.put(self._exec.submit(next(it)))
+            except StopIteration:
+                exhausted = True
+                break
+        while not inflight.empty():
+            fut = inflight.get()
+            staged = fut.result()  # re-raises CPU-stage failures in order
+            if not exhausted:
+                try:
+                    inflight.put(self._exec.submit(next(it)))
+                except StopIteration:
+                    exhausted = True
+            yield self.tpu_step(staged)
+
+    def stop(self) -> None:
+        self._exec.stop()

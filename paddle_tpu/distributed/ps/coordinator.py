@@ -170,14 +170,19 @@ class Coordinator:
 
 class FLClient:
     """Client half (reference ``FLClient``): push state, wait for this
-    round's strategy."""
+    round's strategy. State keys carry a TTL so one that the coordinator
+    never consumes (late pusher, crashed session) cannot satisfy a future
+    session's round on a shared endpoint."""
 
-    def __init__(self, client_id: str, endpoint: str):
+    def __init__(self, client_id: str, endpoint: str,
+                 state_ttl: float = 600.0):
         self.client_id = str(client_id)
         self.kv = KVClient(endpoint)
+        self.state_ttl = float(state_ttl)
 
     def push_client_info(self, round_idx: int, info: ClientInfoAttr) -> None:
-        self.kv.put(f"fl/state/{round_idx}/{self.client_id}", info.to_json())
+        self.kv.put(f"fl/state/{round_idx}/{self.client_id}", info.to_json(),
+                    ttl=self.state_ttl)
 
     def pull_fl_strategy(self, round_idx: int,
                          timeout: float = 300.0) -> FLStrategy:

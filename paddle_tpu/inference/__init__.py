@@ -206,14 +206,26 @@ def build_demo(verbose: bool = False) -> str:
     import os
     import subprocess
 
+    import fcntl
+
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     src = os.path.join(repo, "tools", "infer_demo.c")
     exe = os.path.join(repo, "tools", "infer_demo")
     if os.path.exists(exe) and os.path.getmtime(exe) >= os.path.getmtime(src):
         return exe
-    proc = subprocess.run(["cc", "-O2", "-o", exe, src, "-ldl"],
-                          capture_output=True, text=True)
-    if proc.returncode != 0:
-        raise RuntimeError(f"demo build failed:\n{proc.stderr}")
-    return exe
+    with open(exe + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(exe) and \
+                    os.path.getmtime(exe) >= os.path.getmtime(src):
+                return exe
+            tmp = f"{exe}.tmp.{os.getpid()}"
+            proc = subprocess.run(["cc", "-O2", "-o", tmp, src, "-ldl"],
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"demo build failed:\n{proc.stderr}")
+            os.replace(tmp, exe)
+            return exe
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)

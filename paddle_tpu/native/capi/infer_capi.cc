@@ -27,8 +27,11 @@ void SetError(const char* where) {
     if (value) {
       PyObject* s = PyObject_Str(value);
       if (s) {
-        g_last_error += ": ";
-        g_last_error += PyUnicode_AsUTF8(s);
+        const char* msg = PyUnicode_AsUTF8(s);
+        if (msg) {
+          g_last_error += ": ";
+          g_last_error += msg;
+        }
         Py_DECREF(s);
       }
     }
@@ -191,9 +194,15 @@ int64_t PT_InferRun(void* h, const float* input, const int64_t* shape,
     g_last_error = "output buffer too small";
     return -7;
   }
+  if (view.ndim > 8) {  // header contract: out_shape holds 8 entries
+    PyBuffer_Release(&view);
+    Py_DECREF(cont);
+    g_last_error = "output rank > 8 unsupported";
+    return -8;
+  }
   std::memcpy(output, view.buf, view.len);
   *out_rank = static_cast<int32_t>(view.ndim);
-  for (int i = 0; i < view.ndim && i < 8; ++i) out_shape[i] = view.shape[i];
+  for (int i = 0; i < view.ndim; ++i) out_shape[i] = view.shape[i];
   PyBuffer_Release(&view);
   Py_DECREF(cont);
   return total;

@@ -43,6 +43,10 @@ int main(int argc, char** argv) {
   }
 
   int32_t rank = argc - 4;
+  if (rank > 8) {
+    fprintf(stderr, "at most 8 input dims supported\n");
+    return 2;
+  }
   int64_t shape[8];
   int64_t n = 1;
   for (int i = 0; i < rank; ++i) {
