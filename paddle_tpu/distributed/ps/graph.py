@@ -32,16 +32,34 @@ class GraphTable:
         self._h = self._lib.pt_graph_create()
         self._built = False
 
-    def add_edges(self, src, dst) -> None:
+    def add_edges(self, src, dst, weights=None) -> None:
+        """Add directed edges; optional per-edge float weights bias
+        neighbor sampling and walks toward heavier edges (the reference's
+        weighted CSR, ``gpu_graph_node.h`` weight payloads)."""
         src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.int64)
         dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.int64)
         assert src.size == dst.size
-        self._lib.pt_graph_add_edges(
-            self._h, native.as_i64_ptr(src), native.as_i64_ptr(dst), src.size)
+        if weights is None:
+            self._lib.pt_graph_add_edges(
+                self._h, native.as_i64_ptr(src), native.as_i64_ptr(dst),
+                src.size)
+        else:
+            w = np.ascontiguousarray(
+                np.asarray(weights, np.float32).reshape(-1))
+            assert w.size == src.size
+            self._lib.pt_graph_add_edges_weighted(
+                self._h, native.as_i64_ptr(src), native.as_i64_ptr(dst),
+                native.as_f32_ptr(w), src.size)
+        self._built = False
+
+    def clear_edges(self) -> None:
+        """Drop all edges (and the derived CSR); features are kept."""
+        self._lib.pt_graph_clear_edges(self._h)
         self._built = False
 
     def build(self, symmetric: bool = False) -> None:
-        """Finalize into CSR. ``symmetric=True`` adds reverse edges."""
+        """Finalize into CSR. ``symmetric=True`` adds reverse edges
+        (reverse edges reuse their forward edge's weight)."""
         self._lib.pt_graph_build(self._h, 1 if symmetric else 0)
         self._built = True
 
@@ -218,6 +236,7 @@ _GOP_GET_FEAT = 10
 _GOP_FEAT_DIM = 11
 _GOP_STOP = 12
 _GOP_CLEAR_EDGES = 13
+_GOP_ADD_EDGES_W = 14
 
 
 class DistGraphClient:
@@ -254,6 +273,7 @@ class DistGraphClient:
         self._locks = [threading.Lock() for _ in self._conns]
         self._src_buf: list = []
         self._dst_buf: list = []
+        self._w_buf: list = []
         self._built = False
 
     def _shard_of(self, keys: np.ndarray) -> np.ndarray:
@@ -266,12 +286,23 @@ class DistGraphClient:
             return self._conns[s].request(op, body)
 
     # -- ingest ------------------------------------------------------------
-    def add_edges(self, src, dst) -> None:
+    def clear_edges(self) -> None:
+        """Drop the client-side edge buffer (a later build() starts from
+        scratch; servers clear on every build anyway)."""
+        self._src_buf, self._dst_buf, self._w_buf = [], [], []
+        self._built = False
+
+    def add_edges(self, src, dst, weights=None) -> None:
         src = np.ascontiguousarray(np.asarray(src).reshape(-1), np.int64)
         dst = np.ascontiguousarray(np.asarray(dst).reshape(-1), np.int64)
         assert src.size == dst.size
+        if weights is not None:
+            weights = np.ascontiguousarray(
+                np.asarray(weights, np.float32).reshape(-1))
+            assert weights.size == src.size
         self._src_buf.append(src)
         self._dst_buf.append(dst)
+        self._w_buf.append(weights)
         self._built = False
 
     def build(self, symmetric: bool = False) -> None:
@@ -279,19 +310,34 @@ class DistGraphClient:
                else np.empty(0, np.int64))
         dst = (np.concatenate(self._dst_buf) if self._dst_buf
                else np.empty(0, np.int64))
+        weighted = any(w is not None for w in self._w_buf)
+        if weighted:
+            w = np.concatenate([
+                np.ones(s.size, np.float32) if wb is None else wb
+                for s, wb in zip(self._src_buf, self._w_buf)])
+        else:
+            w = None
         if symmetric:
             # forward stream first, then the reversed stream — the order the
             # single-host Build(symmetric) appends them, so each owner's CSR
-            # rows match
+            # rows match (reverse edges keep their forward weight)
             src, dst = (np.concatenate([src, dst]), np.concatenate([dst, src]))
+            if weighted:
+                w = np.concatenate([w, w])
         owner = self._shard_of(src)
         for s in range(len(self._conns)):
             sel = owner == s
             ss, dd = src[sel], dst[sel]
-            body = struct.pack("<I", ss.size) + ss.tobytes() + dd.tobytes()
             # clear first: the client re-sends its FULL buffer each build
             self._request(s, _GOP_CLEAR_EDGES)
-            self._request(s, _GOP_ADD_EDGES, body)
+            if weighted:
+                ww = w[sel]
+                body = (struct.pack("<I", ss.size) + ss.tobytes()
+                        + dd.tobytes() + ww.tobytes())
+                self._request(s, _GOP_ADD_EDGES_W, body)
+            else:
+                body = struct.pack("<I", ss.size) + ss.tobytes() + dd.tobytes()
+                self._request(s, _GOP_ADD_EDGES, body)
             self._request(s, _GOP_BUILD, struct.pack("<B", 0))
         self._built = True
 

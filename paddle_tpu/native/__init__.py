@@ -134,6 +134,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pt_graph_destroy.argtypes = [c.c_void_p]
     lib.pt_graph_add_edges.argtypes = [c.c_void_p, i64p, i64p, c.c_int64]
     lib.pt_graph_clear_edges.argtypes = [c.c_void_p]
+    lib.pt_graph_add_edges_weighted.argtypes = [
+        c.c_void_p, i64p, i64p, f32p, c.c_int64]
     lib.pt_graph_build.argtypes = [c.c_void_p, c.c_int32]
     lib.pt_graph_num_nodes.restype = c.c_int64
     lib.pt_graph_num_nodes.argtypes = [c.c_void_p]

@@ -27,6 +27,7 @@
 //   FEAT_DIM:   -> [i32]
 //   STOP
 //   CLEAR_EDGES
+//   ADD_EDGES_W: [u32 n][src n*8][dst n*8][w n*4]
 
 #include <cstdint>
 #include <cstring>
@@ -38,6 +39,9 @@ extern "C" {
 // graph store C API (graph_store.cc)
 void pt_graph_add_edges(void* h, const int64_t* src, const int64_t* dst,
                         int64_t n);
+void pt_graph_add_edges_weighted(void* h, const int64_t* src,
+                                 const int64_t* dst, const float* w,
+                                 int64_t n);
 void pt_graph_build(void* h, int32_t symmetric);
 void pt_graph_clear_edges(void* h);
 int64_t pt_graph_num_nodes(void* h);
@@ -72,6 +76,7 @@ enum GraphOp : uint8_t {
   kFeatDim = 11,
   kStop = 12,
   kClearEdges = 13,
+  kAddEdgesW = 14,  // [u32 n][src n*8][dst n*8][w n*4]
 };
 
 int Dispatch(void* graph, int fd, uint8_t op, const char* body, uint32_t len) {
@@ -204,6 +209,18 @@ int Dispatch(void* graph, int fd, uint8_t op, const char* body, uint32_t len) {
     case kFeatDim: {
       int32_t v = pt_graph_feature_dim(graph);
       return SendReply(fd, 0, &v, 4) ? 0 : 1;
+    }
+    case kAddEdgesW: {
+      if (len < 4) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+      uint32_t n;
+      std::memcpy(&n, body, 4);
+      if (static_cast<uint64_t>(len) != 4 + static_cast<uint64_t>(n) * 20)
+        return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+      const int64_t* src = reinterpret_cast<const int64_t*>(body + 4);
+      const int64_t* dst = src + n;
+      const float* w = reinterpret_cast<const float*>(body + 4 + n * 16);
+      pt_graph_add_edges_weighted(graph, src, dst, w, n);
+      return SendReply(fd, 0, nullptr, 0) ? 0 : 1;
     }
     case kClearEdges: {
       pt_graph_clear_edges(graph);

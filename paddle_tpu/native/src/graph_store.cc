@@ -16,6 +16,7 @@
 // value for absent neighbors / terminated walks is -1.
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -32,10 +33,20 @@ class GraphStore {
   // Edge ingestion happens pre-Build into COO buffers. Ingest ops take
   // the adjacency lock exclusively; read ops share it — two clients of one
   // server (one rebuilding, one sampling) must never race a CSR free.
-  void AddEdges(const int64_t* src, const int64_t* dst, int64_t n) {
+  // `w` may be null (unweighted); mixing weighted and unweighted calls
+  // treats missing weights as 1.0 (the reference's default edge weight).
+  void AddEdges(const int64_t* src, const int64_t* dst, const float* w,
+                int64_t n) {
     std::unique_lock<std::shared_mutex> g(adj_mu_);
     coo_src_.insert(coo_src_.end(), src, src + n);
     coo_dst_.insert(coo_dst_.end(), dst, dst + n);
+    if (w) {
+      coo_w_.resize(coo_src_.size() - n, 1.0f);  // backfill earlier edges
+      coo_w_.insert(coo_w_.end(), w, w + n);
+      weighted_ = true;
+    } else if (weighted_) {
+      coo_w_.resize(coo_src_.size(), 1.0f);
+    }
   }
 
   // Drop the COO buffer (and derived CSR): the sharded client re-sends its
@@ -44,10 +55,14 @@ class GraphStore {
     std::unique_lock<std::shared_mutex> g(adj_mu_);
     coo_src_.clear();
     coo_dst_.clear();
+    coo_w_.clear();
+    weighted_ = false;
     id_of_.clear();
     ids_.clear();
     row_ptr_.clear();
     col_.clear();
+    csr_w_.clear();
+    cumw_.clear();
   }
 
   // Rebuildable: the COO edge list is retained, so add_edges -> build ->
@@ -83,10 +98,45 @@ class GraphStore {
     for (int32_t u : s) row_ptr_[static_cast<size_t>(u) + 1]++;
     for (size_t i = 0; i < nn; ++i) row_ptr_[i + 1] += row_ptr_[i];
     col_.resize(m);
+    csr_w_.clear();
+    cumw_.clear();
+    if (weighted_) csr_w_.resize(m, 1.0f);
     std::vector<int64_t> cursor(row_ptr_.begin(), row_ptr_.end() - 1);
     for (size_t i = 0; i < m; ++i) {
-      col_[static_cast<size_t>(cursor[s[i]]++)] = d[i];
+      int64_t slot = cursor[s[i]]++;
+      col_[static_cast<size_t>(slot)] = d[i];
+      if (weighted_) {
+        // reverse edges (i >= n) reuse the forward edge's weight; weights
+        // clamp to a positive floor so a zero/negative weight degrades to
+        // "effectively never" instead of corrupting the CDF scan (all
+        // weighted paths share this clamp)
+        float w = coo_w_.empty() ? 1.0f : coo_w_[i % n];
+        csr_w_[static_cast<size_t>(slot)] = w > 1e-12f ? w : 1e-12f;
+      }
     }
+    if (weighted_) {
+      // per-row cumulative weights: draws and hops become one binary
+      // search instead of an O(deg) scan per draw
+      cumw_.resize(m);
+      for (size_t r = 0; r + 1 < row_ptr_.size(); ++r) {
+        double acc = 0.0;
+        for (int64_t j = row_ptr_[r]; j < row_ptr_[r + 1]; ++j) {
+          acc += csr_w_[j];
+          cumw_[j] = acc;
+        }
+      }
+    }
+  }
+
+  // index into [beg, end) whose (row-local) cumulative weight first
+  // exceeds target mass u — cumw_ resets at each row start
+  int64_t WeightedPick(int64_t beg, int64_t end, double u) const {
+    int64_t lo = beg, hi = end - 1;
+    while (lo < hi) {
+      int64_t mid = (lo + hi) / 2;
+      if (cumw_[mid] > u) hi = mid; else lo = mid + 1;
+    }
+    return lo;
   }
 
   int64_t NumNodes() const {
@@ -134,21 +184,49 @@ class GraphStore {
                              ptn::splitmix64(static_cast<uint64_t>(nodes[i])));
         if (replace || deg <= k) {
           if (replace) {
-            for (int32_t j = 0; j < k; ++j) {
-              row[j] = ids_[col_[beg + static_cast<int64_t>(rng.bounded(deg))]];
+            if (!weighted_) {
+              for (int32_t j = 0; j < k; ++j) {
+                row[j] =
+                    ids_[col_[beg + static_cast<int64_t>(rng.bounded(deg))]];
+              }
+            } else {
+              const double total = cumw_[end - 1];
+              for (int32_t j = 0; j < k; ++j) {
+                double u = rng.uniform() * total;
+                row[j] = ids_[col_[WeightedPick(beg, end, u)]];
+              }
             }
             counts[i] = k;
           } else {
             for (int64_t j = 0; j < deg; ++j) row[j] = ids_[col_[beg + j]];
             counts[i] = static_cast<int32_t>(deg);
           }
-        } else {
+        } else if (!weighted_) {
           // Reservoir sample k of deg without replacement.
           std::vector<int64_t> res(k);
           for (int32_t j = 0; j < k; ++j) res[j] = col_[beg + j];
           for (int64_t j = k; j < deg; ++j) {
             uint64_t r = rng.bounded(static_cast<uint64_t>(j + 1));
             if (r < static_cast<uint64_t>(k)) res[r] = col_[beg + j];
+          }
+          for (int32_t j = 0; j < k; ++j) row[j] = ids_[res[j]];
+          counts[i] = k;
+        } else {
+          // Weighted without replacement: A-Res (Efraimidis-Spirakis) —
+          // keep the k largest keys u^(1/w); O(deg*k) is fine for small k.
+          std::vector<double> keys(k, -1.0);
+          std::vector<int64_t> res(k, -1);
+          for (int64_t j = beg; j < end; ++j) {
+            double w = csr_w_[j];  // clamped positive at Build
+            double key = std::pow(rng.uniform(), 1.0 / w);
+            int32_t lo = 0;
+            for (int32_t t = 1; t < k; ++t) {
+              if (keys[t] < keys[lo]) lo = t;
+            }
+            if (key > keys[lo]) {
+              keys[lo] = key;
+              res[lo] = col_[j];
+            }
           }
           for (int32_t j = 0; j < k; ++j) row[j] = ids_[res[j]];
           counts[i] = k;
@@ -174,7 +252,15 @@ class GraphStore {
     uint64_t h = ptn::splitmix64(
         ptn::splitmix64(seed) ^ ptn::splitmix64((walk_idx << 20) ^ step) ^
         ptn::splitmix64(static_cast<uint64_t>(node)));
-    return ids_[col_[beg + static_cast<int64_t>(h % static_cast<uint64_t>(deg))]];
+    if (!weighted_) {
+      return ids_[col_[beg + static_cast<int64_t>(h % static_cast<uint64_t>(deg))]];
+    }
+    // weighted hop: inverse-CDF via the precomputed row cumsum
+    // (deterministic in the same hash, so the sharded walk stays
+    // bit-identical)
+    const double total = cumw_[end - 1];
+    double u = (h >> 11) * (1.0 / 9007199254740992.0) * total;  // 53-bit
+    return ids_[col_[WeightedPick(beg, end, u)]];
   }
 
   // Batched single hop: next[i] = WalkHop(nodes[i], idxs[i], step, seed).
@@ -260,6 +346,10 @@ class GraphStore {
  private:
   mutable std::shared_mutex adj_mu_;  // ingest exclusive, reads shared
   std::vector<int64_t> coo_src_, coo_dst_;
+  std::vector<float> coo_w_;   // per forward edge (empty = unweighted)
+  std::vector<float> csr_w_;   // aligned with col_ (clamped > 0)
+  std::vector<double> cumw_;   // per-row cumulative csr_w_ (weighted only)
+  bool weighted_ = false;
   std::unordered_map<int64_t, int32_t> id_of_;
   std::vector<int64_t> ids_;       // dense idx -> original id
   std::vector<int64_t> row_ptr_;   // CSR offsets
@@ -279,7 +369,13 @@ void pt_graph_destroy(void* h) { delete static_cast<GraphStore*>(h); }
 
 void pt_graph_add_edges(void* h, const int64_t* src, const int64_t* dst,
                         int64_t n) {
-  static_cast<GraphStore*>(h)->AddEdges(src, dst, n);
+  static_cast<GraphStore*>(h)->AddEdges(src, dst, nullptr, n);
+}
+
+void pt_graph_add_edges_weighted(void* h, const int64_t* src,
+                                 const int64_t* dst, const float* w,
+                                 int64_t n) {
+  static_cast<GraphStore*>(h)->AddEdges(src, dst, w, n);
 }
 
 void pt_graph_clear_edges(void* h) {

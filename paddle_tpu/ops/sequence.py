@@ -1,0 +1,169 @@
+"""Sequence-op family over padded batches + explicit lengths.
+
+Reference parity: the ``sequence_*`` operator family
+(``paddle/fluid/operators/sequence_ops/``: sequence_pad, sequence_unpad,
+sequence_pool, sequence_softmax, sequence_reverse, sequence_expand, ...),
+which the reference drives off LoD (level-of-detail) ragged tensors.
+
+TPU-native shape: XLA wants static shapes, so the LoD representation
+becomes the (padded dense tensor, lengths vector) pair — SURVEY §7's
+"bucketing + padding designed in the data layer". ``sequence_pad`` is the
+eager boundary converting ragged python/flat data into that pair; every
+other op is mask arithmetic on the pair and jit-compiles.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "sequence_pad", "sequence_unpad", "sequence_pool", "sequence_softmax",
+    "sequence_reverse", "sequence_expand", "sequence_expand_as",
+    "sequence_first_step", "sequence_last_step", "sequence_concat",
+]
+
+
+def _valid_mask(lengths, maxlen: int):
+    """[B, T] bool — True inside each row's valid prefix."""
+    lengths = jnp.asarray(lengths)
+    return jnp.arange(maxlen)[None, :] < lengths[:, None]
+
+
+def sequence_pad(x, pad_value=0.0, maxlen: Optional[int] = None,
+                 lengths=None, name=None):
+    """Ragged -> (padded [B, T, ...], lengths [B]).
+
+    Accepts a python list of per-sequence arrays (the eager boundary) or a
+    flat [sum(L), ...] array + ``lengths`` (the LoD form).
+    """
+    # host-side assembly (this is the eager ragged->dense boundary):
+    # one numpy buffer + one device transfer, not B jnp copies
+    if lengths is not None:
+        flat = np.asarray(x)
+        lengths = np.asarray(lengths, np.int64).reshape(-1)
+        offs = np.concatenate([[0], np.cumsum(lengths)])
+        seqs = [flat[int(offs[i]):int(offs[i + 1])]
+                for i in range(lengths.size)]
+    else:
+        seqs = [np.asarray(s) for s in x]
+        lengths = np.asarray([s.shape[0] for s in seqs], np.int64)
+    T = int(maxlen) if maxlen is not None else int(lengths.max(initial=0))
+    feat = seqs[0].shape[1:] if seqs else ()
+    out = np.full((len(seqs), T) + feat, pad_value,
+                  seqs[0].dtype if seqs else np.float32)
+    for i, s in enumerate(seqs):
+        n = min(int(lengths[i]), T)
+        out[i, :n] = s[:n]
+    return jnp.asarray(out), jnp.asarray(np.minimum(lengths, T))
+
+
+def sequence_unpad(x, lengths, name=None) -> List[jnp.ndarray]:
+    """(padded, lengths) -> list of per-sequence arrays (eager: output
+    shapes are data-dependent)."""
+    x = jnp.asarray(x)
+    lengths = np.asarray(lengths).reshape(-1)
+    return [x[i, :int(n)] for i, n in enumerate(lengths)]
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum", name=None):
+    """Masked pooling over the time axis — the ``sequence_pool`` op. All
+    flavors jit-compile (mask arithmetic, no ragged shapes)."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    mask = _valid_mask(lengths, T)
+    fmask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    pool_type = pool_type.lower()
+    if pool_type == "sum":
+        return jnp.sum(jnp.where(fmask, x, 0), axis=1)
+    if pool_type == "average" or pool_type == "mean":
+        denom = jnp.maximum(jnp.asarray(lengths), 1)
+        denom = denom.reshape((B,) + (1,) * (x.ndim - 2))
+        return jnp.sum(jnp.where(fmask, x, 0), axis=1) / denom
+    if pool_type == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(jnp.asarray(lengths), 1).astype(x.dtype))
+        denom = denom.reshape((B,) + (1,) * (x.ndim - 2))
+        return jnp.sum(jnp.where(fmask, x, 0), axis=1) / denom
+    if pool_type == "max":
+        neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jnp.max(jnp.where(fmask, x, neg), axis=1)
+    if pool_type == "first":
+        return sequence_first_step(x, lengths)
+    if pool_type == "last":
+        return sequence_last_step(x, lengths)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(x, lengths=None, name=None):
+    return jnp.asarray(x)[:, 0]
+
+
+def sequence_last_step(x, lengths, name=None):
+    x = jnp.asarray(x)
+    idx = jnp.maximum(jnp.asarray(lengths) - 1, 0)
+    return jnp.take_along_axis(
+        x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+    ).squeeze(1)
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Per-row softmax over the valid prefix; padding gets probability 0."""
+    x = jnp.asarray(x, jnp.float32) if not jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x)
+    mask = _valid_mask(lengths, x.shape[1])
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    z = jnp.where(mask, x, neg)
+    p = jax.nn.softmax(z, axis=1)
+    return jnp.where(mask, p, 0)
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each row's valid prefix in place; padding stays put (the
+    ``sequence_reverse`` op, the bidirectional-RNN building block)."""
+    x = jnp.asarray(x)
+    T = x.shape[1]
+    lengths = jnp.asarray(lengths)
+    pos = jnp.arange(T)[None, :]
+    src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Repeat row i of ``x`` ``ref_lengths[i]`` times along a new flat axis
+    (the ``sequence_expand`` broadcast join). Eager: output length is
+    data-dependent."""
+    x = np.asarray(x)
+    ref_lengths = np.asarray(ref_lengths).reshape(-1)
+    return jnp.asarray(np.repeat(x, ref_lengths, axis=0))
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    return sequence_expand(x, y_lengths)
+
+
+def sequence_concat(inputs: Sequence, lengths_list: Sequence, name=None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Row-wise concatenation of several (padded, lengths) pairs: row b of
+    the result is input0[b][:l0] ++ input1[b][:l1] ++ ... (the
+    ``sequence_concat`` op joining LoD tensors per sequence)."""
+    arrs = [np.asarray(a) for a in inputs]
+    lens = [np.asarray(l).reshape(-1) for l in lengths_list]
+    B = arrs[0].shape[0]
+    total = sum(l.astype(np.int64) for l in lens)
+    T = int(total.max(initial=0))
+    feat = arrs[0].shape[2:]
+    out = np.zeros((B, T) + feat, arrs[0].dtype)
+    for b in range(B):
+        pos = 0
+        for a, l in zip(arrs, lens):
+            n = int(l[b])
+            out[b, pos:pos + n] = a[b, :n]
+            pos += n
+    return jnp.asarray(out), jnp.asarray(total)

@@ -306,3 +306,50 @@ def test_segment_pool():
     x = jnp.asarray([[1.0], [2.0], [3.0]])
     out = G.segment_pool(x, jnp.asarray([0, 0, 1]), "mean")
     np.testing.assert_allclose(out, [[1.5], [3.0]])
+
+
+# ----------------------------------------------- weighted graphs (r3)
+def test_weighted_sampling_bias():
+    """Edge weights bias replace-sampling and walks toward heavy edges
+    (the reference CSR's weight payloads)."""
+    g = GraphTable()
+    g.add_edges([0, 0], [1, 2], weights=[9.0, 1.0])
+    g.build()
+    nb, cnt = g.sample_neighbors([0], sample_size=400, replace=True, seed=5)
+    frac1 = (np.asarray(nb[0]) == 1).mean()
+    assert 0.8 < frac1 < 0.98, frac1  # ~0.9 expected
+    # weighted hops: most walks step to node 1
+    walks = g.random_walk(np.zeros(500, np.int64), walk_len=1, seed=3)
+    frac1 = (np.asarray(walks[:, 0]) == 1).mean()
+    assert 0.8 < frac1 < 0.98, frac1
+    # weighted without replacement (A-Res) heavily prefers heavy edges
+    g2 = GraphTable()
+    g2.add_edges(np.zeros(20, np.int64), np.arange(1, 21),
+                 weights=[100.0] * 2 + [0.01] * 18)
+    g2.build()
+    nb2, _ = g2.sample_neighbors([0], sample_size=2, seed=7)
+    assert set(np.asarray(nb2[0]).tolist()) == {1, 2}
+
+
+def test_weighted_dist_graph_parity(graph_cluster):
+    """Sharded weighted store matches single-host: deterministic weighted
+    hops are bit-identical; weighted sampling draws the same rows."""
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 60, 600).astype(np.int64)
+    dst = rng.integers(0, 60, 600).astype(np.int64)
+    w = rng.uniform(0.1, 5.0, 600).astype(np.float32)
+    local = GraphTable()
+    local.add_edges(src, dst, weights=w)
+    local.build(symmetric=True)
+    graph_cluster.clear_edges()  # module fixture carries earlier graphs
+    graph_cluster.add_edges(src, dst, weights=w)
+    graph_cluster.build(symmetric=True)
+    starts = np.arange(40, dtype=np.int64)
+    np.testing.assert_array_equal(
+        graph_cluster.random_walk(starts, 5, seed=11),
+        local.random_walk(starts, 5, seed=11))
+    nb_d, ct_d = graph_cluster.sample_neighbors(starts, 6, replace=True,
+                                                seed=2)
+    nb_l, ct_l = local.sample_neighbors(starts, 6, replace=True, seed=2)
+    np.testing.assert_array_equal(nb_d, nb_l)
+    np.testing.assert_array_equal(ct_d, ct_l)

@@ -365,3 +365,60 @@ def test_r3_eager_inplace_variants():
     y = x * 2
     with pytest.raises(RuntimeError, match="tape"):
         y.fill_(0.0)
+
+
+def test_r3_sequence_op_family():
+    """Sequence ops over (padded, lengths) pairs — the LoD family restated
+    for static shapes (sequence_ops/, SURVEY L4 gap)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.ops as ops
+
+    seqs = [np.array([[1., 1], [2, 2], [3, 3]]), np.array([[4., 4]])]
+    padded, lens = ops.sequence_pad(seqs, pad_value=0.0)
+    assert padded.shape == (2, 3, 2)
+    np.testing.assert_allclose(np.asarray(lens), [3, 1])
+    np.testing.assert_allclose(np.asarray(padded)[1], [[4, 4], [0, 0], [0, 0]])
+    # flat + lengths (LoD) form round-trips
+    flat = np.concatenate(seqs)
+    p2, l2 = ops.sequence_pad(flat, lengths=[3, 1])
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(padded))
+    back = ops.sequence_unpad(padded, lens)
+    np.testing.assert_allclose(np.asarray(back[0]), seqs[0])
+    np.testing.assert_allclose(np.asarray(back[1]), seqs[1])
+
+    # pooling flavors ignore padding; all jit-compile
+    pool = jax.jit(lambda x, l: ops.sequence_pool(x, l, "mean"))
+    np.testing.assert_allclose(np.asarray(pool(padded, lens)),
+                               [[2, 2], [4, 4]])
+    np.testing.assert_allclose(
+        np.asarray(ops.sequence_pool(padded, lens, "max")), [[3, 3], [4, 4]])
+    np.testing.assert_allclose(
+        np.asarray(ops.sequence_pool(padded, lens, "sqrt")),
+        [[6 / np.sqrt(3)] * 2, [4, 4]], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ops.sequence_last_step(padded, lens)), [[3, 3], [4, 4]])
+    np.testing.assert_allclose(
+        np.asarray(ops.sequence_first_step(padded)), [[1, 1], [4, 4]])
+
+    # masked softmax: padding gets probability 0, valid rows sum to 1
+    scores = jnp.asarray([[1., 2, 3], [5, 0, 0]])
+    sm = ops.sequence_softmax(scores, jnp.asarray([3, 1]))
+    np.testing.assert_allclose(np.asarray(sm).sum(1), [1.0, 1.0], rtol=1e-6)
+    assert float(sm[1, 1]) == 0.0 and float(sm[1, 0]) == 1.0
+
+    # reverse flips only the valid prefix
+    rev = ops.sequence_reverse(padded, lens)
+    np.testing.assert_allclose(np.asarray(rev)[0], [[3, 3], [2, 2], [1, 1]])
+    np.testing.assert_allclose(np.asarray(rev)[1], [[4, 4], [0, 0], [0, 0]])
+
+    # expand repeats rows per ref lengths
+    ex = ops.sequence_expand(np.array([[1., 1], [2, 2]]), [2, 3])
+    assert ex.shape == (5, 2) and float(ex[4, 0]) == 2
+
+    # per-row concat of two padded pairs
+    cat, clens = ops.sequence_concat([padded, padded], [lens, lens])
+    np.testing.assert_allclose(np.asarray(clens), [6, 2])
+    np.testing.assert_allclose(np.asarray(cat)[1][:2], [[4, 4], [4, 4]])
