@@ -29,6 +29,7 @@ from .parallel import (  # noqa: F401
 from .parallel.recompute import recompute  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .heter import HeterPipelineTrainer  # noqa: F401
+from . import passes  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401
 from .ps.graph import GraphDataGenerator, GraphTable  # noqa: F401

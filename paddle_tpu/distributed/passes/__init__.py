@@ -1,0 +1,284 @@
+"""Composable pass registry.
+
+Reference parity: ``python/paddle/distributed/passes`` — ``PassBase`` +
+``register_pass`` + ``PassManager`` (``pass_base.py``), with the concrete
+program-rewrite passes (``auto_parallel_amp.py``, ``_recompute.py``,
+``_gradient_merge.py``, ``auto_parallel_fp16.py``, ...).
+
+TPU-native shape: there is no ProgramDesc to rewrite — XLA owns the IR —
+so a "pass" transforms the TRAINING-STEP CONSTRUCTION instead: each pass
+edits a :class:`PassContext` (model, optimizer, grad-transform chain,
+TrainStep kwargs) before the step compiles, and XLA performs the actual
+graph rewriting the reference passes hand-coded. The registry gives the
+reference's composability contract: passes are named, declare
+compatibility, apply in order, and ``PassManager([...]).apply(ctx)``
+builds the final step.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["PassBase", "PassContext", "PassManager", "register_pass",
+           "new_pass", "list_passes"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    """Class decorator registering a PassBase subclass under ``name``
+    (reference ``pass_base.py`` ``register_pass``)."""
+
+    def deco(cls):
+        if name in _REGISTRY:
+            raise ValueError(f"pass {name!r} already registered")
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name: str, attrs: Optional[Dict[str, Any]] = None) -> "PassBase":
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown pass {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**(attrs or {}))
+
+
+def list_passes() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class PassContext:
+    """What passes transform: the ingredients of a TrainStep."""
+
+    def __init__(self, model, optimizer, loss_fn=None, **step_kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.step_kwargs: Dict[str, Any] = dict(step_kwargs)
+        self.grad_transforms: List[Callable] = []
+        self.applied: List[str] = []
+
+    def chain_grad_transform(self) -> Optional[Callable]:
+        if not self.grad_transforms:
+            return None
+        chain = list(self.grad_transforms)
+
+        def run(grads):
+            for t in chain:
+                grads = t(grads)
+            return grads
+
+        return run
+
+    def build_step(self, distributed: Optional[bool] = None):
+        """Materialize the (Distributed)TrainStep with everything passes
+        configured."""
+        from ...framework.jit import TrainStep
+        from ..mesh import get_mesh
+        from ..shard import DistributedTrainStep
+
+        kwargs = dict(self.step_kwargs)
+        gt = self.chain_grad_transform()
+        if gt is not None:
+            kwargs["grad_transform"] = gt
+        if distributed is None:
+            distributed = get_mesh() is not None
+        cls = DistributedTrainStep if distributed else TrainStep
+        if distributed:
+            kwargs.setdefault("mesh", get_mesh())
+        return cls(self.model, self.optimizer, loss_fn=self.loss_fn, **kwargs)
+
+
+class PassBase:
+    """One named transformation of a PassContext. Subclasses implement
+    ``_apply_single_impl`` (reference naming) and may override
+    ``_check_conflict`` to refuse bad compositions."""
+
+    name = "base"
+
+    def check_compatible(self, ctx: PassContext) -> bool:
+        return self._check_conflict(ctx)
+
+    def _check_conflict(self, ctx: PassContext) -> bool:
+        return True
+
+    def apply(self, ctx: PassContext) -> PassContext:
+        if not self.check_compatible(ctx):
+            raise ValueError(f"pass {self.name!r} incompatible with "
+                             f"already-applied {ctx.applied}")
+        self._apply_single_impl(ctx)
+        ctx.applied.append(self.name)
+        return ctx
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        raise NotImplementedError
+
+
+class PassManager:
+    """Ordered pass application (reference ``PassManager``)."""
+
+    def __init__(self, passes: Sequence):
+        self.passes = [p if isinstance(p, PassBase) else new_pass(p)
+                       for p in passes]
+
+    def apply(self, ctx: PassContext) -> PassContext:
+        for p in self.passes:
+            ctx = p.apply(ctx)
+        return ctx
+
+    @property
+    def names(self) -> List[str]:
+        return [p.name for p in self.passes]
+
+
+# ----------------------------------------------------------- built-ins
+@register_pass("amp")
+class AmpPass(PassBase):
+    """O1/O2 mixed precision (reference ``auto_parallel_amp.py`` /
+    ``auto_parallel_fp16.py``): O2 casts the model and turns on f32 master
+    weights in the optimizer."""
+
+    def __init__(self, level: str = "O2", dtype: str = "bfloat16"):
+        self.level = level
+        self.dtype = dtype
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        from ...amp import auto_cast, decorate
+
+        if self.level == "O2":
+            ctx.model, ctx.optimizer = decorate(
+                ctx.model, ctx.optimizer, level="O2", dtype=self.dtype)
+            return
+        # O1: wrap the loss computation in the autocast context so white-
+        # listed ops (matmul/conv) trace in the low dtype
+        inner = ctx.loss_fn
+        dtype = self.dtype
+
+        if inner is None:
+            raise ValueError("amp O1 pass needs a loss_fn to wrap")
+
+        def amp_loss(out, batch):
+            with auto_cast(True, level="O1", dtype=dtype):
+                return inner(out, batch)
+
+        ctx.loss_fn = amp_loss
+
+
+@register_pass("recompute")
+class RecomputePass(PassBase):
+    """Activation recompute (reference ``auto_parallel_recompute.py``):
+    flips the model's recompute knobs where it exposes them (GPT-style
+    ``cfg.use_recompute`` / pipeline ``remat``)."""
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        hit = False
+        cfg = getattr(ctx.model, "cfg", None)
+        if cfg is not None and hasattr(cfg, "use_recompute"):
+            cfg.use_recompute = True
+            hit = True
+        for layer in getattr(ctx.model, "sublayers", lambda: [])():
+            if hasattr(layer, "remat"):
+                layer.remat = True
+                hit = True
+        if hasattr(ctx.model, "remat"):
+            ctx.model.remat = True
+            hit = True
+        if not hit:
+            raise ValueError(
+                "recompute pass found no recompute-capable layer; wrap "
+                "blocks with distributed.recompute(...) explicitly")
+
+
+@register_pass("gradient_merge")
+class GradientMergePass(PassBase):
+    """k-step gradient accumulation (reference
+    ``auto_parallel_gradient_merge.py``)."""
+
+    def __init__(self, k_steps: int = 2, avg: bool = True):
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        ctx.step_kwargs["grad_accum_steps"] = self.k_steps
+        ctx.step_kwargs["grad_accum_avg"] = self.avg
+
+
+@register_pass("fp16_allreduce")
+class Fp16AllreducePass(PassBase):
+    """Grads cross the (implicit GSPMD) reduction in fp16 (reference
+    ``fp16_allreduce_optimizer.py``) — numerically, a cast-and-back grad
+    transform."""
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        ctx.grad_transforms.append(lambda grads: jax.tree.map(
+            lambda g: g.astype(jnp.float16).astype(g.dtype)
+            if g is not None else None, grads))
+
+
+@register_pass("dgc")
+class DgcPass(PassBase):
+    """Deep gradient compression (reference ``dgc_optimizer.py``): wraps a
+    Momentum optimizer into DGCMomentum."""
+
+    def __init__(self, rampup_begin_step: int = 0, rampup_step: int = 1,
+                 sparsity: Sequence[float] = (0.999,)):
+        self.rampup_begin_step = rampup_begin_step
+        self.rampup_step = rampup_step
+        self.sparsity = tuple(sparsity)
+
+    def _check_conflict(self, ctx: PassContext) -> bool:
+        return "lars" not in ctx.applied  # both rewrite the optimizer
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        from ...optimizer import DGCMomentum, Momentum
+
+        opt = ctx.optimizer
+        if not isinstance(opt, Momentum):
+            raise ValueError("dgc pass needs a Momentum optimizer")
+        if opt.weight_decay or opt.use_nesterov:
+            raise ValueError(
+                "dgc pass cannot preserve Momentum's weight_decay/"
+                "use_nesterov (DGCMomentum applies neither); clear them "
+                "or skip the pass")
+        ctx.optimizer = DGCMomentum(
+            learning_rate=opt._learning_rate, momentum=opt.momentum,
+            rampup_begin_step=self.rampup_begin_step,
+            rampup_step=self.rampup_step, sparsity=self.sparsity,
+            parameters=opt._parameters, grad_clip=opt.grad_clip,
+            multi_precision=opt.multi_precision)
+
+
+@register_pass("lars")
+class LarsPass(PassBase):
+    """LARS meta-optimizer (reference ``lars_optimizer.py``)."""
+
+    def __init__(self, lars_coeff: float = 0.001,
+                 lars_weight_decay: float = 0.0005, epsilon: float = 1e-8):
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+
+    def _check_conflict(self, ctx: PassContext) -> bool:
+        return "dgc" not in ctx.applied
+
+    def _apply_single_impl(self, ctx: PassContext) -> None:
+        from ...optimizer import LarsMomentum, Momentum
+
+        opt = ctx.optimizer
+        if not isinstance(opt, Momentum):
+            raise ValueError("lars pass needs a Momentum optimizer")
+        if opt.weight_decay or opt.use_nesterov:
+            raise ValueError(
+                "lars pass replaces weight_decay/use_nesterov with LARS "
+                "trust-ratio semantics; clear them (set lars_weight_decay "
+                "instead) or skip the pass")
+        ctx.optimizer = LarsMomentum(
+            learning_rate=opt._learning_rate, momentum=opt.momentum,
+            lars_coeff=self.lars_coeff,
+            lars_weight_decay=self.lars_weight_decay,
+            epsilon=self.epsilon, parameters=opt._parameters,
+            grad_clip=opt.grad_clip, multi_precision=opt.multi_precision)

@@ -43,10 +43,12 @@ class GraphStore {
     if (w) {
       coo_w_.resize(coo_src_.size() - n, 1.0f);  // backfill earlier edges
       coo_w_.insert(coo_w_.end(), w, w + n);
-      weighted_ = true;
-    } else if (weighted_) {
+    } else if (!coo_w_.empty()) {
       coo_w_.resize(coo_src_.size(), 1.0f);
     }
+    // NOTE: weighted_ flips only inside Build() (under the exclusive
+    // lock): queries must never see weighted_ == true against a CSR whose
+    // cumw_ was built unweighted.
   }
 
   // Drop the COO buffer (and derived CSR): the sharded client re-sends its
@@ -69,6 +71,7 @@ class GraphStore {
   // add_edges -> build accumulates (the CSR is derived state).
   void Build(bool symmetric) {
     std::unique_lock<std::shared_mutex> g(adj_mu_);
+    weighted_ = !coo_w_.empty();
     const size_t n = coo_src_.size();
     // Dense remap.
     id_of_.clear();
