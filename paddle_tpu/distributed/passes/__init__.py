@@ -80,7 +80,12 @@ class PassContext:
 
         kwargs = dict(self.step_kwargs)
         gt = self.chain_grad_transform()
-        if gt is not None:
+        user_gt = kwargs.get("grad_transform")
+        if gt is not None and user_gt is not None:
+            # compose, never clobber: pass transforms model the reduction
+            # path, the user's (e.g. clipping) applies after
+            kwargs["grad_transform"] = lambda g: user_gt(gt(g))
+        elif gt is not None:
             kwargs["grad_transform"] = gt
         if distributed is None:
             distributed = get_mesh() is not None
@@ -140,29 +145,46 @@ class AmpPass(PassBase):
     weights in the optimizer."""
 
     def __init__(self, level: str = "O2", dtype: str = "bfloat16"):
+        if level not in ("O1", "O2"):
+            raise ValueError(f"amp level must be 'O1' or 'O2', got {level!r}")
         self.level = level
         self.dtype = dtype
 
     def _apply_single_impl(self, ctx: PassContext) -> None:
-        from ...amp import auto_cast, decorate
+        from ...amp import decorate
 
         if self.level == "O2":
             ctx.model, ctx.optimizer = decorate(
                 ctx.model, ctx.optimizer, level="O2", dtype=self.dtype)
             return
-        # O1: wrap the loss computation in the autocast context so white-
-        # listed ops (matmul/conv) trace in the low dtype
-        inner = ctx.loss_fn
-        dtype = self.dtype
+        # O1: the model's forward TRACES inside auto_cast, so white-listed
+        # ops (F.linear / F.conv*) cast their operands to the low dtype;
+        # the loss stays outside in f32
+        ctx.model = _AutocastWrap(ctx.model, self.dtype)
 
-        if inner is None:
-            raise ValueError("amp O1 pass needs a loss_fn to wrap")
 
-        def amp_loss(out, batch):
-            with auto_cast(True, level="O1", dtype=dtype):
-                return inner(out, batch)
+def _make_autocast_wrap():
+    from ...nn.layer import Layer
 
-        ctx.loss_fn = amp_loss
+    class _AutocastWrapImpl(Layer):
+        """Runs the wrapped model's forward under amp.auto_cast(O1)."""
+
+        def __init__(self, inner, dtype):
+            super().__init__()
+            self.inner = inner
+            self._amp_dtype = dtype
+
+        def forward(self, *args, **kwargs):
+            from ...amp import auto_cast
+
+            with auto_cast(True, level="O1", dtype=self._amp_dtype):
+                return self.inner(*args, **kwargs)
+
+    return _AutocastWrapImpl
+
+
+def _AutocastWrap(inner, dtype):
+    return _make_autocast_wrap()(inner, dtype)
 
 
 @register_pass("recompute")

@@ -6,7 +6,13 @@ the PaddleNLP-side GPT/BERT/ERNIE configs the BASELINE targets. Here both
 families live under ``paddle_tpu.models`` (vision re-exports them at
 ``paddle_tpu.vision.models``).
 """
+from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
 from . import resnet  # noqa: F401
+from . import yolo  # noqa: F401
+from .bert import (BertConfig, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, BertModel, bert_base,
+                   bert_tiny)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_1p3b, gpt_tiny  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .yolo import YOLOv3  # noqa: F401

@@ -1,0 +1,233 @@
+"""BERT model family.
+
+Reference parity: the PaddleNLP-style BERT the reference ecosystem
+benchmarks (BASELINE.md row 2, "BERT-base finetune"): word+position+type
+embeddings, a pre-LN-free TransformerEncoder, tanh pooler, and the
+pretraining (masked LM + next-sentence) and sequence-classification
+heads.
+
+TPU-native notes: attention dispatches through the shared
+``causal_attention``-style dense path (bidirectional here, so plain
+XLA-fused attention — flash's causal streaming buys nothing at BERT
+lengths); the MLM loss gathers only masked positions, so logits
+materialize as [num_masked, vocab] rather than [B, L, vocab].
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.layers.common import Dropout, Embedding, Linear
+from ..nn.layers.norm import LayerNorm
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def bert_tiny(**kw) -> BertConfig:
+    return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_heads=4, max_position_embeddings=128, **kw)
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = Normal(std=cfg.initializer_range)
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_epsilon)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        L = input_ids.shape[1]
+        pos = jnp.arange(L)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(pos)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = Normal(std=cfg.initializer_range)
+        h = cfg.hidden_size
+        self.qkv = Linear(h, 3 * h, weight_attr=init)
+        self.out = Linear(h, h, weight_attr=init)
+        self.attn_drop = Dropout(cfg.attention_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        B, L, H = x.shape
+        nh = self.cfg.num_heads
+        hd = H // nh
+        q, k, v = jnp.split(self.qkv(x), 3, axis=-1)
+        q = q.reshape(B, L, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, nh, hd).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+        if attention_mask is not None:
+            # [B, L] 1/0 padding mask -> additive bias
+            bias = (1.0 - attention_mask[:, None, None, :].astype(s.dtype)) \
+                * jnp.asarray(-1e9, s.dtype)
+            s = s + bias
+        p = jax.nn.softmax(s, axis=-1)
+        p = self.attn_drop(p)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return self.out(o.transpose(0, 2, 1, 3).reshape(B, L, H))
+
+
+class BertLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = Normal(std=cfg.initializer_range)
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = LayerNorm(cfg.hidden_size,
+                                   epsilon=cfg.layer_norm_epsilon)
+        self.fc1 = Linear(cfg.hidden_size, cfg.ffn_size, weight_attr=init)
+        self.fc2 = Linear(cfg.ffn_size, cfg.hidden_size, weight_attr=init)
+        self.ffn_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_epsilon)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        # post-LN (original BERT): residual then norm
+        x = self.attn_norm(x + self.dropout(
+            self.attention(x, attention_mask)))
+        x = self.ffn_norm(x + self.dropout(
+            self.fc2(F.gelu(self.fc1(x)))))
+        return x
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                            weight_attr=Normal(std=cfg.initializer_range))
+
+    def forward(self, x):
+        return jnp.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(Layer):
+    """Embeddings + encoder stack + pooler; forward returns
+    ``(sequence_output [B, L, H], pooled_output [B, H])``."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        from ..nn.layers.containers import LayerList
+
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = LayerList([BertLayer(cfg)
+                                  for _ in range(cfg.num_layers)])
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is None:
+            attention_mask = (input_ids != self.cfg.pad_token_id).astype(
+                jnp.float32)
+        h = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class BertForSequenceClassification(Layer):
+    """The finetune head (BASELINE row 2): pooled output -> classes.
+    ``forward(input_ids, ...) -> logits``; with ``labels`` returns loss."""
+
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes,
+                                 weight_attr=Normal(std=cfg.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels)
+
+
+class BertForPretraining(Layer):
+    """Masked-LM + next-sentence heads. The MLM loss gathers ONLY the
+    masked positions before the vocab projection, so [B, L, vocab] logits
+    never materialize — the memory trick that matters at BERT vocab sizes.
+
+    ``forward(input_ids, mlm_positions, mlm_labels, nsp_labels, ...)``
+    returns the summed loss; positions use -1 padding (ignored).
+    """
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                weight_attr=Normal(std=cfg.initializer_range))
+        self.transform_norm = LayerNorm(cfg.hidden_size,
+                                        epsilon=cfg.layer_norm_epsilon)
+        self.nsp = Linear(cfg.hidden_size, 2,
+                          weight_attr=Normal(std=cfg.initializer_range))
+
+    def forward(self, input_ids, mlm_positions, mlm_labels, nsp_labels=None,
+                token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        B = seq.shape[0]
+        pos = jnp.clip(mlm_positions, 0, seq.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            seq, pos[:, :, None].astype(jnp.int32), axis=1)  # [B, M, H]
+        h = self.transform_norm(F.gelu(self.transform(gathered)))
+        # decoder ties the word embedding (standard BERT weight tying)
+        vocab_w = self.bert.embeddings.word_embeddings.weight  # [V, H]
+        logits = jnp.einsum("bmh,vh->bmv", h, vocab_w)
+        valid = (mlm_positions >= 0) & (mlm_labels >= 0)
+        labels = jnp.clip(mlm_labels, 0, self.cfg.vocab_size - 1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels[:, :, None].astype(jnp.int32), axis=-1)[..., 0]
+        mlm_loss = jnp.sum(jnp.where(valid, nll, 0.0)) / \
+            jnp.maximum(jnp.sum(valid), 1)
+        if nsp_labels is None:
+            return mlm_loss
+        nsp_loss = F.cross_entropy(self.nsp(pooled), nsp_labels)
+        return mlm_loss + nsp_loss

@@ -146,7 +146,12 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 # ------------------------------------------------------------- linear / embedding
 def linear(x, weight, bias=None, name=None):
-    """paddle weight layout: [in_features, out_features]."""
+    """paddle weight layout: [in_features, out_features]. Under an O1
+    ``amp.auto_cast`` scope the matmul runs in the autocast dtype (the
+    white-list contract, reference amp O1)."""
+    from ..amp.auto_cast import autocast_call
+
+    x, weight, bias = autocast_call("linear", x, weight, bias)
     out = jnp.matmul(x, weight)
     if bias is not None:
         out = out + bias
@@ -384,6 +389,9 @@ def _conv_padding(padding, n_spatial, kernel, stride, dilation):
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, n_spatial, channel_last):
+    from ..amp.auto_cast import autocast_call
+
+    x, weight, bias = autocast_call("conv", x, weight, bias)
     x, w = jnp.asarray(x), jnp.asarray(weight)
     stride = _pair(stride, n_spatial)
     dilation = _pair(dilation, n_spatial)

@@ -82,3 +82,57 @@ def test_custom_pass_registration():
     ctx = make_ctx()
     PassManager(["double_lr_test_pass"]).apply(ctx)
     assert abs(ctx.optimizer.get_lr() - 0.2) < 1e-9
+
+
+def test_amp_o1_actually_casts():
+    """O1 is real, not decorative: white-listed ops (linear/conv) compute
+    in the autocast dtype inside the scope, f32 outside."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import amp
+
+    pt.seed(0)
+    fc = nn.Linear(8, 8)
+    x = np.ones((2, 8), np.float32)
+    assert fc(jnp.asarray(x)).dtype == jnp.float32
+    with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        assert fc(jnp.asarray(x)).dtype == jnp.bfloat16
+    conv = nn.Conv2D(3, 4, 3)
+    xi = np.ones((1, 3, 8, 8), np.float32)
+    with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        assert conv(jnp.asarray(xi)).dtype == jnp.bfloat16
+    assert conv(jnp.asarray(xi)).dtype == jnp.float32
+
+    # the O1 pass wraps the model so the TRACED step computes in bf16
+    ctx = make_ctx()
+    PassManager([new_pass("amp", {"level": "O1"})]).apply(ctx)
+    step = ctx.build_step(distributed=False)
+    rng = np.random.default_rng(0)
+    xb = rng.standard_normal((8, 8)).astype(np.float32)
+    yb = rng.integers(0, 4, 8)
+    losses = [float(np.asarray(step((xb, yb)))) for _ in range(20)]
+    assert losses[-1] < losses[0]
+    with pytest.raises(ValueError, match="amp level"):
+        new_pass("amp", {"level": "o2"})
+
+
+def test_build_step_composes_user_grad_transform():
+    """A user grad_transform in step kwargs composes with pass transforms
+    instead of being clobbered."""
+    calls = []
+
+    def user_clip(grads):
+        calls.append(1)
+        return grads
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 4))
+    ctx = PassContext(model, Momentum(learning_rate=0.1, momentum=0.9),
+                      loss_fn=lambda out, b: F.cross_entropy(out, b[1]),
+                      grad_transform=user_clip)
+    PassManager(["fp16_allreduce"]).apply(ctx)
+    step = ctx.build_step(distributed=False)
+    rng = np.random.default_rng(0)
+    float(np.asarray(step((rng.standard_normal((4, 8)).astype(np.float32),
+                           rng.integers(0, 4, 4)))))
+    assert calls  # user transform executed (at trace time)
