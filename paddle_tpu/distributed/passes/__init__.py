@@ -159,32 +159,19 @@ class AmpPass(PassBase):
             return
         # O1: the model's forward TRACES inside auto_cast, so white-listed
         # ops (F.linear / F.conv*) cast their operands to the low dtype;
-        # the loss stays outside in f32
-        ctx.model = _AutocastWrap(ctx.model, self.dtype)
+        # the loss stays outside in f32. The wrap is an INSTANCE forward
+        # override — ctx.model stays the same object, so later passes'
+        # introspection (cfg/remat) and state_dict key paths are untouched.
+        from ...amp import auto_cast
 
+        inner_forward = ctx.model.forward
+        dtype = self.dtype
 
-def _make_autocast_wrap():
-    from ...nn.layer import Layer
+        def amp_forward(*args, **kwargs):
+            with auto_cast(True, level="O1", dtype=dtype):
+                return inner_forward(*args, **kwargs)
 
-    class _AutocastWrapImpl(Layer):
-        """Runs the wrapped model's forward under amp.auto_cast(O1)."""
-
-        def __init__(self, inner, dtype):
-            super().__init__()
-            self.inner = inner
-            self._amp_dtype = dtype
-
-        def forward(self, *args, **kwargs):
-            from ...amp import auto_cast
-
-            with auto_cast(True, level="O1", dtype=self._amp_dtype):
-                return self.inner(*args, **kwargs)
-
-    return _AutocastWrapImpl
-
-
-def _AutocastWrap(inner, dtype):
-    return _make_autocast_wrap()(inner, dtype)
+        object.__setattr__(ctx.model, "forward", amp_forward)
 
 
 @register_pass("recompute")

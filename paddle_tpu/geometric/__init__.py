@@ -10,9 +10,11 @@ the native C++ CSR store or over in-memory CSC arrays, returning padded
 static shapes.
 """
 from .message_passing import segment_pool, send_u_recv, send_ue_recv, send_uv
-from .sampling import khop_sampler, reindex_graph, sample_neighbors
+from .sampling import (khop_sampler, khop_sampler_from_store,
+                       reindex_graph, sample_neighbors)
 
 __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv", "segment_pool",
     "sample_neighbors", "reindex_graph", "khop_sampler",
+    "khop_sampler_from_store",
 ]

@@ -80,13 +80,11 @@ def reindex_graph(x, neighbors, count) -> Tuple[np.ndarray, np.ndarray,
     return src, dst, np.asarray(out_nodes, np.int64)
 
 
-def khop_sampler(row, colptr, input_nodes, sample_sizes,
-                 seed: Optional[int] = None):
-    """Multi-hop neighborhood sampling (reference ``graph_khop_sampler``).
-
-    Returns ``(edge_src, edge_dst, sample_index)``: local-id edges over the
-    union frontier and the global ids backing each local id.
-    """
+def _khop_core(fetch, input_nodes, sample_sizes):
+    """Shared khop mechanics: hop loop + local-id interning + edge
+    accumulation. ``fetch(frontier, hop, k) -> list of per-node neighbor
+    id-lists`` abstracts the backing store (CSC arrays or a graph
+    service)."""
     nodes = np.asarray(input_nodes, np.int64).reshape(-1)
     local = {}
     table = []
@@ -104,16 +102,70 @@ def khop_sampler(row, colptr, input_nodes, sample_sizes,
     all_src, all_dst = [], []
     frontier = nodes
     for hop, k in enumerate(sample_sizes):
+        if frontier.size == 0:
+            break
+        per_node = fetch(frontier, hop, int(k))
+        nxt = []
+        for u, neigh in zip(frontier, per_node):
+            du = intern(int(u))
+            for v in neigh:
+                v = int(v)
+                all_src.append(intern(v))
+                all_dst.append(du)
+                nxt.append(v)
+        frontier = np.unique(np.asarray(nxt, np.int64)) if nxt else \
+            np.empty(0, np.int64)
+    return (np.asarray(all_src, np.int64), np.asarray(all_dst, np.int64),
+            np.asarray(table, np.int64))
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes,
+                 seed: Optional[int] = None):
+    """Multi-hop neighborhood sampling (reference ``graph_khop_sampler``).
+
+    Returns ``(edge_src, edge_dst, sample_index)``: local-id edges over the
+    union frontier and the global ids backing each local id.
+    """
+
+    def fetch(frontier, hop, k):
         neigh, cnt = sample_neighbors(
             row, colptr, frontier, k,
             seed=None if seed is None else seed + hop)
-        pos = 0
-        for u, c in zip(frontier, cnt):
-            du = intern(int(u))
-            for v in neigh[pos:pos + c]:
-                all_src.append(intern(int(v)))
-                all_dst.append(du)
+        out, pos = [], 0
+        for c in cnt:
+            out.append(neigh[pos:pos + c])
             pos += c
-        frontier = np.unique(neigh)
-    return (np.asarray(all_src, np.int64), np.asarray(all_dst, np.int64),
-            np.asarray(table, np.int64))
+        return out
+
+    return _khop_core(fetch, input_nodes, sample_sizes)
+
+
+def khop_sampler_from_store(store, input_nodes, sample_sizes,
+                            seed: int = 0, with_features: bool = False):
+    """Multi-hop sampling over a graph STORE — single-host
+    :class:`~paddle_tpu.distributed.ps.graph.GraphTable` or the sharded
+    :class:`~paddle_tpu.distributed.ps.graph.DistGraphClient` — the GNN
+    minibatch feed of the reference's GpuPs khop path
+    (``graph_khop_sampler.py`` over ``GpuPsGraphTable``).
+
+    Because per-node sampling is deterministic in (seed, node), the
+    subgraph is IDENTICAL whether the store is local or sharded across
+    servers. Returns ``(edge_src, edge_dst, sample_index)`` in local ids
+    (edges point neighbor -> center, khop_sampler convention), plus the
+    node-feature matrix for ``sample_index`` when ``with_features``.
+    """
+    if any(int(k) <= 0 for k in sample_sizes):
+        raise ValueError(
+            "store-backed khop needs sample sizes > 0: the padded "
+            "static-shape store sampler has no take-all sentinel")
+
+    def fetch(frontier, hop, k):
+        nb, cnt = store.sample_neighbors(frontier, k, seed=seed + hop)
+        return [[v for v in nb[i][:int(cnt[i])] if v >= 0]
+                for i in range(len(frontier))]
+
+    out = _khop_core(fetch, input_nodes, sample_sizes)
+    if with_features:
+        feats = store.get_features(out[2])
+        return out + (feats,)
+    return out

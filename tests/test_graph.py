@@ -353,3 +353,47 @@ def test_weighted_dist_graph_parity(graph_cluster):
     nb_l, ct_l = local.sample_neighbors(starts, 6, replace=True, seed=2)
     np.testing.assert_array_equal(nb_d, nb_l)
     np.testing.assert_array_equal(ct_d, ct_l)
+
+
+def test_khop_sampler_from_store_local_vs_sharded(graph_cluster):
+    """Multi-hop GNN minibatch over the graph STORE: the sampled subgraph
+    (edges + node table + features) is identical on the single-host table
+    and the 2-server sharded client — the GpuPs khop path restated."""
+    from paddle_tpu import geometric as G
+
+    src, dst = random_coo(n_nodes=80, n_edges=800, seed=9)
+    local = GraphTable()
+    local.add_edges(src, dst)
+    local.build(symmetric=True)
+    rngf = np.random.default_rng(1)
+    # dim 16: the module-scoped cluster's feature table fixed its dim in
+    # an earlier test (first set_features wins)
+    feats = rngf.normal(size=(80, 16)).astype(np.float32)
+    local.set_features(np.arange(80), feats)
+
+    graph_cluster.clear_edges()
+    graph_cluster.add_edges(src, dst)
+    graph_cluster.build(symmetric=True)
+    graph_cluster.set_features(np.arange(80), feats)
+
+    seeds = np.asarray([0, 3, 11], np.int64)
+    es_l, ed_l, idx_l, f_l = G.khop_sampler_from_store(
+        local, seeds, [4, 3], seed=5, with_features=True)
+    es_d, ed_d, idx_d, f_d = G.khop_sampler_from_store(
+        graph_cluster, seeds, [4, 3], seed=5, with_features=True)
+    np.testing.assert_array_equal(es_l, es_d)
+    np.testing.assert_array_equal(ed_l, ed_d)
+    np.testing.assert_array_equal(idx_l, idx_d)
+    np.testing.assert_array_equal(f_l, f_d)
+    # structure sanity: every edge endpoint indexes the node table, seeds
+    # occupy the first rows
+    assert idx_l[:3].tolist() == seeds.tolist()
+    assert es_l.max(initial=-1) < idx_l.size
+    assert f_l.shape == (idx_l.size, 16)
+
+    # and the minibatch feeds message passing end-to-end
+    import jax.numpy as jnp
+
+    h = G.send_u_recv(jnp.asarray(f_l), jnp.asarray(es_l), jnp.asarray(ed_l),
+                      "mean", out_size=idx_l.size)
+    assert np.asarray(h).shape == (idx_l.size, 16)
