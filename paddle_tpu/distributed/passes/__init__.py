@@ -161,17 +161,32 @@ class AmpPass(PassBase):
         # ops (F.linear / F.conv*) cast their operands to the low dtype;
         # the loss stays outside in f32. The wrap is an INSTANCE forward
         # override — ctx.model stays the same object, so later passes'
-        # introspection (cfg/remat) and state_dict key paths are untouched.
+        # introspection (cfg/remat) and state_dict key paths are untouched;
+        # the override is a module-level picklable descriptor-style object
+        # bound to the instance (survives copy/pickle, unlike a closure
+        # over a bound method).
+        object.__setattr__(ctx.model, "forward",
+                           _O1Forward(ctx.model, self.dtype))
+
+
+class _O1Forward:
+    """Picklable per-instance forward override running the layer's class
+    forward under amp.auto_cast(O1). Re-binds through __reduce__, so
+    deepcopy/pickle of the model reconstructs an override pointing at the
+    COPY, not the original instance."""
+
+    def __init__(self, layer, dtype):
+        self._layer = layer
+        self._dtype = dtype
+
+    def __call__(self, *args, **kwargs):
         from ...amp import auto_cast
 
-        inner_forward = ctx.model.forward
-        dtype = self.dtype
+        with auto_cast(True, level="O1", dtype=self._dtype):
+            return type(self._layer).forward(self._layer, *args, **kwargs)
 
-        def amp_forward(*args, **kwargs):
-            with auto_cast(True, level="O1", dtype=dtype):
-                return inner_forward(*args, **kwargs)
-
-        object.__setattr__(ctx.model, "forward", amp_forward)
+    def __reduce__(self):
+        return (_O1Forward, (self._layer, self._dtype))
 
 
 @register_pass("recompute")

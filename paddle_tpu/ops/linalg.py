@@ -270,3 +270,46 @@ def pdist(x, p=2.0, name=None):
     full = cdist(x, x, p=p)
     iu = np.triu_indices(n, k=1)
     return full[iu]
+
+
+def inv(x, name=None):
+    """Matrix inverse — alias of :func:`inverse` (the reference exposes
+    both ``paddle.inverse`` and ``paddle.linalg.inv``)."""
+    return inverse(x)
+
+
+def matrix_transpose(x, name=None):
+    """Swap the last two axes (reference ``matrix_transpose``)."""
+    return jnp.swapaxes(jnp.asarray(x), -1, -2)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return jnp.sum(jnp.asarray(x) * jnp.asarray(y), axis=axis)
+
+
+def householder_product(x, tau, name=None):
+    """Product of Householder reflectors H_0 ... H_{k-1} (reference
+    ``householder_product`` — the orthogonal Q from a QR factorization's
+    compact (v, tau) form). x: [..., m, k] reflector columns, tau: [..., k].
+    """
+    x = jnp.asarray(x, jnp.float32)
+    tau = jnp.asarray(tau, jnp.float32)
+    m, k = x.shape[-2], x.shape[-1]
+
+    def one(xm, tm):
+        q = jnp.eye(m, dtype=x.dtype)
+        # v_i: unit lower-trapezoidal column i (implicit leading 1)
+        for i in range(k):
+            v = xm[:, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[i].set(1.0)
+            q = q - tm[i] * (q @ v)[:, None] * v[None, :]
+        # reference shape contract: Q has x's shape ([..., m, k])
+        return q[:, :k]
+
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.reshape((-1, m, k))
+    bt = tau.reshape((-1, k))
+    out = jax.vmap(one)(batch, bt)
+    return out.reshape(x.shape[:-2] + (m, k))

@@ -110,14 +110,16 @@ def test_throughput_speedup_on_slow_transform():
     # three attempts with a decaying bar: a fully loaded CI box can
     # starve the worker pool of cores, which is scheduler noise rather
     # than a loader regression
-    best = 0.0
-    for attempt, bar in enumerate((2.0, 2.0, 1.5)):
+    attempts = []
+    for bar in (2.0, 2.0, 1.5):
         t_single, t_multi = measure()
-        best = max(best, t_single / t_multi)
-        if best >= bar:
+        attempts.append((t_single / t_multi, t_single, t_multi))
+        if max(a[0] for a in attempts) >= bar:
             return
+    best = max(a[0] for a in attempts)
     assert best >= 1.5, \
-        f"speedup {best:.2f}x < 1.5x ({t_single:.2f}s vs {t_multi:.2f}s)"
+        f"best speedup {best:.2f}x < 1.5x across attempts: " \
+        f"{[(round(r, 2), round(a, 2), round(b, 2)) for r, a, b in attempts]}"
 
 
 class EchoInitDataset(Dataset):

@@ -422,3 +422,28 @@ def test_r3_sequence_op_family():
     cat, clens = ops.sequence_concat([padded, padded], [lens, lens])
     np.testing.assert_allclose(np.asarray(clens), [6, 2])
     np.testing.assert_allclose(np.asarray(cat)[1][:2], [[4, 4], [4, 4]])
+
+
+def test_r3_linalg_additions():
+    import numpy as np
+
+    import paddle_tpu.ops as ops
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(4, 4)).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(ops.inv(a)) @ a, np.eye(4),
+                               atol=1e-4)
+    b = rng.normal(size=(2, 3, 5)).astype(np.float32)
+    assert ops.matrix_transpose(b).shape == (2, 5, 3)
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    y = rng.normal(size=(3, 8)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(ops.vecdot(x, y)),
+                               (x * y).sum(-1), rtol=1e-5)
+    # householder_product reconstructs Q from scipy's compact QR form
+    from scipy.linalg import qr as sqr
+
+    m = rng.normal(size=(5, 3)).astype(np.float32)
+    (qr_raw, tau), _r = sqr(m, mode="raw")
+    q = np.asarray(ops.householder_product(np.asarray(qr_raw), tau))
+    q_ref = sqr(m, mode="economic")[0]
+    np.testing.assert_allclose(q[:, :3], q_ref, atol=1e-4)
