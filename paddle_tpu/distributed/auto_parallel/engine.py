@@ -33,16 +33,37 @@ class Engine:
     def __init__(self, model: Layer, loss_fn: Optional[Callable] = None,
                  optimizer=None, metrics=None, mesh=None,
                  model_spec: Optional[ModelSpec] = None,
-                 strategy=None, batch_axes=("dp", "sdp")):
+                 strategy=None, batch_axes=("dp", "sdp"),
+                 auto_tune: bool = False, cluster=None,
+                 num_heads: Optional[int] = None):
+        """``auto_tune=True`` with a ``model_spec`` runs the full 5-axis
+        :class:`~.tuner.ParallelTuner` (measured-calibrated roofline) and
+        adopts its best plan; the default keeps the cheaper 3-axis
+        Planner (the reference's Engine -> tuner escalation)."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.metrics = metrics or []
         self.plan = None
+        if auto_tune and (mesh is not None or model_spec is None):
+            raise ValueError(
+                "auto_tune=True needs a model_spec and no explicit mesh — "
+                "the tuner's job is to pick the mesh")
+        if not auto_tune and num_heads is not None:
+            raise ValueError(
+                "num_heads is a tuner constraint; pass auto_tune=True "
+                "(the 3-axis planner does not consume it)")
         if mesh is None:
             if model_spec is not None:
                 n = len(jax.devices())
-                self.plan = Planner(model_spec, n).best()
+                if auto_tune:
+                    from .tuner import ParallelTuner
+
+                    self.plan = ParallelTuner(
+                        model_spec, n, cluster=cluster,
+                        num_heads=num_heads).best()
+                else:
+                    self.plan = Planner(model_spec, n, cluster=cluster).best()
                 mesh = init_mesh(self.plan.axes)
             else:
                 mesh = get_mesh() or init_mesh({"dp": -1})

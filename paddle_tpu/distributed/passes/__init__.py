@@ -165,28 +165,33 @@ class AmpPass(PassBase):
         # the override is a module-level picklable descriptor-style object
         # bound to the instance (survives copy/pickle, unlike a closure
         # over a bound method).
+        prior = ctx.model.__dict__.get("forward")  # instance-level only
         object.__setattr__(ctx.model, "forward",
-                           _O1Forward(ctx.model, self.dtype))
+                           _O1Forward(ctx.model, self.dtype, prior))
 
 
 class _O1Forward:
-    """Picklable per-instance forward override running the layer's class
-    forward under amp.auto_cast(O1). Re-binds through __reduce__, so
-    deepcopy/pickle of the model reconstructs an override pointing at the
-    COPY, not the original instance."""
+    """Picklable per-instance forward override running the layer's forward
+    under amp.auto_cast(O1). Composes with a pre-existing INSTANCE-level
+    forward override when one exists (``inner`` holds it); re-binds
+    through __reduce__, so deepcopy/pickle of the model reconstructs an
+    override pointing at the COPY, not the original instance."""
 
-    def __init__(self, layer, dtype):
+    def __init__(self, layer, dtype, inner=None):
         self._layer = layer
         self._dtype = dtype
+        self._inner = inner  # prior instance-level forward (or None)
 
     def __call__(self, *args, **kwargs):
         from ...amp import auto_cast
 
         with auto_cast(True, level="O1", dtype=self._dtype):
+            if self._inner is not None:
+                return self._inner(*args, **kwargs)
             return type(self._layer).forward(self._layer, *args, **kwargs)
 
     def __reduce__(self):
-        return (_O1Forward, (self._layer, self._dtype))
+        return (_O1Forward, (self._layer, self._dtype, self._inner))
 
 
 @register_pass("recompute")

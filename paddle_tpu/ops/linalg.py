@@ -292,8 +292,14 @@ def householder_product(x, tau, name=None):
     ``householder_product`` — the orthogonal Q from a QR factorization's
     compact (v, tau) form). x: [..., m, k] reflector columns, tau: [..., k].
     """
-    x = jnp.asarray(x, jnp.float32)
-    tau = jnp.asarray(tau, jnp.float32)
+    x = jnp.asarray(x)
+    tau = jnp.asarray(tau)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        raise NotImplementedError(
+            "householder_product: complex reflectors not supported")
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
+    tau = tau.astype(x.dtype)
     m, k = x.shape[-2], x.shape[-1]
 
     def one(xm, tm):

@@ -330,3 +330,25 @@ def test_tuner_measured_validation_on_mesh():
     ranked = tuner.validate(top, build, steps=2)
     assert all(c.measured_time and c.measured_time > 0 for c in ranked)
     assert ranked[0].measured_time <= ranked[1].measured_time
+
+
+def test_engine_auto_tune_adopts_tuner_plan():
+    """Engine(auto_tune=True) escalates from the 3-axis planner to the
+    full ParallelTuner and builds its mesh from the winning plan."""
+    import paddle_tpu.nn as nn_mod
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.planner import (ClusterSpec,
+                                                              ModelSpec)
+    from paddle_tpu.distributed.mesh import set_mesh
+    from paddle_tpu.optimizer import SGD
+
+    set_mesh(None)
+    spec = ModelSpec(n_params=1e6, flops_per_token=6e6, hidden_size=64,
+                     n_layers=2, seq_len=64, global_batch_tokens=16 * 64)
+    eng = Engine(nn_mod.Linear(64, 64), optimizer=SGD(learning_rate=0.1),
+                 loss_fn=lambda o, b: None, model_spec=spec, auto_tune=True,
+                 cluster=ClusterSpec(), num_heads=4)
+    assert eng.plan is not None and hasattr(eng.plan, "sp")  # TunedPlan
+    assert eng.plan.n_devices == 8
+    assert int(np.prod(list(eng.mesh.shape.values()))) == 8
+    set_mesh(None)

@@ -148,7 +148,7 @@ def test_heter_pipeline_rpc_workers(tmp_path):
             cwd=REPO) for r in (1, 2)]
         trainer = subprocess.run(
             [sys.executable, str(t_script), master], env=env, cwd=REPO,
-            capture_output=True, text=True, timeout=180)
+            capture_output=True, text=True, timeout=360)
         assert trainer.returncode == 0, trainer.stderr
         assert "HETER_RPC_OK" in trainer.stdout
         for w in workers:
