@@ -24,6 +24,20 @@ from .table import MemorySparseTable, SparseAccessorConfig
 _callbacks_supported = None
 
 
+def _tracing_active() -> bool:
+    """True when called under ANY jax trace (jit or grad), even if every
+    visible operand is a concrete closed-over array. Needed because a layer
+    whose inputs are all closure constants still traces wrong: its host pull
+    would bake stale rows into the compiled program and its push-vjp would
+    be pruned."""
+    try:
+        from jax._src.core import trace_state_clean
+
+        return not trace_state_clean()
+    except Exception:  # API moved — fall back to operand-based detection
+        return False
+
+
 def callbacks_supported() -> bool:
     """Whether the active backend supports host callbacks inside jit.
 
@@ -33,10 +47,16 @@ def callbacks_supported() -> bool:
     global _callbacks_supported
     if _callbacks_supported is None:
         try:
-            out = jax.jit(lambda x: jax.pure_callback(
-                lambda y: y, jax.ShapeDtypeStruct((), jnp.float32), x))(
-                    jnp.float32(3.0))
-            _callbacks_supported = float(out) == 3.0
+            # ensure_compile_time_eval: the first call may come from inside
+            # an active trace (eval-mode forward under an outer jit), where
+            # a plain jit dispatch would stage into that trace, float()
+            # would raise, and False would be cached forever on a
+            # callback-capable backend
+            with jax.ensure_compile_time_eval():
+                out = jax.jit(lambda x: jax.pure_callback(
+                    lambda y: y, jax.ShapeDtypeStruct((), jnp.float32), x))(
+                        jnp.float32(3.0))
+                _callbacks_supported = float(out) == 3.0
         except Exception:
             _callbacks_supported = False
     return _callbacks_supported
@@ -121,10 +141,32 @@ class SparseEmbedding(Layer):
 
     def forward(self, ids):
         ids = jnp.asarray(ids)
-        if not isinstance(ids, jax.core.Tracer) and \
-                not isinstance(self.grad_anchor, jax.core.Tracer):
+        anchor_traced = isinstance(self.grad_anchor, jax.core.Tracer)
+        in_trace = (anchor_traced or isinstance(ids, jax.core.Tracer)
+                    or _tracing_active())
+        if not in_trace:
             # Eager path: plain host pull, no callback machinery (works on
             # backends without host-callback support).
+            rows = self.table.pull(np.asarray(ids).reshape(-1))
+            return jnp.asarray(rows).reshape(ids.shape + (self.embed_dim,))
+        if self.training and not anchor_traced:
+            # Inside a jit/grad trace but grad_anchor is a plain array: the
+            # push-vjp is unreachable from the differentiated inputs and AD
+            # would silently prune it — the step would run, loss would move,
+            # and the embedding would never train. Fail loudly instead.
+            raise RuntimeError(
+                "SparseEmbedding used inside a traced step, but its "
+                "grad_anchor parameter is not among the traced/differentiated "
+                "values, so embedding gradients would be silently dropped. "
+                "Run the layer via functional_call/TrainStep with "
+                "param_state(model) (which includes grad_anchor), or call "
+                ".eval() on the layer for inference.")
+        if (not anchor_traced and not isinstance(ids, jax.core.Tracer)
+                and not callbacks_supported()):
+            # eval composition (everything concrete, just an enclosing
+            # trace) on a backend without host callbacks (axon tunnel):
+            # bake the rows into the compiled program at trace time —
+            # frozen-table serving. The io_callback path would fail there.
             rows = self.table.pull(np.asarray(ids).reshape(-1))
             return jnp.asarray(rows).reshape(ids.shape + (self.embed_dim,))
         return self._lookup(ids, self.grad_anchor)

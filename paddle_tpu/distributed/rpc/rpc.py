@@ -59,6 +59,21 @@ _state: Dict[str, object] = {
 _cycle = 0
 
 
+def _kv_retry(fn, deadline, what):
+    """Run a KV-store operation, retrying transport failures (server not up
+    yet / transient refusal) until ``deadline``."""
+    import urllib.error
+
+    while True:
+        try:
+            return fn()
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rpc {what}: master store unreachable: {e}") from e
+            time.sleep(0.2)
+
+
 def _read_full(sock, n):
     buf = b""
     while len(buf) < n:
@@ -148,22 +163,38 @@ def init_rpc(name: str, rank: Optional[int] = None,
     service = _Service()  # bound (port known) but NOT accepting yet
     ip = socket.gethostbyname(socket.gethostname())
     ns = _namespace()
-    kv.put(f"{ns}/worker/{rank}",
-           pickle.dumps(WorkerInfo(name, rank, ip, service.port)).hex(),
-           ttl=_KEY_TTL)
-    workers: Dict[str, WorkerInfo] = {}
     deadline = time.time() + _DEFAULT_RPC_TIMEOUT
-    for r in range(world_size):
-        raw = None
-        while raw is None:
-            raw = kv.get(f"{ns}/worker/{r}")
-            if raw is None:
-                if time.time() > deadline:
-                    service.stop()
-                    raise TimeoutError(f"rpc rendezvous: rank {r} missing")
-                time.sleep(0.1)
-        info = pickle.loads(bytes.fromhex(raw))
-        workers[info.name] = info
+    # non-zero ranks commonly start BEFORE rank 0 has its store up (the
+    # launch CLI spawns all pods at once), so every KV touch during
+    # rendezvous retries connection failures until the shared deadline —
+    # the TCPStore-client behavior of the reference
+    workers: Dict[str, WorkerInfo] = {}
+    try:
+        _kv_retry(lambda: kv.put(
+            f"{ns}/worker/{rank}",
+            pickle.dumps(WorkerInfo(name, rank, ip, service.port)).hex(),
+            ttl=_KEY_TTL), deadline, "register")
+        for r in range(world_size):
+            raw = None
+            while raw is None:
+                raw = _kv_retry(lambda: kv.get(f"{ns}/worker/{r}"),
+                                deadline, f"rendezvous rank {r}")
+                if raw is None:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"rpc rendezvous: rank {r} missing")
+                    time.sleep(0.1)
+            info = pickle.loads(bytes.fromhex(raw))
+            workers[info.name] = info
+    except Exception:
+        # failed init must not leak the listening socket — nor, on rank 0,
+        # the KV server this attempt started (a retry would see its own
+        # orphan holding the port and mistake it for an external store)
+        service.stop()
+        if _state["kv_server"] is not None:
+            _state["kv_server"].stop()
+            _state["kv_server"] = None
+        raise
     _state.update(server=service, workers=workers,
                   self=next(w for w in workers.values() if w.rank == rank),
                   kv=kv, pool=ThreadPoolExecutor(max_workers=16),

@@ -165,6 +165,60 @@ def test_sparse_embedding_only_anchor_param():
     assert len(leaves) == 1 and leaves[0].shape == ()
 
 
+def test_sparse_embedding_push_dce_guard():
+    """A user-composed step that forgets the embedding's params must fail
+    loudly — the silent alternative is AD pruning the push-vjp and the
+    embedding never training (VERDICT r3 item 7)."""
+    emb = SparseEmbedding(4, optimizer="sgd", seed=3)
+    ids = jnp.asarray([1, 2], jnp.int32)
+
+    @jax.jit
+    def user_step(w):
+        # emb's grad_anchor is a closed-over concrete array here, not a
+        # differentiated input — the push could never fire
+        e = emb(ids)
+        return jnp.sum(w * jnp.sum(e))
+
+    with pytest.raises(RuntimeError, match="grad_anchor"):
+        jax.grad(user_step)(jnp.ones(4, jnp.float32))
+
+    # same composition is legitimate for inference after .eval()
+    emb.eval()
+    out = jax.jit(lambda: jnp.sum(emb(ids)))()
+    assert np.isfinite(float(out))
+    emb.train()
+
+    # and the supported path (params threaded functionally) still pushes:
+    # the table rows must actually change after a grad step
+    from paddle_tpu.nn.layer import buffer_state, functional_call, param_state
+
+    params, buffers = param_state(emb), buffer_state(emb)
+    before = emb.table.pull(np.asarray([1, 2])).copy()
+
+    def loss_fn(p):
+        e, _ = functional_call(emb, p, buffers, ids)
+        return jnp.sum(e ** 2)
+
+    jax.grad(loss_fn)(params)
+    after = emb.table.pull(np.asarray([1, 2]))
+    assert not np.allclose(before, after), "push was dead-code-eliminated"
+
+
+def test_sparse_embedding_eval_no_callback_backend(monkeypatch):
+    """Eval-mode composition on a backend without host callbacks (the axon
+    tunnel): rows are baked at trace time instead of routed through
+    io_callback (which would fail there)."""
+    from paddle_tpu.distributed.ps import embedding as emb_mod
+
+    emb = SparseEmbedding(4, optimizer="sgd", seed=5)
+    ids = np.asarray([3, 9], np.int64)
+    want = emb.table.pull(ids)
+    emb.eval()
+    monkeypatch.setattr(emb_mod, "_callbacks_supported", False)
+    out = jax.jit(lambda: emb(jnp.asarray(ids)))()
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+
 def test_ps_context_persistables(tmp_path):
     ctx = PSContext()
     t1 = ctx.create_table("emb_a", embed_dim=4, optimizer="sgd", seed=1)
