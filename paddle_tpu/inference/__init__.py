@@ -16,7 +16,10 @@ import numpy as np
 
 import jax
 
-__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+from .dist_model import DistModel, DistModelConfig, save_dist_model
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "DistModel", "DistModelConfig", "save_dist_model"]
 
 
 class Config:

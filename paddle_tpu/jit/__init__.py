@@ -67,13 +67,14 @@ def _spec_to_shape_dtype(spec, scope, idx):
 
 
 def save(layer, path: str, input_spec: Optional[Sequence] = None,
-         **config) -> None:
+         **config) -> "jax_export.Exported":
     """``paddle.jit.save`` analogue.
 
     ``layer`` may be a :class:`Layer` (its eval-mode forward is captured) or
     a jit-wrapped function from :func:`to_static` over a Layer. The export
     is multi-platform (cpu + tpu) so a model saved on a TPU host serves
-    anywhere XLA runs.
+    anywhere XLA runs. Returns the in-memory ``Exported`` (callers chaining
+    exports can read ``out_avals`` without re-reading the artifact).
     """
     if callable(layer) and hasattr(layer, "__wrapped_layer__"):
         layer = layer.__wrapped_layer__
@@ -116,6 +117,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None,
         host_state = jax.tree.map(np.asarray, (params, buffers))
         with open(path + ".pdiparams", "wb") as f:
             pickle.dump(host_state, f, protocol=4)
+        return exported
     finally:
         if was_training:
             layer.train()
