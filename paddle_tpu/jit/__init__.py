@@ -3,10 +3,11 @@
 Reference parity: ``python/paddle/jit/`` (``@to_static`` AST transpiler,
 ``paddle.jit.save/load`` → TranslatedLayer) and the C++ loader
 (``paddle/fluid/jit/``: CompilationUnit/serializer). TPU-native: "static
-graph" = StableHLO captured by ``jax.export`` — no AST transpilation needed
-(jax traces Python directly), no ProgramDesc protobuf (StableHLO *is* the
-portable IR), and the saved artifact runs under any XLA runtime incl. C++
-(PjRt) without Python model code.
+graph" = StableHLO captured by ``jax.export`` — jax traces straight-line
+Python directly, so the only AST work is converting tensor-dependent
+control flow to lax ops (:mod:`.dy2static`); there is no ProgramDesc
+protobuf (StableHLO *is* the portable IR), and the saved artifact runs
+under any XLA runtime incl. C++ (PjRt) without Python model code.
 
 Artifacts (mirroring the reference's ``.pdmodel``/``.pdiparams`` pair):
   ``<path>.pdmodel``   — serialized StableHLO (jax.export bytes)
@@ -32,12 +33,34 @@ from ..nn.layer import Layer, buffer_state, functional_call, param_state
 __all__ = ["to_static", "save", "load", "TranslatedLayer", "InputSpec",
            "not_to_static"]
 
-to_static = jit
+
+def to_static(fn=None, **kwargs):
+    """``paddle.jit.to_static``: dy2static conversion + compilation.
+
+    Tensor-dependent ``if``/``while``/``for`` in the function (or the
+    Layer's ``forward``) is AST-converted to ``lax.cond``/``while_loop``/
+    ``scan`` first (:mod:`paddle_tpu.jit.dy2static` — the
+    ``program_translator.py`` analogue), then the result is jit-compiled.
+    Code without data-dependent control flow passes through unchanged.
+    """
+    if fn is None:
+        import functools
+
+        return functools.partial(to_static, **kwargs)
+    from .dy2static import convert_control_flow, convert_layer
+
+    if isinstance(fn, Layer):
+        convert_layer(fn)
+        return jit(fn, **kwargs)
+    if callable(fn):
+        return jit(convert_control_flow(fn), **kwargs)
+    return jit(fn, **kwargs)
 
 
 def not_to_static(fn):
-    """Marker for API parity (reference skips transpiling the function; here
-    tracing is structural, so this is identity)."""
+    """Mark ``fn`` to be skipped by dy2static conversion (reference
+    ``paddle.jit.not_to_static``)."""
+    fn.__not_to_static__ = True
     return fn
 
 

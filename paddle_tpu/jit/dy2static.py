@@ -1,0 +1,465 @@
+"""dy2static: AST conversion of Python control flow to XLA control flow.
+
+Reference parity: ``python/paddle/fluid/dygraph/dygraph_to_static/`` — the
+``ProgramTranslator`` AST transformer set (``program_translator.py``,
+``ifelse_transformer.py``, ``loop_transformer.py``) that converts
+tensor-dependent ``if``/``while``/``for`` into ``cond``/``while_loop`` ops.
+
+TPU-native restatement: jax already traces straight-line Python, so the
+only thing to transpile is *data-dependent control flow*. Each ``if`` /
+``while`` / ``for`` statement is rewritten into a functional form whose
+assigned locals are threaded explicitly, dispatched at RUNTIME:
+
+- condition/iterable is a concrete Python value  -> plain Python control
+  flow (eager semantics, loops unroll under trace exactly as before);
+- condition/iterable is a traced value           -> ``lax.cond`` /
+  ``lax.while_loop`` / ``lax.scan`` / ``lax.fori_loop``.
+
+So converted code behaves identically eagerly, and additionally compiles
+when the condition depends on tensor data — where the unconverted original
+would raise a ConcretizationTypeError.
+
+Known v1 limits (each degrades to the old trace-only behavior, never to
+silent wrongness): ``return``/``break``/``continue`` inside a converted
+block keep that block un-converted; a ``for`` loop's target variable read
+AFTER the loop sees its pre-loop value when the loop was converted;
+foreign decorators / generators / ``super()`` / walrus-in-while-test skip
+conversion. And one inherited from XLA itself: reverse-mode grad through
+a converted ``while`` (dynamic trip count) is unsupported — jax raises a
+clear error; bound the loop (``for i in range(k)``) for training, the
+same advice the reference gives for RNN-style while loops it cannot
+differentiate efficiently.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import logging
+import textwrap
+import types
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["convert_control_flow", "convert_if", "convert_while",
+           "convert_for", "make_range", "maybe", "UNDEF"]
+
+_log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------- runtime
+class _Undef:
+    """Placeholder for 'variable not yet defined here' (the reference's
+    ``UndefinedVar``). Any use poisons loudly instead of mis-executing."""
+
+    _MSG = ("variable is not defined on every path through converted "
+            "control flow (dy2static): define it before the if/loop, or "
+            "in both branches")
+
+    def __repr__(self):
+        return "<dy2static UNDEF>"
+
+    def _poison(self, *a, **k):
+        raise RuntimeError(self._MSG)
+
+    __bool__ = __call__ = __getattr__ = __getitem__ = _poison
+    __add__ = __radd__ = __mul__ = __rmul__ = __sub__ = _poison
+    __iter__ = __len__ = __float__ = __int__ = _poison
+
+
+UNDEF = _Undef()
+
+
+def maybe(thunk: Callable[[], Any]):
+    """Evaluate a variable read, mapping not-yet-defined to UNDEF."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _as_pred(x):
+    arr = jnp.asarray(x)
+    if arr.shape != ():
+        raise ValueError(
+            f"converted condition must be a scalar, got shape {arr.shape}")
+    return arr.astype(bool)
+
+
+def convert_if(pred, true_fn, false_fn, operands: tuple):
+    """``if`` dispatch. ``true_fn``/``false_fn`` take the carried locals
+    positionally and return their updated tuple."""
+    if not _is_traced(pred):
+        return true_fn(*operands) if pred else false_fn(*operands)
+    # traced: UNDEF slots (defined only inside the branches) ride closure,
+    # defined slots ride the cond operands so they are properly traced
+    defined = [i for i, op in enumerate(operands) if op is not UNDEF]
+
+    def _call(branch, dops):
+        full = list(operands)
+        for i, v in zip(defined, dops):
+            full[i] = v
+        return branch(*full)
+
+    return lax.cond(_as_pred(pred),
+                    lambda dops: _call(true_fn, dops),
+                    lambda dops: _call(false_fn, dops),
+                    tuple(operands[i] for i in defined))
+
+
+def convert_while(test_fn, body_fn, init: tuple):
+    """``while`` dispatch: python loop when the condition is concrete
+    (unrolls under trace like the original), ``lax.while_loop`` when the
+    condition is data-dependent."""
+    carry = tuple(init)
+    first = test_fn(*carry)
+    if not _is_traced(first):
+        while first:
+            carry = tuple(body_fn(*carry))
+            first = test_fn(*carry)
+        return carry
+    return tuple(lax.while_loop(
+        lambda c: _as_pred(test_fn(*c)),
+        lambda c: tuple(body_fn(*c)), carry))
+
+
+@dataclass(frozen=True)
+class _RangeSpec:
+    """A ``range(...)`` whose bounds are traced (a plain range() would
+    raise before control ever reached convert_for)."""
+
+    start: Any
+    stop: Any
+    step: Any
+
+
+def make_range(*args):
+    if not any(_is_traced(a) for a in args):
+        return range(*args)
+    if len(args) == 1:
+        return _RangeSpec(0, args[0], 1)
+    if len(args) == 2:
+        return _RangeSpec(args[0], args[1], 1)
+    return _RangeSpec(*args)
+
+
+def convert_for(iterable, body_fn, init: tuple):
+    """``for`` dispatch. ``body_fn(loop_var, *carry) -> carry``."""
+    if isinstance(iterable, _RangeSpec):
+        start = jnp.asarray(iterable.start)
+        stop = jnp.asarray(iterable.stop)
+        step = jnp.asarray(iterable.step)
+        # iteration count, correct for negative steps, clamped at 0
+        n = jnp.maximum(0, (stop - start + step - jnp.sign(step))
+                        // step).astype(jnp.int32)
+        return tuple(lax.fori_loop(
+            0, n,
+            lambda k, c: tuple(body_fn(start + k * step, *c)),
+            tuple(init)))
+    if _is_traced(iterable):
+        carry, _ = lax.scan(
+            lambda c, x: (tuple(body_fn(x, *c)), None),
+            tuple(init), iterable)
+        return tuple(carry)
+    carry = tuple(init)
+    for x in iterable:
+        carry = tuple(body_fn(x, *carry))
+    return carry
+
+
+# ----------------------------------------------------------- AST analysis
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef, ast.ListComp, ast.SetComp, ast.DictComp,
+                ast.GeneratorExp)
+
+
+def _assigned_names(nodes) -> set:
+    """Names bound in ``nodes``: Store/Del contexts, plus def/class names
+    and import aliases (they bind in the enclosing scope too). Does not
+    descend into nested scopes (their internal bindings are their own)."""
+    out: set = set()
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)  # the NAME binds here; the body is its own
+            return
+        if isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add(alias.asname or alias.name.split(".")[0])
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for n in nodes:
+        walk(n)
+    # our own synthesized helpers re-bind on every execution of the block;
+    # threading them as loop/branch state would put non-tensor callables
+    # (or UNDEF on the first iteration) into lax carries
+    return {n for n in out if not n.startswith("_d2s_")}
+
+
+def _unconvertible(nodes, *, loops_shield: bool) -> bool:
+    """True if ``nodes`` contain a construct that cannot be moved into an
+    extracted function without changing semantics: return; break/continue
+    binding to an OUTER loop (``loops_shield``: ones inside a nested loop
+    bind there and are fine); global/nonlocal declarations (a parameter
+    would shadow the outer binding); ``except ... as e`` (python unbinds
+    the name after the handler, so threading it out would crash)."""
+    found = False
+
+    def walk(node, in_loop):
+        nonlocal found
+        if found or isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, (ast.Return, ast.Global, ast.Nonlocal)):
+            found = True
+            return
+        if isinstance(node, ast.ExceptHandler) and node.name:
+            found = True
+            return
+        if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
+            found = True
+            return
+        nested = in_loop or (loops_shield
+                             and isinstance(node, (ast.For, ast.While)))
+        for child in ast.iter_child_nodes(node):
+            walk(child, nested)
+
+    for n in nodes:
+        walk(n, False)
+    return found
+
+
+def _contains(nodes, types_) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, types_):
+                return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _maybe_call(var: str) -> ast.expr:
+    # _jst.maybe(lambda: var)
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr="maybe",
+                           ctx=ast.Load()),
+        args=[ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=_name(var))],
+        keywords=[])
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx and type(ctx)()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _fn_def(name: str, params: Sequence[str], body, returns: Sequence[str]):
+    # returns are maybe-wrapped: a carried name may have been del'd (or
+    # conditionally bound) inside the block; it comes back as UNDEF rather
+    # than crashing the synthesized return
+    ret = ast.Tuple(elts=[_maybe_call(r) for r in returns], ctx=ast.Load())
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[], kwonlyargs=[], kw_defaults=[], defaults=[],
+            args=[ast.arg(arg=p) for p in params]),
+        body=list(body) + [ast.Return(value=ret)],
+        decorator_list=[])
+
+
+def _jst_call(helper: str, args) -> ast.Call:
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr=helper,
+                           ctx=ast.Load()),
+        args=list(args), keywords=[])
+
+
+def _result_stmt(carried, call: ast.Call) -> ast.stmt:
+    if carried:
+        return ast.Assign(targets=[_tuple_of(carried, ast.Store())],
+                          value=call)
+    return ast.Expr(value=call)
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    """Bottom-up statement rewrite of If/While/For into _jst dispatch."""
+
+    def __init__(self):
+        self.changed = False
+        self._n = 0
+
+    def _uid(self) -> int:
+        self._n += 1
+        return self._n
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _unconvertible(node.body + node.orelse, loops_shield=True):
+            return node
+        carried = sorted(_assigned_names(node.body + node.orelse))
+        uid = self._uid()
+        tname, fname = f"_d2s_true_{uid}", f"_d2s_false_{uid}"
+        tdef = _fn_def(tname, carried, node.body, carried)
+        fdef = _fn_def(fname, carried, node.orelse or [ast.Pass()], carried)
+        call = _jst_call("convert_if", [
+            node.test, _name(tname), _name(fname),
+            ast.Tuple(elts=[_maybe_call(c) for c in carried],
+                      ctx=ast.Load())])
+        self.changed = True
+        return [tdef, fdef, _result_stmt(carried, call)]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if (node.orelse or _unconvertible(node.body, loops_shield=True)
+                # a walrus in the test would bind inside the extracted
+                # test_fn and never reach the body/enclosing scope
+                or _contains([node.test], ast.NamedExpr)):
+            return node
+        carried = sorted(_assigned_names(node.body) |
+                         _assigned_names([node.test]))
+        if not carried:
+            return node  # stateless while: nothing to thread, leave as-is
+        uid = self._uid()
+        test_name, body_name = f"_d2s_wtest_{uid}", f"_d2s_wbody_{uid}"
+        tdef = ast.FunctionDef(
+            name=test_name,
+            args=ast.arguments(
+                posonlyargs=[], kwonlyargs=[], kw_defaults=[], defaults=[],
+                args=[ast.arg(arg=p) for p in carried]),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        bdef = _fn_def(body_name, carried, node.body, carried)
+        call = _jst_call("convert_while", [
+            _name(test_name), _name(body_name),
+            ast.Tuple(elts=[_maybe_call(c) for c in carried],
+                      ctx=ast.Load())])
+        self.changed = True
+        return [tdef, bdef, _result_stmt(carried, call)]
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or _unconvertible(node.body, loops_shield=True)):
+            return node
+        target = node.target.id
+        carried = sorted(_assigned_names(node.body) - {target})
+        uid = self._uid()
+        body_name = f"_d2s_fbody_{uid}"
+        bdef = _fn_def(body_name, [target] + carried, node.body, carried)
+        it = node.iter
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            it = _jst_call("make_range", it.args)
+        call = _jst_call("convert_for", [
+            it, _name(body_name),
+            ast.Tuple(elts=[_maybe_call(c) for c in carried],
+                      ctx=ast.Load())])
+        self.changed = True
+        return [bdef, _result_stmt(carried, call)]
+
+
+# --------------------------------------------------------------- driver
+def convert_control_flow(fn):
+    """Return ``fn`` rewritten so tensor-dependent control flow lowers to
+    lax ops; returns ``fn`` unchanged when there is nothing to convert or
+    its source is unavailable (lambdas, C extensions, exec'd code)."""
+    if getattr(fn, "__d2s_converted__", False) or \
+            getattr(fn, "__not_to_static__", False):
+        return fn
+    if hasattr(fn, "__wrapped__"):
+        # a functools.wraps wrapper: getsource would see through to the
+        # inner function and the rebuild would silently drop the wrapper
+        return fn
+    if (inspect.isgeneratorfunction(fn) or inspect.iscoroutinefunction(fn)
+            or inspect.isasyncgenfunction(fn)):
+        return fn  # yields/awaits cannot be moved into extracted fns
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    # only the conversion entry points may be stripped from the source;
+    # any other decorator's behavior would be silently lost in the rebuild
+    _SAFE_DECOS = {"to_static", "jit", "not_to_static"}
+
+    def _deco_tail(d):
+        while isinstance(d, ast.Call):
+            d = d.func
+        return d.attr if isinstance(d, ast.Attribute) else \
+            d.id if isinstance(d, ast.Name) else None
+
+    if any(_deco_tail(d) not in _SAFE_DECOS for d in fdef.decorator_list):
+        return fn
+    if _contains([fdef], (ast.Yield, ast.YieldFrom, ast.Await)):
+        return fn
+    # zero-arg super() / __class__ need the compiler's implicit class cell,
+    # which the factory rebuild cannot reproduce
+    for sub in ast.walk(fdef):
+        if isinstance(sub, ast.Name) and sub.id in ("super", "__class__"):
+            return fn
+    fdef.decorator_list = []  # the conversion entry must not re-apply
+    transformer = _CtrlFlowTransformer()
+    fdef = transformer.visit(fdef)
+    if not transformer.changed:
+        return fn
+
+    # wrap in a factory taking the original free variables, so the rebuilt
+    # function keeps its closure bindings (cell contents snapshotted)
+    freevars = fn.__code__.co_freevars
+    factory_name = "_d2s_factory"
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=ast.arguments(
+            posonlyargs=[], kwonlyargs=[], kw_defaults=[], defaults=[],
+            args=[ast.arg(arg=v) for v in freevars]),
+        body=[fdef, ast.Return(value=_name(fdef.name))],
+        decorator_list=[])
+    module = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(module)
+    try:
+        code = compile(module, filename=f"<dy2static:{fn.__qualname__}>",
+                       mode="exec")
+    except SyntaxError:  # construct we mis-rebuilt: keep original behavior
+        _log.warning("dy2static: could not recompile %s; control flow "
+                     "stays trace-only", fn.__qualname__)
+        return fn
+    glb = dict(fn.__globals__)
+    from . import dy2static as _self
+
+    glb["_jst"] = _self
+    exec(code, glb)
+    cells = [c.cell_contents for c in (fn.__closure__ or ())]
+    new_fn = glb[factory_name](*cells)
+    functools.update_wrapper(new_fn, fn)
+    new_fn.__d2s_converted__ = True
+    return new_fn
+
+
+def convert_layer(layer) -> None:
+    """Patch ``layer.forward`` in place with its converted version (the
+    reference's StaticFunction patching on ``paddle.jit.to_static(layer)``)."""
+    fwd = type(layer).forward
+    conv = convert_control_flow(fwd)
+    if conv is not fwd:
+        layer.forward = types.MethodType(conv, layer)
