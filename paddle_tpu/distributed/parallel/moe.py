@@ -100,6 +100,68 @@ def switch_gating(logits, capacity: int, noise_key=None, jitter_eps=0.01):
 GATES = {"gshard": top2_gating, "top2": top2_gating, "switch": switch_gating,
          "top1": switch_gating, "naive": switch_gating}
 
+GATE_TOPK = {"gshard": 2, "top2": 2, "switch": 1, "top1": 1, "naive": 1}
+
+
+# -------------------------------------------------- sparse (all2all) path
+def _route_topk(logits, k: int, noise_key=None, jitter_eps: float = 0.01):
+    """Top-k routing: renormalized gate weights + expert ids per token and
+    the per-expert load statistics (density of top-1 assignments, mean
+    gate probability) whose product is the gshard aux loss. ``noise_key``
+    applies the switch-gate training jitter (parity with
+    :func:`switch_gating`)."""
+    if noise_key is not None:
+        noise = jax.random.uniform(noise_key, logits.shape,
+                                   minval=1 - jitter_eps,
+                                   maxval=1 + jitter_eps)
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [S, E]
+    g, e_idx = jax.lax.top_k(probs, k)                           # [S, k]
+    if k > 1:  # gshard renormalizes top-k gates; switch (k=1) keeps raw prob
+        g = g / jnp.maximum(jnp.sum(g, axis=-1, keepdims=True), 1e-9)
+    E = logits.shape[-1]
+    mask1 = jax.nn.one_hot(e_idx[:, 0], E, dtype=jnp.float32)
+    density = jnp.mean(mask1, axis=0)       # [E]
+    proxy = jnp.mean(probs, axis=0)         # [E]
+    return g, e_idx, density, proxy
+
+
+def _dispatch_buffers(tokens, e_idx, capacity: int, E: int):
+    """Scatter routed tokens into per-expert capacity buffers.
+
+    Unlike the dense GShard formulation this never materializes a
+    [S, E, C] one-hot — memory is O(S*d + E*C*d), which is what lets
+    E scale (reference ``global_scatter_op.cu.cc`` moves only routed
+    tokens for the same reason). Slots are assigned in CHOICE-MAJOR order
+    (all first choices, then all second choices), matching the dense
+    gate's drop priority: under capacity pressure a token's top-1 beats
+    any token's top-2 (``top2_gating``'s pos2-offset-by-count1).
+    Returns (buf [E, C, d], meta); meta addresses each routed copy's slot
+    for the combine gather, in the same choice-major order."""
+    S, k = e_idx.shape
+    d = tokens.shape[-1]
+    flat_e = e_idx.T.reshape(-1)                    # [k*S], choice-major
+    oh = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+    pos = jnp.cumsum(oh, axis=0) - 1                # queue position per expert
+    pos = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
+    keep = pos < capacity
+    slot = jnp.where(keep, pos, capacity)           # sentinel slot for drops
+    xk = jnp.tile(tokens, (k, 1))                   # [k*S, d], choice-major
+    buf = jnp.zeros((E, capacity + 1, d), tokens.dtype)
+    buf = buf.at[flat_e, slot].add(
+        xk * keep[:, None].astype(tokens.dtype))
+    return buf[:, :capacity], (flat_e, slot, keep)
+
+
+def _combine_buffers(buf, g, meta, S: int, k: int):
+    """Gather expert outputs back to token order, weighted by gates;
+    capacity-dropped copies contribute zero (gshard residual semantics)."""
+    flat_e, slot, keep = meta
+    pad = jnp.pad(buf, ((0, 0), (0, 1), (0, 0)))    # restore sentinel slot
+    vals = pad[flat_e, slot]                        # [k*S, d], choice-major
+    w = (g.T.reshape(-1) * keep).astype(vals.dtype)
+    return jnp.sum((vals * w[:, None]).reshape(k, S, -1), axis=0)
+
 
 class ExpertFFN(Layer):
     """Stacked expert FFNs: weights [E, d, d_hidden] sharded over "ep"."""
@@ -135,18 +197,111 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, gate: str = "gshard",
                  capacity_factor: float = 1.25, eval_capacity_factor: float = 2.0,
-                 activation: str = "gelu", group=None):
+                 activation: str = "gelu", group=None,
+                 dispatch_mode: str = "dense"):
+        """``dispatch_mode``: "dense" = GShard one-hot einsums (GSPMD
+        derives the collective; memory scales with S*E*C — right for small
+        E); "alltoall" = shard_map sparse path: per-device top-k routing,
+        scatter into [E, C, d] capacity buffers, ``lax.all_to_all`` of only
+        the routed tokens (reference ``global_scatter_op.cu.cc``) — right
+        for large E where the one-hot would dominate HBM."""
         super().__init__()
+        if dispatch_mode not in ("dense", "alltoall"):
+            raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
+        if gate not in GATES:
+            raise ValueError(
+                f"unknown gate {gate!r}; choose from {sorted(GATES)}")
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.eval_capacity_factor = eval_capacity_factor
         self.gate_name = gate
+        self.dispatch_mode = dispatch_mode
         self.gate_weight = self.create_parameter(
             (d_model, num_experts), default_initializer=XavierUniform())
         self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
         self.register_buffer("aux_loss", jnp.zeros((), jnp.float32), persistable=False)
 
     def forward(self, x):
+        if self.dispatch_mode == "alltoall":
+            return self._forward_a2a(x)
+        return self._forward_dense(x)
+
+    def _forward_a2a(self, x):
+        """Sparse dispatch: explicit shard_map over "ep". Tokens are
+        sharded over the batch dim; each device routes its S_local tokens
+        into per-expert capacity buffers and all_to_all's ONLY those."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        B, L, d = x.shape
+        E = self.num_experts
+        k = GATE_TOPK.get(self.gate_name, 2)
+        factor = (self.capacity_factor if self.training
+                  else self.eval_capacity_factor)
+        mesh = get_mesh()
+        ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        if B % ep or E % ep:
+            raise ValueError(
+                f"alltoall dispatch needs batch ({B}) and num_experts "
+                f"({E}) divisible by the ep axis ({ep})")
+        s_local = (B // ep) * L
+        # same factor semantics as the dense gate: capacity counts TOKENS
+        # per expert, shared across the k choices (top2_gating seats both
+        # choices in one per-expert queue)
+        capacity = max(int(math.ceil(s_local / E * factor)), 4)
+        gate_w = self.gate_weight.astype(x.dtype)
+        ex = self.experts
+        jitter_key = (take_rng_key("gumbel")
+                      if self.training and self.gate_name in
+                      ("switch", "top1", "naive") else None)
+
+        def local_fn(xs, gate_w, w1, b1, w2, b2):
+            # xs [B_local, L, d]; expert weights are this device's block
+            tokens = xs.reshape(-1, d)
+            logits = tokens @ gate_w
+            nk = jitter_key
+            if nk is not None and ep > 1:
+                nk = jax.random.fold_in(nk, jax.lax.axis_index("ep"))
+            g, e_idx, density, proxy = _route_topk(logits, k, noise_key=nk)
+            buf, meta = _dispatch_buffers(tokens, e_idx, capacity, E)
+            if ep > 1:
+                e_loc = E // ep
+                buf = jax.lax.all_to_all(buf, "ep", split_axis=0,
+                                         concat_axis=0, tiled=True)
+                recv = (buf.reshape(ep, e_loc, capacity, d)
+                        .transpose(1, 0, 2, 3).reshape(e_loc, -1, d))
+            else:
+                recv = buf
+            act = getattr(F, ex._activation)
+            h = act(jnp.einsum("ecd,edh->ech", recv, w1) + b1)
+            out = jnp.einsum("ech,ehd->ecd", h, w2) + b2
+            if ep > 1:
+                e_loc = E // ep
+                out = (out.reshape(e_loc, ep, capacity, d)
+                       .transpose(1, 0, 2, 3).reshape(E, capacity, d))
+                out = jax.lax.all_to_all(out, "ep", split_axis=0,
+                                         concat_axis=0, tiled=True)
+                # GLOBAL load statistics (mean over all tokens, not mean of
+                # per-shard aux scalars): matches the dense gate's aux
+                density = jax.lax.pmean(density, "ep")
+                proxy = jax.lax.pmean(proxy, "ep")
+            aux = jnp.mean(density * proxy) * (E * E)
+            y = _combine_buffers(out, g, meta, tokens.shape[0], k)
+            return y.reshape(xs.shape), aux
+
+        if ep == 1:
+            # no mesh / single ep shard: same math, no collective
+            out, aux = local_fn(x, gate_w, ex.w1, ex.b1, ex.w2, ex.b2)
+        else:
+            fn = shard_map(
+                local_fn, mesh=mesh,
+                in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+                out_specs=(P("ep"), P()), check_vma=False)
+            out, aux = fn(x, gate_w, ex.w1, ex.b1, ex.w2, ex.b2)
+        self.aux_loss = aux
+        return out
+
+    def _forward_dense(self, x):
         B, L, d = x.shape
         S = B * L
         E = self.num_experts

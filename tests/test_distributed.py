@@ -268,6 +268,104 @@ def test_moe_expert_parallel(mesh8):
     set_mesh(None)
 
 
+def test_moe_alltoall_parity_dense():
+    """Sparse all2all dispatch vs the dense GShard einsums: identical
+    weights + generous capacity (no drops) must give matching outputs
+    (VERDICT r3 item 4; reference global_scatter_op.cu.cc)."""
+    from paddle_tpu.distributed.parallel.moe import MoELayer
+
+    for gate in ("gshard", "switch"):
+        pt.seed(3)
+        dense = MoELayer(d_model=16, d_hidden=32, num_experts=4, gate=gate,
+                         eval_capacity_factor=8.0)
+        sparse = MoELayer(d_model=16, d_hidden=32, num_experts=4, gate=gate,
+                          eval_capacity_factor=8.0,
+                          dispatch_mode="alltoall")
+        sparse.set_state_dict(dense.state_dict())
+        dense.eval()
+        sparse.eval()
+        x = pt.randn([2, 12, 16])
+        np.testing.assert_allclose(np.asarray(dense(x)),
+                                   np.asarray(sparse(x)),
+                                   rtol=2e-5, atol=2e-5, err_msg=gate)
+        np.testing.assert_allclose(float(dense.aux_loss),
+                                   float(sparse.aux_loss), rtol=1e-5)
+
+
+def test_moe_alltoall_parity_under_drops():
+    """Capacity pressure: the sparse path's choice-major slot order must
+    reproduce the dense gate's drop priority (every top-1 seats before any
+    top-2), so outputs match even when tokens are dropped."""
+    from paddle_tpu.distributed.parallel.moe import MoELayer
+
+    pt.seed(9)
+    dense = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                     eval_capacity_factor=1.0)
+    sparse = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                      eval_capacity_factor=1.0, dispatch_mode="alltoall")
+    sparse.set_state_dict(dense.state_dict())
+    dense.eval()
+    sparse.eval()
+    x = pt.randn([2, 32, 16])
+    np.testing.assert_allclose(np.asarray(dense(x)), np.asarray(sparse(x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_alltoall_ep2_parity(mesh8):
+    """2-way expert parallelism: the shard_map all2all path matches the
+    dense path on the same weights."""
+    from paddle_tpu.distributed.parallel.moe import MoELayer
+
+    m = init_mesh(ep=2, dp=4)
+    with mesh_scope(m):
+        pt.seed(4)
+        dense = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                         eval_capacity_factor=8.0)
+        sparse = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                          eval_capacity_factor=8.0,
+                          dispatch_mode="alltoall")
+        sparse.set_state_dict(dense.state_dict())
+        dense.eval()
+        sparse.eval()
+        x = pt.randn([4, 8, 16])
+        np.testing.assert_allclose(np.asarray(dense(x)),
+                                   np.asarray(sparse(x)),
+                                   rtol=2e-5, atol=2e-5)
+        # aux loss is the GLOBAL statistic even under ep sharding
+        np.testing.assert_allclose(float(dense.aux_loss),
+                                   float(sparse.aux_loss), rtol=1e-5)
+    set_mesh(None)
+
+
+def test_moe_alltoall_ep8_trains(mesh8):
+    """Large-E regime on the full virtual mesh: ep=8, E=16 — forward,
+    grads, and capacity-drop path all exercised."""
+    from paddle_tpu.distributed.parallel.moe import MoELayer
+    from paddle_tpu.nn import functional_call, param_state
+
+    m = init_mesh(ep=8)
+    with mesh_scope(m):
+        pt.seed(5)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=16,
+                       capacity_factor=1.0, dispatch_mode="alltoall")
+        x = pt.randn([8, 8, 16])
+        out = moe(x)
+        assert out.shape == (8, 8, 16)
+        assert np.isfinite(np.asarray(out)).all()
+        assert float(moe.aux_loss) > 0
+
+        params = param_state(moe)
+
+        def loss(p):
+            o, _ = functional_call(moe, p, {}, x)
+            return jnp.sum(o ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert float(jnp.abs(grads["gate_weight"]).sum()) > 0
+        assert float(jnp.abs(grads["experts.w1"]).sum()) > 0
+    set_mesh(None)
+
+
 # ------------------------------------------------------------ ring attention
 def test_ring_attention_matches_full():
     from paddle_tpu.distributed.parallel.sequence_parallel import (
