@@ -27,6 +27,17 @@ from .framework.random import (  # noqa: F401
     default_generator, get_rng_state, next_key, seed, set_rng_state,
 )
 from .framework.io import load, save  # noqa: F401
+from .framework.compat import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, LazyGuard, NPUPlace, TPUPlace,
+    array_length, array_read, array_write, batch, check_shape,
+    create_array, create_parameter, disable_signal_handler, disable_static,
+    dtype, enable_static, in_dynamic_mode, index_add_, is_grad_enabled,
+    set_grad_enabled,
+)
+from .framework.random import (  # noqa: F401
+    get_rng_state as get_cuda_rng_state,  # device RNG collapses to one
+    set_rng_state as set_cuda_rng_state,
+)
 from .framework.flags import get_flags, set_flags  # noqa: F401
 from .framework.debugging import check_numerics  # noqa: F401
 from .framework.jit import EvalStep, TrainStep  # noqa: F401
@@ -40,6 +51,7 @@ from . import signal  # noqa: F401
 from . import vision  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
+from . import strings  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 from . import optimizer  # noqa: F401
@@ -130,3 +142,9 @@ def device_count() -> int:
 
 
 __version__ = "0.1.0"
+
+# late aliases (kept last: `bool` would shadow the builtin above)
+from .eager import Tensor  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+
+bool = bool_  # noqa: F401,A001  — paddle.bool dtype name

@@ -1,2 +1,3 @@
 from . import (localsgd, moe, mp_layers, pipeline, recompute,  # noqa: F401
                sequence_parallel)
+from .data_parallel import DataParallel  # noqa: F401

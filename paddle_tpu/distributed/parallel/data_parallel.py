@@ -1,0 +1,47 @@
+"""DataParallel wrapper (API parity).
+
+Reference: dygraph ``DataParallel``
+(``python/paddle/fluid/dygraph/parallel.py:457``) wraps a Layer and
+installs the C++ ``Reducer`` (``paddle/fluid/imperative/reducer.h:129``)
+— bucketed fused allreduce overlapped with backward.
+
+TPU-native collapse: gradient synchronization is not a wrapper concern —
+batch-sharded ``jit`` (``DistributedTrainStep`` with ``batch_axes=("dp",)``)
+makes XLA insert and overlap the gradient all-reduce itself (GSPMD). This
+class therefore only preserves the reference's API shape so ported
+training scripts run unchanged: ``forward`` delegates, ``scale_loss`` is
+identity (the mean over the global batch already includes the dp factor),
+``no_sync`` is a no-op context (there is no per-step collective to
+suppress; gradient merge lives in ``TrainStep(grad_accum_steps=k)``).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ...nn.layer import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, state, *a, **kw):
+        return self._layers.set_state_dict(state, *a, **kw)

@@ -1,0 +1,273 @@
+"""Top-level API long tail: places, mode switches, tensor-array helpers.
+
+Reference parity for the remaining ``paddle.*`` exports
+(``python/paddle/__init__.py`` __all__): device Places
+(``python/paddle/fluid/core.py`` wrappers over ``paddle/fluid/platform/place.h``),
+static/dynamic mode switches (``python/paddle/fluid/framework.py``),
+grad-mode toggles (``python/paddle/framework/``), ``paddle.batch``
+(``python/paddle/batch.py``), LoDTensorArray ops
+(``python/paddle/tensor/array.py``), and ``check_shape``
+(``python/paddle/fluid/layers/utils.py:453``).
+
+TPU-native collapses: a Place is a thin name tag resolved against
+``jax.devices()`` (PJRT owns placement); LoDTensorArray is a Python list
+(jax traces Python directly, so array_write/read need no graph ops);
+static mode is a flag only — programs are always traced functions.
+"""
+from __future__ import annotations
+
+import builtins
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "NPUPlace", "TPUPlace",
+    "enable_static", "disable_static", "in_dynamic_mode",
+    "is_grad_enabled", "set_grad_enabled", "LazyGuard", "batch",
+    "check_shape", "create_parameter", "disable_signal_handler",
+    "create_array", "array_write", "array_read", "array_length",
+    "index_add_", "dtype",
+]
+
+
+# ------------------------------------------------------------------ places
+class _Place:
+    """Device tag; resolves lazily against jax.devices() (PJRT owns actual
+    placement — reference ``platform::Place`` carries much more because it
+    keys allocators; here it is identity only)."""
+
+    _backend: Optional[str] = None  # None = default backend
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def jax_device(self):
+        import jax
+
+        devs = (jax.devices() if self._backend is None
+                else jax.devices(self._backend))
+        return devs[self.device_id]
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(_Place):
+    _backend = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(_Place):
+    """On this stack the accelerator is the default jax backend (TPU);
+    CUDAPlace(n) keeps ported scripts running unchanged."""
+
+
+class TPUPlace(_Place):
+    pass
+
+
+class CUDAPinnedPlace(_Place):
+    _backend = "cpu"  # pinned host memory: host-side on PJRT
+
+
+class NPUPlace(_Place):
+    pass
+
+
+# ------------------------------------------------- static/dynamic switches
+_static_mode = [False]
+
+
+def enable_static():
+    """Flag-level parity: programs here are ALWAYS traced functions
+    compiled by XLA, so static mode changes nothing about execution —
+    only what ``in_dynamic_mode()`` reports."""
+    if not _static_mode[0]:
+        warnings.warn(
+            "paddle_tpu has one execution model (traced functions under "
+            "XLA); enable_static() only flips the mode flag", stacklevel=2)
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode() -> builtins.bool:
+    return not _static_mode[0]
+
+
+# --------------------------------------------------------------- grad mode
+def is_grad_enabled() -> builtins.bool:
+    """Whether the eager tape records (reference
+    ``paddle.is_grad_enabled``). jax.grad closures are unaffected — they
+    differentiate whatever they wrap."""
+    from ..eager import _grad_enabled
+
+    return _grad_enabled()
+
+
+class set_grad_enabled:
+    """Context manager / direct call, like paddle.set_grad_enabled."""
+
+    def __init__(self, mode: builtins.bool):
+        from ..eager import _grad_enabled, _state
+
+        self.prev = _grad_enabled()
+        _state.grad_enabled = builtins.bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        from ..eager import _state
+
+        _state.grad_enabled = self.prev
+        return False
+
+
+class LazyGuard:
+    """Reference ``paddle.LazyGuard`` defers parameter materialization to
+    first forward to avoid host-memory spikes on huge models. Here
+    parameters are jax arrays created on demand by the functional state
+    (no per-parameter CUDA malloc at definition time), so the guard has
+    nothing to defer; it is a documented no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ------------------------------------------------------------- misc utils
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Classic ``paddle.batch``: wrap a sample reader into a batch reader."""
+
+    def batched():
+        buf: List = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape) -> None:
+    """Validate a shape argument (reference
+    ``fluid/layers/utils.py:453``): ints (-1 allowed once per use for
+    inferred dims) or a 1-D integer tensor."""
+    import jax
+
+    if isinstance(shape, (list, tuple)):
+        for d in shape:
+            if isinstance(d, (int, np.integer)):
+                if d < -1:
+                    raise ValueError(f"invalid dim {d} in shape {shape}")
+            elif not isinstance(d, (jax.Array, np.ndarray)):
+                raise TypeError(f"shape dims must be int/tensor, got "
+                                f"{type(d).__name__}")
+    elif isinstance(shape, (jax.Array, np.ndarray)):
+        if np.asarray(shape).ndim != 1:
+            raise ValueError("shape tensor must be 1-D")
+    else:
+        raise TypeError(f"shape must be list/tuple/tensor, got "
+                        f"{type(shape).__name__}")
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone trainable parameter (reference
+    ``paddle.create_parameter``): an eager Tensor with grad history
+    enabled, initialized like ``nn.Layer.create_parameter``. ``name`` is
+    accepted for API parity but unused — there is no global variable scope
+    to register names into (jaxprs name nothing)."""
+    from ..eager import Tensor
+    from ..framework.dtype import convert_dtype
+    from ..nn.initializer import Constant, XavierUniform
+    from ..nn.layer import take_rng_key
+
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierUniform())
+    val = init(take_rng_key("params"), tuple(shape), convert_dtype(dtype))
+    t = Tensor(val)
+    t.stop_gradient = False
+    return t
+
+
+def disable_signal_handler() -> None:
+    """Reference installs SIGSEGV/SIGBUS handlers for C++ stack capture and
+    lets users disable them; this runtime installs none — no-op."""
+
+
+# ----------------------------------------------- LoDTensorArray (as list)
+def create_array(dtype: str = "float32", initialized_list=None) -> list:
+    """LoDTensorArray analogue: a Python list (tracing handles it)."""
+    return [] if initialized_list is None else list(initialized_list)
+
+
+def array_write(x, i, array: Optional[list] = None) -> list:
+    if array is None:
+        array = []
+    i = int(i)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(f"array_write index {i} beyond length {len(array)}")
+    return array
+
+
+def array_read(array: list, i):
+    return array[int(i)]
+
+
+def array_length(array: list):
+    import jax.numpy as jnp
+
+    return jnp.asarray(len(array), jnp.int32)
+
+
+def index_add_(x, index, axis, value, name=None):
+    """Inplace ``index_add``: mutates an eager Tensor's storage; on plain
+    arrays returns the updated value (jax arrays are immutable). Obeys the
+    tape's in-place invariant: mutating a grad-requiring tensor would make
+    recorded vjps silently stale, so it raises like the other ``_`` ops."""
+    from ..eager import Tensor, _grad_enabled
+    from ..ops.search import index_add
+
+    if isinstance(x, Tensor):
+        if _grad_enabled() and not x.stop_gradient:
+            raise RuntimeError(
+                "index_add_ on a tensor that requires grad would break the "
+                "recorded tape; use the functional index_add, detach() "
+                "first, or run under no_grad()")
+        x._data = index_add(x._data, index, axis, value)
+        return x
+    return index_add(x, index, axis, value)
+
+
+class dtype:
+    """``paddle.dtype`` callable: normalizes any dtype spec to numpy dtype
+    (the runtime's canonical form)."""
+
+    def __new__(cls, spec):
+        from ..framework.dtype import convert_dtype
+
+        return convert_dtype(spec)

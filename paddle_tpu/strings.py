@@ -1,0 +1,45 @@
+"""String-tensor op family.
+
+Reference parity: ``paddle/phi/kernels/strings/`` —
+``strings_lower_upper_kernel.h:1`` (``strings_lower``/``strings_upper``
+over ``StringTensor``) and the ``StringTensor`` type
+(``paddle/phi/core/string_tensor.h``).
+
+TPU-native: XLA has no string dtype, and the reference runs these kernels
+on CPU only anyway (strings never reach the accelerator). A "string
+tensor" here is a numpy array of dtype object/str on host; the ops are
+vectorized numpy, so they compose with the host-side serving pipeline
+(tokenizer -> int ids -> compiled program).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_string_tensor", "lower", "upper"]
+
+
+def to_string_tensor(strings) -> np.ndarray:
+    """List of python strings -> host string tensor (numpy object array)."""
+    return np.asarray(list(strings), dtype=object)
+
+
+def _map(x, fn):
+    arr = to_string_tensor(x) if not isinstance(x, np.ndarray) else x
+    return np.asarray([fn(s) for s in arr.reshape(-1)],
+                      dtype=object).reshape(arr.shape)
+
+
+def lower(x, use_utf8_encoding: bool = True) -> np.ndarray:
+    """``strings_lower``: python ``str.lower`` IS the UTF-8 aware path; the
+    reference's ``use_utf8_encoding=False`` variant is ASCII-only."""
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        c.lower() if ord(c) < 128 else c for c in s))
+
+
+def upper(x, use_utf8_encoding: bool = True) -> np.ndarray:
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        c.upper() if ord(c) < 128 else c for c in s))

@@ -4,7 +4,9 @@ Reference parity: ``python/paddle/text`` (dataset loaders and
 ``viterbi_decode``/``ViterbiDecoder``).
 """
 from .datasets import Conll05, Imdb, Imikolov, Movielens, UCIHousing
+from .tokenizer import FasterTokenizer, load_vocab
 from .viterbi_decode import ViterbiDecoder, viterbi_decode
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
-           "viterbi_decode", "ViterbiDecoder"]
+           "viterbi_decode", "ViterbiDecoder", "FasterTokenizer",
+           "load_vocab"]

@@ -1,0 +1,189 @@
+"""In-graph(-pipeline) BERT tokenizer: the faster_tokenizer analogue.
+
+Reference parity: ``paddle/fluid/operators/string/faster_tokenizer_op.cc``
+(+ ``faster_tokenizer_op.h``): a graph op holding the vocab as a VOCAB
+tensor, running basic+wordpiece tokenization inside the serving program so
+a saved model consumes RAW STRINGS and emits ``(input_ids,
+token_type_ids)``.
+
+TPU-native: strings cannot enter XLA, so "in-graph" becomes "in-pipeline":
+:class:`FasterTokenizer` is a Layer whose forward runs on host (numpy) and
+returns device-ready int32 batches. For serving parity a text Predictor
+composes it in front of a compiled program — the same single-artifact
+serve-raw-text contract, with the string stage pinned to host exactly
+where the reference pins its op (CPU-only kernel).
+"""
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..nn.layer import Layer
+
+__all__ = ["FasterTokenizer", "load_vocab"]
+
+
+def load_vocab(path: str) -> Dict[str, int]:
+    """vocab.txt (one token per line, id = line number) -> dict."""
+    vocab: Dict[str, int] = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            vocab[line.rstrip("\n")] = i
+    return vocab
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(ch: str) -> bool:
+    """CJK ideographs get split into single-char words (reference
+    BasicTokenizer::tokenize_chinese_chars — the op's primary use case is
+    Chinese BERT/ERNIE)."""
+    cp = ord(ch)
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+def _basic_tokenize(text: str, do_lower_case: bool) -> List[str]:
+    """BERT BasicTokenizer: clean, lowercase+strip accents, split on
+    whitespace and punctuation (reference ``BertTokenizer::BasicTokenizer``
+    in faster_tokenizer_op.h)."""
+    if do_lower_case:
+        text = text.lower()
+        text = unicodedata.normalize("NFD", text)
+        text = "".join(c for c in text if unicodedata.category(c) != "Mn")
+    out: List[str] = []
+    cur = []
+    for ch in text:
+        if ch.isspace():
+            if cur:
+                out.append("".join(cur))
+                cur = []
+        elif _is_punct(ch) or _is_cjk(ch):
+            if cur:
+                out.append("".join(cur))
+                cur = []
+            out.append(ch)
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _wordpiece(token: str, vocab: Dict[str, int], unk: str,
+               max_chars: int = 100) -> List[str]:
+    """Greedy longest-match-first wordpiece (reference
+    ``WordPieceTokenizer::Tokenize``)."""
+    if len(token) > max_chars:
+        return [unk]
+    pieces: List[str] = []
+    start = 0
+    while start < len(token):
+        end = len(token)
+        piece = None
+        while start < end:
+            sub = token[start:end]
+            if start > 0:
+                sub = "##" + sub
+            if sub in vocab:
+                piece = sub
+                break
+            end -= 1
+        if piece is None:
+            return [unk]
+        pieces.append(piece)
+        start = end
+    return pieces
+
+
+class FasterTokenizer(Layer):
+    """BERT tokenizer layer (reference ``FasterTokenizer`` python wrapper in
+    ``test_faster_tokenizer_op.py:69`` over ``faster_tokenizer_op.cc``).
+
+    ``forward(text, text_pair=None, ...)`` -> ``(input_ids,
+    token_type_ids)`` int32 arrays, one row per input string, padded to the
+    longest sequence in the batch (or ``max_seq_len`` when
+    ``pad_to_max_seq_len``).
+    """
+
+    def __init__(self, vocab: Dict[str, int], cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]",
+                 unk_token: str = "[UNK]"):
+        super().__init__()
+        self.vocab = dict(vocab)
+        self.cls_token, self.sep_token = cls_token, sep_token
+        self.pad_token, self.unk_token = pad_token, unk_token
+
+    def _encode_one(self, text: str, do_lower_case: bool,
+                    is_split_into_words: bool) -> List[int]:
+        words = ([text] if is_split_into_words
+                 else _basic_tokenize(text, do_lower_case))
+        ids: List[int] = []
+        for w in words:
+            for piece in _wordpiece(w, self.vocab, self.unk_token):
+                ids.append(self.vocab.get(piece,
+                                          self.vocab.get(self.unk_token, 0)))
+        return ids
+
+    def forward(self, text: Sequence[str],
+                text_pair: Optional[Sequence[str]] = None,
+                do_lower_case: bool = True, max_seq_len: int = -1,
+                pad_to_max_seq_len: bool = False,
+                is_split_into_words: bool = False
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        if isinstance(text, str):
+            text = [text]
+        if isinstance(text_pair, str):
+            text_pair = [text_pair]
+        if text_pair is not None and len(text_pair) != len(text):
+            raise ValueError("text and text_pair must align")
+        cls_id = self.vocab[self.cls_token]
+        sep_id = self.vocab[self.sep_token]
+        pad_id = self.vocab.get(self.pad_token, 0)
+
+        rows: List[List[int]] = []
+        segs: List[List[int]] = []
+        for i, t in enumerate(text):
+            a = self._encode_one(t, do_lower_case, is_split_into_words)
+            b = (self._encode_one(text_pair[i], do_lower_case,
+                                  is_split_into_words)
+                 if text_pair is not None else None)
+            if max_seq_len and max_seq_len > 0:
+                # reference truncation: longest-first down to the budget
+                # (clamped at 0: max_seq_len smaller than the special
+                # tokens leaves no room for content at all)
+                budget = max(
+                    max_seq_len - 2 - (1 if b is not None else 0), 0)
+                if b is None:
+                    a = a[:budget]
+                else:
+                    while len(a) + len(b) > budget and (a or b):
+                        (a if len(a) >= len(b) else b).pop()
+            ids = [cls_id] + a + [sep_id]
+            seg = [0] * len(ids)
+            if b is not None:
+                ids += b + [sep_id]
+                seg += [1] * (len(b) + 1)
+            rows.append(ids)
+            segs.append(seg)
+
+        width = (max_seq_len if (pad_to_max_seq_len and max_seq_len > 0)
+                 else max(len(r) for r in rows))
+        input_ids = np.full((len(rows), width), pad_id, np.int32)
+        token_type = np.zeros((len(rows), width), np.int32)
+        for i, (r, s) in enumerate(zip(rows, segs)):
+            # width can undercut even the special tokens (max_seq_len < 2):
+            # clip rather than overflow the padded buffer
+            r, s = r[:width], s[:width]
+            input_ids[i, :len(r)] = r
+            token_type[i, :len(s)] = s
+        return input_ids, token_type
