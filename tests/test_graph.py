@@ -397,3 +397,26 @@ def test_khop_sampler_from_store_local_vs_sharded(graph_cluster):
     h = G.send_u_recv(jnp.asarray(f_l), jnp.asarray(es_l), jnp.asarray(ed_l),
                       "mean", out_size=idx_l.size)
     assert np.asarray(h).shape == (idx_l.size, 16)
+
+
+def test_graph_bench_tool_smoke():
+    """tools/graph_bench.py (the scale-proof harness) stays runnable: tiny
+    graph, all sections produce positive numbers."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graph_bench.py"),
+         "--edges", "20000", "--iters", "3"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo)
+    assert out.returncode == 0, out.stderr[-800:]
+    data = json.loads(out.stdout.strip().splitlines()[-1])
+    for section in ("single_host", "two_shard"):
+        for metric, v in data[section].items():
+            assert v > 0, (section, metric, data)
+    assert data["feed_train_overlap"]["overlapped_s"] > 0

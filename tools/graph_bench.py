@@ -123,10 +123,20 @@ def bench_overlap(g, steps: int = 30, batch_size: int = 4096):
         return emb - 0.1 * grad, loss
 
     def batches():
-        gen = iter(GraphDataGenerator(g, batch_size=batch_size, walk_len=8,
-                                      window=2, num_neg=4, seed=0))
-        for _ in range(steps):
-            yield next(gen)
+        gen = GraphDataGenerator(g, batch_size=batch_size, walk_len=8,
+                                 window=2, num_neg=4, seed=0)
+        count = 0
+        while count < steps:  # small graphs need several epochs per run
+            produced = False
+            for b in gen:
+                produced = True
+                yield b
+                count += 1
+                if count >= steps:
+                    return
+            if not produced:
+                raise RuntimeError("graph too small for one batch; lower "
+                                   "batch_size or raise --edges")
 
     # warm the compile outside both timed regions
     c, x, negs = next(iter(batches()))

@@ -71,6 +71,17 @@ def build_suite():
         jax.grad(lambda q: flash_attention(q, q, q, causal=True)
                  .astype(jnp.float32).sum()), (q,))
 
+    # L=4096: the shape where should_use_flash engages the Pallas kernel
+    # on TPU — a silent fallback to the O(L^2) XLA path is exactly the
+    # order-of-magnitude regression this gate exists to trip on
+    # (VERDICT r3 item 9)
+    q4 = bf16(1, 8, 4096, 64)
+    suite["flash_attn_fwd_L4096"] = (
+        lambda q: flash_attention(q, q, q, causal=True), (q4,))
+    suite["flash_attn_grad_L4096"] = (
+        jax.grad(lambda q: flash_attention(q, q, q, causal=True)
+                 .astype(jnp.float32).sum()), (q4,))
+
     logits = bf16(8 * 1024, 50304)
     labels = jnp.asarray(rng.integers(0, 50304, 8 * 1024))
     suite["vocab_xent"] = (
@@ -116,15 +127,23 @@ def compare(baseline_path, threshold):
               f"the baseline with --save on this machine", flush=True)
         return 2  # distinct from regression (1): no comparable baseline
     failed = []
+    new_ops = []
     for op, ms in cur["ms"].items():
         ref = base["ms"].get(op)
         if ref is None:
+            # visible, not silent: a suite addition is uncompared until
+            # the baseline is regenerated — say so every run
+            print(f"{op:24s} {'—':>9s} -> {ms:9.3f} ms  NEW (no baseline; "
+                  f"regenerate with --save)")
+            new_ops.append(op)
             continue
         ratio = ms / ref
         status = "REGRESSED" if ratio > 1 + threshold else "ok"
         print(f"{op:24s} {ref:9.3f} -> {ms:9.3f} ms  ({ratio:5.2f}x) {status}")
         if ratio > 1 + threshold:
             failed.append(op)
+    if new_ops:
+        print(f"NOTE: {len(new_ops)} op(s) not in baseline: {new_ops}")
     if failed:
         print(f"FAIL: {len(failed)} op(s) regressed past "
               f"{threshold:.0%}: {failed}")
