@@ -34,7 +34,7 @@ __all__ = ["to_static", "save", "load", "TranslatedLayer", "InputSpec",
            "not_to_static"]
 
 
-def to_static(fn=None, **kwargs):
+def to_static(fn=None, *, loop_bound=None, **kwargs):
     """``paddle.jit.to_static``: dy2static conversion + compilation.
 
     Tensor-dependent ``if``/``while``/``for`` in the function (or the
@@ -42,18 +42,24 @@ def to_static(fn=None, **kwargs):
     ``scan`` first (:mod:`paddle_tpu.jit.dy2static` — the
     ``program_translator.py`` analogue), then the result is jit-compiled.
     Code without data-dependent control flow passes through unchanged.
+
+    ``loop_bound=N`` bakes a max trip count into converted ``while``
+    loops, lowering them to a masked ``lax.scan`` that supports
+    reverse-mode grad (the ``while_grad`` analogue) — use it to TRAIN
+    while-based models; plain ``lax.while_loop`` is forward-only.
     """
     if fn is None:
         import functools
 
-        return functools.partial(to_static, **kwargs)
+        return functools.partial(to_static, loop_bound=loop_bound, **kwargs)
     from .dy2static import convert_control_flow, convert_layer
 
     if isinstance(fn, Layer):
-        convert_layer(fn)
+        convert_layer(fn, loop_bound=loop_bound)
         return jit(fn, **kwargs)
     if callable(fn):
-        return jit(convert_control_flow(fn), **kwargs)
+        return jit(convert_control_flow(fn, loop_bound=loop_bound),
+                   **kwargs)
     return jit(fn, **kwargs)
 
 

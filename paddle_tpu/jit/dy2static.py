@@ -25,10 +25,11 @@ block keep that block un-converted; a ``for`` loop's target variable read
 AFTER the loop sees its pre-loop value when the loop was converted;
 foreign decorators / generators / ``super()`` / walrus-in-while-test skip
 conversion. And one inherited from XLA itself: reverse-mode grad through
-a converted ``while`` (dynamic trip count) is unsupported — jax raises a
-clear error; bound the loop (``for i in range(k)``) for training, the
-same advice the reference gives for RNN-style while loops it cannot
-differentiate efficiently.
+a converted ``while`` (dynamic trip count) is unsupported by
+``lax.while_loop`` — either bound the loop statically
+(``for i in range(k)``) or convert with ``to_static(fn, loop_bound=N)``,
+which lowers whiles to a differentiable masked ``lax.scan`` (the
+``while_grad`` analogue).
 """
 from __future__ import annotations
 
@@ -115,10 +116,45 @@ def convert_if(pred, true_fn, false_fn, operands: tuple):
                     tuple(operands[i] for i in defined))
 
 
-def convert_while(test_fn, body_fn, init: tuple):
+def _bounded_while(test_fn, body_fn, init: tuple, bound: int):
+    """Masked fixed-length scan with while semantics (differentiable).
+
+    Two selects per step ("double where"): the body also RUNS on the
+    frozen post-exit state for the masked tail steps, where it may be
+    numerically undefined (1/x at a converged root, sqrt of a crossed
+    threshold); masking only the OUTPUT would still backprop 0 * NaN
+    through the dead branch. Feeding the body the initial state whenever
+    the step is dead keeps the dead branch finite (the body was
+    evaluated on init by the first real step), so its zero cotangent
+    stays zero.
+    """
+    init_t = tuple(init)
+
+    def step(state, _):
+        alive = _as_pred(test_fn(*state))
+        safe = jax.tree_util.tree_map(
+            lambda s, i: jnp.where(alive, s, i), tuple(state), init_t)
+        new_state = tuple(body_fn(*safe))
+        sel = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(alive, n, o), new_state, tuple(state))
+        return sel, None
+
+    out, _ = lax.scan(step, init_t, None, length=bound)
+    return tuple(out)
+
+
+def convert_while(test_fn, body_fn, init: tuple, bound=None):
     """``while`` dispatch: python loop when the condition is concrete
-    (unrolls under trace like the original), ``lax.while_loop`` when the
-    condition is data-dependent."""
+    (unrolls under trace like the original); ``lax.while_loop`` when the
+    condition is data-dependent; bounded masked scan (reverse-mode
+    differentiable) when the conversion was built with
+    ``to_static(..., loop_bound=N)``.
+
+    The bound is BAKED into the converted function — deliberately not
+    ambient state: a context manager read at trace time would not be part
+    of any jit cache key, so cached executables would silently keep (or
+    miss) the bound depending on call order.
+    """
     carry = tuple(init)
     first = test_fn(*carry)
     if not _is_traced(first):
@@ -126,6 +162,8 @@ def convert_while(test_fn, body_fn, init: tuple):
             carry = tuple(body_fn(*carry))
             first = test_fn(*carry)
         return carry
+    if bound is not None:
+        return _bounded_while(test_fn, body_fn, carry, int(bound))
     return tuple(lax.while_loop(
         lambda c: _as_pred(test_fn(*c)),
         lambda c: tuple(body_fn(*c)), carry))
@@ -350,7 +388,8 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         call = _jst_call("convert_while", [
             _name(test_name), _name(body_name),
             ast.Tuple(elts=[_maybe_call(c) for c in carried],
-                      ctx=ast.Load())])
+                      ctx=ast.Load()),
+            _name("_d2s_loop_bound")])
         self.changed = True
         return [tdef, bdef, _result_stmt(carried, call)]
 
@@ -377,10 +416,18 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
 
 
 # --------------------------------------------------------------- driver
-def convert_control_flow(fn):
+def convert_control_flow(fn, loop_bound=None):
     """Return ``fn`` rewritten so tensor-dependent control flow lowers to
     lax ops; returns ``fn`` unchanged when there is nothing to convert or
-    its source is unavailable (lambdas, C extensions, exec'd code)."""
+    its source is unavailable (lambdas, C extensions, exec'd code).
+
+    ``loop_bound``: bake a max iteration count into every converted
+    ``while`` — it lowers to a masked ``lax.scan`` of that length, which
+    IS reverse-mode differentiable (the reference's ``while_grad``
+    equivalent), at the cost of always spending ``loop_bound`` steps of
+    compute. Loops that would run longer are truncated — size it like the
+    reference sizes an unrolled RNN length.
+    """
     if getattr(fn, "__d2s_converted__", False) or \
             getattr(fn, "__not_to_static__", False):
         return fn
@@ -448,18 +495,21 @@ def convert_control_flow(fn):
     from . import dy2static as _self
 
     glb["_jst"] = _self
+    glb["_d2s_loop_bound"] = (None if loop_bound is None
+                              else int(loop_bound))
     exec(code, glb)
     cells = [c.cell_contents for c in (fn.__closure__ or ())]
     new_fn = glb[factory_name](*cells)
     functools.update_wrapper(new_fn, fn)
     new_fn.__d2s_converted__ = True
+    new_fn.__d2s_loop_bound__ = loop_bound
     return new_fn
 
 
-def convert_layer(layer) -> None:
+def convert_layer(layer, loop_bound=None) -> None:
     """Patch ``layer.forward`` in place with its converted version (the
     reference's StaticFunction patching on ``paddle.jit.to_static(layer)``)."""
     fwd = type(layer).forward
-    conv = convert_control_flow(fwd)
+    conv = convert_control_flow(fwd, loop_bound=loop_bound)
     if conv is not fwd:
         layer.forward = types.MethodType(conv, layer)

@@ -209,6 +209,12 @@ def test_sample_with_features_local_and_dist(graph_cluster):
 def test_dist_graph_feeds_deepwalk_generator(graph_cluster):
     """GraphDataGenerator runs unchanged over the sharded client (the
     PGLBox walk-based feed over the distributed engine)."""
+    if not getattr(graph_cluster, "_built", False):
+        # self-sufficient under -k subset runs: earlier tests normally
+        # populate the module-scoped cluster, but must not be required
+        src, dst = random_coo()
+        graph_cluster.add_edges(src, dst)
+        graph_cluster.build()
     gen = GraphDataGenerator(graph_cluster, batch_size=32, walk_len=4,
                              window=2, num_neg=3, seed=1)
     batches = list(gen)
