@@ -7,12 +7,19 @@ families live under ``paddle_tpu.models`` (vision re-exports them at
 ``paddle_tpu.vision.models``).
 """
 from . import bert  # noqa: F401
+from . import ernie  # noqa: F401
 from . import gpt  # noqa: F401
+from . import llama  # noqa: F401
 from . import resnet  # noqa: F401
 from . import yolo  # noqa: F401
 from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, BertModel, bert_base,
                    bert_tiny)
+from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
+                    ErnieForSequenceClassification, ErnieModel,
+                    ernie_3_base, ernie_tiny)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_1p3b, gpt_tiny  # noqa: F401
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
+                    llama2_7b, llama_tiny)
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .yolo import YOLOv3  # noqa: F401

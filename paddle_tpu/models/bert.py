@@ -72,14 +72,18 @@ class BertEmbeddings(Layer):
                                     epsilon=cfg.layer_norm_epsilon)
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def _embed_sum(self, input_ids, token_type_ids):
+        """The input-sum subclasses extend (ERNIE adds a task addend)."""
         L = input_ids.shape[1]
         pos = jnp.arange(L)[None, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        h = (self.word_embeddings(input_ids)
-             + self.position_embeddings(pos)
-             + self.token_type_embeddings(token_type_ids))
+        return (self.word_embeddings(input_ids)
+                + self.position_embeddings(pos)
+                + self.token_type_embeddings(token_type_ids))
+
+    def forward(self, input_ids, token_type_ids=None):
+        h = self._embed_sum(input_ids, token_type_ids)
         return self.dropout(self.layer_norm(h))
 
 
@@ -147,46 +151,63 @@ class BertPooler(Layer):
 
 class BertModel(Layer):
     """Embeddings + encoder stack + pooler; forward returns
-    ``(sequence_output [B, L, H], pooled_output [B, H])``."""
+    ``(sequence_output [B, L, H], pooled_output [B, H])``.
+
+    ``embeddings_cls`` is the subclass hook ERNIE uses to swap in its
+    task-aware embeddings without copying the encoder wiring."""
+
+    embeddings_cls = BertEmbeddings
 
     def __init__(self, cfg: BertConfig):
         super().__init__()
         from ..nn.layers.containers import LayerList
 
         self.cfg = cfg
-        self.embeddings = BertEmbeddings(cfg)
+        self.embeddings = self.embeddings_cls(cfg)
         self.encoder = LayerList([BertLayer(cfg)
                                   for _ in range(cfg.num_layers)])
         self.pooler = BertPooler(cfg)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        if attention_mask is None:
-            attention_mask = (input_ids != self.cfg.pad_token_id).astype(
-                jnp.float32)
-        h = self.embeddings(input_ids, token_type_ids)
+    def _default_mask(self, input_ids):
+        return (input_ids != self.cfg.pad_token_id).astype(jnp.float32)
+
+    def _encode(self, h, attention_mask):
         for layer in self.encoder:
             h = layer(h, attention_mask)
         return h, self.pooler(h)
 
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is None:
+            attention_mask = self._default_mask(input_ids)
+        h = self.embeddings(input_ids, token_type_ids)
+        return self._encode(h, attention_mask)
+
 
 class BertForSequenceClassification(Layer):
     """The finetune head (BASELINE row 2): pooled output -> classes.
-    ``forward(input_ids, ...) -> logits``; with ``labels`` returns loss."""
+    ``forward(input_ids, ...) -> logits``; with ``labels`` returns loss.
+    ``_make_encoder`` is the subclass hook for encoder swaps (ERNIE)."""
 
     def __init__(self, cfg: BertConfig, num_classes: int = 2):
         super().__init__()
-        self.bert = BertModel(cfg)
+        self.bert = self._make_encoder(cfg)
         self.dropout = Dropout(cfg.hidden_dropout_prob)
         self.classifier = Linear(cfg.hidden_size, num_classes,
                                  weight_attr=Normal(std=cfg.initializer_range))
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
-                labels=None):
-        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+    def _make_encoder(self, cfg):
+        return BertModel(cfg)
+
+    def _classify(self, pooled, labels):
         logits = self.classifier(self.dropout(pooled))
         if labels is None:
             return logits
         return F.cross_entropy(logits, labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self._classify(pooled, labels)
 
 
 class BertForPretraining(Layer):
@@ -201,7 +222,7 @@ class BertForPretraining(Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.cfg = cfg
-        self.bert = BertModel(cfg)
+        self.bert = self._make_encoder(cfg)
         self.transform = Linear(cfg.hidden_size, cfg.hidden_size,
                                 weight_attr=Normal(std=cfg.initializer_range))
         self.transform_norm = LayerNorm(cfg.hidden_size,
@@ -209,9 +230,17 @@ class BertForPretraining(Layer):
         self.nsp = Linear(cfg.hidden_size, 2,
                           weight_attr=Normal(std=cfg.initializer_range))
 
+    def _make_encoder(self, cfg):
+        return BertModel(cfg)
+
     def forward(self, input_ids, mlm_positions, mlm_labels, nsp_labels=None,
                 token_type_ids=None, attention_mask=None):
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self._mlm_nsp_loss(seq, pooled, mlm_positions, mlm_labels,
+                                  nsp_labels)
+
+    def _mlm_nsp_loss(self, seq, pooled, mlm_positions, mlm_labels,
+                      nsp_labels=None):
         B = seq.shape[0]
         pos = jnp.clip(mlm_positions, 0, seq.shape[1] - 1)
         gathered = jnp.take_along_axis(

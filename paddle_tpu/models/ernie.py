@@ -1,0 +1,106 @@
+"""ERNIE family: BERT-style encoder with task-type embeddings.
+
+Reference parity: BASELINE.md row "ERNIE-3.0 / Llama-2-7B ... sharding-
+stage3 pretrain". Architecturally ERNIE (2.0/3.0 base) is the BERT
+encoder plus a task-type embedding in the input sum (continual multi-task
+pretraining) — the reference trains it through PaddleNLP on the same
+fleet machinery. Everything except that delta is SHARED with :mod:`.bert`
+via the subclass hooks (``embeddings_cls``, ``_make_encoder``,
+``_encode``/``_classify``/``_mlm_nsp_loss``): one encoder implementation,
+two families.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..nn.initializer import Normal
+from ..nn.layers.common import Embedding
+from .bert import (BertConfig, BertEmbeddings, BertForPretraining,
+                   BertForSequenceClassification, BertModel)
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForPretraining", "ernie_tiny", "ernie_3_base"]
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+def ernie_tiny(**kw) -> ErnieConfig:
+    return ErnieConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                       num_heads=4, max_position_embeddings=128, **kw)
+
+
+def ernie_3_base(**kw) -> ErnieConfig:
+    """ERNIE-3.0 base encoder shape: 40000-word-piece vocab, 2048
+    positions, 4 token types (the reference config values)."""
+    kw.setdefault("max_position_embeddings", 2048)
+    kw.setdefault("type_vocab_size", 4)
+    return ErnieConfig(vocab_size=40000, hidden_size=768, num_layers=12,
+                       num_heads=12, **kw)
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    """BERT input sum + task-type embedding (the ERNIE delta)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        self.use_task_id = cfg.use_task_id
+        if cfg.use_task_id:
+            self.task_type_embeddings = Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size,
+                weight_attr=Normal(std=cfg.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        h = self._embed_sum(input_ids, token_type_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = jnp.zeros_like(input_ids)
+            h = h + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class ErnieModel(BertModel):
+    """Task-aware embeddings over the shared BERT encoder stack."""
+
+    embeddings_cls = ErnieEmbeddings
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        if attention_mask is None:
+            attention_mask = self._default_mask(input_ids)
+        h = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        return self._encode(h, attention_mask)
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def _make_encoder(self, cfg):
+        return ErnieModel(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                              task_type_ids)
+        return self._classify(pooled, labels)
+
+
+class ErnieForPretraining(BertForPretraining):
+    """Knowledge-masked LM pretrain head: same gather-before-vocab MLM as
+    BERT (span masks arrive as mlm_positions — whole-entity spans in the
+    ERNIE recipe are a DATA property, not a model one), over the
+    task-aware encoder."""
+
+    def _make_encoder(self, cfg):
+        return ErnieModel(cfg)
+
+    def forward(self, input_ids, mlm_positions, mlm_labels, nsp_labels=None,
+                token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                                task_type_ids)
+        return self._mlm_nsp_loss(seq, pooled, mlm_positions, mlm_labels,
+                                  nsp_labels)

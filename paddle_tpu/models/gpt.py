@@ -28,7 +28,6 @@ from ..nn.initializer import Constant, Normal
 from ..nn.layer import Layer
 from ..nn.layers.norm import LayerNorm
 from ..nn.layers.common import Dropout
-from ..distributed.mesh import get_mesh, sharding
 from ..distributed.parallel.mp_layers import (
     ColumnParallelLinear,
     ParallelCrossEntropy,
@@ -37,7 +36,6 @@ from ..distributed.parallel.mp_layers import (
     parallel_matmul,
 )
 from ..distributed.parallel.recompute import recompute_wrap
-from ..kernels import flash_attention as fa
 
 
 @dataclass
@@ -90,41 +88,8 @@ def gpt_1p3b(**overrides) -> "GPTConfig":
     return GPTConfig(**cfg)
 
 
-def _constrain_seq(x, cfg):
-    """Between-block activation sharding: [dp, sp, mp-free] when sequence
-    parallel is on, else [dp, None, None]."""
-    mesh = get_mesh()
-    if mesh is None or x.ndim != 3:
-        return x
-    seq_axis = "sp" if (cfg.sequence_parallel and "sp" in mesh.shape) else None
-    batch_axes = tuple(a for a in ("dp", "sdp") if a in mesh.shape) or None
-    return jax.lax.with_sharding_constraint(
-        x, sharding(batch_axes, seq_axis, None, mesh=mesh))
-
-
-def causal_attention(q, k, v, dropout_p=0.0, training=True, use_flash=True):
-    """Causal self-attention on [B, L, H, D]; Pallas flash path when the
-    gate allows, XLA-fused softmax otherwise."""
-    p_drop = dropout_p if training else 0.0
-    if use_flash and fa.should_use_flash(q, k, None, p_drop):
-        if p_drop > 0.0:
-            from ..nn.layer import take_rng_key
-
-            seed = jax.random.randint(take_rng_key("dropout"), (), 0, 2**31 - 1)
-        else:
-            seed = 0
-        return fa.flash_attention_blhd(q, k, v, causal=True,
-                                       dropout_p=p_drop, seed=seed)
-    B, Lq, H, D = q.shape
-    Lk = k.shape[1]
-    scale = 1.0 / math.sqrt(D)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    mask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
-    s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    if dropout_p > 0.0 and training:
-        p = F.dropout(p, p=dropout_p, training=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+# shared decoder plumbing lives in lm_utils; legacy names kept for callers
+from .lm_utils import causal_attention, constrain_seq as _constrain_seq  # noqa: E402
 
 
 class GPTAttention(Layer):
@@ -228,19 +193,10 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
-class _BlockList(Layer):
-    def __init__(self, cfg: GPTConfig):
-        super().__init__()
-        self.cfg = cfg
-        for i in range(cfg.num_layers):
-            self.add_sublayer(str(i), GPTBlock(cfg))
+def _BlockList(cfg: GPTConfig):
+    from .lm_utils import DecoderBlockList
 
-    def forward(self, x):
-        for blk in self._sub_layers.values():
-            fn = (recompute_wrap(blk, policy=self.cfg.recompute_policy)
-                  if self.cfg.use_recompute else blk)
-            x = fn(x)
-        return x
+    return DecoderBlockList(cfg, GPTBlock)
 
 
 class GPTForCausalLM(Layer):
@@ -288,46 +244,22 @@ class GPTForCausalLM(Layer):
         return jnp.mean(per_tok)
 
     def chunked_lm_loss(self, h, labels, chunk=256):
-        """Head-projection + softmax-CE fused over sequence chunks.
-
-        The [B, L, vocab] logits tensor (the single largest HBM allocation in
+        """Head-projection + softmax-CE fused over sequence chunks: the
+        [B, L, vocab] logits tensor (the single largest HBM allocation in
         GPT pretrain — e.g. 1.5 GB per materialization at B=16, L=1024,
-        V=50304) is never formed: each chunk's logits live only inside a
-        ``jax.checkpoint`` region, so the backward recomputes them per chunk
-        instead of stashing them. Reference contrast:
-        ``c_softmax_with_cross_entropy_op.cu`` fuses softmax+CE but still
-        materializes full logits."""
-        hs = h[:, :-1]
-        ys = jnp.asarray(labels)[:, 1:]
-        B, Lm1, H = hs.shape
-        nchunk = -(-Lm1 // chunk)
-        pad = nchunk * chunk - Lm1
-        hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
-        ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-100)
-        # [nchunk, B, chunk, *]
-        hs = jnp.swapaxes(hs.reshape(B, nchunk, chunk, H), 0, 1)
-        ys = jnp.swapaxes(ys.reshape(B, nchunk, chunk), 0, 1)
+        V=50304) is never formed. Shared machinery in
+        :func:`..models.lm_utils.chunked_lm_loss`."""
+        from .lm_utils import chunked_lm_loss
+
         w = self._head_weight()
-        if w is None:
-            w = self.lm_head.weight
 
-        @jax.checkpoint
-        def chunk_losses(h_c, y_c):
+        def logits_fn(h_c):
             if self.cfg.tie_word_embeddings:
-                logits = parallel_matmul(h_c, w, transpose_y=True)
-            else:
-                logits = self.lm_head(h_c)
-            per_tok = self.parallel_ce(logits, y_c)
-            valid = (y_c != -100).astype(jnp.float32)
-            return jnp.sum(per_tok * valid), jnp.sum(valid)
+                return parallel_matmul(h_c, w, transpose_y=True)
+            return self.lm_head(h_c)
 
-        def body(carry, xs):
-            s, c = chunk_losses(*xs)
-            return (carry[0] + s, carry[1] + c), None
-
-        (total, count), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)),
-                                         (hs, ys))
-        return total / jnp.maximum(count, 1.0)
+        return chunked_lm_loss(h, labels, logits_fn, self.parallel_ce,
+                               chunk=chunk)
 
     def forward_with_loss(self, input_ids, labels):
         return self.forward(input_ids, labels)

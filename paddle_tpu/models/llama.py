@@ -1,0 +1,295 @@
+"""Llama decoder-only family: RoPE + RMSNorm + SwiGLU + GQA.
+
+Reference parity: BASELINE.md lists "ERNIE-3.0 / Llama-2-7B, v5p-64,
+sharding-stage3 (ZeRO-3-equivalent) pretrain" as a target config; the
+reference trains such models through PaddleNLP on the same fleet
+machinery as GPT. Here the family is written once against the
+TP-annotated layers (``distributed/parallel/mp_layers.py``) and composes
+with ZeRO (``distributed/shard.py`` stage 3), sequence parallel, flash
+attention, recompute, and the chunked LM loss — the exact knobs the
+GPT flagship uses.
+
+TPU-first notes: rotary embeddings are precomputed once per config and
+closed over as constants (XLA folds them); GQA repeats K/V heads to the
+query head count before attention so the Pallas flash kernel (equal-head
+layout) serves grouped queries unchanged.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..distributed.parallel.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    parallel_matmul,
+)
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.layers.norm import RMSNorm
+from .lm_utils import causal_attention, constrain_seq as _constrain_seq
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
+           "llama2_7b", "llama_loss_fn", "llama_flops_per_token"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None = MHA; < num_heads = GQA
+    intermediate_size: Optional[int] = None  # default: llama 8/3 rule
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False  # llama unties
+    use_recompute: bool = False
+    recompute_policy: str = None
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    loss_chunk: int = 0
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size is None:
+            # llama MLP sizing: 2/3 * 4h rounded up to a multiple of 256
+            inter = int(8 * self.hidden_size / 3)
+            self.intermediate_size = -(-inter // 256) * 256
+        assert self.num_heads % self.num_kv_heads == 0
+
+
+def llama_tiny(**overrides) -> "LlamaConfig":
+    cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+               num_kv_heads=2, max_position_embeddings=256)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+def llama2_7b(**overrides) -> "LlamaConfig":
+    """Llama-2-7B: the BASELINE.md sharding-stage3 target config."""
+    cfg = dict(vocab_size=32000, hidden_size=4096, num_layers=32,
+               num_heads=32, num_kv_heads=32, intermediate_size=11008,
+               max_position_embeddings=4096)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+# ------------------------------------------------------------------ rotary
+_ROPE_CACHE = {}
+
+
+def _rope_tables(head_dim: int, max_len: int, theta: float):
+    """Cos/sin tables, cached per (head_dim, max_len, theta): every layer
+    of every model instance shares ONE pair instead of each holding a
+    buffer copy (32 layers of llama2_7b would otherwise pin ~134 MB of
+    identical constants). As closure constants XLA folds them."""
+    key = (head_dim, max_len, float(theta))
+    if key not in _ROPE_CACHE:
+        # numpy on purpose: the first call may come from INSIDE a jit/remat
+        # trace, and caching jnp values there would cache tracers (leak)
+        import numpy as np
+
+        inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2,
+                                              dtype=np.float32) / head_dim))
+        t = np.arange(max_len, dtype=np.float32)
+        freqs = np.outer(t, inv_freq)                  # [L, D/2]
+        emb = np.concatenate([freqs, freqs], axis=-1)  # [L, D]
+        _ROPE_CACHE[key] = (np.cos(emb), np.sin(emb))
+    return _ROPE_CACHE[key]
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary(q, k, cos, sin, position_offset: int = 0):
+    """Rotary position embedding on [B, L, H, D] (llama rotate-half
+    convention)."""
+    L = q.shape[1]
+    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, L, axis=0)
+    s = jax.lax.dynamic_slice_in_dim(sin, position_offset, L, axis=0)
+    c = c[None, :, None, :].astype(q.dtype)
+    s = s[None, :, None, :].astype(q.dtype)
+    return q * c + _rotate_half(q) * s, k * c + _rotate_half(k) * s
+
+
+def _repeat_kv(x, groups: int):
+    """[B, L, Hkv, D] -> [B, L, Hkv*groups, D] for GQA (each kv head
+    serves `groups` query heads)."""
+    if groups == 1:
+        return x
+    return jnp.repeat(x, groups, axis=2)
+
+
+# ------------------------------------------------------------------ layers
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = Normal(0.0, cfg.initializer_range)
+        out_init = Normal(0.0, cfg.initializer_range
+                          / math.sqrt(2 * cfg.num_layers))
+        kv_out = cfg.num_kv_heads * self.head_dim
+        self.q_proj = ColumnParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            cfg.hidden_size, kv_out, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            cfg.hidden_size, kv_out, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, weight_attr=out_init,
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, position_offset: int = 0):
+        B, L, _ = x.shape
+        cfg = self.cfg
+        q = self.q_proj(x).reshape(B, L, cfg.num_heads, self.head_dim)
+        k = self.k_proj(x).reshape(B, L, cfg.num_kv_heads, self.head_dim)
+        v = self.v_proj(x).reshape(B, L, cfg.num_kv_heads, self.head_dim)
+        cos, sin = _rope_tables(self.head_dim, cfg.max_position_embeddings,
+                                cfg.rope_theta)
+        q, k = apply_rotary(q, k, cos, sin, position_offset)
+        groups = cfg.num_heads // cfg.num_kv_heads
+        k, v = _repeat_kv(k, groups), _repeat_kv(v, groups)
+        out = causal_attention(q, k, v, dropout_p=0.0,
+                               training=self.training,
+                               use_flash=cfg.use_flash_attention)
+        return self.o_proj(out.reshape(B, L, cfg.hidden_size))
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        init = Normal(0.0, cfg.initializer_range)
+        out_init = Normal(0.0, cfg.initializer_range
+                          / math.sqrt(2 * cfg.num_layers))
+        self.gate_proj = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(
+            cfg.intermediate_size, cfg.hidden_size, weight_attr=out_init,
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.input_layernorm = RMSNorm(cfg.hidden_size,
+                                       epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return _constrain_seq(x, self.cfg)
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        from .lm_utils import DecoderBlockList
+
+        self.cfg = cfg
+        self.embed_tokens = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=Normal(0.0, cfg.initializer_range))
+        self.layers = DecoderBlockList(cfg, LlamaBlock)
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        x = _constrain_seq(x, self.cfg)
+        x = self.layers(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    """LM head model; same contract as :class:`GPTForCausalLM` (logits, or
+    the loss directly when labels are given, chunk-fused when
+    ``cfg.loss_chunk > 0``)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size,
+                weight_attr=Normal(0.0, cfg.initializer_range),
+                has_bias=False, gather_output=False)
+        self.parallel_ce = ParallelCrossEntropy()
+
+    def _logits(self, h):
+        if self.cfg.tie_word_embeddings:
+            return parallel_matmul(h, self.model.embed_tokens.weight,
+                                   transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, labels=None):
+        if labels is not None and self.cfg.loss_chunk:
+            from .lm_utils import chunked_lm_loss
+
+            return chunked_lm_loss(self.model(input_ids), labels,
+                                   self._logits, self.parallel_ce,
+                                   chunk=self.cfg.loss_chunk)
+        logits = self._logits(self.model(input_ids))
+        if labels is None:
+            return logits
+        return self.loss(logits, labels)
+
+    def loss(self, logits, labels):
+        shift_logits = logits[:, :-1, :]
+        shift_labels = jnp.asarray(labels)[:, 1:]
+        return jnp.mean(self.parallel_ce(shift_logits, shift_labels))
+
+
+def llama_loss_fn(model: LlamaForCausalLM):
+    def loss_fn(outputs, batch):
+        return model.loss(outputs, batch[1])
+
+    return loss_fn
+
+
+def llama_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """6ND + attention term (PaLM formula), GQA-aware."""
+    head_dim = cfg.hidden_size // cfg.num_heads
+    kv = cfg.num_kv_heads * head_dim
+    n_params = (
+        cfg.vocab_size * cfg.hidden_size
+        * (1 if cfg.tie_word_embeddings else 2)
+        + cfg.num_layers * (
+            cfg.hidden_size * cfg.hidden_size * 2      # q + o
+            + cfg.hidden_size * kv * 2                  # k + v
+            + 3 * cfg.hidden_size * cfg.intermediate_size  # swiglu
+            + 2 * cfg.hidden_size))                     # rmsnorm
+    attn = 12 * cfg.num_layers * cfg.hidden_size * seq_len
+    return 6.0 * n_params + attn

@@ -1,0 +1,115 @@
+"""Shared language-model loss plumbing (GPT/Llama/ERNIE families).
+
+The memory-fused chunked LM loss: head projection + softmax-CE computed
+over sequence chunks inside ``jax.checkpoint`` regions, so the
+[B, L, vocab] logits tensor — the single largest HBM allocation in LM
+pretrain — never materializes. Reference contrast:
+``paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu``
+fuses softmax+CE but still materializes full logits.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..distributed.mesh import get_mesh, sharding
+from ..distributed.parallel.recompute import recompute_wrap
+from ..kernels import flash_attention as fa
+from ..nn import functional as F
+from ..nn.layer import Layer
+
+__all__ = ["chunked_lm_loss", "DecoderBlockList", "constrain_seq",
+           "causal_attention"]
+
+
+def constrain_seq(x, cfg):
+    """Between-block activation sharding for decoder stacks: [dp, sp,
+    mp-free] when ``cfg.sequence_parallel`` and the mesh has an "sp" axis,
+    else [dp, None, None]."""
+    mesh = get_mesh()
+    if mesh is None or x.ndim != 3:
+        return x
+    seq_axis = "sp" if (cfg.sequence_parallel and "sp" in mesh.shape) else None
+    batch_axes = tuple(a for a in ("dp", "sdp") if a in mesh.shape) or None
+    return jax.lax.with_sharding_constraint(
+        x, sharding(batch_axes, seq_axis, None, mesh=mesh))
+
+
+def causal_attention(q, k, v, dropout_p=0.0, training=True, use_flash=True):
+    """Causal self-attention on [B, L, H, D]; Pallas flash path when the
+    gate allows, XLA-fused softmax otherwise."""
+    p_drop = dropout_p if training else 0.0
+    if use_flash and fa.should_use_flash(q, k, None, p_drop):
+        if p_drop > 0.0:
+            from ..nn.layer import take_rng_key
+
+            seed = jax.random.randint(take_rng_key("dropout"), (), 0,
+                                      2 ** 31 - 1)
+        else:
+            seed = 0
+        return fa.flash_attention_blhd(q, k, v, causal=True,
+                                       dropout_p=p_drop, seed=seed)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
+    s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        p = F.dropout(p, p=dropout_p, training=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class DecoderBlockList(Layer):
+    """Shared N-block decoder stack with per-block recompute dispatch
+    (GPT/Llama): ``cfg`` provides ``num_layers``/``use_recompute``/
+    ``recompute_policy``; ``block_cls(cfg)`` builds one block."""
+
+    def __init__(self, cfg, block_cls):
+        super().__init__()
+        self.cfg = cfg
+        for i in range(cfg.num_layers):
+            self.add_sublayer(str(i), block_cls(cfg))
+
+    def forward(self, x):
+        for blk in self._sub_layers.values():
+            fn = (recompute_wrap(blk, policy=self.cfg.recompute_policy)
+                  if self.cfg.use_recompute else blk)
+            x = fn(x)
+        return x
+
+
+def chunked_lm_loss(h, labels, logits_fn, ce, chunk: int = 256):
+    """Shifted next-token loss over ``h`` [B, L, H] without full logits.
+
+    ``logits_fn(h_chunk) -> logits`` is the head projection (possibly
+    vocab-sharded); ``ce(logits, labels) -> per-token loss`` (e.g.
+    ParallelCrossEntropy). Labels are shifted internally; padding chunks
+    use label -100 (ignored).
+    """
+    hs = h[:, :-1]
+    ys = jnp.asarray(labels)[:, 1:]
+    B, Lm1, H = hs.shape
+    nchunk = -(-Lm1 // chunk)
+    pad = nchunk * chunk - Lm1
+    hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+    ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-100)
+    hs = jnp.swapaxes(hs.reshape(B, nchunk, chunk, H), 0, 1)
+    ys = jnp.swapaxes(ys.reshape(B, nchunk, chunk), 0, 1)
+
+    @jax.checkpoint
+    def chunk_losses(h_c, y_c):
+        per_tok = ce(logits_fn(h_c), y_c)
+        valid = (y_c != -100).astype(jnp.float32)
+        return jnp.sum(per_tok * valid), jnp.sum(valid)
+
+    def body(carry, xs):
+        s, c = chunk_losses(*xs)
+        return (carry[0] + s, carry[1] + c), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0), jnp.float32(0)), (hs, ys))
+    return total / jnp.maximum(count, 1.0)

@@ -1,0 +1,89 @@
+// Package paddletpu — Go serving bindings over the C inference API.
+//
+// Reference parity: paddle/fluid/inference/goapi/ (cgo over capi_exp).
+// This mirrors ../capi/infer_capi.h 1:1: load a jit.save artifact, run
+// float32 inference, collect the output and its shape.
+//
+// Build: the image this repo develops in carries no Go toolchain, so this
+// file is NOT compiled in CI (the C API itself is — tests/test_jit_export.py
+// builds and runs the plain-C consumer). To use from Go:
+//
+//	CGO_LDFLAGS="-L/path/to/paddle_tpu/native/capi -lpaddle_tpu_infer" \
+//	  go build ./...
+//
+// with libpaddle_tpu_infer.so built by paddle_tpu.inference.build_capi()
+// and PYTHONPATH/JAX_PLATFORMS set as infer_capi.h documents.
+package paddletpu
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_infer
+#include <stdint.h>
+#include <stdlib.h>
+#include "../capi/infer_capi.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Predictor wraps one loaded artifact (reference paddle.Predictor).
+type Predictor struct {
+	handle unsafe.Pointer
+}
+
+// NewPredictor loads a jit.save artifact by path prefix.
+func NewPredictor(artifactPrefix string) (*Predictor, error) {
+	cs := C.CString(artifactPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.PT_InferCreate(cs)
+	if h == nil {
+		return nil, errors.New(C.GoString(C.PT_InferLastError()))
+	}
+	return &Predictor{handle: h}, nil
+}
+
+// NumInputs / NumOutputs report the graph arity.
+func (p *Predictor) NumInputs() int  { return int(C.PT_InferNumInputs(p.handle)) }
+func (p *Predictor) NumOutputs() int { return int(C.PT_InferNumOutputs(p.handle)) }
+
+// Run executes one inference on a C-contiguous float32 tensor and returns
+// the flattened output plus its shape.
+func (p *Predictor) Run(input []float32, shape []int64) ([]float32, []int64, error) {
+	capacity := int64(1)
+	for _, d := range shape {
+		capacity *= d
+	}
+	capacity *= 64 // generous output headroom; grows on retry below
+	for {
+		out := make([]float32, capacity)
+		outShape := make([]int64, 8)
+		var outRank C.int32_t
+		n := C.PT_InferRun(p.handle,
+			(*C.float)(unsafe.Pointer(&input[0])),
+			(*C.int64_t)(unsafe.Pointer(&shape[0])),
+			C.int32_t(len(shape)),
+			(*C.float)(unsafe.Pointer(&out[0])),
+			C.int64_t(capacity),
+			(*C.int64_t)(unsafe.Pointer(&outShape[0])),
+			&outRank)
+		if n < 0 {
+			msg := C.GoString(C.PT_InferLastError())
+			if msg == "output buffer too small" {
+				capacity *= 4
+				continue
+			}
+			return nil, nil, errors.New(msg)
+		}
+		return out[:int64(n)], outShape[:int(outRank)], nil
+	}
+}
+
+// Destroy releases the predictor.
+func (p *Predictor) Destroy() {
+	if p.handle != nil {
+		C.PT_InferDestroy(p.handle)
+		p.handle = nil
+	}
+}
