@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,30 @@ try:  # pallas TPU backend only exists on TPU-enabled jaxlibs
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pltpu = None
+
+# Experimental: keep dot operands in their native (bf16) dtype instead of
+# upcasting to f32. Mosaic rejected bf16 operands for these transposed
+# contractions when the kernels were written ("Bad lhs type") — re-test on
+# jax/Mosaic upgrades; native-bf16 MXU issue would be a large win at
+# L>=4096. Softmax statistics and accumulators stay f32 regardless
+# (preferred_element_type).
+_BF16_OPERANDS = os.environ.get("PT_FLASH_BF16", "") == "1"
+
+
+def _operand_dtype(*refs):
+    """Dot-operand dtype policy, decided over ALL of a kernel body's
+    inputs at once: mixed-precision inputs (e.g. bf16 q/k with an f32
+    value cache) fall back to f32 — per-tensor decisions would hand
+    lax.dot_general unequal operand dtypes."""
+    if _BF16_OPERANDS and all(r.dtype == jnp.bfloat16 for r in refs):
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def _cast_like(a, ref):
+    """Match a derived f32 matrix (p/ds) to the other dot operand's dtype
+    — lax.dot_general requires equal operand dtypes."""
+    return a if a.dtype == ref.dtype else a.astype(ref.dtype)
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
@@ -117,11 +142,10 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_bias, dropout_p):
     k_start = ki * block_k
 
     def _body():
-        # upcast to f32: Mosaic rejects bf16 operands for the transposed
-        # contractions these kernels use ("Bad lhs type"); correctness first
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        od = _operand_dtype(q_ref, k_ref, v_ref)
+        q = q_ref[0, 0].astype(od)
+        k = k_ref[0, 0].astype(od)
+        v = v_ref[0, 0].astype(od)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_bias:
@@ -144,7 +168,8 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_bias, dropout_p):
                             pl.num_programs(2), pl.num_programs(3))
             p = p * _dropout_mask((block_q, block_k), dropout_p, seed_ref, bid)
         acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            _cast_like(p, v), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_s[:] = m_new
 
     if causal:
@@ -191,10 +216,11 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_bias,
     k_start = ki * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        od = _operand_dtype(q_ref, k_ref, v_ref, do_ref)
+        q = q_ref[0, 0].astype(od)
+        k = k_ref[0, 0].astype(od)
+        v = v_ref[0, 0].astype(od)
+        do = do_ref[0, 0].astype(od)
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -215,8 +241,9 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_bias,
         ds = p * (dp - delta)
         if ds_ref is not None:
             ds_ref[0, 0] = ds.astype(ds_ref.dtype)
-        dq_s[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32) * scale
+        dq_s[:] += jax.lax.dot_general(
+            _cast_like(ds, k), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
 
     if causal:
         pl.when(k_start <= q_start + block_q - 1)(_body)
@@ -255,10 +282,11 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_bias, dropout_p)
     k_start = ki * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        od = _operand_dtype(q_ref, k_ref, v_ref, do_ref)
+        q = q_ref[0, 0].astype(od)
+        k = k_ref[0, 0].astype(od)
+        v = v_ref[0, 0].astype(od)
+        do = do_ref[0, 0].astype(od)
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -278,16 +306,18 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_bias, dropout_p)
         else:
             pd = p
         # dv = pd^T do
-        dv_s[:] += jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
+        dv_s[:] += jax.lax.dot_general(
+            _cast_like(pd, do), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
             dp = dp * drop
         ds = p * (dp - delta)
         # dk = ds^T q * scale
-        dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=jnp.float32) * scale
+        dk_s[:] += jax.lax.dot_general(
+            _cast_like(ds, q), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
 
     if causal:
         # q block participates unless entirely above this k block's diagonal
