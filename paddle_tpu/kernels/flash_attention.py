@@ -33,21 +33,22 @@ try:  # pallas TPU backend only exists on TPU-enabled jaxlibs
 except ImportError:  # pragma: no cover
     pltpu = None
 
-# Experimental: keep dot operands in their native (bf16) dtype instead of
-# upcasting to f32. Mosaic rejected bf16 operands for these transposed
-# contractions when the kernels were written ("Bad lhs type") — re-test on
-# jax/Mosaic upgrades; native-bf16 MXU issue would be a large win at
-# L>=4096. Softmax statistics and accumulators stay f32 regardless
-# (preferred_element_type).
-_BF16_OPERANDS = os.environ.get("PT_FLASH_BF16", "") == "1"
-
-
 def _operand_dtype(*refs):
     """Dot-operand dtype policy, decided over ALL of a kernel body's
     inputs at once: mixed-precision inputs (e.g. bf16 q/k with an f32
     value cache) fall back to f32 — per-tensor decisions would hand
-    lax.dot_general unequal operand dtypes."""
-    if _BF16_OPERANDS and all(r.dtype == jnp.bfloat16 for r in refs):
+    lax.dot_general unequal operand dtypes.
+
+    Experimental PT_FLASH_BF16=1 keeps all-bf16 bodies in native bf16
+    (Mosaic rejected bf16 operands for these transposed contractions when
+    the kernels were written, "Bad lhs type" — re-test on jax/Mosaic
+    upgrades; native-bf16 MXU issue would be a large win at L>=4096).
+    Softmax statistics and accumulators stay f32 regardless
+    (preferred_element_type). The env var is read at TRACE time, so
+    setting it after import still takes effect on the next compile.
+    """
+    if os.environ.get("PT_FLASH_BF16", "") == "1" and \
+            all(r.dtype == jnp.bfloat16 for r in refs):
         return jnp.bfloat16
     return jnp.float32
 

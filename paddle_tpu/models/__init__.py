@@ -10,6 +10,7 @@ from . import bert  # noqa: F401
 from . import ernie  # noqa: F401
 from . import gpt  # noqa: F401
 from . import llama  # noqa: F401
+from . import ppyoloe  # noqa: F401
 from . import resnet  # noqa: F401
 from . import yolo  # noqa: F401
 from .bert import (BertConfig, BertForPretraining,  # noqa: F401
@@ -21,5 +22,6 @@ from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_1p3b, gpt_tiny  # noqa: F401
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
                     llama2_7b, llama_tiny)
+from .ppyoloe import PPYOLOE, ppyoloe_s, ppyoloe_tiny  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .yolo import YOLOv3  # noqa: F401

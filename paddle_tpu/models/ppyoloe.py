@@ -1,0 +1,404 @@
+"""PP-YOLOE detector: CSPRepResNet + CSPPAN + anchor-free ET-head.
+
+Reference parity: BASELINE.md row "PP-YOLOE / PP-OCRv3 — conv-heavy kernel
+coverage"; the reference trains PP-YOLOE through PaddleDetection on this
+fork. The architecture pieces mirrored here: RepVGG-style re-parameterized
+blocks (train-time 3x3+1x1 branches, foldable into ONE conv for deploy via
+:meth:`RepConv.fuse`), CSP stages with effective-SE attention, a PAN neck,
+and the ET-head — anchor-free per-cell predictions with Distribution Focal
+Loss (DFL) box regression, Task-Aligned Assignment (TAL), varifocal cls
+loss, and GIoU box loss.
+
+TPU-native notes: assignment and losses are fully vectorized over
+[B, G, A] (no per-box Python loops — everything jits with static shapes;
+ground truth arrives padded with label -1); decoding integrates the DFL
+distribution in-graph; NMS stays host-side (dynamic output length), same
+as the YOLOv3 family.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.containers import LayerList
+from ..nn.layers.conv import Conv2D
+from ..nn.layers.norm import BatchNorm2D
+from ..vision import ops as V
+
+__all__ = ["PPYOLOE", "ppyoloe_tiny", "ppyoloe_s"]
+
+
+class ConvBNAct(Layer):
+    def __init__(self, cin, cout, k=3, stride=1, act=True):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.silu(x) if self.act else x
+
+
+class RepConv(Layer):
+    """Re-parameterizable conv: training runs 3x3 + 1x1 branches summed;
+    :meth:`fuse` folds both (conv+BN each) into ONE 3x3 conv for serving —
+    the RepVGG trick PP-YOLOE's backbone is built from."""
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.b3 = ConvBNAct(cin, cout, 3, stride, act=False)
+        self.b1 = ConvBNAct(cin, cout, 1, stride, act=False)
+        self._fused = None
+
+    def forward(self, x):
+        if self._fused is not None:
+            return F.silu(self._fused(x))
+        return F.silu(self.b3(x) + self.b1(x))
+
+    @staticmethod
+    def _fold_bn(conv, bn):
+        """(conv W, BN) -> equivalent (W', b')."""
+        w = jnp.asarray(conv.weight)
+        gamma = jnp.asarray(bn.weight)
+        beta = jnp.asarray(bn.bias)
+        mean = jnp.asarray(bn._mean)
+        var = jnp.asarray(bn._variance)
+        std = jnp.sqrt(var + bn.epsilon)
+        w2 = w * (gamma / std)[:, None, None, None]
+        b2 = beta - gamma * mean / std
+        return w2, b2
+
+    def fuse(self) -> None:
+        """Fold both branches into one 3x3 conv (inference only)."""
+        w3, b3 = self._fold_bn(self.b3.conv, self.b3.bn)
+        w1, b1 = self._fold_bn(self.b1.conv, self.b1.bn)
+        w1 = jnp.pad(w1, ((0, 0), (0, 0), (1, 1), (1, 1)))  # 1x1 -> 3x3
+        stride = self.b3.conv.stride
+        if isinstance(stride, (tuple, list)):
+            stride = stride[0]
+        fused = Conv2D(self.b3.conv.in_channels, self.b3.conv.out_channels,
+                       3, stride=stride, padding=1)
+        fused.weight = w3 + w1
+        fused.bias = b3 + b1
+        self._fused = fused
+
+
+class ESE(Layer):
+    """Effective squeeze-excite: one linear gate on pooled features."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.fc = Conv2D(ch, ch, 1)
+
+    def forward(self, x):
+        g = jnp.mean(x, axis=(2, 3), keepdims=True)
+        return x * jax.nn.sigmoid(self.fc(g))
+
+
+class CSPResStage(Layer):
+    """CSP split + n RepConv blocks + ESE, stride-2 entry."""
+
+    def __init__(self, cin, cout, n):
+        super().__init__()
+        self.down = ConvBNAct(cin, cout, 3, stride=2)
+        mid = cout // 2
+        self.split_a = ConvBNAct(cout, mid, 1)
+        self.split_b = ConvBNAct(cout, mid, 1)
+        self.blocks = LayerList([RepConv(mid, mid) for _ in range(n)])
+        self.attn = ESE(cout)
+        self.out_conv = ConvBNAct(cout, cout, 1)
+
+    def forward(self, x):
+        x = self.down(x)
+        a = self.split_a(x)
+        b = self.split_b(x)
+        for blk in self.blocks:
+            b = blk(b)
+        return self.out_conv(self.attn(jnp.concatenate([a, b], axis=1)))
+
+
+class CSPRepBackbone(Layer):
+    """Stem + 3 CSPRep stages emitting stride 8/16/32 features."""
+
+    def __init__(self, width=32, depths=(1, 2, 2)):
+        super().__init__()
+        w = width
+        self.stem = ConvBNAct(3, w, 3, stride=2)        # /2
+        self.stem2 = ConvBNAct(w, w * 2, 3, stride=2)   # /4
+        self.s8 = CSPResStage(w * 2, w * 4, depths[0])   # /8
+        self.s16 = CSPResStage(w * 4, w * 8, depths[1])  # /16
+        self.s32 = CSPResStage(w * 8, w * 16, depths[2])  # /32
+        self.out_channels = [w * 4, w * 8, w * 16]
+
+    def forward(self, x):
+        x = self.stem2(self.stem(x))
+        c8 = self.s8(x)
+        c16 = self.s16(c8)
+        c32 = self.s32(c16)
+        return c8, c16, c32
+
+
+class CSPPAN(Layer):
+    """PAN neck: top-down then bottom-up fusion with conv blocks."""
+
+    def __init__(self, chans: Sequence[int]):
+        super().__init__()
+        c8, c16, c32 = chans
+        self.lat32 = ConvBNAct(c32, c16, 1)
+        self.td16 = ConvBNAct(c16 + c16, c16, 3)
+        self.lat16 = ConvBNAct(c16, c8, 1)
+        self.td8 = ConvBNAct(c8 + c8, c8, 3)
+        self.bu16 = ConvBNAct(c8, c16, 3, stride=2)
+        self.fuse16 = ConvBNAct(c16 + c16, c16, 3)
+        self.bu32 = ConvBNAct(c16, c16, 3, stride=2)
+        self.fuse32 = ConvBNAct(c16 + c16, c16, 3)
+        self.out_channels = [c8, c16, c16]
+
+    @staticmethod
+    def _up(x):
+        B, C, H, W = x.shape
+        return jax.image.resize(x, (B, C, H * 2, W * 2), method="nearest")
+
+    def forward(self, c8, c16, c32):
+        p32 = self.lat32(c32)
+        p16 = self.td16(jnp.concatenate([self._up(p32), c16], axis=1))
+        p8 = self.td8(jnp.concatenate(
+            [self._up(self.lat16(p16)), c8], axis=1))
+        n16 = self.fuse16(jnp.concatenate([self.bu16(p8), p16], axis=1))
+        n32 = self.fuse32(jnp.concatenate([self.bu32(n16), p32], axis=1))
+        return p8, n16, n32
+
+
+class ETHead(Layer):
+    """Anchor-free head: per cell, class logits + 4*(reg_max+1) DFL bins."""
+
+    def __init__(self, chans: Sequence[int], num_classes: int, reg_max: int):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.stems = LayerList([ConvBNAct(c, c, 3) for c in chans])
+        # cls prior: start near p=0.01 (retinanet-style focal init)
+        self.cls_heads = LayerList([Conv2D(c, num_classes, 1) for c in chans])
+        for h in self.cls_heads:
+            h.bias = jnp.full_like(jnp.asarray(h.bias),
+                                   -math.log((1 - 0.01) / 0.01))
+        self.reg_heads = LayerList(
+            [Conv2D(c, 4 * (reg_max + 1), 1) for c in chans])
+
+    def forward(self, feats):
+        cls_out, reg_out = [], []
+        for f, stem, ch, rh in zip(feats, self.stems, self.cls_heads,
+                                   self.reg_heads):
+            h = stem(f)
+            B, _, H, W = h.shape
+            cls_out.append(ch(h).reshape(B, self.num_classes, H * W))
+            reg_out.append(rh(h).reshape(B, 4 * (self.reg_max + 1), H * W))
+        # [B, A_total, *]
+        return (jnp.swapaxes(jnp.concatenate(cls_out, -1), 1, 2),
+                jnp.swapaxes(jnp.concatenate(reg_out, -1), 1, 2))
+
+
+class PPYOLOE(Layer):
+    """``forward(images) -> (cls_logits [B, A, C], reg_logits
+    [B, A, 4*(reg_max+1)], anchor_points [A, 2], strides [A])``;
+    ``loss``/``predict`` implement TAL + VFL/DFL/GIoU and decode+NMS."""
+
+    def __init__(self, num_classes: int = 80, width: int = 32,
+                 depths=(1, 2, 2), reg_max: int = 16,
+                 strides=(8, 16, 32), tal_topk: int = 13,
+                 tal_alpha: float = 1.0, tal_beta: float = 6.0):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.strides = list(strides)
+        self.tal_topk = tal_topk
+        self.tal_alpha = tal_alpha
+        self.tal_beta = tal_beta
+        self.backbone = CSPRepBackbone(width, depths)
+        self.neck = CSPPAN(self.backbone.out_channels)
+        self.head = ETHead(self.neck.out_channels, num_classes, reg_max)
+
+    def fuse_rep(self) -> None:
+        """Fold every RepConv for serving (the deploy-time re-param)."""
+        for layer in self.sublayers(include_self=True):
+            if isinstance(layer, RepConv):
+                layer.fuse()
+
+    # ------------------------------------------------------------ forward
+    def _anchors(self, img_hw):
+        """Cell-center anchor points (input pixels) + per-anchor stride."""
+        H, W = img_hw
+        pts, strs = [], []
+        for s in self.strides:
+            hs, ws = H // s, W // s
+            yy, xx = jnp.meshgrid(jnp.arange(hs), jnp.arange(ws),
+                                  indexing="ij")
+            centers = (jnp.stack([xx, yy], -1).reshape(-1, 2) + 0.5) * s
+            pts.append(centers.astype(jnp.float32))
+            strs.append(jnp.full((hs * ws,), s, jnp.float32))
+        return jnp.concatenate(pts), jnp.concatenate(strs)
+
+    def forward(self, images):
+        feats = self.neck(*self.backbone(images))
+        cls_logits, reg_logits = self.head(feats)
+        pts, strs = self._anchors(images.shape[2:])
+        return cls_logits, reg_logits, pts, strs
+
+    def _decode(self, reg_logits, pts, strs):
+        """DFL expectation -> (l, t, r, b) -> xyxy in input pixels."""
+        B, A, _ = reg_logits.shape
+        bins = jnp.arange(self.reg_max + 1, dtype=jnp.float32)
+        dist = jax.nn.softmax(
+            reg_logits.reshape(B, A, 4, self.reg_max + 1), axis=-1)
+        ltrb = jnp.einsum("bakn,n->bak", dist, bins) * strs[None, :, None]
+        x1y1 = pts[None] - ltrb[..., :2]
+        x2y2 = pts[None] + ltrb[..., 2:]
+        return jnp.concatenate([x1y1, x2y2], axis=-1)  # [B, A, 4]
+
+    # --------------------------------------------------------------- loss
+    @staticmethod
+    def _iou_union(a, b):
+        """Broadcasted (iou, union) for xyxy boxes."""
+        lt = jnp.maximum(a[..., :2], b[..., :2])
+        rb = jnp.minimum(a[..., 2:], b[..., 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = ((a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1]))
+        area_b = ((b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]))
+        union = jnp.maximum(area_a + area_b - inter, 1e-9)
+        return inter / union, union
+
+    @classmethod
+    def _iou_xyxy(cls, a, b):
+        """Pairwise IoU: a [..., G, 1, 4] vs b [..., 1, A, 4]."""
+        return cls._iou_union(a, b)[0]
+
+    def _assign(self, cls_scores, pred_boxes, pts, gt_boxes, gt_labels):
+        """Task-aligned assignment (TAL): metric = s^alpha * iou^beta over
+        anchors whose center lies inside the gt box; top-k anchors per gt;
+        anchors claimed by several gts go to the highest metric. Returns
+        (fg_mask [B, A], tgt_labels [B, A], tgt_boxes [B, A, 4],
+        tgt_scores [B, A]) — all static-shaped."""
+        B, A, C = cls_scores.shape
+        G = gt_boxes.shape[1]
+        valid = (gt_labels >= 0)  # [B, G] padded gts
+        gb = gt_boxes[:, :, None, :]                      # [B, G, 1, 4]
+        inside = ((pts[None, None, :, 0] > gb[..., 0])
+                  & (pts[None, None, :, 0] < gb[..., 2])
+                  & (pts[None, None, :, 1] > gb[..., 1])
+                  & (pts[None, None, :, 1] < gb[..., 3]))  # [B, G, A]
+        iou = self._iou_xyxy(gb, pred_boxes[:, None, :, :])  # [B, G, A]
+        safe_lbl = jnp.clip(gt_labels, 0, C - 1)
+        # s: [B, G, A] — each anchor's predicted score for the gt's class
+        s = jnp.take_along_axis(
+            jnp.swapaxes(cls_scores, 1, 2),               # [B, C, A]
+            safe_lbl[:, :, None].astype(jnp.int32), axis=1)
+        metric = (s ** self.tal_alpha) * (iou ** self.tal_beta)
+        metric = jnp.where(inside & valid[:, :, None], metric, 0.0)
+        # top-k anchors per gt
+        k = min(self.tal_topk, A)
+        thresh = jnp.sort(metric, axis=-1)[..., -k][..., None]
+        cand = (metric >= jnp.maximum(thresh, 1e-12)) & (metric > 0)
+        # conflicts: anchor keeps the gt with the highest metric
+        best_gt = jnp.argmax(jnp.where(cand, metric, -1.0), axis=1)  # [B, A]
+        fg = jnp.any(cand, axis=1)                                    # [B, A]
+        bidx = jnp.arange(B)[:, None]
+        tgt_boxes = gt_boxes[bidx, best_gt]                   # [B, A, 4]
+        tgt_labels = jnp.where(fg, gt_labels[bidx, best_gt], -1)
+        # normalize targets per gt: t_hat = t / max_t * max_iou (TAL paper)
+        max_m = jnp.max(metric, axis=-1, keepdims=True)       # [B, G, 1]
+        max_iou = jnp.max(jnp.where(cand, iou, 0.0), -1, keepdims=True)
+        norm = (metric / jnp.maximum(max_m, 1e-9)) * max_iou  # [B, G, A]
+        tgt_scores = jnp.take_along_axis(norm, best_gt[:, None, :],
+                                         axis=1)[:, 0]
+        tgt_scores = jnp.where(fg, tgt_scores, 0.0)
+        return fg, tgt_labels, tgt_boxes, tgt_scores
+
+    def loss(self, images, gt_boxes, gt_labels):
+        """VFL (cls) + GIoU (box) + DFL (distribution) with TAL targets.
+        ``gt_boxes`` [B, G, 4] xyxy input pixels, ``gt_labels`` [B, G]
+        int (-1 padding)."""
+        cls_logits, reg_logits, pts, strs = self.forward(images)
+        cls_scores = jax.nn.sigmoid(cls_logits)
+        pred_boxes = self._decode(reg_logits, pts, strs)
+        fg, tgt_lbl, tgt_box, tgt_q = self._assign(
+            jax.lax.stop_gradient(cls_scores),
+            jax.lax.stop_gradient(pred_boxes), pts,
+            jnp.asarray(gt_boxes, jnp.float32), jnp.asarray(gt_labels))
+
+        B, A, C = cls_logits.shape
+        # varifocal: positives weighted by the aligned target q, negatives
+        # focal-downweighted
+        onehot = jax.nn.one_hot(jnp.clip(tgt_lbl, 0, C - 1), C) \
+            * fg[..., None]
+        q = tgt_q[..., None] * onehot
+        p = cls_scores
+        weight = jnp.where(q > 0, q, 0.75 * p ** 2.0)
+        bce = -(q * jnp.log(jnp.clip(p, 1e-9, 1.0))
+                + (1 - q) * jnp.log(jnp.clip(1 - p, 1e-9, 1.0)))
+        norm = jnp.maximum(jnp.sum(tgt_q), 1.0)
+        cls_loss = jnp.sum(weight * bce) / norm
+
+        # GIoU on foreground
+        giou = self._giou(pred_boxes, tgt_box)
+        box_loss = jnp.sum((1.0 - giou) * tgt_q * fg) / norm
+
+        # DFL: lrtb targets in stride units, split across adjacent bins
+        ltrb_t = jnp.concatenate(
+            [pts[None] - tgt_box[..., :2], tgt_box[..., 2:] - pts[None]],
+            axis=-1) / strs[None, :, None]
+        ltrb_t = jnp.clip(ltrb_t, 0, self.reg_max - 0.01)
+        lo = jnp.floor(ltrb_t)
+        hi_w = ltrb_t - lo
+        logp = jax.nn.log_softmax(
+            reg_logits.reshape(B, A, 4, self.reg_max + 1), axis=-1)
+        lo_i = lo.astype(jnp.int32)
+        pick = lambda idx: jnp.take_along_axis(  # noqa: E731
+            logp, idx[..., None], axis=-1)[..., 0]
+        dfl = -(pick(lo_i) * (1 - hi_w) + pick(lo_i + 1) * hi_w)
+        dfl_loss = jnp.sum(jnp.mean(dfl, -1) * tgt_q * fg) / norm
+        return cls_loss + 2.0 * box_loss + 0.5 * dfl_loss
+
+    @classmethod
+    def _giou(cls, a, b):
+        """[..., 4] xyxy GIoU."""
+        iou, union = cls._iou_union(a, b)
+        clt = jnp.minimum(a[..., :2], b[..., :2])
+        crb = jnp.maximum(a[..., 2:], b[..., 2:])
+        cwh = jnp.clip(crb - clt, 0)
+        carea = jnp.maximum(cwh[..., 0] * cwh[..., 1], 1e-9)
+        return iou - (carea - union) / carea
+
+    # ------------------------------------------------------------ predict
+    def predict(self, images, conf_thresh: float = 0.01,
+                post_threshold: float = 0.01, nms_top_k: int = 400,
+                keep_top_k: int = 100):
+        """Decode + matrix-NMS; rows [label, score, x1, y1, x2, y2]."""
+        cls_logits, reg_logits, pts, strs = self.forward(images)
+        boxes = np.asarray(self._decode(reg_logits, pts, strs))
+        scores = np.moveaxis(
+            np.asarray(jax.nn.sigmoid(cls_logits)), 2, 1)  # [B, C, A]
+        return V.matrix_nms(boxes, scores, conf_thresh, post_threshold,
+                            nms_top_k, keep_top_k, background_label=-1)
+
+
+def ppyoloe_tiny(num_classes: int = 4, **kw) -> PPYOLOE:
+    kw.setdefault("width", 8)
+    kw.setdefault("depths", (1, 1, 1))
+    kw.setdefault("reg_max", 8)
+    return PPYOLOE(num_classes=num_classes, **kw)
+
+
+def ppyoloe_s(num_classes: int = 80, **kw) -> PPYOLOE:
+    """PP-YOLOE-s-class capacity."""
+    kw.setdefault("width", 32)
+    kw.setdefault("depths", (2, 4, 2))
+    return PPYOLOE(num_classes=num_classes, **kw)

@@ -159,3 +159,111 @@ def test_random_crop_pad_if_needed_narrow_image():
     img = np.zeros((40, 20, 3), np.uint8)
     out = T.RandomCrop(32, pad_if_needed=True)(img)
     assert out.shape == (32, 32, 3)
+
+
+# ------------------------------------------------------------ pp-yoloe
+def test_ppyoloe_forward_and_decode():
+    from paddle_tpu.models.ppyoloe import ppyoloe_tiny
+
+    pt.seed(0)
+    m = ppyoloe_tiny(num_classes=4)
+    m.eval()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3, 64, 64)),
+                    jnp.float32)
+    cls_logits, reg_logits, pts, strs = m(x)
+    A = (8 * 8) + (4 * 4) + (2 * 2)  # strides 8/16/32 on 64x64
+    assert cls_logits.shape == (2, A, 4)
+    assert reg_logits.shape == (2, A, 4 * (m.reg_max + 1))
+    assert pts.shape == (A, 2) and strs.shape == (A,)
+    boxes = m._decode(reg_logits, pts, strs)
+    assert boxes.shape == (2, A, 4)
+    assert np.isfinite(np.asarray(boxes)).all()
+    dets, num = m.predict(x, conf_thresh=0.0, keep_top_k=5)
+    assert np.asarray(dets).shape[1] == 6 and len(np.asarray(num)) == 2
+
+
+def test_ppyoloe_repconv_fuse_parity():
+    from paddle_tpu.models.ppyoloe import RepConv
+
+    pt.seed(1)
+    blk = RepConv(6, 6)
+    blk.eval()
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(1, 6, 16, 16)),
+                    jnp.float32)
+    before = np.asarray(blk(x))
+    blk.fuse()
+    after = np.asarray(blk(x))
+    np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
+
+
+def test_ppyoloe_tal_assigns_inside_anchors():
+    from paddle_tpu.models.ppyoloe import ppyoloe_tiny
+
+    pt.seed(2)
+    m = ppyoloe_tiny(num_classes=4)
+    m.eval()
+    x = jnp.zeros((1, 3, 64, 64), jnp.float32)
+    cls_logits, reg_logits, pts, strs = m(x)
+    cls_scores = jax.nn.sigmoid(cls_logits)
+    pred_boxes = m._decode(reg_logits, pts, strs)
+    gt_boxes = jnp.asarray([[[8.0, 8.0, 40.0, 40.0]]])
+    gt_labels = jnp.asarray([[2]])
+    fg, tgt_lbl, tgt_box, tgt_q = m._assign(cls_scores, pred_boxes, pts,
+                                            gt_boxes, gt_labels)
+    fg = np.asarray(fg)[0]
+    assert fg.sum() >= 1
+    p = np.asarray(pts)
+    inside = ((p[:, 0] > 8) & (p[:, 0] < 40)
+              & (p[:, 1] > 8) & (p[:, 1] < 40))
+    assert (fg <= inside).all()  # only inside-gt anchors assigned
+    assert set(np.asarray(tgt_lbl)[0][fg].tolist()) == {2}
+    # padded gt rows assign nothing
+    fg2, _, _, _ = m._assign(cls_scores, pred_boxes, pts,
+                             jnp.asarray([[[-1.0, -1, -1, -1]]]),
+                             jnp.asarray([[-1]]))
+    assert np.asarray(fg2).sum() == 0
+
+
+def test_ppyoloe_trains():
+    from paddle_tpu.models.ppyoloe import ppyoloe_tiny
+    from paddle_tpu.nn.layer import buffer_state, functional_call, param_state
+
+    pt.seed(3)
+    m = ppyoloe_tiny(num_classes=4)
+    rng = np.random.default_rng(3)
+    imgs = jnp.asarray(rng.normal(size=(2, 3, 64, 64)), jnp.float32)
+    gt_boxes = jnp.asarray([[[8, 8, 40, 40], [-1, -1, -1, -1]],
+                            [[24, 16, 56, 48], [4, 4, 20, 20]]], jnp.float32)
+    gt_labels = jnp.asarray([[1, -1], [0, 3]], jnp.int32)
+    params = param_state(m)
+    buffers = buffer_state(m)
+
+    def loss_fn(p):
+        # functional_call drives forward; loss() is the training entry, so
+        # route it through the call protocol by temporary forward swap
+        out, _ = functional_call(_LossShim(m), p, buffers,
+                                 imgs, gt_boxes, gt_labels)
+        return out
+
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    for _ in range(8):
+        l, g = vg(params)
+        params = jax.tree.map(lambda a, b: a - 2e-3 * b, params, g)
+        losses.append(float(l))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+class _LossShim:
+    """Adapter: exposes a PPYOLOE's loss() as the callable/stateful surface
+    functional_call drives."""
+
+    def __init__(self, model):
+        self._m = model
+
+    def __call__(self, *a, **k):
+        return self._m.loss(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self._m, name)
