@@ -1,0 +1,92 @@
+"""Text-recognition model: CRNN with CTC (the PP-OCR-class pipeline).
+
+Reference parity: BASELINE.md row "PP-YOLOE / PP-OCRv3 — conv-heavy
+kernel coverage"; PP-OCR's recognition branch is a conv backbone over
+height-32 crops, a sequence encoder, and a CTC head (the reference trains
+it through PaddleOCR on this fork's warpctc op — here
+:func:`paddle_tpu.nn.functional.ctc_loss`).
+
+TPU-native: the conv stack collapses height to 1 with stride-(2,1)
+downsampling so width becomes the time axis; the whole
+forward+CTC-forward-backward compiles to one XLA program (the alpha
+recursion is a lax.scan — no warpctc kernel needed, autodiff provides
+the backward).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
+from ..nn.layers.norm import BatchNorm2D
+from ..nn.layers.rnn import LSTM
+
+__all__ = ["CRNN", "crnn_tiny"]
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, 3, stride=stride, padding=1,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class CRNN(Layer):
+    """``forward(images [B, C, 32, W]) -> log-prob inputs [T, B, classes]``
+    (time-major for CTC; T = W/4); ``loss`` wires ctc_loss; ``decode``
+    does greedy collapse."""
+
+    def __init__(self, num_classes: int, in_channels: int = 3,
+                 width: int = 32, hidden: int = 64, blank: int = 0):
+        super().__init__()
+        self.blank = blank
+        w = width
+        # height 32 -> 1: three (2,2) then one (4,1); width /4 only
+        self.stem = _ConvBN(in_channels, w, (2, 2))        # 16 x W/2
+        self.c2 = _ConvBN(w, w * 2, (2, 2))                # 8 x W/4
+        self.c3 = _ConvBN(w * 2, w * 4, (2, 1))            # 4 x W/4
+        self.c4 = _ConvBN(w * 4, w * 4, (4, 1))            # 1 x W/4
+        self.rnn = LSTM(w * 4, hidden, direction="bidirect",
+                        time_major=True)
+        self.head = Linear(2 * hidden, num_classes)
+
+    def forward(self, images):
+        f = self.c4(self.c3(self.c2(self.stem(images))))   # [B, C, 1, T]
+        seq = jnp.transpose(f[:, :, 0, :], (2, 0, 1))      # [T, B, C]
+        out, _ = self.rnn(seq)
+        return self.head(out)                              # [T, B, classes]
+
+    def loss(self, images, labels, label_lengths):
+        logits = self.forward(images)
+        T, B, _ = logits.shape
+        input_lengths = jnp.full((B,), T, jnp.int32)
+        return F.ctc_loss(logits, labels, input_lengths, label_lengths,
+                          blank=self.blank)
+
+    def decode(self, images):
+        """Greedy CTC decode: argmax per frame, collapse repeats, drop
+        blanks. Returns a list of id lists (host-side)."""
+        import numpy as np
+
+        ids = np.asarray(jnp.argmax(self.forward(images), axis=-1))  # [T, B]
+        outs = []
+        for b in range(ids.shape[1]):
+            prev, seq = -1, []
+            for t in ids[:, b]:
+                if t != prev and t != self.blank:
+                    seq.append(int(t))
+                prev = t
+            outs.append(seq)
+        return outs
+
+
+def crnn_tiny(num_classes: int = 11, **kw) -> CRNN:
+    kw.setdefault("width", 8)
+    kw.setdefault("hidden", 32)
+    return CRNN(num_classes=num_classes, **kw)

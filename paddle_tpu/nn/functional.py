@@ -848,6 +848,83 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=
     return _reduce_loss(loss, reduction)
 
 
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """Connectionist Temporal Classification loss (reference
+    ``python/paddle/nn/functional/loss.py:1736`` over the warpctc C++ op).
+
+    TPU-native: the forward (log-alpha) recursion over the blank-extended
+    label sequence runs as ONE ``lax.scan`` over time, vectorized across
+    the batch — gradients come from autodiff through the scan, so no
+    hand-written backward kernel is needed.
+
+    ``log_probs``: [T, B, C] logits (time-major, like warpctc; softmax is
+    applied internally). ``labels``: [B, S] int padded ids.
+    """
+    lp = jax.nn.log_softmax(jnp.asarray(log_probs, jnp.float32), axis=-1)
+    T, B, C = lp.shape
+    labels = jnp.asarray(labels, jnp.int32)
+    S = labels.shape[1]
+    L = 2 * S + 1
+    in_len = jnp.asarray(input_lengths, jnp.int32)
+    lab_len = jnp.asarray(label_lengths, jnp.int32)
+    NEG = jnp.float32(-1e30)
+
+    # blank-extended sequence: [blank, l1, blank, l2, ..., blank]
+    ext = jnp.full((B, L), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(L)
+    valid_pos = pos[None, :] < (2 * lab_len[:, None] + 1)
+    # the i-2 skip is allowed only between distinct labels
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+
+    def emit(lp_t):
+        return jnp.take_along_axis(lp_t, ext, axis=1)  # [B, L]
+
+    alpha0 = jnp.full((B, L), NEG)
+    e0 = emit(lp[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    if L > 1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, e0[:, 1], NEG))
+    alpha0 = jnp.where(valid_pos, alpha0, NEG)
+
+    def step(alpha, lp_t):
+        stay = alpha
+        one = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+        two = jnp.where(
+            skip_ok,
+            jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1),
+            NEG)
+        new = jnp.logaddexp(jnp.logaddexp(stay, one), two) + emit(lp_t)
+        new = jnp.where(valid_pos, new, NEG)
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
+    # alpha at each sequence's last frame
+    a_fin = jnp.take_along_axis(
+        alphas, jnp.clip(in_len - 1, 0)[None, :, None], axis=0)[0]
+    end_blank = 2 * lab_len                       # final blank position
+    end_label = jnp.maximum(2 * lab_len - 1, 0)   # final label position
+    v1 = jnp.take_along_axis(a_fin, end_blank[:, None], 1)[:, 0]
+    v2 = jnp.where(lab_len > 0,
+                   jnp.take_along_axis(a_fin, end_label[:, None], 1)[:, 0],
+                   NEG)
+    loss = -jnp.logaddexp(v1, v2)
+    if norm_by_times:
+        # warpctc semantics: normalize only the GRADIENT by the number of
+        # frames; the reported loss value is unchanged
+        scaled = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        loss = jax.lax.stop_gradient(loss - scaled) + scaled
+    if reduction == "mean":
+        # reference mean is per-token: mean(loss_i / label_len_i)
+        return jnp.mean(loss / jnp.maximum(
+            lab_len.astype(jnp.float32), 1.0))
+    return _reduce_loss(loss, reduction)
+
+
 def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
     x = jnp.asarray(input)
     y = jnp.asarray(label)

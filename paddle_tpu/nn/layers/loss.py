@@ -135,3 +135,17 @@ class TripletMarginLoss(Layer):
     def forward(self, input, positive, negative):  # noqa: A002
         return F.triplet_margin_loss(input, positive, negative, self.margin,
                                      self.p, self.epsilon, self.swap, self.reduction)
+
+
+class CTCLoss(Layer):
+    """CTC loss layer (reference ``paddle.nn.CTCLoss`` over warpctc)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
