@@ -12,14 +12,15 @@ from .layer import (  # noqa: F401
 )
 from .layers.activation import (  # noqa: F401
     CELU, ELU, GELU, SELU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
-    LeakyReLU, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, RReLU, Sigmoid,
-    Silu, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh, Tanhshrink,
-    ThresholdedReLU,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+    RReLU, Sigmoid, Silu, Softmax, Softmax2D, Softplus, Softshrink,
+    Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layers.common import (  # noqa: F401
-    AlphaDropout, CosineSimilarity, Dropout, Dropout2D, Dropout3D, Embedding,
-    Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle, Unfold,
-    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+    Pad2D, Pad3D, PairwiseDistance, PixelShuffle, PixelUnshuffle, Unfold,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
 )
 from .layers.containers import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .layers.conv import (  # noqa: F401
@@ -27,8 +28,10 @@ from .layers.conv import (  # noqa: F401
 )
 from .layers.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
-    CTCLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss,
-    MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss,
+    CTCLoss, HingeEmbeddingLoss, HSigmoidLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    NLLLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layers.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
@@ -36,12 +39,17 @@ from .layers.norm import (  # noqa: F401
     SpectralNorm, SyncBatchNorm,
 )
 from .layers.pooling import (  # noqa: F401
-    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D,
-    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .layers.rnn import (  # noqa: F401
-    GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN, SimpleRNNCell,
+    GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN,
+    SimpleRNNCell,
 )
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,

@@ -1,0 +1,101 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference parity: ``python/paddle/nn/decode.py`` (``BeamSearchDecoder``
+over an RNN cell, ``dynamic_decode`` driving it to max length / all-beams
+finished).
+
+TPU-native: each step is dense [B, beam, ...] math (top-k over
+beam*vocab); the driver loop is a Python loop over ``max_step_num`` with
+a finished mask — decoding is inference-side and eager here (compile the
+per-step cell with ``to_static`` if needed). ``gather_tree`` backtraces
+the surviving beams' ancestry at the end, same as the reference op.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from .layer import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Beam search over a cell: ``cell(inputs [B*beam, emb], states)``
+    -> (logits-or-hidden, new_states); an output layer maps cell output to
+    vocab logits when the cell itself does not."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn or (lambda ids: ids)
+        self.output_fn = output_fn or (lambda x: x)
+
+    # states are pytrees with leading dim B*beam
+    def initialize(self, initial_states, batch_size: int):
+        K = self.beam_size
+        tok = jnp.full((batch_size, K), self.start_token, jnp.int32)
+        # only beam 0 is live initially (the reference's -inf trick keeps
+        # duplicate start beams from all surviving the first top-k)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-1e9] * (K - 1)], jnp.float32),
+            (batch_size, 1))
+        finished = jnp.zeros((batch_size, K), bool)
+        states = jax.tree.map(
+            lambda s: jnp.repeat(jnp.asarray(s), K, axis=0), initial_states)
+        return tok, log_probs, finished, states
+
+    def step(self, tok, log_probs, finished, states):
+        B, K = tok.shape
+        emb = self.embedding_fn(tok.reshape(B * K))
+        out, new_states = self.cell(emb, states)
+        logits = self.output_fn(out)
+        V = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(
+            jnp.asarray(logits, jnp.float32), -1).reshape(B, K, V)
+        # finished beams only extend with end_token at zero cost
+        fin_mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], fin_mask[None, None, :],
+                            step_lp)
+        total = log_probs[..., None] + step_lp           # [B, K, V]
+        top_lp, top_idx = jax.lax.top_k(total.reshape(B, K * V), K)
+        parent = top_idx // V                            # [B, K]
+        token = (top_idx % V).astype(jnp.int32)
+        bidx = jnp.arange(B)[:, None]
+        new_finished = finished[bidx, parent] | (token == self.end_token)
+        # reorder states along the beam dim to follow surviving parents
+        flat_parent = (bidx * K + parent).reshape(-1)
+        new_states = jax.tree.map(lambda s: jnp.asarray(s)[flat_parent],
+                                  new_states)
+        return token, top_lp, new_finished, new_states, parent
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 100, batch_size: Optional[int] = None,
+                   **kwargs):
+    """Drive ``decoder`` until all beams finish or ``max_step_num``.
+    Returns ``(sequences [B, beam, T], final_log_probs [B, beam])`` with
+    beam ancestry resolved via ``gather_tree``."""
+    if batch_size is None:
+        leaf = jax.tree.leaves(inits)[0]
+        batch_size = leaf.shape[0]
+    tok, log_probs, finished, states = decoder.initialize(inits, batch_size)
+    tokens, parents = [], []
+    for _ in range(max_step_num):
+        tok, log_probs, finished, states, parent = decoder.step(
+            tok, log_probs, finished, states)
+        tokens.append(tok)
+        parents.append(parent)
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(tokens)                  # [T, B, K]
+    par = jnp.stack(parents)
+    seqs = F.gather_tree(ids, par)           # [T, B, K]
+    return jnp.transpose(seqs, (1, 2, 0)), log_probs

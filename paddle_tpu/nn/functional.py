@@ -513,12 +513,17 @@ def _pool(x, kernel_size, stride, padding, n_spatial, channel_last, reducer, ini
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
-                jax.lax.max, -jnp.inf if jnp.issubdtype(jnp.asarray(x).dtype, np.floating)
-                else jnp.iinfo(jnp.asarray(x).dtype).min, ceil_mode)
     if return_mask:
-        raise NotImplementedError("return_mask is not supported on the TPU backend yet")
-    return out
+        if data_format != "NCHW" or ceil_mode:
+            raise NotImplementedError(
+                "return_mask supports NCHW without ceil_mode")
+        # explicit-window path: emits the flat H*W argmax indices
+        # max_unpool2d consumes (defined below)
+        return _max_pool2d_with_mask(jnp.asarray(x), kernel_size, stride,
+                                     padding)
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 jax.lax.max, -jnp.inf if jnp.issubdtype(jnp.asarray(x).dtype, np.floating)
+                 else jnp.iinfo(jnp.asarray(x).dtype).min, ceil_mode)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -591,17 +596,10 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     if H % out_h == 0 and W % out_w == 0:
         kh, kw = H // out_h, W // out_w
         return avg_pool2d(x, (kh, kw), (kh, kw), 0, data_format=data_format)
-    # general adaptive: per-output-cell variable windows via mean over gathers
-    def pool_axis(arr, axis, out_size):
-        size = arr.shape[axis]
-        starts = (np.arange(out_size) * size) // out_size
-        ends = ((np.arange(out_size) + 1) * size + out_size - 1) // out_size
-        segs = [jnp.mean(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis), axis=axis, keepdims=True)
-                for s, e in zip(starts, ends)]
-        return jnp.concatenate(segs, axis=axis)
-
-    h_ax, w_ax = (2, 3) if data_format == "NCHW" else (1, 2)
-    return pool_axis(pool_axis(x, h_ax, out_h), w_ax, out_w)
+    # general adaptive: shared variable-window machinery (defined with the
+    # 3d pools below)
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return _adaptive_pool_nd(x, (out_h, out_w), axes, jnp.mean)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
@@ -611,16 +609,7 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     if H % out_h == 0 and W % out_w == 0:
         kh, kw = H // out_h, W // out_w
         return max_pool2d(x, (kh, kw), (kh, kw), 0)
-
-    def pool_axis(arr, axis, out_size):
-        size = arr.shape[axis]
-        starts = (np.arange(out_size) * size) // out_size
-        ends = ((np.arange(out_size) + 1) * size + out_size - 1) // out_size
-        segs = [jnp.max(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis), axis=axis, keepdims=True)
-                for s, e in zip(starts, ends)]
-        return jnp.concatenate(segs, axis=axis)
-
-    return pool_axis(pool_axis(x, 2, out_h), 3, out_w)
+    return _adaptive_pool_nd(x, (out_h, out_w), (2, 3), jnp.max)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -1050,3 +1039,540 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
     from ..ops.manipulation import pad as _pad
 
     return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+# ---------------------------------------------------- API long tail (r4)
+# Reference parity for the remaining nn.functional exports
+# (python/paddle/nn/functional/__init__.py __all__ audit).
+
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(jnp.asarray(x))
+
+
+# "inplace" variants: jax arrays are immutable, so these are value aliases
+# (the reference's _ ops mutate dygraph storage; semantics here match the
+# functional form, which is what traced/compiled code sees either way)
+def relu_(x, name=None):
+    return relu(x)
+
+
+def tanh_(x, name=None):
+    return jnp.tanh(jnp.asarray(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return softmax(x, axis=axis, dtype=dtype)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return elu(x, alpha=alpha)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = jnp.asarray(x) - jnp.asarray(y) + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, o] = x1[b, :] @ W[o] @ x2[b, :] (+ bias)."""
+    out = jnp.einsum("bi,oij,bj->bo", jnp.asarray(x1), jnp.asarray(weight),
+                     jnp.asarray(x2))
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    rng_ = jnp.arange(x.shape[-1])
+    rows = rng_ + max(-offset, 0)
+    cols = rng_ + max(offset, 0)
+    out = out.at[..., rows, cols].set(x)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for dst, src in order:
+            perm.insert(dst, src)
+        out = jnp.transpose(out, perm)
+    return out
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        x = x.reshape(N, groups, C // groups, H, W)
+        return jnp.swapaxes(x, 1, 2).reshape(N, C, H, W)
+    N, H, W, C = x.shape
+    x = x.reshape(N, H, W, groups, C // groups)
+    return jnp.swapaxes(x, 3, 4).reshape(N, H, W, C)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    r = downscale_factor
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        x = x.reshape(N, C, H // r, r, W // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(N, C * r * r, H // r, W // r)
+    N, H, W, C = x.shape
+    x = x.reshape(N, H // r, r, W // r, r, C)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(N, H // r, W // r, C * r * r)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    left, right, top, bottom = _pair(padding, 4)
+    x = jnp.asarray(x)
+    if data_format == "NCHW":
+        return jnp.pad(x, ((0, 0), (0, 0), (top, bottom), (left, right)))
+    return jnp.pad(x, ((0, 0), (top, bottom), (left, right), (0, 0)))
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry backtrace (reference ``gather_tree`` op):
+    ``ids``/``parents`` [T, B, beam] -> full sequences re-rooted so every
+    step follows the surviving beam's parent chain."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T, B, K = ids.shape
+    binx = jnp.arange(B)[:, None]
+
+    def step(beam_at_t, t):
+        # walking backwards: pick each output beam's token, then its parent
+        tok = ids[t][binx, beam_at_t]
+        par = parents[t][binx, beam_at_t]
+        return par, tok
+
+    _, toks = jax.lax.scan(step, jnp.broadcast_to(jnp.arange(K), (B, K)),
+                           jnp.arange(T - 1, -1, -1))
+    return toks[::-1]
+
+
+# ------------------------------------------------ pooling long tail (r4)
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask is not supported for adaptive max pooling")
+    x4 = jnp.expand_dims(jnp.asarray(x), -1)
+    out = adaptive_max_pool2d(x4, (output_size, 1), return_mask=False)
+    return jnp.squeeze(out, -1)
+
+
+def _adaptive_pool_nd(x, output_size, axes, reduce_fn):
+    def pool_axis(arr, axis, out_size):
+        size = arr.shape[axis]
+        starts = (np.arange(out_size) * size) // out_size
+        ends = ((np.arange(out_size) + 1) * size + out_size - 1) // out_size
+        segs = [reduce_fn(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                          axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)]
+        return jnp.concatenate(segs, axis=axis)
+
+    for axis, osz in zip(axes, output_size):
+        x = pool_axis(x, axis, osz)
+    return x
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    x = jnp.asarray(x)
+    sizes = _pair(output_size, 3)
+    axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    sizes = [x.shape[a] if s is None else int(s)
+             for a, s in zip(axes, sizes)]
+    return _adaptive_pool_nd(x, sizes, axes, jnp.mean)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask is not supported for adaptive max pooling")
+    x = jnp.asarray(x)
+    sizes = [x.shape[a] if s is None else int(s)
+             for a, s in zip((2, 3, 4), _pair(output_size, 3))]
+    return _adaptive_pool_nd(x, sizes, (2, 3, 4), jnp.max)
+
+
+def _max_pool2d_with_mask(x, kernel_size, stride, padding):
+    """(pooled, flat spatial argmax) via explicit window gathers — the
+    indices max_unpool consumes (reference flattens over H*W)."""
+    kh, kw = _pair(kernel_size, 2)
+    sh, sw = _pair(stride or kernel_size, 2)
+    ph, pw = _pair(padding, 2)
+    N, C, H, W = x.shape
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    rows = (np.arange(Ho)[:, None] * sh - ph) + np.arange(kh)[None]  # [Ho,kh]
+    cols = (np.arange(Wo)[:, None] * sw - pw) + np.arange(kw)[None]
+    rvalid = (rows >= 0) & (rows < H)
+    cvalid = (cols >= 0) & (cols < W)
+    rc = jnp.asarray(np.clip(rows, 0, H - 1))
+    cc = jnp.asarray(np.clip(cols, 0, W - 1))
+    # windows [N, C, Ho, kh, Wo, kw]
+    wnd = x[:, :, rc][:, :, :, :, cc]
+    mask = jnp.asarray(rvalid)[None, None, :, :, None, None] \
+        & jnp.asarray(cvalid)[None, None, None, None, :, :]
+    sentinel = (-jnp.inf if jnp.issubdtype(x.dtype, np.floating)
+                else jnp.iinfo(x.dtype).min)  # keep int inputs int
+    wnd = jnp.where(mask, wnd, sentinel)
+    wnd = jnp.transpose(wnd, (0, 1, 2, 4, 3, 5)).reshape(
+        N, C, Ho, Wo, kh * kw)
+    arg = jnp.argmax(wnd, axis=-1)
+    pooled = jnp.max(wnd, axis=-1)
+    ar = jnp.take_along_axis(jnp.asarray(rows).reshape(1, 1, Ho, 1, kh),
+                             (arg // kw)[..., None].astype(jnp.int32),
+                             axis=4)[..., 0]
+    acw = jnp.take_along_axis(jnp.asarray(cols).reshape(1, 1, 1, Wo, kw),
+                              (arg % kw)[..., None].astype(jnp.int32),
+                              axis=4)[..., 0]
+    return pooled, (ar * W + acw).astype(jnp.int32)
+
+
+def _flat_unpool(x, idx, out_len):
+    """Scatter pooled values to their flat spatial argmax positions."""
+    N, C = x.shape[:2]
+    flat = jnp.zeros((N, C, out_len), x.dtype)
+    nidx = jnp.arange(N)[:, None, None]
+    cidx = jnp.arange(C)[None, :, None]
+    return flat.at[nidx, cidx, idx.reshape(N, C, -1)].set(
+        x.reshape(N, C, -1))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Scatter pooled values back to their argmax positions (reference
+    ``max_unpool2d``; indices are flat over H*W, as ``max_pool2d``'s
+    ``return_mask`` emits)."""
+    x = jnp.asarray(x)
+    idx = jnp.asarray(indices)
+    kh, kw = _pair(kernel_size, 2)
+    sh, sw = _pair(stride or kernel_size, 2)
+    ph, pw = _pair(padding, 2)
+    N, C, Ho, Wo = x.shape
+    if output_size is None:
+        H = (Ho - 1) * sh - 2 * ph + kh
+        W = (Wo - 1) * sw - 2 * pw + kw
+    else:
+        H, W = output_size[-2], output_size[-1]
+    return _flat_unpool(x, idx, H * W).reshape(N, C, H, W)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    x4 = jnp.expand_dims(jnp.asarray(x), -1)
+    i4 = jnp.expand_dims(jnp.asarray(indices), -1)
+    osz = None if output_size is None else (output_size[-1], 1)
+    out = max_unpool2d(x4, i4, (kernel_size, 1),
+                       (stride or kernel_size, 1), (padding, 0), osz)
+    return jnp.squeeze(out, -1)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """Flat-over-D*H*W indices, same scatter as 2d."""
+    x = jnp.asarray(x)
+    idx = jnp.asarray(indices)
+    kd, kh, kw = _pair(kernel_size, 3)
+    sd, sh, sw = _pair(stride or kernel_size, 3)
+    pd, ph, pw = _pair(padding, 3)
+    N, C, Do, Ho, Wo = x.shape
+    if output_size is None:
+        D = (Do - 1) * sd - 2 * pd + kd
+        H = (Ho - 1) * sh - 2 * ph + kh
+        W = (Wo - 1) * sw - 2 * pw + kw
+    else:
+        D, H, W = output_size[-3:]
+    return _flat_unpool(x, idx, D * H * W).reshape(N, C, D, H, W)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of :func:`unfold`: scatter-add column patches back into the
+    image (overlaps sum, reference ``fold``)."""
+    x = jnp.asarray(x)                       # [N, C*kh*kw, L]
+    H, W = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+    N = x.shape[0]
+    C = x.shape[1] // (kh * kw)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(N, C, kh, kw, Ho, Wo)
+    out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + Ho * sh:sh,
+                         j * dw:j * dw + Wo * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + H, pw:pw + W]
+
+
+# ------------------------------------------------- loss long tail (r4)
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Reference ``dice_loss``: input [N, ..., C] probabilities, label
+    [N, ..., 1] class ids."""
+    x = jnp.asarray(input)
+    lab = jnp.asarray(label)
+    if lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    onehot = jax.nn.one_hot(lab, x.shape[-1], dtype=x.dtype)
+    reduce_axes = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * onehot, axis=reduce_axes)
+    denom = jnp.sum(x, axis=reduce_axes) + jnp.sum(onehot, axis=reduce_axes)
+    return jnp.mean(1.0 - (inter + epsilon) / (denom + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    p = jnp.asarray(input)
+    y = jnp.asarray(label).astype(p.dtype)
+    return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).astype(x.dtype)
+    # softplus(-yx), not log1p(exp(-yx)): the latter overflows at |x|>~88
+    return _reduce_loss(jax.nn.softplus(-y * x), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).astype(x.dtype)
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,  # noqa: A002
+                      weight=None, reduction="mean", name=None):
+    x = jnp.asarray(input)
+    lab = jnp.asarray(label).astype(jnp.int32)
+    target = jnp.take_along_axis(x, lab[:, None], axis=1)
+    m = jnp.maximum(0.0, margin - target + x) ** p
+    if weight is not None:
+        m = m * jnp.take(jnp.asarray(weight), lab)[:, None]
+    # exclude the target class term
+    m = m * (1 - jax.nn.one_hot(lab, x.shape[1], dtype=x.dtype))
+    return _reduce_loss(jnp.sum(m, axis=1) / x.shape[1], reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    a = jnp.asarray(anchor)
+    pos = jnp.asarray(positive)
+    lab = jnp.asarray(labels).reshape(-1)
+    sim = a @ pos.T                                    # [B, B]
+    tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    xent = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                    + jnp.mean(jnp.sum(pos * pos, 1))) * 0.25
+    return xent + reg
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: jnp.linalg.norm(jnp.asarray(a) - jnp.asarray(b),
+                                     axis=-1))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce_loss(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid (reference ``hsigmoid_loss``): default
+    complete-binary-tree coding (word2vec heap scheme — leaf ``c`` is heap
+    node ``num_classes + c``; internal nodes 1..num_classes-1, weight row
+    = node - 1), or a custom tree via path_table/path_code."""
+    x = jnp.asarray(input)
+    lab = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    w = jnp.asarray(weight)
+    if path_table is None:
+        depth = int(math.ceil(math.log2(max(num_classes, 2)))) + 1
+        nodes, codes, masks = [], [], []
+        node = lab + num_classes
+        for _ in range(depth):
+            parent = node // 2
+            codes.append((node % 2).astype(jnp.float32))
+            live = parent >= 1
+            masks.append(live.astype(jnp.float32))
+            nodes.append(jnp.where(live, parent, 1))
+            node = parent
+        path_table = jnp.stack(nodes, 1) - 1          # weight rows
+        path_code = jnp.stack(codes, 1)
+        mask = jnp.stack(masks, 1)
+    else:
+        path_table = jnp.asarray(path_table)
+        path_code = jnp.asarray(path_code).astype(jnp.float32)
+        mask = (path_table >= 0).astype(jnp.float32)
+        path_table = jnp.maximum(path_table, 0)
+    logits = jnp.einsum("bd,bkd->bk", x, w[path_table])
+    if bias is not None:
+        logits = logits + jnp.asarray(bias).reshape(-1)[path_table]
+    # code 1 -> sigmoid(logit), code 0 -> sigmoid(-logit)
+    sign = 2.0 * path_code - 1.0
+    nll = jax.nn.softplus(-sign * logits) * mask
+    return jnp.sum(nll, axis=1, keepdims=True)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-style combined-margin softmax (reference
+    ``margin_cross_entropy``): target logit cos(theta) becomes
+    cos(m1*theta + m2) - m3, everything scaled by ``scale``."""
+    cos = jnp.clip(jnp.asarray(logits), -1.0, 1.0)
+    lab = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, cos.shape[-1], dtype=cos.dtype)
+    theta = jnp.arccos(jnp.clip(cos, -1 + 1e-7, 1 - 1e-7))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adj = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    loss = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+    loss = _reduce_loss(loss, reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(adj, axis=-1)
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC class-center sampling (reference
+    ``class_center_sample``): keep every positive class plus random
+    negatives up to ``num_samples``; labels are remapped into the sampled
+    index space. Host-side/eager (data-prep op, dynamic output)."""
+    lab = np.asarray(label).reshape(-1)
+    pos = np.unique(lab)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, num_samples - pos.size)
+    from ..framework.random import next_key
+
+    # framework-governed randomness: varies per call, reproducible under
+    # paddle_tpu.seed (the label-sum seeding an earlier draft used would
+    # resample the SAME negatives for any batch with colliding label sums)
+    seed = int(jax.random.randint(next_key(), (), 0, 2 ** 31 - 1))
+    rng = np.random.default_rng(seed)
+    extra = rng.choice(rest, size=min(n_extra, rest.size), replace=False)
+    sampled = np.concatenate([pos, np.sort(extra)]).astype(np.int64)
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.asarray([remap[c] for c in lab], np.int64)
+    return jnp.asarray(remapped), jnp.asarray(sampled)
+
+
+# ------------------------------------------------ vision warps (r4)
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """[N, 2, 3] affine params -> [N, H, W, 2] normalized sampling grid."""
+    theta = jnp.asarray(theta)
+    N, _, H, W = (out_shape[0], out_shape[1], out_shape[2], out_shape[3])
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys = axis_coords(H)
+    xs = axis_coords(W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,nik->nhwi", base, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample [N, C, H, W] at normalized grid [N, Ho, Wo, 2] (reference
+    ``grid_sample``; bilinear/nearest, zeros/border/reflection padding)."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    N, C, H, W = x.shape
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1) * (size - 1) / 2
+        return ((g + 1) * size - 1) / 2
+
+    gx = unnorm(grid[..., 0], W)
+    gy = unnorm(grid[..., 1], H)
+
+    def reflect(v, lo, hi):
+        rng_ = hi - lo
+        if rng_ <= 0:  # size-1 axis: every coordinate maps to the texel
+            return jnp.full_like(v, max(lo, 0.0))
+        v = jnp.abs((v - lo) % (2 * rng_))
+        return jnp.where(v > rng_, 2 * rng_ - v, v) + lo
+
+    if padding_mode == "reflection":
+        # reference semantics: reflect about [0, s-1] with align_corners,
+        # about [-0.5, s-0.5] without
+        if align_corners:
+            gx = reflect(gx, 0.0, W - 1.0)
+            gy = reflect(gy, 0.0, H - 1.0)
+        else:
+            gx = jnp.clip(reflect(gx, -0.5, W - 0.5), 0, W - 1)
+            gy = jnp.clip(reflect(gy, -0.5, H - 0.5), 0, H - 1)
+
+    def gather(ix, iy):
+        inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+        ixc = jnp.clip(ix, 0, W - 1)
+        iyc = jnp.clip(iy, 0, H - 1)
+        vals = x[jnp.arange(N)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+        if padding_mode == "zeros":
+            vals = vals * inb[..., None]
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(gx).astype(jnp.int32),
+                     jnp.round(gy).astype(jnp.int32))
+        return jnp.moveaxis(out, -1, 1)
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    wx = (gx - x0)[..., None]
+    wy = (gy - y0)[..., None]
+    out = (gather(x0, y0) * (1 - wx) * (1 - wy)
+           + gather(x0 + 1, y0) * wx * (1 - wy)
+           + gather(x0, y0 + 1) * (1 - wx) * wy
+           + gather(x0 + 1, y0 + 1) * wx * wy)
+    return jnp.moveaxis(out, -1, 1)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention (reference CUDA-only ``sparse_attention``).
+    TPU stance: the CSR layout is materialized as a dense boolean mask and
+    fed to the fused XLA softmax-attention — numerically identical to the
+    reference; for real long-context sparsity use the Pallas flash kernel
+    (``kernels/flash_attention``) or ring attention instead."""
+    q = jnp.asarray(query)
+    k = jnp.asarray(key)
+    v = jnp.asarray(value)
+    B, H, L, D = q.shape
+    offs = np.asarray(sparse_csr_offset)
+    cols = np.asarray(sparse_csr_columns)
+    mask = np.zeros((B, H, L, L), bool)
+    for b in range(B):
+        for h in range(H):
+            o = offs[b, h]
+            c = cols[b, h]
+            for r in range(L):
+                mask[b, h, r, c[o[r]:o[r + 1]]] = True
+    s = jnp.einsum("bhld,bhmd->bhlm", q, k) / math.sqrt(D)
+    s = jnp.where(jnp.asarray(mask), s, -1e30)
+    if attn_mask is not None:
+        s = s + jnp.asarray(attn_mask)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bhmd->bhld", p, v)

@@ -238,3 +238,16 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of [N, C, H, W] (reference
+    ``paddle.nn.Softmax2D``)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
