@@ -61,6 +61,7 @@ from .hapi import InputSpec, Model, flops, summary  # noqa: F401
 # paddle.jit module parity (to_static/save/load); the bare compile decorator
 # stays available as paddle_tpu.jit.to_static and framework.jit.jit
 from . import jit  # noqa: F401
+from . import static  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
 from . import eager  # noqa: F401  (Tensor.backward dygraph facade)

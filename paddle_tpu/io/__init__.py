@@ -3,7 +3,7 @@ from .slot_dataset import InMemoryDataset  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .worker import WorkerInfo, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
-    BatchSampler, ChainDataset, ConcatDataset, Dataset,
+    BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, Dataset,
     DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
     SequenceSampler, Subset, TensorDataset, WeightedRandomSampler,
     random_split,

@@ -76,6 +76,31 @@ class ChainDataset(IterableDataset):
             yield from d
 
 
+class ComposeDataset(Dataset):
+    """Zip datasets by index: sample i is the flattened concatenation of
+    every component's sample i (reference ``paddle.io.ComposeDataset``)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "need at least one dataset"
+        n = len(self.datasets[0])
+        assert all(len(d) == n for d in self.datasets), \
+            "ComposeDataset requires equal lengths"
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            if isinstance(sample, (tuple, list)):
+                out.extend(sample)
+            else:
+                out.append(sample)
+        return tuple(out)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+
 def random_split(dataset, lengths, generator=None):
     from ..framework import random as fr
 

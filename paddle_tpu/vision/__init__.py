@@ -8,4 +8,37 @@ directly — see ``paddle_tpu.models.resnet``).
 """
 from . import datasets, models, ops, transforms
 
-__all__ = ["models", "transforms", "datasets", "ops"]
+__all__ = ["models", "transforms", "datasets", "ops",
+           "get_image_backend", "set_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    """Reference ``paddle.vision.set_image_backend``: choose the decoder
+    for ``image_load``. 'pil' and 'cv2' accepted; 'cv2' requires opencv
+    (not in this image — errors at load time, not here, matching the
+    reference's lazy check)."""
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path: str, backend: str = None):
+    """Load an image via the configured backend (reference
+    ``paddle.vision.image_load``)."""
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    if backend == "cv2":
+        import cv2  # noqa: F401  (not shipped in this image)
+
+        return cv2.imread(path)
+    from PIL import Image
+
+    return Image.open(path)

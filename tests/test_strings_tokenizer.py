@@ -156,3 +156,28 @@ def test_top_level_api_compat():
         pass
     pt.disable_signal_handler()
     assert pt.Tensor is pt.eager.Tensor
+
+
+def test_static_facade(tmp_path):
+    """paddle.static collapsed surface: data->InputSpec,
+    save/load_inference_model over jit artifacts, honest migration errors
+    on op-append machinery."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu import static
+
+    spec = static.data("x", [None, 4], "float32")
+    assert spec.name == "x" and spec.shape[1] == 4
+    with static.program_guard(static.default_main_program()):
+        with static.name_scope("block"):
+            pass
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 2))
+    prefix = str(tmp_path / "sim")
+    static.save_inference_model(prefix, [spec], net)
+    prog = static.load_inference_model(prefix)
+    out = prog(np.ones((3, 4), np.float32))
+    assert np.asarray(out).shape == (3, 2)
+    with pytest.raises(NotImplementedError, match="to_static"):
+        static.Executor().run()
+    with pytest.raises(NotImplementedError, match="to_static"):
+        static.default_main_program().global_block()
