@@ -32,6 +32,16 @@ from .heter import HeterPipelineTrainer  # noqa: F401
 from . import passes  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401
+from . import stream  # noqa: F401
+from .api_compat import (  # noqa: F401
+    CountFilterEntry, Group, P2POp, ParallelEnv, ParallelMode,
+    ProbabilityEntry, ShowClickEntry, all_gather_object, alltoall_single,
+    batch_isend_irecv, destroy_process_group, get_group,
+    group_sharded_parallel, irecv, isend, new_group, recv, reduce,
+    save_group_sharded_model, scatter, send, split, wait,
+)
+from .auto_parallel import shard_op, shard_tensor  # noqa: F401
+from ..io.slot_dataset import BoxPSDataset, QueueDataset  # noqa: F401
 from .ps.graph import GraphDataGenerator, GraphTable  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .checkpoint import (  # noqa: F401

@@ -33,9 +33,18 @@ class ReduceOp:
 
 
 def _axis(group):
-    """Accept an axis name, tuple of names, or None (-> 'dp')."""
+    """Accept an axis name, tuple of names, a Group (maps via its mesh
+    axis), or None (-> 'dp')."""
     if group is None:
         return "dp"
+    ax = getattr(group, "axis", None)  # api_compat.Group
+    if ax is not None:
+        return ax
+    if hasattr(group, "ranks"):
+        raise ValueError(
+            "this Group carries no mesh-axis mapping; create it with "
+            "new_group(..., axis=<mesh axis name>) to use it in "
+            "collectives")
     return group
 
 

@@ -149,6 +149,11 @@ class DistributedTrainStep:
         self.mesh = mesh or require_mesh()
         self.batch_axes = batch_axes
 
+        # the public ZeRO entry (group_sharded_parallel) tags the optimizer
+        # with the USER's requested stage; it must not be silently
+        # downgraded by a caller's heuristic default (Engine passes 2)
+        sharding_stage = max(sharding_stage,
+                             getattr(optimizer, "_group_sharded_stage", 0))
         zero3 = "sdp" if sharding_stage >= 3 else None
         self.specs = param_specs(model, self.mesh, zero3_axis=zero3)
         self.params = shard_params(param_state(model), self.specs, self.mesh)

@@ -1,0 +1,37 @@
+"""paddle.distributed.stream — stream-variant collectives.
+
+Reference parity: ``python/paddle/distributed/communication/stream/``
+(collectives launched on a caller-chosen CUDA stream with
+``sync_op``/``use_calc_stream`` control). TPU-native collapse: XLA owns
+stream scheduling and overlaps collectives with compute during
+fusion/latency-hiding — the knobs are accepted and ignored, the math
+delegates to :mod:`.collective`.
+"""
+from __future__ import annotations
+
+from . import api_compat as _a
+from . import collective as _c
+
+
+def _wrap(fn):
+    def call(*args, sync_op=True, use_calc_stream=False, **kw):
+        return fn(*args, **kw)
+
+    call.__name__ = fn.__name__
+    call.__doc__ = f"stream variant of collective.{fn.__name__} " \
+                   "(sync_op/use_calc_stream collapse under XLA)"
+    return call
+
+
+all_reduce = _wrap(_c.all_reduce)
+all_gather = _wrap(_c.all_gather)
+alltoall = _wrap(_c.alltoall)
+alltoall_single = _wrap(_a.alltoall_single)
+broadcast = _wrap(_c.broadcast)
+reduce_scatter = _wrap(_c.reduce_scatter)
+scatter = _wrap(_a.scatter)
+send = _wrap(_a.send)
+recv = _wrap(_a.recv)
+
+__all__ = ["all_reduce", "all_gather", "alltoall", "alltoall_single",
+           "broadcast", "reduce_scatter", "scatter", "send", "recv"]

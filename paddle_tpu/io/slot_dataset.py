@@ -21,7 +21,7 @@ import numpy as np
 
 from .. import native
 
-__all__ = ["InMemoryDataset"]
+__all__ = ["InMemoryDataset", "QueueDataset", "BoxPSDataset"]
 
 
 class InMemoryDataset:
@@ -109,3 +109,28 @@ class InMemoryDataset:
                 self._lib.pt_feed_destroy(h)
             except Exception:
                 pass
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming slot dataset (reference ``QueueDataset``): feeds files in
+    order without the in-memory global shuffle pass — the reference skips
+    its shuffle channels; here the collapse is ``shuffle=False`` on the
+    same C++ slot feed."""
+
+    def local_shuffle(self, seed=None):
+        # reference QueueDataset raises here too: streaming mode cannot
+        # shuffle, and a silent no-op would train on file-ordered data
+        raise NotImplementedError(
+            "QueueDataset streams files in order; use InMemoryDataset for "
+            "shuffled training")
+
+    def global_shuffle(self, fleet=None, seed=None):
+        raise NotImplementedError(
+            "QueueDataset streams files in order; use InMemoryDataset for "
+            "shuffled training")
+
+
+class BoxPSDataset(QueueDataset):
+    """Reference ``BoxPSDataset`` targets the BoxPS ads engine
+    (``paddle/fluid/framework/fleet/box_wrapper.h``); its data path is the
+    streaming slot feed, which is what this collapse keeps."""
