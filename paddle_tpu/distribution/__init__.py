@@ -7,7 +7,8 @@ Gumbel/Laplace/LogNormal/Multinomial, TransformedDistribution + transforms,
 keys (a ``seed`` argument or the global generator), densities are jnp —
 everything traces under jit and vmaps.
 """
-from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,  # noqa: E501
+                            ExponentialFamily, Independent,
                             Distribution, Exponential, Gamma, Geometric,
                             Gumbel, Laplace, LogNormal, Multinomial, Normal,
                             Uniform)
@@ -17,6 +18,7 @@ from .transformed import (AbsTransform, AffineTransform, ChainTransform,
                           Transform, TransformedDistribution, TanhTransform)
 
 __all__ = [
+    "ExponentialFamily", "Independent",
     "Distribution", "Normal", "Uniform", "Bernoulli", "Beta", "Categorical",
     "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
     "LogNormal", "Multinomial", "kl_divergence", "register_kl", "Transform",

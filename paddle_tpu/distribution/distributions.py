@@ -488,3 +488,77 @@ class Multinomial(Distribution):
         coeff = (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
                  - jsp.gammaln(value + 1.0).sum(-1))
         return coeff + (value * logp).sum(-1)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    ``exponential_family.py``): subclasses expose natural parameters and
+    the log-normalizer; a generic Bregman-divergence entropy falls out of
+    autodiff on the log-normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    # subclasses override when the carrier measure is non-zero
+    _mean_carrier_measure = 0.0
+
+    def entropy(self):
+        """Batch-shaped entropy via the Bregman trick (reference
+        ``exponential_family.py``): A(nat) - <nat, dA/dnat> -
+        E[carrier]. The grad of the SUMMED log-normalizer is the
+        per-element gradient (batch entries are independent), so the
+        inner product stays batch-shaped."""
+        import jax
+
+        nat = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        logA = self._log_normalizer(*nat)
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(nat)
+        ent = logA - self._mean_carrier_measure
+        for n, g in zip(nat, grads):
+            ent = ent - n * g
+        return ent
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_ndims`` batch dims
+    of a base distribution as event dims (reference ``independent.py``):
+    log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_ndims: int):
+        self.base = base
+        self.k = int(reinterpreted_batch_ndims)
+        bshape = tuple(base.batch_shape)
+        if self.k > len(bshape):
+            raise ValueError("reinterpreted_batch_ndims exceeds the base "
+                             "distribution's batch rank")
+        super().__init__(bshape[:len(bshape) - self.k],
+                         bshape[len(bshape) - self.k:]
+                         + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=(), seed=None):
+        return self.base.sample(shape, seed)
+
+    def rsample(self, shape=(), seed=None):
+        return self.base.rsample(shape, seed)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.k, 0)) if self.k else ()
+        return jnp.sum(lp, axis=axes) if axes else lp
+
+    def entropy(self):
+        ent = self.base.entropy()
+        axes = tuple(range(-self.k, 0)) if self.k else ()
+        return jnp.sum(ent, axis=axes) if axes else ent

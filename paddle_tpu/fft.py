@@ -94,3 +94,42 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return jnp.fft.ifftshift(jnp.asarray(x), axes=axes)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d Hermitian FFT (reference ``hfftn``): c2c FFT over the leading
+    axes, Hermitian c2r transform over the last — the torch/paddle
+    decomposition (jnp ships only the 1-d ``hfft``)."""
+    x = jnp.asarray(x)
+    if axes is None:  # numpy semantics: s decides how many trailing axes
+        axes = tuple(range(-(len(s) if s is not None else x.ndim), 0))
+    axes = tuple(axes)
+    if len(axes) > 1:
+        x = jnp.fft.fftn(x, s=None if s is None else tuple(s[:-1]),
+                         axes=axes[:-1], norm=_norm(norm))
+    return jnp.fft.hfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=_norm(norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(-(len(s) if s is not None else x.ndim), 0))
+    axes = tuple(axes)
+    y = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                      norm=_norm(norm))
+    if len(axes) > 1:
+        y = jnp.fft.ifftn(y, s=None if s is None else tuple(s[:-1]),
+                          axes=axes[:-1], norm=_norm(norm))
+    return y
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+__all__ += ["hfft2", "hfftn", "ihfft2", "ihfftn"]

@@ -6,6 +6,15 @@ jvp/vjp/Jacobian/Hessian), ``asp/`` (2:4 structured sparsity),
 ``paddle_tpu.distributed.parallel.moe`` (already first-class here).
 """
 from . import asp, autograd, nn
+from .operators import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, identity_loss, segment_max, segment_mean, segment_min,
+    segment_sum, softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
 from .optimizer import LookAhead, ModelAverage
 
-__all__ = ["autograd", "asp", "nn", "LookAhead", "ModelAverage"]
+__all__ = ["autograd", "asp", "nn", "LookAhead", "ModelAverage",
+           "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
+           "graph_send_recv", "identity_loss", "segment_max",
+           "segment_mean", "segment_min", "segment_sum",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

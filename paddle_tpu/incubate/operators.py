@@ -1,0 +1,114 @@
+"""incubate op wrappers: graph sampling + segment + fused-softmax names.
+
+Reference parity: ``python/paddle/incubate/__init__.py`` exports —
+``graph_khop_sampler``/``graph_sample_neighbors``/``graph_reindex``/
+``graph_send_recv`` (``incubate/operators/graph_*.py``, deprecated
+aliases of the ``paddle.geometric`` API, kept because ported code still
+imports them), ``segment_{sum,mean,min,max}``
+(``incubate/tensor/math.py``), ``identity_loss``, and
+``softmax_mask_fuse(_upper_triangle)``
+(``incubate/operators/softmax_mask_fuse*.py`` — hand-fused CUDA in the
+reference; a plain composition here, XLA fuses it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import geometric as G
+
+__all__ = ["graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
+           "graph_send_recv", "segment_sum", "segment_mean", "segment_min",
+           "segment_max", "identity_loss", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    if return_eids:
+        raise NotImplementedError("edge-id return is not tracked by the "
+                                  "geometric sampler")
+    return G.sample_neighbors(row, colptr, input_nodes,
+                              sample_size=sample_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    if return_eids:
+        raise NotImplementedError("edge-id return is not tracked by the "
+                                  "geometric sampler")
+    return G.khop_sampler(row, colptr, input_nodes, sample_sizes)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    return G.reindex_graph(x, neighbors, count)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    return G.send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                         out_size=out_size)
+
+
+def _num_segments(seg, num_segments):
+    """Eager default: max(seg)+1. Under jit, ids are traced and the
+    output shape must be static — pass ``num_segments`` explicitly."""
+    if num_segments is not None:
+        return int(num_segments)
+    return int(np.asarray(seg).max()) + 1 if seg.size else 0
+
+
+def _segment(reduce_fn):
+    def apply(data, segment_ids, num_segments=None, name=None):
+        data = jnp.asarray(data)
+        seg = jnp.asarray(segment_ids)
+        return reduce_fn(data, seg,
+                         num_segments=_num_segments(seg, num_segments))
+
+    return apply
+
+
+segment_sum = _segment(jax.ops.segment_sum)
+segment_max = _segment(jax.ops.segment_max)
+segment_min = _segment(jax.ops.segment_min)
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    data = jnp.asarray(data)
+    seg = jnp.asarray(segment_ids)
+    num = _num_segments(seg, num_segments)
+    s = jax.ops.segment_sum(data, seg, num_segments=num)
+    cnt = jax.ops.segment_sum(jnp.ones_like(seg, data.dtype), seg,
+                              num_segments=num)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(cnt.reshape(shape), 1)
+
+
+def identity_loss(x, reduction="none", name=None):
+    """Reference ``identity_loss``: marks a tensor as the loss for IPU
+    pipelining; numerically reduce-or-identity."""
+    x = jnp.asarray(x)
+    if reduction in ("mean", 1):
+        return jnp.mean(x)
+    if reduction in ("sum", 0):
+        return jnp.sum(x)
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Reference fused softmax(x + mask) (CUDA kernel
+    ``fused_softmax_mask_op``); XLA fuses the composition."""
+    return jax.nn.softmax(jnp.asarray(x) + jnp.asarray(mask), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the causal (upper-triangle masked) pattern fused."""
+    x = jnp.asarray(x)
+    L = x.shape[-1]
+    mask = jnp.tril(jnp.ones((x.shape[-2], L), bool), k=L - x.shape[-2])
+    return jax.nn.softmax(jnp.where(mask, x, jnp.finfo(x.dtype).min),
+                          axis=-1)

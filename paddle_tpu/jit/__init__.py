@@ -31,7 +31,8 @@ from ..hapi.model import InputSpec
 from ..nn.layer import Layer, buffer_state, functional_call, param_state
 
 __all__ = ["to_static", "save", "load", "TranslatedLayer", "InputSpec",
-           "not_to_static"]
+           "not_to_static", "ProgramTranslator", "TracedLayer",
+           "set_code_level", "set_verbosity", "enable_to_static"]
 
 
 def to_static(fn=None, *, loop_bound=None, **kwargs):
@@ -54,12 +55,34 @@ def to_static(fn=None, *, loop_bound=None, **kwargs):
         return functools.partial(to_static, loop_bound=loop_bound, **kwargs)
     from .dy2static import convert_control_flow, convert_layer
 
+    # the global switch is consulted at CALL time (the reference's
+    # StaticFunction checks it per call): enable(False) after decoration
+    # must fall back to the ORIGINAL eager code
     if isinstance(fn, Layer):
+        orig_forward = fn.forward  # bound, pre-conversion
         convert_layer(fn, loop_bound=loop_bound)
-        return jit(fn, **kwargs)
+        compiled = jit(fn, **kwargs)
+
+        def dispatch(*args, **kw):
+            if not ProgramTranslator.enable_to_static:
+                return orig_forward(*args, **kw)
+            return compiled(*args, **kw)
+
+        dispatch.__wrapped_layer__ = fn
+        return dispatch
     if callable(fn):
-        return jit(convert_control_flow(fn, loop_bound=loop_bound),
-                   **kwargs)
+        compiled = jit(convert_control_flow(fn, loop_bound=loop_bound),
+                       **kwargs)
+
+        def dispatch(*args, **kw):
+            if not ProgramTranslator.enable_to_static:
+                return fn(*args, **kw)
+            return compiled(*args, **kw)
+
+        import functools
+
+        functools.update_wrapper(dispatch, fn)
+        return dispatch
     return jit(fn, **kwargs)
 
 
@@ -200,3 +223,76 @@ def load(path: str) -> TranslatedLayer:
     with open(path + ".pdiparams", "rb") as f:
         params, buffers = pickle.load(f)
     return TranslatedLayer(exported, params, buffers)
+
+
+# ---------------------------------------------------- translator controls
+class ProgramTranslator:
+    """Global dy2static switch (reference ``ProgramTranslator``): ported
+    code calls ``get_instance().enable(False)`` to run converted models
+    eagerly — here that makes :func:`to_static` skip AST conversion AND
+    compilation (functions run as plain python)."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls) -> "ProgramTranslator":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool) -> None:
+        type(self).enable_to_static = bool(enable_to_static)
+
+
+def enable_to_static(flag: bool = True) -> None:
+    ProgramTranslator.get_instance().enable(flag)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    """dy2static logging verbosity (reference ``set_verbosity``)."""
+    import logging
+    import sys as _sys
+
+    logger = logging.getLogger("paddle_tpu.jit.dy2static")
+    logger.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stdout and not any(
+            isinstance(h, logging.StreamHandler) for h in logger.handlers):
+        logger.addHandler(logging.StreamHandler(_sys.stdout))
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """Log the transformed source of converted functions (reference
+    ``set_code_level``); consumed by dy2static.convert_control_flow."""
+    from . import dy2static
+
+    dy2static.CODE_LEVEL = int(level)
+    set_verbosity(1 if level > 0 else 0, also_to_stdout)
+
+
+class TracedLayer:
+    """Reference ``TracedLayer``: trace a layer once on example inputs and
+    reuse/serve the captured program. Collapsed: the capture is
+    ``to_static`` + ``jax.jit``; ``save_inference_model`` writes the same
+    StableHLO artifact the Predictor serves."""
+
+    def __init__(self, layer, example_inputs):
+        self._layer = layer
+        self._inputs = list(example_inputs)
+        self._compiled = jit(layer)
+
+    @staticmethod
+    def trace(layer, inputs):
+        traced = TracedLayer(layer, inputs)
+        return traced(*inputs), traced
+
+    def __call__(self, *inputs):
+        return self._compiled(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from ..hapi.model import InputSpec
+
+        specs = [InputSpec(list(jnp.shape(x)),
+                           dtype=str(jnp.asarray(x).dtype))
+                 for x in self._inputs]
+        save(self._layer, path, input_spec=specs)

@@ -51,6 +51,10 @@ __all__ = ["convert_control_flow", "convert_if", "convert_while",
 
 _log = logging.getLogger(__name__)
 
+# >0: log the rebuilt source of every converted function (set via
+# paddle_tpu.jit.set_code_level — the reference's transformed-code dump)
+CODE_LEVEL = 0
+
 
 # --------------------------------------------------------------- runtime
 class _Undef:
@@ -491,6 +495,9 @@ def convert_control_flow(fn, loop_bound=None):
         _log.warning("dy2static: could not recompile %s; control flow "
                      "stays trace-only", fn.__qualname__)
         return fn
+    if CODE_LEVEL:
+        _log.info("dy2static transformed %s:\n%s", fn.__qualname__,
+                  ast.unparse(module))
     glb = dict(fn.__globals__)
     from . import dy2static as _self
 

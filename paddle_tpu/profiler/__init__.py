@@ -206,6 +206,9 @@ class Profiler:
                 self.on_trace_ready(self)
 
     def start(self):
+        # fresh session: a lingering previous session's spans must not
+        # leak into this capture's export
+        _recorder.clear()
         self.current_state = self.scheduler(self.step_num)
         self._ensure_tracing(self.current_state in
                              (ProfilerState.RECORD,
@@ -313,3 +316,65 @@ _global_timer = Timer()
 def benchmark() -> Timer:
     """Module-level benchmarker (reference ``paddle.profiler.utils`` style)."""
     return _global_timer
+
+
+class SortedKeys(enum.Enum):
+    """Summary-table sort keys (reference ``profiler_statistic.SortedKeys``)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """Summary-table views (reference ``SummaryView``)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing the raw span record (reference
+    exports its EventNode tree as protobuf; the host-span JSON here is the
+    same data and :func:`load_profiler_result` reads it back)."""
+    import json
+    import os
+    import socket
+    import time as _time
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"{socket.gethostname()}_{os.getpid()}"
+        path = os.path.join(dir_name,
+                            f"{name}_{int(_time.time() * 1000)}.pb.json")
+        with open(path, "w") as f:
+            json.dump([{"name": n, "start": t0, "end": t1}
+                       for n, t0, t1 in _recorder.spans], f)
+        prof.last_protobuf_path = path
+
+    return handler
+
+
+def load_profiler_result(filename: str):
+    """Load a record written by :func:`export_protobuf`: a list of span
+    dicts (name/start/end/tid)."""
+    import json
+
+    with open(filename) as f:
+        return json.load(f)
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf",
+            "load_profiler_result"]

@@ -151,3 +151,130 @@ def relu(x):
         return SparseCooTensor(jsparse.BCOO((jax.nn.relu(s.data), s.indices),
                                             shape=s.shape))
     return jax.nn.relu(jnp.asarray(x))
+
+
+# ------------------------------------------------- unary/elementwise (r4)
+def _unary(fn):
+    """Lift an elementwise fn that maps 0 -> 0 onto sparse values: apply to
+    the stored values only (the zero pattern is preserved, which is why
+    the reference restricts its sparse unary set to odd-ish functions)."""
+
+    def apply(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            b = x.bcoo
+            return SparseCooTensor(
+                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+        return fn(jnp.asarray(x))
+
+    apply.__name__ = fn.__name__
+    return apply
+
+
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+tanh = _unary(jnp.tanh)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+abs = _unary(jnp.abs)  # noqa: A001
+neg = _unary(jnp.negative)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    if isinstance(x, SparseCooTensor):
+        b = x.bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((jnp.power(b.data, factor), b.indices),
+                         shape=b.shape))
+    return jnp.power(jnp.asarray(x), factor)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    b = x.bcoo
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(index_dtype)
+    val = b.data if value_dtype is None else b.data.astype(value_dtype)
+    return SparseCooTensor(jsparse.BCOO((val, idx), shape=b.shape))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def reshape(x, shape, name=None):
+    return SparseCooTensor(x.bcoo.reshape(tuple(shape)))
+
+
+def transpose(x, perm, name=None):
+    """Permute sparse dims by reindexing (values unchanged)."""
+    b = x.bcoo
+    perm = list(perm)
+    if len(perm) != len(b.shape):
+        raise ValueError("perm must cover every dim")
+    idx = b.indices[:, jnp.asarray(perm, jnp.int32)]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def subtract(a, b, name=None):
+    return add(a, _unary(jnp.negative)(b))
+
+
+def divide(a, b, name=None):
+    """Sparse / dense-scalar-or-sparse-same-pattern divide (reference
+    restricts to matching patterns; here: divide values when patterns are
+    identical, else densify-divide)."""
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        ab, bb = a.bcoo.sum_duplicates(), b.bcoo.sum_duplicates()
+        if ab.indices.shape == bb.indices.shape and bool(
+                jnp.all(ab.indices == bb.indices)):
+            return SparseCooTensor(
+                jsparse.BCOO((ab.data / bb.data, ab.indices),
+                             shape=ab.shape))
+        return ab.todense() / bb.todense()
+    if isinstance(a, SparseCooTensor):
+        b_arr = jnp.asarray(b)
+        bc = a.bcoo
+        if b_arr.ndim > 0:
+            # gather the divisor AT the stored coordinates (positional
+            # broadcast against the nse-ordered value vector would divide
+            # by the wrong elements) — same pattern as multiply()
+            b_arr = b_arr[tuple(bc.indices.T)] if b_arr.ndim == len(
+                bc.shape) else jnp.broadcast_to(
+                    b_arr, bc.shape)[tuple(bc.indices.T)]
+        return SparseCooTensor(
+            jsparse.BCOO((bc.data / b_arr, bc.indices), shape=bc.shape))
+    return jnp.asarray(a) / jnp.asarray(b)
+
+
+def mv(mat, vec, name=None):
+    """Sparse[M, N] @ dense[N] -> dense[M]."""
+    return matmul(mat, jnp.asarray(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta * input + alpha * (x @ y) with sparse x (reference addmm)."""
+    prod = matmul(x, y)
+    prod = prod.to_dense() if isinstance(prod, SparseCooTensor) else prod
+    inp = input.to_dense() if isinstance(input, SparseCooTensor) \
+        else jnp.asarray(input)
+    return beta * inp + alpha * prod
+
+
+__all__ += ["sin", "sinh", "tan", "tanh", "asin", "asinh", "atan", "atanh",
+            "sqrt", "square", "abs", "neg", "log1p", "expm1", "pow",
+            "deg2rad", "rad2deg", "cast", "coalesce", "reshape",
+            "is_same_shape", "subtract", "divide", "mv", "addmm",
+            "transpose"]

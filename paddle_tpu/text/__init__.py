@@ -3,10 +3,11 @@
 Reference parity: ``python/paddle/text`` (dataset loaders and
 ``viterbi_decode``/``ViterbiDecoder``).
 """
-from .datasets import Conll05, Imdb, Imikolov, Movielens, UCIHousing
+from .datasets import (Conll05, Conll05st, Imdb, Imikolov, Movielens,
+                       UCIHousing, WMT14, WMT16)
 from .tokenizer import FasterTokenizer, load_vocab
 from .viterbi_decode import ViterbiDecoder, viterbi_decode
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
-           "viterbi_decode", "ViterbiDecoder", "FasterTokenizer",
-           "load_vocab"]
+           "Conll05st", "WMT14", "WMT16", "viterbi_decode",
+           "ViterbiDecoder", "FasterTokenizer", "load_vocab"]

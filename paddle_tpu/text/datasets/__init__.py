@@ -219,3 +219,58 @@ class Conll05(Dataset):
 
     def __getitem__(self, idx):
         return self._samples[idx]
+
+
+# paddle names the SRL dataset Conll05st; keep both spellings
+Conll05st = Conll05
+
+
+class _WMT(Dataset):
+    """WMT translation pairs from a local tab-separated file (reference
+    ``wmt14.py``/``wmt16.py`` download+tokenize; this environment has no
+    egress, so the published archive must be provided locally; lines:
+    ``src_ids<TAB>trg_ids`` of space-separated ints, or raw
+    ``src<TAB>trg`` text tokenized by whitespace against the dicts)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        super().__init__()
+        data_file = _require(data_file, type(self).__name__)
+        self._samples = []
+        with open(data_file, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) < 2:
+                    continue
+                src, trg = parts[0].split(), parts[1].split()
+
+                def ids(tokens):
+                    import zlib
+
+                    try:
+                        return np.asarray([int(t) for t in tokens], np.int64)
+                    except ValueError:  # raw text: hash-bucket tokenize
+                        # crc32, not hash(): python's hash is salted per
+                        # process — ids must agree across runs/workers
+                        return np.asarray(
+                            [zlib.crc32(t.encode()) % 30000
+                             for t in tokens], np.int64)
+
+                self._samples.append((ids(src), ids(trg)))
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+    def __len__(self):
+        return len(self._samples)
+
+
+class WMT14(_WMT):
+    pass
+
+
+class WMT16(_WMT):
+    pass
+
+
+__all__ += ["Conll05st", "WMT14", "WMT16"]
