@@ -168,3 +168,33 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def resnext50_32x4d(pretrained=False, **kwargs):
     kwargs.update(groups=32, width=4)
     return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=64, width=4)
+    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=32, width=4)
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=64, width=4)
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=32, width=4)
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    kwargs.update(groups=64, width=4)
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs["width"] = 128
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)

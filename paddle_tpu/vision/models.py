@@ -8,17 +8,31 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax.numpy as jnp
+
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
 from ..models.resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
-                             resnet152, resnext50_32x4d, wide_resnet50_2)
+                             resnet152, resnext50_32x4d, resnext50_64x4d,
+                             resnext101_32x4d, resnext101_64x4d,
+                             resnext152_32x4d, resnext152_64x4d,
+                             wide_resnet50_2, wide_resnet101_2)
 
 __all__ = [
     "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1",
-    "MobileNetV2", "MobileNetV3Small", "mobilenet_v1", "mobilenet_v2",
-    "mobilenet_v3_small", "ResNet", "resnet18", "resnet34", "resnet50",
-    "resnet101", "resnet152", "wide_resnet50_2", "resnext50_32x4d",
+    "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v1",
+    "mobilenet_v2", "mobilenet_v3_small", "mobilenet_v3_large", "ResNet",
+    "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
+    "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d", "resnext152_64x4d", "AlexNet", "alexnet",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1", "ShuffleNetV2",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+    "shufflenet_v2_swish", "DenseNet", "densenet121", "densenet161",
+    "densenet169", "densenet201", "densenet264", "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3",
 ]
 
 
@@ -322,3 +336,577 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kw):
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
     return MobileNetV3Small(scale=scale, **kw)
+
+
+# ------------------------------------------------- r4: remaining families
+class MobileNetV3Large(nn.Layer):
+    """``mobilenetv3.py`` large variant (same block algebra as Small)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        HS, RE = nn.Hardswish, nn.ReLU
+        cfg = [  # k, hidden, out, se, act, stride
+            (3, 16, 16, False, RE, 1), (3, 64, 24, False, RE, 2),
+            (3, 72, 24, False, RE, 1), (5, 72, 40, True, RE, 2),
+            (5, 120, 40, True, RE, 1), (5, 120, 40, True, RE, 1),
+            (3, 240, 80, False, HS, 2), (3, 200, 80, False, HS, 1),
+            (3, 184, 80, False, HS, 1), (3, 184, 80, False, HS, 1),
+            (3, 480, 112, True, HS, 1), (3, 672, 112, True, HS, 1),
+            (5, 672, 160, True, HS, 2), (5, 960, 160, True, HS, 1),
+            (5, 960, 160, True, HS, 1),
+        ]
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        layers = [_ConvBNReLU(3, c(16), 3, stride=2, act=HS)]
+        c_in = c(16)
+        for k, hidden, out, se, act, s in cfg:
+            layers.append(_MBV3Block(c_in, c(hidden), c(out), k, s, se, act))
+            c_in = c(out)
+        layers.append(_ConvBNReLU(c_in, c(960), 1, act=HS))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(960), 1280), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((x.shape[0], -1))
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+class AlexNet(nn.Layer):
+    """``alexnet.py``: the 2012 5-conv/3-fc classifier."""
+
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2))
+        self.pool = nn.AdaptiveAvgPool2D((6, 6))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Linear(256 * 36, 4096), nn.ReLU(),
+                nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        x = x.reshape((x.shape[0], -1))
+        return self.classifier(x) if self.num_classes > 0 else x
+
+
+def alexnet(pretrained=False, **kw):
+    return AlexNet(**kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, c_in, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(c_in, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return jnp.concatenate(
+            [F.relu(self.expand1(s)), F.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """``squeezenet.py``: Fire modules, versions "1.0"/"1.1"."""
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"version must be '1.0' or '1.1', "
+                             f"got {version!r}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.head = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.head(x)
+        if self.with_pool:
+            x = self.pool(x)
+            if self.num_classes > 0:
+                x = x.reshape((x.shape[0], -1))
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+class _ShuffleUnit(nn.Layer):
+    """ShuffleNetV2 unit: channel split + shuffle (rides
+    F.channel_shuffle)."""
+
+    def __init__(self, c_in, c_out, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = c_out // 2
+        self.act = F.swish if act == "swish" else F.relu
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(c_in, c_in, 3, stride=stride, padding=1,
+                          groups=c_in, bias_attr=False),
+                nn.BatchNorm2D(c_in),
+                nn.Conv2D(c_in, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch))
+            b2_in = c_in
+        else:
+            self.branch1 = None
+            b2_in = c_in // 2
+        # reference InvertedResidual: act after the FIRST pointwise conv
+        # and after the LAST; the depthwise conv stays linear
+        self.pw1 = nn.Sequential(
+            nn.Conv2D(b2_in, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch))
+        self.dw = nn.Sequential(
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch))
+        self.pw2 = nn.Sequential(
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch))
+
+    def _branch2(self, x):
+        return self.act(self.pw2(self.dw(self.act(self.pw1(x)))))
+
+    def forward(self, x):
+        if self.stride > 1:
+            out = jnp.concatenate(
+                [self.act(self.branch1(x)), self._branch2(x)], axis=1)
+        else:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = jnp.concatenate([x1, self._branch2(x2)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """``shufflenetv2.py``: scale in {0.25,0.33,0.5,1.0,1.5,2.0}, optional
+    swish activation."""
+
+    _stage_out = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                  0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                  1.5: [24, 176, 352, 704, 1024],
+                  2.0: [24, 244, 488, 976, 2048]}
+
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        outs = self._stage_out[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, outs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(outs[0]), nn.ReLU(), nn.MaxPool2D(3, stride=2,
+                                                             padding=1))
+        stages = []
+        c_in = outs[0]
+        for stage_i, repeat in enumerate((4, 8, 4)):
+            c_out = outs[stage_i + 1]
+            stages.append(_ShuffleUnit(c_in, c_out, 2, act))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(c_out, c_out, 1, act))
+            c_in = c_out
+        self.stages = nn.Sequential(*stages)
+        self.final = nn.Sequential(
+            nn.Conv2D(c_in, outs[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(outs[-1]), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.final(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape((x.shape[0], -1)))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, c_in, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(c_in)
+        self.conv1 = nn.Conv2D(c_in, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.bn1(x)))
+        out = self.conv2(F.relu(self.bn2(out)))
+        return jnp.concatenate([x, out], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """``densenet.py``: dense blocks with concat growth; layers in
+    {121, 161, 169, 201, 264}."""
+
+    _cfgs = {121: (32, (6, 12, 24, 16), 64),
+             161: (48, (6, 12, 36, 24), 96),
+             169: (32, (6, 12, 32, 32), 64),
+             201: (32, (6, 12, 48, 32), 64),
+             264: (32, (6, 12, 64, 48), 64)}
+
+    def __init__(self, layers: int = 121, bn_size: int = 4,
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        growth, blocks, init_c = self._cfgs[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(blocks) - 1:  # transition: halve channels + pool
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, stride=2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self._out_ch = ch
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape((x.shape[0], -1)))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+class _Inception(nn.Layer):
+    """GoogLeNet inception module (1x1 / 3x3 / 5x5 / pool branches)."""
+
+    def __init__(self, c_in, c1, r3, c3, r5, c5, cp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(c_in, c1, 1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(c_in, r3, 1), nn.ReLU(),
+                                nn.Conv2D(r3, c3, 3, padding=1), nn.ReLU())
+        self.b5 = nn.Sequential(nn.Conv2D(c_in, r5, 1), nn.ReLU(),
+                                nn.Conv2D(r5, c5, 5, padding=2), nn.ReLU())
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(c_in, cp, 1), nn.ReLU())
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """``googlenet.py`` (inception v1). ``forward`` returns the main
+    logits (the reference also returns two aux heads during training;
+    deep supervision belongs to the recipe, main head carries serving)."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.blocks = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.head = nn.Sequential(nn.Dropout(0.4),
+                                      nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.head(x.reshape((x.shape[0], -1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+class _BasicConv(nn.Sequential):
+    def __init__(self, ci, co, k, s=1, p=0):
+        super().__init__(
+            nn.Conv2D(ci, co, k, stride=s, padding=p, bias_attr=False),
+            nn.BatchNorm2D(co), nn.ReLU())
+
+
+class _InceptionA(nn.Layer):
+    """35x35 cell: 1x1 / 5x5 / double-3x3 / pool -> 224 + pool_ch."""
+
+    def __init__(self, c_in, pool_ch):
+        super().__init__()
+        self.b1 = _BasicConv(c_in, 64, 1)
+        self.b5 = nn.Sequential(_BasicConv(c_in, 48, 1),
+                                _BasicConv(48, 64, 5, p=2))
+        self.b3d = nn.Sequential(_BasicConv(c_in, 64, 1),
+                                 _BasicConv(64, 96, 3, p=1),
+                                 _BasicConv(96, 96, 3, p=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BasicConv(c_in, pool_ch, 1))
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b5(x), self.b3d(x), self.bp(x)], axis=1)
+
+
+class _ReductionA(nn.Layer):
+    """35 -> 17: stride-2 3x3 / stride-2 double-3x3 / maxpool."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = _BasicConv(c_in, 384, 3, s=2)
+        self.b3d = nn.Sequential(_BasicConv(c_in, 64, 1),
+                                 _BasicConv(64, 96, 3, p=1),
+                                 _BasicConv(96, 96, 3, s=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """17x17 cell with 1x7/7x1 factorized branches -> 768."""
+
+    def __init__(self, c_in, mid):
+        super().__init__()
+        self.b1 = _BasicConv(c_in, 192, 1)
+        self.b7 = nn.Sequential(
+            _BasicConv(c_in, mid, 1),
+            _BasicConv(mid, mid, (1, 7), p=(0, 3)),
+            _BasicConv(mid, 192, (7, 1), p=(3, 0)))
+        self.b7d = nn.Sequential(
+            _BasicConv(c_in, mid, 1),
+            _BasicConv(mid, mid, (7, 1), p=(3, 0)),
+            _BasicConv(mid, mid, (1, 7), p=(0, 3)),
+            _BasicConv(mid, mid, (7, 1), p=(3, 0)),
+            _BasicConv(mid, 192, (1, 7), p=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BasicConv(c_in, 192, 1))
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class _ReductionB(nn.Layer):
+    """17 -> 8: 1280 out."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = nn.Sequential(_BasicConv(c_in, 192, 1),
+                                _BasicConv(192, 320, 3, s=2))
+        self.b7 = nn.Sequential(
+            _BasicConv(c_in, 192, 1),
+            _BasicConv(192, 192, (1, 7), p=(0, 3)),
+            _BasicConv(192, 192, (7, 1), p=(3, 0)),
+            _BasicConv(192, 192, 3, s=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    """8x8 cell with expanded 1x3/3x1 splits -> 2048."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = _BasicConv(c_in, 320, 1)
+        self.b3_stem = _BasicConv(c_in, 384, 1)
+        self.b3_a = _BasicConv(384, 384, (1, 3), p=(0, 1))
+        self.b3_b = _BasicConv(384, 384, (3, 1), p=(1, 0))
+        self.b3d_stem = nn.Sequential(_BasicConv(c_in, 448, 1),
+                                      _BasicConv(448, 384, 3, p=1))
+        self.b3d_a = _BasicConv(384, 384, (1, 3), p=(0, 1))
+        self.b3d_b = _BasicConv(384, 384, (3, 1), p=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BasicConv(c_in, 192, 1))
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        s3d = self.b3d_stem(x)
+        return jnp.concatenate(
+            [self.b1(x), self.b3_a(s3), self.b3_b(s3),
+             self.b3d_a(s3d), self.b3d_b(s3d), self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """``inceptionv3.py``: the full v3 block plan — 3x InceptionA (5x5 +
+    double-3x3 branches), ReductionA, 4x InceptionB (7x7 factorized as
+    1x7/7x1), ReductionB, 2x InceptionC (expanded 1x3/3x1 splits), 2048
+    final channels. Aux head omitted (training-recipe deep supervision;
+    the serving graph is the main head)."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BasicConv(3, 32, 3, s=2), _BasicConv(32, 32, 3),
+            _BasicConv(32, 64, 3, p=1), nn.MaxPool2D(3, stride=2),
+            _BasicConv(64, 80, 1), _BasicConv(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32),    # -> 256
+            _InceptionA(256, 64),    # -> 288
+            _InceptionA(288, 64),    # -> 288
+            _ReductionA(288),        # -> 768
+            _InceptionB(768, 128),   # -> 768
+            _InceptionB(768, 160),
+            _InceptionB(768, 160),
+            _InceptionB(768, 192),
+            _ReductionB(768),        # -> 1280
+            _InceptionC(1280),       # -> 2048
+            _InceptionC(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Sequential(nn.Dropout(0.5),
+                                    nn.Linear(2048, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape((x.shape[0], -1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)

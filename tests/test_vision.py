@@ -267,3 +267,37 @@ class _LossShim:
 
     def __getattr__(self, name):
         return getattr(self._m, name)
+
+
+# --------------------------------------------- r4 model-zoo completion
+def test_vision_models_zero_missing_vs_reference():
+    import re
+
+    import paddle_tpu.vision.models as M
+
+    try:
+        s = open('/root/reference/python/paddle/vision/models/'
+                 '__init__.py').read()
+    except OSError:
+        pytest.skip("reference tree not mounted")
+    ref = set(re.findall(r"'(\w+)'",
+                         re.search(r"__all__ = \[(.*?)\]", s, re.S).group(1)))
+    missing = sorted(x for x in ref if x not in set(dir(M)))
+    assert missing == [], missing
+
+
+@pytest.mark.parametrize("factory", [
+    "alexnet", "squeezenet1_1", "shufflenet_v2_x0_25", "densenet121",
+    "googlenet", "inception_v3", "mobilenet_v3_large", "resnext50_64x4d",
+])
+def test_new_vision_family_forward(factory):
+    import paddle_tpu.vision.models as M
+
+    pt.seed(0)
+    m = getattr(M, factory)(num_classes=7)
+    m.eval()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 3, 64, 64)),
+                    jnp.float32)
+    out = m(x)
+    assert out.shape == (1, 7), (factory, out.shape)
+    assert np.isfinite(np.asarray(out)).all(), factory
