@@ -201,3 +201,81 @@ class ImageFolder(Dataset):
         if self.transform:
             img = self.transform(img)
         return (img,)
+
+
+class FashionMNIST(MNIST):
+    """Same IDX wire format as MNIST (reference ``fashion_mnist.py``);
+    point image_path/label_path at the Fashion-MNIST archives."""
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference ``flowers.py``): local extracted archive —
+    ``data_file`` is the image directory (image_%05d.jpg), ``label_file``
+    the imagelabels .mat, ``setid_file`` the split ids .mat."""
+
+    def __init__(self, data_file: str, label_file: str, setid_file: str,
+                 mode: str = "train", transform: Optional[Callable] = None,
+                 backend: str = "pil"):
+        import scipy.io as sio
+
+        self.transform = transform
+        self.data_dir = data_file
+        labels = sio.loadmat(label_file)["labels"].reshape(-1)
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.ids = setid[key].reshape(-1)
+        self.labels = labels
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        import os
+
+        from PIL import Image
+
+        img_id = int(self.ids[idx])
+        path = os.path.join(self.data_dir, f"image_{img_id:05d}.jpg")
+        img = np.asarray(Image.open(path))
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(self.labels[img_id - 1] - 1)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference ``voc2012.py``): point
+    ``data_file`` at the extracted VOCdevkit/VOC2012 directory."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 backend: str = "pil"):
+        import os
+
+        self.transform = transform
+        self.root = data_file
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "trainval": "trainval"}[mode]
+        list_path = os.path.join(self.root, "ImageSets", "Segmentation",
+                                 f"{split}.txt")
+        with open(list_path) as f:
+            self.names = [ln.strip() for ln in f if ln.strip()]
+
+    def __len__(self):
+        return len(self.names)
+
+    def __getitem__(self, idx):
+        import os
+
+        from PIL import Image
+
+        name = self.names[idx]
+        img = np.asarray(Image.open(
+            os.path.join(self.root, "JPEGImages", f"{name}.jpg")))
+        seg = np.asarray(Image.open(
+            os.path.join(self.root, "SegmentationClass", f"{name}.png")))
+        if self.transform:
+            img = self.transform(img)
+        return img, seg
+
+
+__all__ += ["FashionMNIST", "Flowers", "VOC2012"]

@@ -732,3 +732,84 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     per_img = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +
                loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
     return per_img
+
+
+# --------------------------------------------- r4: layer-class wrappers
+class RoIAlign:
+    """Layer form of :func:`roi_align` (reference ``paddle.vision.ops.RoIAlign``)."""
+
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def _make_deform_conv2d():
+    # Layer import deferred: vision.ops is imported by modules that load
+    # before nn is fully initialized
+    from ..nn.layer import Layer
+    from ..nn.layers.conv import Conv2D
+
+    class DeformConv2D(Layer):
+        """Layer form of :func:`deform_conv2d`: a real nn.Layer, so its
+        kernel parameters register with parameters()/state_dict and reach
+        the optimizer (reference ``paddle.vision.ops.DeformConv2D``)."""
+
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, dilation=1, deformable_groups=1,
+                     groups=1, weight_attr=None, bias_attr=None):
+            super().__init__()
+            # borrow Conv2D's parameter init/naming (registered sublayer)
+            self.conv = Conv2D(in_channels, out_channels, kernel_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups,
+                               weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+            self.stride, self.padding = stride, padding
+            self.dilation, self.groups = dilation, groups
+            self.deformable_groups = deformable_groups
+
+        @property
+        def weight(self):
+            return self.conv.weight
+
+        @property
+        def bias(self):
+            return self.conv.bias
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(x, offset, self.conv.weight,
+                                 self.conv.bias, self.stride, self.padding,
+                                 self.dilation, self.deformable_groups,
+                                 self.groups, mask)
+
+    return DeformConv2D
+
+
+DeformConv2D = _make_deform_conv2d()
+
+
+__all__ += ["RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D"]

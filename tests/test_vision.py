@@ -301,3 +301,99 @@ def test_new_vision_family_forward(factory):
     out = m(x)
     assert out.shape == (1, 7), (factory, out.shape)
     assert np.isfinite(np.asarray(out)).all(), factory
+
+
+def test_transforms_long_tail():
+    import paddle_tpu.vision.transforms as T
+
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 255, (16, 20, 3)).astype(np.uint8)
+    # identity factors are exact (within integer rounding)
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+    np.testing.assert_allclose(T.adjust_contrast(img, 1.0).astype(float),
+                               img.astype(float), atol=1)
+    np.testing.assert_allclose(T.adjust_saturation(img, 1.0).astype(float),
+                               img.astype(float), atol=1)
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0).astype(float),
+                               img.astype(float), atol=2)
+    with pytest.raises(ValueError):
+        T.adjust_hue(img, 0.9)
+    g = T.to_grayscale(img)
+    assert g.shape == (16, 20, 1)
+    # warps: zero rotation / identity perspective preserve the image
+    np.testing.assert_allclose(T.rotate(img, 0.0).astype(float),
+                               img.astype(float), atol=1)
+    pts = [(0, 0), (19, 0), (19, 15), (0, 15)]
+    np.testing.assert_allclose(
+        T.perspective(img, pts, pts).astype(float), img.astype(float),
+        atol=1)
+    e = T.erase(img, 2, 3, 4, 5, 0)
+    assert (e[2:6, 3:8] == 0).all() and (e[0, 0] == img[0, 0]).all()
+    # transform classes run and keep shapes
+    for cls in [T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+                T.RandomAffine(10, translate=(0.1, 0.1)),
+                T.RandomErasing(prob=1.0), T.RandomPerspective(prob=1.0),
+                T.RandomRotation(15)]:
+        assert cls(img).shape == img.shape
+    assert T.RandomResizedCrop(8)(img).shape[:2] == (8, 8)
+    assert T.Grayscale(3)(img).shape == img.shape
+    # BaseTransform keyed dispatch: non-image entries pass through
+    class ImgOnly(T.BaseTransform):
+        def __init__(self):
+            super().__init__(keys=("image", "label"))
+
+        def _apply_image(self, x):
+            return x + 1
+
+        def _apply_label(self, y):
+            return y
+
+    out_img, out_lbl = ImgOnly()((np.zeros((2, 2, 1), np.uint8), 7))
+    assert out_img.sum() == 4 and out_lbl == 7
+
+
+def test_vision_ops_layer_wrappers():
+    from paddle_tpu.vision.ops import RoIAlign
+
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(1, 4, 8, 8)),
+                    jnp.float32)
+    boxes = jnp.asarray([[0.0, 0.0, 7.0, 7.0]])
+    layer = RoIAlign(output_size=2)
+    out = layer(x, boxes, jnp.asarray([1], jnp.int32))
+    assert np.asarray(out).shape == (1, 4, 2, 2)
+
+
+def test_fashion_mnist_and_voc(tmp_path):
+    import gzip
+    import struct
+
+    from paddle_tpu.vision.datasets import FashionMNIST, VOC2012
+
+    # synthesize a 3-image IDX pair (FashionMNIST = MNIST wire format)
+    imgs = np.arange(3 * 4 * 4, dtype=np.uint8).reshape(3, 4, 4)
+    ip = tmp_path / "im.gz"
+    lp = tmp_path / "lb.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 3, 4, 4) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 3) + bytes([0, 1, 2]))
+    ds = FashionMNIST(str(ip), str(lp))
+    assert len(ds) == 3
+    img, lbl = ds[1]
+    assert img.shape == (4, 4) and lbl == 1
+
+    # VOC layout with one sample
+    from PIL import Image
+
+    root = tmp_path / "VOC2012"
+    (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+    (root / "JPEGImages").mkdir()
+    (root / "SegmentationClass").mkdir()
+    (root / "ImageSets" / "Segmentation" / "train.txt").write_text("a\n")
+    Image.fromarray(np.zeros((6, 6, 3), np.uint8)).save(
+        root / "JPEGImages" / "a.jpg")
+    Image.fromarray(np.ones((6, 6), np.uint8)).save(
+        root / "SegmentationClass" / "a.png")
+    voc = VOC2012(str(root), mode="train")
+    img, seg = voc[0]
+    assert img.shape == (6, 6, 3) and seg.shape == (6, 6)
