@@ -175,15 +175,25 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
                  parameters=None, weight_decay=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False, name=None,
+                 moment_dtype=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        # storage dtype for the moment1 slot; update math is always f32.
+        # bf16 m cuts optimizer-state HBM by 2 bytes/param — part of the
+        # lever that fits GPT-1.3B on a 16 GB v5e (bench.py:bench_gpt_1p3b).
+        # moment2 deliberately STAYS f32: its beta2=0.999 EMA moves only
+        # ~0.1% per step, below bf16's ~0.39% half-ULP, so round-to-nearest
+        # would store it unchanged forever (a frozen second moment pins the
+        # effective LR at whatever an early spike set it to). moment1's
+        # beta1=0.9 moves ~10% per step — far above ULP, safe in bf16.
+        self._moment_dtype = jnp.dtype(moment_dtype) if moment_dtype else jnp.float32
 
     def _init_slots(self, params):
         return {
-            "moment1": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+            "moment1": _tree_map(lambda p: jnp.zeros_like(p, dtype=self._moment_dtype), params),
             "moment2": _tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
         }
 
@@ -202,14 +212,15 @@ class Adam(Optimizer):
             g = g.astype(jnp.float32)
             if not isinstance(self, AdamW):
                 g = self._decayed_grad(g, p)
-            m_new = self.beta1 * m + (1 - self.beta1) * g
-            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            m_new = self.beta1 * m.astype(jnp.float32) + (1 - self.beta1) * g
+            v_new = self.beta2 * v.astype(jnp.float32) + (1 - self.beta2) * jnp.square(g)
             m_hat = m_new / b1c
             v_hat = v_new / b2c
             delta = lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
             if isinstance(self, AdamW) and self.weight_decay:
                 delta = delta + lr * self.weight_decay * p.astype(jnp.float32)
-            return p - delta.astype(p.dtype), m_new, v_new
+            return (p - delta.astype(p.dtype),
+                    m_new.astype(self._moment_dtype), v_new)
 
         triples = _tree_map(upd, params, grads, state["moment1"], state["moment2"])
         is_leaf = lambda x: isinstance(x, tuple)  # noqa: E731
@@ -228,9 +239,11 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
                  parameters=None, weight_decay=0.01, grad_clip=None,
-                 apply_decay_param_fun=None, lazy_mode=False, multi_precision=False, name=None):
+                 apply_decay_param_fun=None, lazy_mode=False, multi_precision=False, name=None,
+                 moment_dtype=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, lazy_mode, multi_precision)
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         moment_dtype=moment_dtype)
         self.apply_decay_param_fun = apply_decay_param_fun
 
     def _apply(self, grads, state, params, lr):
@@ -250,13 +263,14 @@ class AdamW(Adam):
                 new_p[k], new_m[k], new_v[k] = p, m, v
                 continue
             g = g.astype(jnp.float32)
-            m_new = self.beta1 * m + (1 - self.beta1) * g
-            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            m_new = self.beta1 * m.astype(jnp.float32) + (1 - self.beta1) * g
+            v_new = self.beta2 * v.astype(jnp.float32) + (1 - self.beta2) * jnp.square(g)
             delta = lr * (m_new / b1c) / (jnp.sqrt(v_new / b2c) + self.epsilon)
             if decay_mask[k] and saved:
                 delta = delta + lr * saved * p.astype(jnp.float32)
             new_p[k] = p - delta.astype(p.dtype)
-            new_m[k], new_v[k] = m_new, v_new
+            new_m[k] = m_new.astype(self._moment_dtype)
+            new_v[k] = v_new
         return new_p, {"moment1": new_m, "moment2": new_v}
 
 

@@ -191,3 +191,34 @@ def test_train_step_checkpoint_resume(tmp_path):
     step2.set_state_dict(pt.load(path))
     resumed_next = float(step2((x, y)))
     np.testing.assert_allclose(resumed_next, ref_next, rtol=1e-5)
+
+
+def test_adamw_bf16_moment_dtype_descends():
+    """moment_dtype='bfloat16' stores moment1 in bf16 (2 bytes/param off
+    optimizer-state HBM — part of fitting GPT-1.3B on a 16 GB v5e,
+    bench.py:bench_gpt_1p3b); the update math stays f32 and must still
+    descend close to the f32-slot path."""
+    import jax.numpy as jnp
+
+    def run(moment_dtype):
+        pt.seed(0)
+        model = nn.Linear(8, 1)
+        opt = AdamW(learning_rate=0.05, moment_dtype=moment_dtype)
+        step = pt.TrainStep(model, opt,
+                            loss_fn=lambda o, b: F.mse_loss(o, b[1]))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = (x @ rng.standard_normal((8, 1))).astype(np.float32)
+        losses = [float(step((x, y))) for _ in range(60)]
+        return losses, step.opt_state
+
+    losses_bf16, state = run("bfloat16")
+    assert state["moment1"]["weight"].dtype == jnp.bfloat16
+    # moment2 must stay f32 regardless: its 0.999-EMA moves ~0.1%/step,
+    # below bf16 half-ULP (~0.39%), so a bf16 slot would freeze forever
+    assert state["moment2"]["weight"].dtype == jnp.float32
+    assert losses_bf16[-1] < 0.25 * losses_bf16[0]
+    losses_f32, state_f32 = run(None)
+    assert state_f32["moment1"]["weight"].dtype == jnp.float32
+    # bf16 slot rounding perturbs but must not derail the trajectory
+    assert abs(losses_bf16[-1] - losses_f32[-1]) < 0.15 * losses_f32[0] + 1e-3
