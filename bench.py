@@ -494,6 +494,19 @@ def _on_signal(signum, frame):
     os._exit(0)
 
 
+def _last_metric_line(text: str):
+    """Last COMPLETE '{"metric"' JSON line in ``text`` (a killed child can
+    leave a truncated record as the final line)."""
+    for line in reversed(text.splitlines()):
+        if line.startswith('{"metric"'):
+            try:
+                json.loads(line)
+                return line
+            except ValueError:
+                continue
+    return None
+
+
 def _run_child(backend: str, deadline: float):
     """Run the benches in a FRESH subprocess with a hard wall-clock cap.
 
@@ -525,20 +538,19 @@ def _run_child(backend: str, deadline: float):
     except subprocess.TimeoutExpired as e:
         # salvage: the child prints its primary metric line EARLY (before
         # the hang-prone breadth benches) and an enriched final line later;
-        # take the last one present — a hang mid-breadth still keeps the
-        # measured primary number instead of discarding it
+        # take the last COMPLETE one — a kill mid-print can leave a
+        # truncated final record, and the earlier complete line must win
         partial = e.stdout.decode() if isinstance(e.stdout, bytes) else \
             (e.stdout or "")
-        lines = [l for l in partial.splitlines()
-                 if l.startswith('{"metric"')]
-        if lines:
-            return lines[-1], None
+        line = _last_metric_line(partial)
+        if line:
+            return line, None
         return None, f"bench timed out after {timeout_s:.0f}s (tunnel hang)"
     if stderr:
         sys.stderr.write(stderr)
-    lines = [l for l in stdout.splitlines() if l.startswith('{"metric"')]
-    if lines:
-        return lines[-1], None
+    line = _last_metric_line(stdout)
+    if line:
+        return line, None
     lines = (stderr or stdout or "").strip().splitlines()
     tail = lines[-1] if lines else ""
     return None, f"child rc={rc}: {tail}"

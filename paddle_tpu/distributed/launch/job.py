@@ -34,9 +34,12 @@ class Container:
             out = self._log_file
         else:
             out = None
+        from ...utils.procutil import pdeathsig_preexec
+
         self.proc = subprocess.Popen(
             self.cmd, env={**os.environ, **self.env},
-            stdout=out, stderr=subprocess.STDOUT if out else None)
+            stdout=out, stderr=subprocess.STDOUT if out else None,
+            preexec_fn=pdeathsig_preexec())
 
     @property
     def alive(self) -> bool:

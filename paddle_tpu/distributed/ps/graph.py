@@ -237,6 +237,7 @@ _GOP_FEAT_DIM = 11
 _GOP_STOP = 12
 _GOP_CLEAR_EDGES = 13
 _GOP_ADD_EDGES_W = 14
+_GOP_WALK_MULTI = 15
 
 
 class DistGraphClient:
@@ -285,6 +286,38 @@ class DistGraphClient:
         with self._locks[s]:
             return self._conns[s].request(op, body)
 
+    def _request_multi(self, reqs):
+        """Scatter-gather: write EVERY request before reading any reply,
+        so the shards' server-side work overlaps instead of serializing
+        one round-trip per shard (the brpc parallel-channel pattern,
+        ``brpc_ps_client.cc`` DownpourBrpcClosure over N requests).
+        ``reqs`` is ``[(shard, op, body), ...]``; replies come back in the
+        same order (the framed protocol answers pipelined requests in
+        FIFO order per connection)."""
+        held = sorted({s for s, _, _ in reqs})
+        for s in held:
+            self._locks[s].acquire()
+        try:
+            for s, op, body in reqs:
+                self._conns[s].send(op, body)
+            # EVERY pipelined reply must be read even when one is an error
+            # frame — an unread reply would desync that connection and the
+            # next request would parse a stale payload as its own
+            results, first_err = [], None
+            for s, op, _ in reqs:
+                try:
+                    results.append(self._conns[s].recv(op))
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    results.append(None)
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
+            return results
+        finally:
+            for s in held:
+                self._locks[s].release()
+
     # -- ingest ------------------------------------------------------------
     def clear_edges(self) -> None:
         """Drop the client-side edge buffer (a later build() starts from
@@ -325,20 +358,45 @@ class DistGraphClient:
             if weighted:
                 w = np.concatenate([w, w])
         owner = self._shard_of(src)
+        # clear first: the client re-sends its FULL buffer each build.
+        # Scatter incrementally — build each shard's edge body, send its
+        # three pipelined requests, and FREE the body before building the
+        # next shard's (the bodies together would double the edge set's
+        # footprint) — then gather every reply at the end, so each shard
+        # partitions/sorts while the client streams the next shard's edges.
+        sent = []  # (shard, op) in send order
         for s in range(len(self._conns)):
-            sel = owner == s
-            ss, dd = src[sel], dst[sel]
-            # clear first: the client re-sends its FULL buffer each build
-            self._request(s, _GOP_CLEAR_EDGES)
-            if weighted:
-                ww = w[sel]
-                body = (struct.pack("<I", ss.size) + ss.tobytes()
-                        + dd.tobytes() + ww.tobytes())
-                self._request(s, _GOP_ADD_EDGES_W, body)
-            else:
-                body = struct.pack("<I", ss.size) + ss.tobytes() + dd.tobytes()
-                self._request(s, _GOP_ADD_EDGES, body)
-            self._request(s, _GOP_BUILD, struct.pack("<B", 0))
+            self._locks[s].acquire()
+        try:
+            for s in range(len(self._conns)):
+                sel = owner == s
+                ss, dd = src[sel], dst[sel]
+                if weighted:
+                    body = (struct.pack("<I", ss.size) + ss.tobytes()
+                            + dd.tobytes() + w[sel].tobytes())
+                    add_op = _GOP_ADD_EDGES_W
+                else:
+                    body = (struct.pack("<I", ss.size) + ss.tobytes()
+                            + dd.tobytes())
+                    add_op = _GOP_ADD_EDGES
+                del ss, dd
+                self._conns[s].send(_GOP_CLEAR_EDGES)
+                self._conns[s].send(add_op, body)
+                del body
+                self._conns[s].send(_GOP_BUILD, struct.pack("<B", 0))
+                sent += [(s, _GOP_CLEAR_EDGES), (s, add_op), (s, _GOP_BUILD)]
+            first_err = None
+            for s, op in sent:
+                try:
+                    self._conns[s].recv(op)
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
+        finally:
+            for s in range(len(self._conns)):
+                self._locks[s].release()
         self._built = True
 
     # -- control plane -----------------------------------------------------
@@ -373,6 +431,7 @@ class DistGraphClient:
         out = np.empty((nodes.size, sample_size), np.int64)
         counts = np.empty(nodes.size, np.int32)
         owner = self._shard_of(nodes)
+        reqs, sels = [], []
         for s in range(len(self._conns)):
             sel = np.where(owner == s)[0]
             if sel.size == 0:
@@ -380,42 +439,71 @@ class DistGraphClient:
             part = nodes[sel]
             body = (struct.pack("<IiBQ", part.size, sample_size,
                                 1 if replace else 0, seed) + part.tobytes())
-            payload = self._request(s, _GOP_SAMPLE, body)
-            nb = np.frombuffer(payload[:part.size * sample_size * 8],
-                               np.int64).reshape(part.size, sample_size)
-            ct = np.frombuffer(payload[part.size * sample_size * 8:], np.int32)
+            reqs.append((s, _GOP_SAMPLE, body))
+            sels.append(sel)
+        for sel, payload in zip(sels, self._request_multi(reqs)):
+            nb = np.frombuffer(payload[:sel.size * sample_size * 8],
+                               np.int64).reshape(sel.size, sample_size)
+            ct = np.frombuffer(payload[sel.size * sample_size * 8:], np.int32)
             out[sel] = nb
             counts[sel] = ct
         return out, counts
 
     def random_walk(self, starts, walk_len: int, seed: int = 0) -> np.ndarray:
-        """Client-driven distributed walk: one cross-shard hop per step."""
+        """Distributed walk with server-side multi-hop runs: each walker
+        advances ON its owner shard until it dies, finishes, or its next
+        node belongs to another shard — one scatter-gather round per
+        shard-crossing instead of one round-trip per hop (for 2 uniform
+        shards that halves the RPC rounds; the reference's server-side
+        FillWalkBuf + HeterComm handoff, ``ps_gpu_wrapper.h:198``).
+        Per-hop hashing is unchanged, so output stays bit-identical to the
+        single-host :meth:`GraphTable.random_walk`."""
         assert self._built, "call build() first"
         starts = np.ascontiguousarray(np.asarray(starts).reshape(-1), np.int64)
         n = starts.size
         out = np.full((n, walk_len), -1, np.int64)
         cur = starts.copy()
+        step = np.zeros(n, np.int32)
         rows = np.arange(n, dtype=np.int64)
-        for step in range(walk_len):
-            active = np.where(cur >= 0)[0]
-            if active.size == 0:
-                break
-            nxt = np.full(active.size, -1, np.int64)
+        num_shards = len(self._conns)
+        active = np.where(cur >= 0)[0]
+        # chunk so BOTH frames stay safely under the server's 256 MB cap:
+        # worst-case reply is walk_len*8+5 bytes/walker, the request is a
+        # flat 20 bytes/walker (which dominates at walk_len=1)
+        max_per_req = max(1, (200 << 20) // max(walk_len * 8 + 5, 20))
+        while active.size:
             owner = self._shard_of(cur[active])
-            for s in range(len(self._conns)):
-                sel = np.where(owner == s)[0]
-                if sel.size == 0:
-                    continue
-                part = cur[active[sel]]
-                idxs = rows[active[sel]]
-                body = (struct.pack("<IiQ", part.size, step, seed)
-                        + part.tobytes() + idxs.tobytes())
-                payload = self._request(s, _GOP_WALK_STEP, body)
-                nxt[sel] = np.frombuffer(payload, np.int64)
-            out[active, step] = nxt
-            new_cur = np.full(n, -1, np.int64)
-            new_cur[active] = nxt
-            cur = new_cur
+            reqs, sels = [], []
+            for s in range(num_shards):
+                shard_sel = active[owner == s]
+                for lo in range(0, shard_sel.size, max_per_req):
+                    sel = shard_sel[lo:lo + max_per_req]
+                    body = (struct.pack("<IiIIQ", sel.size, walk_len, s,
+                                        num_shards, seed)
+                            + cur[sel].tobytes() + rows[sel].tobytes()
+                            + step[sel].tobytes())
+                    reqs.append((s, _GOP_WALK_MULTI, body))
+                    sels.append(sel)
+            still = []
+            for sel, payload in zip(sels, self._request_multi(reqs)):
+                m = sel.size
+                adv = np.frombuffer(payload[:4 * m], np.int32)
+                status = np.frombuffer(payload[4 * m:5 * m], np.uint8)
+                flat = np.frombuffer(payload[5 * m:], np.int64)
+                adv64 = adv.astype(np.int64)
+                # scatter variable-length runs into out[row, step:step+adv]
+                tgt_rows = np.repeat(sel, adv64)
+                run_end = np.cumsum(adv64)
+                tgt_cols = (np.arange(flat.size, dtype=np.int64)
+                            - np.repeat(run_end - adv64, adv64)
+                            + np.repeat(step[sel].astype(np.int64), adv64))
+                out[tgt_rows, tgt_cols] = flat
+                step[sel] += adv
+                has = adv64 > 0
+                cur[sel[has]] = flat[run_end[has] - 1]
+                still.append(sel[status == 2])  # handoff: still walking
+            active = (np.concatenate(still) if still
+                      else np.empty(0, np.int64))
         return out
 
     # -- features ----------------------------------------------------------
@@ -425,6 +513,7 @@ class DistGraphClient:
             np.asarray(feats, np.float32).reshape(keys.size, -1))
         dim = feats.shape[1]
         owner = self._shard_of(keys)
+        reqs = []
         for s in range(len(self._conns)):
             sel = owner == s
             if not sel.any():
@@ -432,7 +521,8 @@ class DistGraphClient:
             kk, ff = keys[sel], feats[sel]
             body = (struct.pack("<Ii", kk.size, dim) + kk.tobytes()
                     + ff.tobytes())
-            self._request(s, _GOP_SET_FEAT, body)
+            reqs.append((s, _GOP_SET_FEAT, body))
+        self._request_multi(reqs)
 
     @property
     def feature_dim(self) -> int:
@@ -453,14 +543,18 @@ class DistGraphClient:
             return np.zeros((keys.size, 0), np.float32)
         out = np.zeros((keys.size, dim), np.float32)
         owner = self._shard_of(keys)
+        reqs, sels = [], []
         for s in range(len(self._conns)):
             sel = np.where(owner == s)[0]
             if sel.size == 0:
                 continue
             kk = keys[sel]
             body = struct.pack("<Ii", kk.size, dim) + kk.tobytes()
-            payload = self._request(s, _GOP_GET_FEAT, body)
-            out[sel] = np.frombuffer(payload, np.float32).reshape(kk.size, dim)
+            reqs.append((s, _GOP_GET_FEAT, body))
+            sels.append(sel)
+        for sel, payload in zip(sels, self._request_multi(reqs)):
+            out[sel] = np.frombuffer(payload, np.float32).reshape(sel.size,
+                                                                  dim)
         return out
 
     # -- lifecycle ---------------------------------------------------------

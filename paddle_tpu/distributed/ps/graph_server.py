@@ -14,8 +14,12 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     args = ap.parse_args(argv)
 
+    from ...utils.procutil import start_ppid_watchdog
     from .graph import GraphServer
 
+    # belt-and-braces with the launcher's PDEATHSIG: exit when the parent
+    # disappears, so an aborted run can't leak shard servers
+    start_ppid_watchdog()
     srv = GraphServer(port=args.port)
     print(f"PORT {srv.port}", flush=True)
     srv.wait()

@@ -20,9 +20,11 @@ def main(argv=None) -> int:
     ap.add_argument("--load", default=None, help="snapshot to preload")
     args = ap.parse_args(argv)
 
+    from ...utils.procutil import start_ppid_watchdog
     from .service import PsServer
     from .table import SparseAccessorConfig
 
+    start_ppid_watchdog()
     srv = PsServer(SparseAccessorConfig(
         embed_dim=args.embed_dim, optimizer=args.optimizer,
         learning_rate=args.lr, seed=args.seed, num_shards=args.num_shards),

@@ -132,7 +132,17 @@ class _Conn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def request(self, op: int, body: bytes = b"") -> bytes:
+        self.send(op, body)
+        return self.recv(op)
+
+    def send(self, op: int, body: bytes = b"") -> None:
+        """Write one framed request without waiting for the reply — the
+        scatter half of scatter-gather; the framed protocol serves
+        pipelined requests strictly in order, so N sends followed by N
+        recvs on one connection are well-defined."""
         self.sock.sendall(struct.pack("<IB", len(body), op) + body)
+
+    def recv(self, op: int = -1) -> bytes:
         hdr = self._read(8)
         status, blen = struct.unpack("<iI", hdr)
         payload = self._read(blen) if blen else b""
@@ -603,9 +613,14 @@ def launch_servers(num_servers: int, embed_dim: int, optimizer: str = "adagrad",
 def launch_port_subprocesses(argvs, timeout: float = 30.0):
     """Spawn one subprocess per argv; each must print ``PORT <p>`` on stdout
     once its server socket is bound. Returns ``(procs, endpoints)``."""
+    from ...utils.procutil import pdeathsig_preexec
+
     procs, endpoints = [], []
     for argv in argvs:
-        procs.append(subprocess.Popen(argv, stdout=subprocess.PIPE))
+        # servers die with the client (PDEATHSIG): an aborted test/bench
+        # run must not leave shard servers running for hours
+        procs.append(subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                      preexec_fn=pdeathsig_preexec()))
     deadline = time.time() + timeout
 
     def fail(exc):

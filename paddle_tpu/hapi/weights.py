@@ -1,0 +1,157 @@
+"""Pretrained-weight infrastructure: download + paddle-checkpoint loading.
+
+Reference parity: ``python/paddle/vision/models/resnet.py:360`` (every
+model constructor's ``pretrained=True`` branch calls
+``get_weights_path_from_url(model_urls[arch])`` then ``load_dict``) and
+``python/paddle/utils/download.py``. The model zoo here kept paddle's
+parameter names AND layouts on purpose (conv ``[out, in, kh, kw]``,
+linear ``[in, out]``, BN ``_mean``/``_variance``), so a paddle
+``.pdparams`` state_dict loads directly — the "converter" is mostly dtype
+coercion plus head-mismatch handling.
+
+URL + md5 tables are the reference's public registries (config data).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.download import get_weights_path_from_url
+
+__all__ = ["PRETRAINED_URLS", "load_paddle_state_dict", "load_pretrained"]
+
+PRETRAINED_URLS: Dict[str, tuple] = {
+    "alexnet": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/AlexNet_pretrained.pdparams",
+                "7f0f9f737132e02732d75a1459d98a43"),
+    "densenet121": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/DenseNet121_pretrained.pdparams",
+                    "db1b239ed80a905290fd8b01d3af08e4"),
+    "densenet161": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/DenseNet161_pretrained.pdparams",
+                    "62158869cb315098bd25ddbfd308a853"),
+    "densenet169": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/DenseNet169_pretrained.pdparams",
+                    "82cc7c635c3f19098c748850efb2d796"),
+    "densenet201": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/DenseNet201_pretrained.pdparams",
+                    "16ca29565a7712329cf9e36e02caaf58"),
+    "densenet264": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/DenseNet264_pretrained.pdparams",
+                    "3270ce516b85370bba88cfdd9f60bff4"),
+    "googlenet": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/GoogLeNet_pretrained.pdparams",
+                  "80c06f038e905c53ab32c40eca6e26ae"),
+    "inception_v3": ("https://paddle-hapi.bj.bcebos.com/models/inception_v3.pdparams",
+                     "649a4547c3243e8b59c656f41fe330b8"),
+    "mobilenet_v3_large_x1.0": ("https://paddle-hapi.bj.bcebos.com/models/mobilenet_v3_large_x1.0.pdparams",
+                                "118db5792b4e183b925d8e8e334db3df"),
+    "mobilenet_v3_small_x1.0": ("https://paddle-hapi.bj.bcebos.com/models/mobilenet_v3_small_x1.0.pdparams",
+                                "34fe0e7c1f8b00b2b056ad6788d0590c"),
+    "mobilenetv1_1.0": ("https://paddle-hapi.bj.bcebos.com/models/mobilenetv1_1.0.pdparams",
+                        "3033ab1975b1670bef51545feb65fc45"),
+    "mobilenetv2_1.0": ("https://paddle-hapi.bj.bcebos.com/models/mobilenet_v2_x1.0.pdparams",
+                        "0340af0a901346c8d46f4529882fb63d"),
+    "resnet101": ("https://paddle-hapi.bj.bcebos.com/models/resnet101.pdparams",
+                  "02f35f034ca3858e1e54d4036443c92d"),
+    "resnet152": ("https://paddle-hapi.bj.bcebos.com/models/resnet152.pdparams",
+                  "7ad16a2f1e7333859ff986138630fd7a"),
+    "resnet18": ("https://paddle-hapi.bj.bcebos.com/models/resnet18.pdparams",
+                 "cf548f46534aa3560945be4b95cd11c4"),
+    "resnet34": ("https://paddle-hapi.bj.bcebos.com/models/resnet34.pdparams",
+                 "8d2275cf8706028345f78ac0e1d31969"),
+    "resnet50": ("https://paddle-hapi.bj.bcebos.com/models/resnet50.pdparams",
+                 "ca6f485ee1ab0492d38f323885b0ad80"),
+    "resnext101_32x4d": ("https://paddle-hapi.bj.bcebos.com/models/resnext101_32x4d.pdparams",
+                         "967b090039f9de2c8d06fe994fb9095f"),
+    "resnext101_64x4d": ("https://paddle-hapi.bj.bcebos.com/models/resnext101_64x4d.pdparams",
+                         "98e04e7ca616a066699230d769d03008"),
+    "resnext152_32x4d": ("https://paddle-hapi.bj.bcebos.com/models/resnext152_32x4d.pdparams",
+                         "18ff0beee21f2efc99c4b31786107121"),
+    "resnext152_64x4d": ("https://paddle-hapi.bj.bcebos.com/models/resnext152_64x4d.pdparams",
+                         "77c4af00ca42c405fa7f841841959379"),
+    "resnext50_32x4d": ("https://paddle-hapi.bj.bcebos.com/models/resnext50_32x4d.pdparams",
+                        "dc47483169be7d6f018fcbb7baf8775d"),
+    "resnext50_64x4d": ("https://paddle-hapi.bj.bcebos.com/models/resnext50_64x4d.pdparams",
+                        "063d4b483e12b06388529450ad7576db"),
+    "shufflenet_v2_swish": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_swish.pdparams",
+                            "adde0aa3b023e5b0c94a68be1c394b84"),
+    "shufflenet_v2_x0_25": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_x0_25.pdparams",
+                            "1e509b4c140eeb096bb16e214796d03b"),
+    "shufflenet_v2_x0_33": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_x0_33.pdparams",
+                            "3d7b3ab0eaa5c0927ff1026d31b729bd"),
+    "shufflenet_v2_x0_5": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_x0_5.pdparams",
+                           "5e5cee182a7793c4e4c73949b1a71bd4"),
+    "shufflenet_v2_x1_0": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_x1_0.pdparams",
+                           "122d42478b9e81eb49f8a9ede327b1a4"),
+    "shufflenet_v2_x1_5": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_x1_5.pdparams",
+                           "faced5827380d73531d0ee027c67826d"),
+    "shufflenet_v2_x2_0": ("https://paddle-hapi.bj.bcebos.com/models/shufflenet_v2_x2_0.pdparams",
+                           "cd3dddcd8305e7bcd8ad14d1c69a5784"),
+    "squeezenet1_0": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/SqueezeNet1_0_pretrained.pdparams",
+                      "30b95af60a2178f03cf9b66cd77e1db1"),
+    "squeezenet1_1": ("https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/SqueezeNet1_1_pretrained.pdparams",
+                      "a11250d3a1f91d7131fd095ebbf09eee"),
+    "vgg16": ("https://paddle-hapi.bj.bcebos.com/models/vgg16.pdparams",
+              "89bbffc0f87d260be9b8cdc169c991c4"),
+    "vgg19": ("https://paddle-hapi.bj.bcebos.com/models/vgg19.pdparams",
+              "23b18bb13d8894f60f54e642be79a0dd"),
+    "wide_resnet101_2": ("https://paddle-hapi.bj.bcebos.com/models/wide_resnet101_2.pdparams",
+                         "d4360a2d23657f059216f5d5a1a9ac93"),
+    "wide_resnet50_2": ("https://paddle-hapi.bj.bcebos.com/models/wide_resnet50_2.pdparams",
+                        "0282f804d73debdab289bd9fea3fa6dc"),
+}
+
+
+def load_paddle_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a paddle ``.pdparams`` checkpoint into ``{name: np.ndarray}``.
+
+    The format is a pickle of a flat state_dict (the reference's
+    ``paddle.save``); tensor-like leaves are coerced through ``.numpy()``.
+    Like the reference loader this trusts the archive — only load
+    checkpoints from sources you trust.
+    """
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: expected a pickled state_dict, got "
+                         f"{type(raw).__name__}")
+    out = {}
+    for key, val in raw.items():
+        if hasattr(val, "numpy"):
+            val = val.numpy()
+        out[str(key)] = np.asarray(val)
+    return out
+
+
+def load_pretrained(model, arch: str, url: Optional[str] = None,
+                    md5sum: Optional[str] = None):
+    """Fill ``model`` with the published weights for ``arch`` (or an
+    explicit ``url``): the shared ``pretrained=True`` implementation.
+
+    Head layers whose shape differs from the checkpoint (custom
+    ``num_classes``) are skipped, mirroring transfer-learning practice;
+    any OTHER missing/mismatched parameter raises — silently random
+    backbone weights would be a correctness trap.
+    """
+    if url is None:
+        if arch not in PRETRAINED_URLS:
+            raise ValueError(
+                f"no pretrained weights registered for '{arch}' "
+                f"(known: {sorted(PRETRAINED_URLS)})")
+        url, md5sum = PRETRAINED_URLS[arch]
+    path = get_weights_path_from_url(url, md5sum)
+    ckpt = load_paddle_state_dict(path)
+
+    target = model.state_dict()
+    converted, skipped = {}, []
+    for name, cur in target.items():
+        if name not in ckpt:
+            continue
+        arr = ckpt[name]
+        if tuple(arr.shape) != tuple(np.shape(cur)):
+            skipped.append(name)  # e.g. fc head at custom num_classes
+            continue
+        converted[name] = arr.astype(np.asarray(cur).dtype, copy=False)
+    missing = [k for k in target if k not in converted and k not in skipped]
+    if missing:
+        raise ValueError(
+            f"pretrained '{arch}' is missing {len(missing)} parameters "
+            f"(first: {missing[:5]}) — checkpoint/model structure mismatch")
+    model.set_state_dict(converted)
+    return model

@@ -133,11 +133,15 @@ class ResNet(Layer):
         return x
 
 
-def _resnet(block, depth, pretrained=False, **kwargs):
+def _resnet(block, depth, pretrained=False, arch=None, **kwargs):
+    model = ResNet(block, depth, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled; "
-                                  "load a converted state_dict instead")
-    return ResNet(block, depth, **kwargs)
+        # published paddle checkpoints load directly: names and layouts
+        # were kept parity-compatible (reference resnet.py:356-363)
+        from ..hapi.weights import load_pretrained
+
+        load_pretrained(model, arch or f"resnet{depth}")
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):
@@ -162,39 +166,39 @@ def resnet152(pretrained=False, **kwargs):
 
 def wide_resnet50_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, arch="wide_resnet50_2", **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
     kwargs.update(groups=32, width=4)
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, arch="resnext50_32x4d", **kwargs)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
     kwargs.update(groups=64, width=4)
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, arch="resnext50_64x4d", **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
     kwargs.update(groups=32, width=4)
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained, arch="resnext101_32x4d", **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
     kwargs.update(groups=64, width=4)
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained, arch="resnext101_64x4d", **kwargs)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
     kwargs.update(groups=32, width=4)
-    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 152, pretrained, arch="resnext152_32x4d", **kwargs)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
     kwargs.update(groups=64, width=4)
-    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 152, pretrained, arch="resnext152_64x4d", **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained, arch="wide_resnet101_2", **kwargs)

@@ -53,6 +53,10 @@ void pt_graph_sample_neighbors(void* h, const int64_t* nodes, int64_t n,
                                int64_t* out, int32_t* counts);
 void pt_graph_walk_step(void* h, const int64_t* nodes, const int64_t* idxs,
                         int64_t n, int32_t step, uint64_t seed, int64_t* next);
+void pt_graph_walk_multi(void* h, const int64_t* nodes, const int64_t* idxs,
+                         const int32_t* steps, int64_t n, int32_t walk_len,
+                         uint64_t seed, uint32_t my_shard, uint32_t num_shards,
+                         int64_t* out, int32_t* adv, uint8_t* status);
 int32_t pt_graph_set_features(void* h, const int64_t* keys, const float* vals,
                               int64_t n, int32_t dim);
 int32_t pt_graph_get_features(void* h, const int64_t* keys, int64_t n,
@@ -77,6 +81,10 @@ enum GraphOp : uint8_t {
   kStop = 12,
   kClearEdges = 13,
   kAddEdgesW = 14,  // [u32 n][src n*8][dst n*8][w n*4]
+  // [u32 n][i32 walk_len][u32 my_shard][u32 num_shards][u64 seed]
+  // [keys n*8][idxs n*8][steps n*4]
+  //   -> [adv n*4][status n*1][flat sum(adv)*8]
+  kWalkMulti = 15,
 };
 
 int Dispatch(void* graph, int fd, uint8_t op, const char* body, uint32_t len) {
@@ -170,6 +178,59 @@ int Dispatch(void* graph, int fd, uint8_t op, const char* body, uint32_t len) {
       pt_graph_walk_step(graph, keys, idxs, n, step, seed, next.data());
       return SendReply(fd, 0, next.data(), static_cast<uint32_t>(n * 8)) ? 0
                                                                          : 1;
+    }
+    case kWalkMulti: {
+      if (len < 24) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+      uint32_t n, my_shard, num_shards;
+      int32_t walk_len;
+      uint64_t seed;
+      std::memcpy(&n, body, 4);
+      std::memcpy(&walk_len, body + 4, 4);
+      std::memcpy(&my_shard, body + 8, 4);
+      std::memcpy(&num_shards, body + 12, 4);
+      std::memcpy(&seed, body + 16, 8);
+      if (walk_len <= 0 || num_shards == 0 || my_shard >= num_shards ||
+          static_cast<uint64_t>(len) != 24 + static_cast<uint64_t>(n) * 20 ||
+          // worst-case reply (every walker advances walk_len hops) must
+          // fit the frame cap
+          static_cast<uint64_t>(n) * walk_len * 8 +
+                  static_cast<uint64_t>(n) * 5 >
+              ptn::kMaxFrameLen)
+        return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+      const int64_t* keys = reinterpret_cast<const int64_t*>(body + 24);
+      const int64_t* idxs = keys + n;
+      const int32_t* steps =
+          reinterpret_cast<const int32_t*>(body + 24 +
+                                           static_cast<uint64_t>(n) * 16);
+      // per-walker step must sit inside [0, walk_len]: a negative step
+      // would let adv overrun the fixed n*walk_len rows (heap OOB write)
+      for (uint32_t i = 0; i < n; ++i) {
+        if (steps[i] < 0 || steps[i] > walk_len)
+          return SendReply(fd, -10, nullptr, 0) ? 0 : 1;
+      }
+      std::vector<int64_t> paths(static_cast<size_t>(n) * walk_len);
+      std::vector<int32_t> adv(n);
+      std::vector<uint8_t> status(n);
+      pt_graph_walk_multi(graph, keys, idxs, steps, n, walk_len, seed,
+                          my_shard, num_shards, paths.data(), adv.data(),
+                          status.data());
+      // compact reply: [adv][status][flat visited nodes]
+      uint64_t total = 0;
+      for (uint32_t i = 0; i < n; ++i) total += adv[i];
+      std::vector<char> reply(static_cast<size_t>(n) * 5 + total * 8);
+      std::memcpy(reply.data(), adv.data(), static_cast<size_t>(n) * 4);
+      std::memcpy(reply.data() + static_cast<size_t>(n) * 4, status.data(),
+                  n);
+      char* w = reply.data() + static_cast<size_t>(n) * 5;
+      for (uint32_t i = 0; i < n; ++i) {
+        std::memcpy(w, paths.data() + static_cast<size_t>(i) * walk_len,
+                    static_cast<size_t>(adv[i]) * 8);
+        w += static_cast<size_t>(adv[i]) * 8;
+      }
+      return SendReply(fd, 0, reply.data(),
+                       static_cast<uint32_t>(reply.size()))
+                 ? 0
+                 : 1;
     }
     case kSetFeat: {
       if (len < 8) return SendReply(fd, -10, nullptr, 0) ? 0 : 1;

@@ -315,6 +315,64 @@ class GraphStore {
     }, 64);
   }
 
+  // Multi-hop sharded walk: advance each (node, row, step) walker until
+  // walk_len, a dead end, or its next node hashes to ANOTHER shard
+  // (shard routing must match service.py shard_of: splitmix64 upper 32
+  // bits mod num_shards). Walkers run server-side between handoffs, so the
+  // client pays one round-trip per shard-crossing instead of one per hop —
+  // the reference's server-side FillWalkBuf with HeterComm handoff
+  // (ps_gpu_wrapper.h:198, graph_gpu_ps_table.h:128-134). Hop hashing is
+  // WalkHop's (seed, row, step, node), so sharded output stays
+  // bit-identical to the single-host RandomWalk.
+  //
+  // out is n*walk_len (fixed stride; row i holds adv[i] visited nodes);
+  // status[i]: 0 = reached walk_len, 1 = dead end, 2 = handoff (last
+  // written node is foreign; client resumes it at step steps[i]+adv[i]).
+  void WalkMulti(const int64_t* nodes, const int64_t* idxs,
+                 const int32_t* steps, int64_t n, int32_t walk_len,
+                 uint64_t seed, uint32_t my_shard, uint32_t num_shards,
+                 int64_t* out, int32_t* adv, uint8_t* status) const {
+    std::shared_lock<std::shared_mutex> g(adj_mu_);
+    ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
+      // step-major over the chunk (same rationale as RandomWalk): each
+      // walker is a dependent pointer chase; interleaving keeps ~64
+      // independent chains in flight across cache misses
+      const size_t m = hi - lo;
+      std::vector<int64_t> cur(nodes + lo, nodes + hi);
+      std::vector<int32_t> t(steps + lo, steps + hi);
+      std::vector<uint8_t> st(m, 3);  // 3 = running
+      for (size_t i = 0; i < m; ++i) {
+        adv[lo + i] = 0;
+        if (cur[i] < 0) st[i] = 1;              // dead-walk sentinel
+        else if (t[i] >= walk_len) st[i] = 0;   // already complete
+      }
+      bool any = true;
+      while (any) {
+        any = false;
+        for (size_t i = 0; i < m; ++i) {
+          if (st[i] != 3) continue;
+          const int64_t nxt =
+              WalkHop(cur[i], static_cast<uint64_t>(idxs[lo + i]),
+                      static_cast<uint64_t>(t[i]), seed);
+          if (nxt < 0) { st[i] = 1; continue; }
+          out[(lo + i) * walk_len + adv[lo + i]] = nxt;
+          ++adv[lo + i];
+          ++t[i];
+          cur[i] = nxt;
+          if (t[i] >= walk_len) { st[i] = 0; continue; }
+          if (num_shards > 1 &&
+              (ptn::splitmix64(static_cast<uint64_t>(nxt)) >> 32) %
+                      num_shards != my_shard) {
+            st[i] = 2;  // handoff: client re-routes to the owner
+            continue;
+          }
+          any = true;
+        }
+      }
+      for (size_t i = 0; i < m; ++i) status[lo + i] = st[i];
+    }, 64);
+  }
+
   // -- node feature table (GpuPsCommGraphFea analogue, gpu_graph_node.h:326:
   // per-node float payloads carried next to the adjacency) ----------------
   int32_t SetFeatures(const int64_t* keys, const float* vals, int64_t n,
@@ -430,6 +488,15 @@ void pt_graph_random_walk(void* h, const int64_t* starts, int64_t n,
 void pt_graph_walk_step(void* h, const int64_t* nodes, const int64_t* idxs,
                         int64_t n, int32_t step, uint64_t seed, int64_t* next) {
   static_cast<GraphStore*>(h)->WalkStep(nodes, idxs, n, step, seed, next);
+}
+
+void pt_graph_walk_multi(void* h, const int64_t* nodes, const int64_t* idxs,
+                         const int32_t* steps, int64_t n, int32_t walk_len,
+                         uint64_t seed, uint32_t my_shard, uint32_t num_shards,
+                         int64_t* out, int32_t* adv, uint8_t* status) {
+  static_cast<GraphStore*>(h)->WalkMulti(nodes, idxs, steps, n, walk_len, seed,
+                                         my_shard, num_shards, out, adv,
+                                         status);
 }
 
 int32_t pt_graph_set_features(void* h, const int64_t* keys, const float* vals,

@@ -1,0 +1,9 @@
+"""Utility namespace (reference: ``python/paddle/utils/__init__.py`` —
+download/install_check/cpp_extension there; here the pieces that make
+sense TPU-side: weight download/cache and process lifetime hardening)."""
+from . import download  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+from .procutil import pdeathsig_preexec, start_ppid_watchdog  # noqa: F401
+
+__all__ = ["download", "get_weights_path_from_url", "pdeathsig_preexec",
+           "start_ppid_watchdog"]

@@ -1,0 +1,151 @@
+"""Weight download + cache (reference: ``python/paddle/utils/download.py``
+— ``get_weights_path_from_url`` / ``get_path_from_url`` over
+``WEIGHTS_HOME``, md5-checked, rank-0-only in multi-process jobs).
+
+TPU-native differences: urllib instead of requests (no extra deps), the
+multi-process gate is ``jax.process_index() == 0`` + a completion-marker
+wait instead of trainer-endpoint dedup, and tar/zip decompression is kept
+(model zoos ship archives). Checkpoint conversion from paddle layouts
+lives in :mod:`paddle_tpu.hapi.weights` — layouts were kept
+parity-compatible on purpose.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import time
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url",
+           "WEIGHTS_HOME", "DATA_HOME", "is_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/hapi/weights")
+DATA_HOME = osp.expanduser("~/.cache/paddle_tpu/datasets")
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def is_url(path: str) -> bool:
+    return path.startswith(("http://", "https://", "file://"))
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """Fetch ``url`` into ``WEIGHTS_HOME`` (md5-checked, cached) and return
+    the local path — the ``pretrained=True`` backbone."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _md5check(path: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    return _md5(path) == md5sum
+
+
+def _download(url: str, root_dir: str, md5sum: str | None) -> str:
+    import urllib.request
+
+    os.makedirs(root_dir, exist_ok=True)
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    retry = 0
+    while not (osp.exists(fullname) and _md5check(fullname, md5sum)):
+        if retry >= DOWNLOAD_RETRY_LIMIT:
+            raise RuntimeError(
+                f"Download from {url} failed {retry} times "
+                f"(md5 mismatch or network error)")
+        retry += 1
+        tmp = fullname + ".tmp"
+        try:
+            with urllib.request.urlopen(url) as resp, open(tmp, "wb") as f:
+                shutil.copyfileobj(resp, f)
+        except OSError:
+            if osp.exists(tmp):
+                os.remove(tmp)
+            if retry >= DOWNLOAD_RETRY_LIMIT:
+                raise
+            continue
+        # an md5-passing download REPLACES whatever is there — a corrupt
+        # cached file must be repairable, not permanently poisonous
+        if _md5check(tmp, md5sum):
+            os.replace(tmp, fullname)
+        else:
+            os.remove(tmp)
+    return fullname
+
+
+def _decompress(fname: str) -> str:
+    """Unpack tar/zip next to the archive; return the extracted root."""
+    root = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            names = tf.getnames()
+            tf.extractall(root, filter="data")
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            names = zf.namelist()
+            zf.extractall(root)
+    else:
+        return fname
+    top = names[0].split("/")[0] if names else ""
+    out = osp.join(root, top)
+    return out if osp.exists(out) else root
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
+                      check_exist: bool = True,
+                      decompress: bool = True) -> str:
+    """Cached fetch: returns the local path (downloading on rank 0 only in
+    a multi-process job; other ranks wait for the completion marker —
+    reference ``download.py:118`` dedups by trainer endpoint)."""
+    if url.startswith("file://"):
+        return url[len("file://"):]
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if check_exist and osp.exists(fullname) and _md5check(fullname, md5sum):
+        pass
+    else:
+        rank = 0
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:
+            pass
+        marker = fullname + ".done"
+        if rank == 0:
+            if osp.exists(marker):
+                os.remove(marker)
+            fullname = _download(url, root_dir, md5sum)
+            # the marker carries the downloaded file's md5 so waiters can
+            # tell a FRESH completion from a stale marker left by an old
+            # run (whose file may be outdated or corrupt)
+            with open(marker + ".tmp", "w") as f:
+                f.write(_md5(fullname))
+            os.replace(marker + ".tmp", marker)
+        else:
+            deadline = time.time() + 600
+            while True:
+                if osp.exists(marker) and osp.exists(fullname):
+                    content = open(marker).read().strip()
+                    if (md5sum is None or content == md5sum) and \
+                            _md5check(fullname, md5sum):
+                        break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank}: timed out waiting for rank 0 to "
+                        f"download {url}")
+                time.sleep(0.5)
+    if decompress and (tarfile.is_tarfile(fullname)
+                       or zipfile.is_zipfile(fullname)):
+        return _decompress(fullname)
+    return fullname

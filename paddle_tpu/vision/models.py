@@ -36,6 +36,18 @@ __all__ = [
 ]
 
 
+def _finish(model, arch, pretrained):
+    """Shared ``pretrained=True`` tail of every constructor: fetch the
+    published paddle checkpoint for ``arch`` and load it (reference:
+    each model file's ``get_weights_path_from_url`` + ``load_dict`` branch,
+    e.g. ``python/paddle/vision/models/resnet.py:356-363``)."""
+    if pretrained:
+        from ..hapi.weights import load_pretrained
+
+        load_pretrained(model, arch)
+    return model
+
+
 class LeNet(nn.Layer):
     """``paddle.vision.models.LeNet`` (28x28 single-channel input)."""
 
@@ -118,19 +130,19 @@ def _vgg(cfg: str, batch_norm=False, **kw):
 
 
 def vgg11(pretrained=False, batch_norm=False, **kw):
-    return _vgg("A", batch_norm, **kw)
+    return _finish(_vgg("A", batch_norm, **kw), "vgg11", pretrained)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kw):
-    return _vgg("B", batch_norm, **kw)
+    return _finish(_vgg("B", batch_norm, **kw), "vgg13", pretrained)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kw):
-    return _vgg("D", batch_norm, **kw)
+    return _finish(_vgg("D", batch_norm, **kw), "vgg16", pretrained)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kw):
-    return _vgg("E", batch_norm, **kw)
+    return _finish(_vgg("E", batch_norm, **kw), "vgg19", pretrained)
 
 
 class _ConvBNReLU(nn.Sequential):
@@ -327,15 +339,18 @@ class MobileNetV3Small(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kw):
-    return MobileNetV1(scale=scale, **kw)
+    return _finish(MobileNetV1(scale=scale, **kw),
+                   f"mobilenetv1_{scale}", pretrained)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kw):
-    return MobileNetV2(scale=scale, **kw)
+    return _finish(MobileNetV2(scale=scale, **kw),
+                   f"mobilenetv2_{scale}", pretrained)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
-    return MobileNetV3Small(scale=scale, **kw)
+    return _finish(MobileNetV3Small(scale=scale, **kw),
+                   f"mobilenet_v3_small_x{scale}", pretrained)
 
 
 # ------------------------------------------------- r4: remaining families
@@ -387,7 +402,8 @@ class MobileNetV3Large(nn.Layer):
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
-    return MobileNetV3Large(scale=scale, **kw)
+    return _finish(MobileNetV3Large(scale=scale, **kw),
+                   f"mobilenet_v3_large_x{scale}", pretrained)
 
 
 class AlexNet(nn.Layer):
@@ -419,7 +435,7 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kw):
-    return AlexNet(**kw)
+    return _finish(AlexNet(**kw), "alexnet", pretrained)
 
 
 class _Fire(nn.Layer):
@@ -483,11 +499,11 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kw):
-    return SqueezeNet("1.0", **kw)
+    return _finish(SqueezeNet("1.0", **kw), "squeezenet1_0", pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kw):
-    return SqueezeNet("1.1", **kw)
+    return _finish(SqueezeNet("1.1", **kw), "squeezenet1_1", pretrained)
 
 
 class _ShuffleUnit(nn.Layer):
@@ -583,31 +599,38 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
-    return ShuffleNetV2(0.25, **kw)
+    return _finish(ShuffleNetV2(0.25, **kw),
+                   "shufflenet_v2_x0_25", pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kw):
-    return ShuffleNetV2(0.33, **kw)
+    return _finish(ShuffleNetV2(0.33, **kw),
+                   "shufflenet_v2_x0_33", pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
-    return ShuffleNetV2(0.5, **kw)
+    return _finish(ShuffleNetV2(0.5, **kw),
+                   "shufflenet_v2_x0_5", pretrained)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kw):
-    return ShuffleNetV2(1.0, **kw)
+    return _finish(ShuffleNetV2(1.0, **kw),
+                   "shufflenet_v2_x1_0", pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kw):
-    return ShuffleNetV2(1.5, **kw)
+    return _finish(ShuffleNetV2(1.5, **kw),
+                   "shufflenet_v2_x1_5", pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
-    return ShuffleNetV2(2.0, **kw)
+    return _finish(ShuffleNetV2(2.0, **kw),
+                   "shufflenet_v2_x2_0", pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kw):
-    return ShuffleNetV2(1.0, act="swish", **kw)
+    return _finish(ShuffleNetV2(1.0, act="swish", **kw),
+                   "shufflenet_v2_swish", pretrained)
 
 
 class _DenseLayer(nn.Layer):
@@ -673,23 +696,23 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kw):
-    return DenseNet(121, **kw)
+    return _finish(DenseNet(121, **kw), "densenet121", pretrained)
 
 
 def densenet161(pretrained=False, **kw):
-    return DenseNet(161, **kw)
+    return _finish(DenseNet(161, **kw), "densenet161", pretrained)
 
 
 def densenet169(pretrained=False, **kw):
-    return DenseNet(169, **kw)
+    return _finish(DenseNet(169, **kw), "densenet169", pretrained)
 
 
 def densenet201(pretrained=False, **kw):
-    return DenseNet(201, **kw)
+    return _finish(DenseNet(201, **kw), "densenet201", pretrained)
 
 
 def densenet264(pretrained=False, **kw):
-    return DenseNet(264, **kw)
+    return _finish(DenseNet(264, **kw), "densenet264", pretrained)
 
 
 class _Inception(nn.Layer):
@@ -753,7 +776,7 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kw):
-    return GoogLeNet(**kw)
+    return _finish(GoogLeNet(**kw), "googlenet", pretrained)
 
 
 class _BasicConv(nn.Sequential):
@@ -909,4 +932,4 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kw):
-    return InceptionV3(**kw)
+    return _finish(InceptionV3(**kw), "inception_v3", pretrained)

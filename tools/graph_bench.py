@@ -190,6 +190,12 @@ def main():
     result = {
         "edges": args.edges,
         "nodes_with_edges": int(ids.size),
+        # sharding/overlap wins are scale-OUT effects: on a single-core
+        # host every byte of IPC and every producer-thread switch is pure
+        # added work, so two_shard <= single_host and overlap <= 1.0 are
+        # the expected envelope there; record the context so the numbers
+        # are read against the right ceiling
+        "host": {"cpu_count": os.cpu_count()},
         "single_host": {
             "build_edges_per_sec": round(args.edges / build_s, 1),
             "neighbor_samples_per_sec": round(
@@ -197,11 +203,12 @@ def main():
             "walk_hops_per_sec": round(
                 bench_walks(g, ids, batch, walk_len, args.iters), 1),
         },
-        # sharded run uses a tenth of the edges: the service path measures
-        # RPC+shard overhead, not raw CSR speed
-        "two_shard": bench_sharded(num_nodes // 10 or 100, args.edges // 10,
+        # sharded service at the SAME scale as the single-host run so the
+        # two throughput columns are a fair head-to-head (the r4 bench used
+        # a tenth of the edges for the service, flattering neither side)
+        "two_shard": bench_sharded(num_nodes, args.edges,
                                    batch, sample_size, walk_len,
-                                   max(args.iters // 5, 5)),
+                                   max(args.iters // 2, 5)),
         "feed_train_overlap": bench_overlap(g),
     }
     print(json.dumps(result))
