@@ -3,7 +3,10 @@ download/install_check/cpp_extension there; here the pieces that make
 sense TPU-side: weight download/cache and process lifetime hardening)."""
 from . import download  # noqa: F401
 from .download import get_weights_path_from_url  # noqa: F401
+from .helpers import (deprecated, require_version, run_check,  # noqa: F401
+                      try_import)
 from .procutil import pdeathsig_preexec, start_ppid_watchdog  # noqa: F401
 
 __all__ = ["download", "get_weights_path_from_url", "pdeathsig_preexec",
-           "start_ppid_watchdog"]
+           "start_ppid_watchdog", "deprecated", "run_check",
+           "require_version", "try_import"]

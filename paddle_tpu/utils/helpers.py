@@ -1,0 +1,133 @@
+"""The reference ``paddle.utils`` public helpers
+(``python/paddle/utils/__init__.py:31``: ``deprecated``, ``run_check``,
+``require_version``, ``try_import``), TPU-native where behavior differs:
+``run_check`` validates the JAX device path (and the virtual/real mesh
+collective path when more than one device is visible) instead of CUDA.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import re
+import warnings
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Decorator marking an API deprecated (reference
+    ``python/paddle/utils/deprecated.py``): extends the docstring and
+    warns once per call site. ``level=2`` raises instead of warning."""
+
+    def decorator(func):
+        msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        func.__doc__ = f"(Deprecated) {msg}\n\n{func.__doc__ or ''}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level < 2:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def run_check() -> None:
+    """Installation self-check (reference
+    ``python/paddle/utils/install_check.py``): run a tiny differentiated
+    matmul on the default backend, and when several devices are visible,
+    a psum over an all-device mesh — then report what works."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu
+
+    x = jnp.ones((4, 4), jnp.float32)
+    loss, grad = jax.value_and_grad(lambda a: (a @ a).sum())(x)
+    # real raises, not asserts: a self-check must still check under -O
+    if float(np.asarray(loss)) != 64.0 or not np.allclose(np.asarray(grad),
+                                                          8.0):
+        raise RuntimeError(
+            f"paddle_tpu self-check failed: matmul/grad produced "
+            f"loss={float(np.asarray(loss))}, expected 64.0")
+    n = len(jax.devices())
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        y = jax.device_put(np.arange(n * 2, dtype=np.float32).reshape(n, 2),
+                           NamedSharding(mesh, P("dp")))
+        total = float(np.asarray(jnp.sum(y)))
+        if total != sum(range(n * 2)):
+            raise RuntimeError(
+                f"paddle_tpu self-check failed: sharded reduction gave "
+                f"{total}, expected {sum(range(n * 2))}")
+        print(f"paddle_tpu {paddle_tpu.__version__} works on "
+              f"{n} {jax.default_backend()} device(s), collectives OK.")
+    else:
+        print(f"paddle_tpu {paddle_tpu.__version__} works on "
+              f"1 {jax.default_backend()} device.")
+    print("paddle_tpu is installed successfully!")
+
+
+def _ver_tuple(v: str):
+    parts = []
+    for piece in str(v).split("."):
+        m = re.match(r"\d+", piece)
+        parts.append(int(m.group()) if m else 0)
+    return tuple(parts)
+
+
+def require_version(min_version: str, max_version: str | None = None) -> None:
+    """Raise unless ``min_version <= paddle_tpu.__version__``
+    (``<= max_version`` when given) — reference
+    ``python/paddle/utils/__init__.py`` require_version."""
+    import paddle_tpu
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("version arguments must be strings")
+
+    def padded(*tuples):
+        # zero-fill to equal length (reference require_version does):
+        # '0.1' and '0.1.0' must compare equal
+        width = max(len(t) for t in tuples)
+        return [t + (0,) * (width - len(t)) for t in tuples]
+
+    cur, lo = padded(_ver_tuple(paddle_tpu.__version__),
+                     _ver_tuple(min_version))
+    if cur < lo:
+        raise Exception(
+            f"installed paddle_tpu {paddle_tpu.__version__} < required "
+            f"minimum {min_version}")
+    if max_version is not None:
+        cur, hi = padded(_ver_tuple(paddle_tpu.__version__),
+                         _ver_tuple(max_version))
+        if cur > hi:
+            raise Exception(
+                f"installed paddle_tpu {paddle_tpu.__version__} > supported "
+                f"maximum {max_version}")
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import a module, raising a friendly install hint when missing
+    (reference ``python/paddle/utils/lazy_import.py``)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Failed to import '{module_name}'. "
+                       f"Install it (e.g. pip install {module_name}) "
+                       f"to use this feature.") from e

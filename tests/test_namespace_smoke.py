@@ -111,7 +111,8 @@ INVOKE_ELSEWHERE = {
 }
 
 # functions that legitimately return None (setters/config)
-NONE_OK = {"set_code_level", "set_verbosity", "seed", "enable_operator_stats_collection",
+NONE_OK = {"run_check", "require_version",
+           "set_code_level", "set_verbosity", "seed", "enable_operator_stats_collection",
            "disable_operator_stats_collection", "reset_profiler",
            "start_profiler", "stop_profiler", "disable_signal_handler",
            "set_flags", "set_device", "set_default_dtype",
@@ -133,6 +134,7 @@ TARGETS = [
      "paddle_tpu.nn.functional"),
     ("/root/reference/python/paddle/vision/models/__init__.py",
      "paddle_tpu.vision.models"),
+    ("/root/reference/python/paddle/utils/__init__.py", "paddle_tpu.utils"),
 ]
 
 

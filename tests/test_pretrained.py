@@ -104,3 +104,29 @@ def test_structure_mismatch_raises(fixture_ckpt, weights_home, monkeypatch,
                         (f"file://{p}", None))
     with pytest.raises(ValueError, match="missing"):
         resnet18(pretrained=True)
+
+
+def test_utils_helpers():
+    """reference paddle.utils __all__: deprecated/run_check/
+    require_version/try_import (python/paddle/utils/__init__.py:31)."""
+    import warnings
+
+    import paddle_tpu.utils as U
+
+    @U.deprecated(since="0.1", update_to="paddle_tpu.new_api")
+    def old_api(x):
+        return x + 1
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert old_api(1) == 2
+    assert any(issubclass(w.category, DeprecationWarning) for w in rec)
+    assert "Deprecated" in old_api.__doc__
+
+    U.run_check()  # prints success on the virtual mesh; must not raise
+    U.require_version("0.0.1")
+    with pytest.raises(Exception, match="<|minimum"):
+        U.require_version("999.0")
+    assert U.try_import("math").sqrt(4) == 2.0
+    with pytest.raises(ImportError, match="no_such_module_xyz"):
+        U.try_import("no_such_module_xyz")
