@@ -342,8 +342,153 @@ def _result_stmt(carried, call: ast.Call) -> ast.stmt:
     return ast.Expr(value=call)
 
 
-class _CtrlFlowTransformer(ast.NodeTransformer):
-    """Bottom-up statement rewrite of If/While/For into _jst dispatch."""
+# ------------------------------------------------------ return lowering
+# The result-variable name deliberately does NOT use the "_d2s_" prefix:
+# _assigned_names drops that prefix from carried state, and the return
+# value must be threaded OUT of the extracted branch functions.
+_RET_VAR = "__return_value__"
+
+
+def _fn_level_return(nodes) -> bool:
+    """Any ``return`` reachable at function level (not inside a nested
+    scope, loop, or try)."""
+    stop = _SCOPE_NODES + (ast.For, ast.AsyncFor, ast.While, ast.Try)
+    found = False
+
+    def walk(node):
+        nonlocal found
+        if found or isinstance(node, stop):
+            return
+        if isinstance(node, ast.Return):
+            found = True
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for n in nodes:
+        walk(n)
+    return found
+
+
+def _hazardous_return(fdef) -> bool:
+    """A ``return`` inside a loop or try (at function level) cannot be
+    lowered by branch folding — leave the whole function's returns alone
+    (those constructs stay trace-only, as documented)."""
+    hazard = (ast.For, ast.AsyncFor, ast.While, ast.Try)
+    found = False
+
+    def walk(node, in_hazard):
+        nonlocal found
+        if found or isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, ast.Return) and in_hazard:
+            found = True
+            return
+        nested = in_hazard or isinstance(node, hazard)
+        for child in ast.iter_child_nodes(node):
+            walk(child, nested)
+
+    walk(fdef, False)
+    return found
+
+
+def _fold_returns(body):
+    """Restructure a statement list so every ``return`` ends a (possibly
+    nested) trailing if-chain: statements after a return-containing If
+    are folded into its branches (dead code after a return is dropped).
+    The caller appends an explicit ``return None`` sentinel first, so
+    every path ends in a Return."""
+    import copy
+
+    out = []
+    for i, st in enumerate(body):
+        if isinstance(st, ast.Return):
+            out.append(st)
+            return out  # anything after is unreachable
+        if isinstance(st, ast.If) and _fn_level_return([st]):
+            rest = body[i + 1:]
+            st.body = _fold_returns(list(st.body) + copy.deepcopy(rest))
+            st.orelse = _fold_returns(list(st.orelse) + rest)
+            out.append(st)
+            return out
+        out.append(st)
+    return out
+
+
+def _retify_tail(body):
+    """After folding, rewrite each trailing ``return expr`` into
+    ``__return_value__ = expr`` so the if-chain becomes convertible."""
+    last = body[-1]
+    if isinstance(last, ast.Return):
+        body[-1] = ast.Assign(
+            targets=[_name(_RET_VAR, ast.Store())],
+            value=last.value or ast.Constant(value=None))
+    else:  # by construction the tail is an If whose branches both return
+        _retify_tail(last.body)
+        _retify_tail(last.orelse)
+    return body
+
+
+def _lower_returns(fdef):
+    """Make mid-function returns convertible (the reference's
+    ReturnTransformer, ``python/paddle/jit/dy2static/return_transformer
+    .py``): ``if cond: return a`` / ``return b`` becomes an if/else
+    assigning one result variable, so a tensor ``cond`` lowers to
+    ``lax.cond`` instead of degrading the whole If to trace-only.
+    Returns inside loops/try are left untouched (still trace-only)."""
+    if not any(isinstance(st, ast.If) and _fn_level_return([st])
+               for st in fdef.body):
+        return fdef
+    if _hazardous_return(fdef):
+        return fdef
+    folded = _fold_returns(
+        list(fdef.body) + [ast.Return(value=ast.Constant(value=None))])
+    fdef.body = _retify_tail(folded) + [
+        ast.Return(value=_name(_RET_VAR))]
+    return fdef
+
+
+def _read_names(nodes) -> set:
+    """Names READ anywhere in ``nodes`` (Load/Del contexts, augmented
+    targets — ``y += 1`` reads y — plus global/nonlocal declarations);
+    crosses nested scopes on purpose — a closure's free-variable read
+    keeps the name live."""
+    out = set()
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Load, ast.Del)):
+                out.add(sub.id)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Name):
+                out.add(sub.target.id)
+            elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+                out.update(sub.names)
+    return out
+
+
+def _deferred_reads(stmts) -> set:
+    """Reads inside NESTED SCOPES anywhere in ``stmts``: a closure defined
+    before a converted if reads its free variables at CALL time, which may
+    be after it — backward statement-order liveness alone would miss it."""
+    out = set()
+    for n in stmts:
+        for sub in ast.walk(n):
+            if isinstance(sub, _SCOPE_NODES):
+                out |= _read_names([sub])
+    return out
+
+
+class _CtrlFlowTransformer:
+    """Bottom-up statement rewrite of If/While/For into _jst dispatch.
+
+    Blocks are processed in REVERSE so each statement knows the set of
+    names read after it (syntactic liveness): only those are threaded
+    through the extracted branch/body functions. Over-carrying is not
+    just waste — a name assigned in one branch only and never read again
+    (the shape return-lowering produces) would ride the lax.cond outputs
+    as UNDEF on one side and a tensor on the other, crashing the trace.
+    """
 
     def __init__(self):
         self.changed = False
@@ -353,11 +498,58 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         self._n += 1
         return self._n
 
-    def visit_If(self, node: ast.If):
-        self.generic_visit(node)
+    def visit(self, fdef):
+        fdef.body = self._block(fdef.body, set())
+        return fdef
+
+    def _block(self, stmts, live_after):
+        # nested-scope reads are live EVERYWHERE in the block (late-bound
+        # closures), not just above their def statement
+        live = set(live_after) | _deferred_reads(stmts)
+        processed = []
+        for st in reversed(stmts):
+            processed.append(self._stmt(st, set(live)))
+            live |= _read_names([st])
+        out = []
+        for repl in reversed(processed):
+            out.extend(repl)
+        return out
+
+    def _stmt(self, st, live):
+        if isinstance(st, ast.If):
+            return self._conv_if(st, live)
+        if isinstance(st, ast.While):
+            return self._conv_while(st, live)
+        if isinstance(st, ast.For):
+            return self._conv_for(st, live)
+        return self._generic(st, live)
+
+    def _generic(self, st, live):
+        """Recurse into any other compound statement's blocks. Inner
+        positions see a conservative live set: everything live after the
+        statement plus everything the statement itself reads (covers
+        loop-back reads, handler reads, with-exit reads)."""
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            st.body = self._block(st.body, set())  # fresh scope
+            return [st]
+        inner_live = live | _read_names([st])
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(st, field, None)
+            if isinstance(block, list) and block and \
+                    isinstance(block[0], ast.stmt):
+                setattr(st, field, self._block(block, inner_live))
+        for handler in getattr(st, "handlers", []):
+            handler.body = self._block(handler.body, inner_live)
+        for case in getattr(st, "cases", []):  # match statements
+            case.body = self._block(case.body, inner_live)
+        return [st]
+
+    def _conv_if(self, node: ast.If, live):
+        node.body = self._block(node.body, live)
+        node.orelse = self._block(node.orelse, live)
         if _unconvertible(node.body + node.orelse, loops_shield=True):
-            return node
-        carried = sorted(_assigned_names(node.body + node.orelse))
+            return [node]
+        carried = sorted(_assigned_names(node.body + node.orelse) & live)
         uid = self._uid()
         tname, fname = f"_d2s_true_{uid}", f"_d2s_false_{uid}"
         tdef = _fn_def(tname, carried, node.body, carried)
@@ -369,17 +561,22 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return [tdef, fdef, _result_stmt(carried, call)]
 
-    def visit_While(self, node: ast.While):
-        self.generic_visit(node)
+    def _conv_while(self, node: ast.While, live):
+        # body statements may be read by the NEXT iteration, the test, or
+        # a while-else block (which runs after normal exit)
+        loop_live = live | _read_names(node.body + node.orelse
+                                       + [node.test])
+        node.body = self._block(node.body, loop_live)
         if (node.orelse or _unconvertible(node.body, loops_shield=True)
                 # a walrus in the test would bind inside the extracted
                 # test_fn and never reach the body/enclosing scope
                 or _contains([node.test], ast.NamedExpr)):
-            return node
-        carried = sorted(_assigned_names(node.body) |
-                         _assigned_names([node.test]))
+            node.orelse = self._block(node.orelse, live)
+            return [node]
+        carried = sorted((_assigned_names(node.body) |
+                          _assigned_names([node.test])) & loop_live)
         if not carried:
-            return node  # stateless while: nothing to thread, leave as-is
+            return [node]  # stateless while: nothing to thread, leave as-is
         uid = self._uid()
         test_name, body_name = f"_d2s_wtest_{uid}", f"_d2s_wbody_{uid}"
         tdef = ast.FunctionDef(
@@ -397,13 +594,16 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return [tdef, bdef, _result_stmt(carried, call)]
 
-    def visit_For(self, node: ast.For):
-        self.generic_visit(node)
+    def _conv_for(self, node: ast.For, live):
+        loop_live = live | _read_names(node.body + node.orelse
+                                       + [node.iter])
+        node.body = self._block(node.body, loop_live)
         if (node.orelse or not isinstance(node.target, ast.Name)
                 or _unconvertible(node.body, loops_shield=True)):
-            return node
+            node.orelse = self._block(node.orelse, live)
+            return [node]
         target = node.target.id
-        carried = sorted(_assigned_names(node.body) - {target})
+        carried = sorted((_assigned_names(node.body) - {target}) & loop_live)
         uid = self._uid()
         body_name = f"_d2s_fbody_{uid}"
         bdef = _fn_def(body_name, [target] + carried, node.body, carried)
@@ -470,6 +670,7 @@ def convert_control_flow(fn, loop_bound=None):
         if isinstance(sub, ast.Name) and sub.id in ("super", "__class__"):
             return fn
     fdef.decorator_list = []  # the conversion entry must not re-apply
+    fdef = _lower_returns(fdef)
     transformer = _CtrlFlowTransformer()
     fdef = transformer.visit(fdef)
     if not transformer.changed:

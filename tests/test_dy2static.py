@@ -405,3 +405,202 @@ def test_dynamic_rnn_style_model():
     h, n = g(jnp.ones((8,), jnp.float32) * 0.1)
     assert float(jnp.linalg.norm(h)) >= 10.0
     assert int(n) >= 1
+
+
+# ------------------------------------------------ return lowering (r5)
+def test_return_in_both_branches_converts():
+    """`if cond: return a / else: return b` with a TENSOR predicate must
+    lower to lax.cond (reference ReturnTransformer,
+    python/paddle/jit/dy2static/return_transformer.py) — under jit a
+    trace-only fallback would raise a TracerBoolConversionError."""
+    def f(x):
+        if jnp.mean(x) > 0:
+            return x * 2.0
+        else:
+            return x - 1.0
+
+    g = to_static(f)
+    compiled = jax.jit(g)
+    np.testing.assert_allclose(np.asarray(compiled(jnp.asarray([1.0, 2.0]))),
+                               [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(compiled(jnp.asarray([-1.0, -2.0]))),
+                               [-2.0, -3.0])
+
+
+def test_guard_clause_return_converts():
+    """Early-return guard followed by more statements: the tail folds into
+    the else path."""
+    def f(x):
+        if jnp.sum(x) > 10.0:
+            return x * 0.0
+        y = x + 1.0
+        y = y * 2.0
+        return y
+
+    g = jax.jit(to_static(f))
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([20.0]))), [0.0])
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([1.0]))), [4.0])
+
+
+def test_elif_chain_returns_convert():
+    def f(x):
+        s = jnp.sum(x)
+        if s > 10.0:
+            return x * 3.0
+        elif s > 0.0:
+            return x * 2.0
+        else:
+            return -x
+
+    g = jax.jit(to_static(f))
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([20.0]))), [60.0])
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([2.0]))), [4.0])
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([-2.0]))), [2.0])
+
+
+def test_bare_and_implicit_none_returns_match_python():
+    """Concrete predicates (outside jit) must keep exact python
+    semantics, including the implicit `return None` when the guard does
+    not fire. (Under jit a tensor predicate with structurally-mismatched
+    branch returns still errors loudly — lax.cond demands matching
+    pytrees — exactly as the unconverted trace would.)"""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x, flag):
+        if flag:  # concrete bool: python dispatch at runtime
+            return x + 1.0
+        # implicit: returns None
+
+    g = convert_control_flow(f)
+    assert g.__d2s_converted__
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([1.0]), True)),
+                               [2.0])
+    assert g(jnp.asarray([1.0]), False) is None
+
+
+def test_return_inside_loop_stays_python():
+    """Returns inside loops are NOT lowered (documented limit): eager
+    semantics must be preserved untouched."""
+    def f(xs):
+        for i in range(3):
+            if i == 2:
+                return xs + i
+        return xs
+
+    g = to_static(f)
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([1.0]))), [3.0])
+
+
+def test_mixed_assignment_and_return_branch():
+    """One branch returns, the other assigns and falls through."""
+    def f(x):
+        if jnp.sum(x) < 0:
+            return jnp.zeros_like(x)
+        else:
+            y = x * 3.0
+        return y + 1.0
+
+    g = jax.jit(to_static(f))
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([-1.0]))), [0.0])
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([2.0]))), [7.0])
+
+
+# ------------------------- liveness soundness regressions (r5 review)
+def test_augassign_keeps_branch_result_live():
+    """y += 1 READS y: liveness must keep y carried out of the if."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x):
+        if x > 0:
+            y = x
+        else:
+            y = -x
+        y += 1.0
+        return x * 2.0
+
+    g = convert_control_flow(f)
+    assert g(2.0) == 4.0
+    assert g(-2.0) == -4.0
+
+
+def test_closure_defined_before_if_keeps_name_live():
+    """A nested def BEFORE the if reads its free variable at CALL time —
+    backward statement-order liveness alone would prune it."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x, cond):
+        def g():
+            return y
+
+        if cond:
+            y = x * 2
+        else:
+            y = x
+        return g()
+
+    h = convert_control_flow(f)
+    assert h(3.0, True) == 6.0
+    assert h(3.0, False) == 3.0
+
+
+def test_loop_else_reads_keep_inner_if_results():
+    """for/while-else blocks run after the loop: their reads must keep
+    names assigned by converted ifs inside the (python-kept) loop body."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(items, cond):
+        for i in items:
+            if cond:
+                y = i
+            else:
+                y = -i
+        else:
+            out = y + 1
+        return out
+
+    g = convert_control_flow(f)
+    assert g([1.0, 2.0], True) == 3.0
+    assert g([1.0, 2.0], False) == -1.0
+
+    def fw(n, cond):
+        i = 0
+        while i < n:
+            if cond:
+                y = i
+            else:
+                y = -i
+            i += 1
+        else:
+            out = y + 10
+        return out
+
+    gw = convert_control_flow(fw)
+    assert gw(3, True) == 12
+    assert gw(3, False) == 8
+
+
+def test_match_case_bodies_still_convert():
+    """Control flow inside match-case bodies must still be reached by the
+    converter (the block traversal must visit `cases`)."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x, tag):
+        match tag:
+            case "double":
+                if jnp.sum(x) > 0:
+                    y = x * 2.0
+                else:
+                    y = x - 1.0
+            case _:
+                y = x
+        return y
+
+    g = convert_control_flow(f)
+    assert g.__d2s_converted__
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(g, static_argnums=1)(jnp.asarray([1.0]),
+                                                "double")), [2.0])
+    np.testing.assert_allclose(
+        np.asarray(g(jnp.asarray([-1.0]), "double")), [-2.0])
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([5.0]), "other")),
+                               [5.0])
