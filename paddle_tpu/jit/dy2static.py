@@ -99,6 +99,27 @@ def _as_pred(x):
     return arr.astype(bool)
 
 
+def logical_not(x):
+    """``not x`` that stays traceable: python ``not`` for concrete values
+    (exact truthiness semantics — concrete scalar jnp bools included),
+    ``jnp.logical_not`` for tracers."""
+    if isinstance(x, jax.core.Tracer):
+        return jnp.logical_not(x)
+    return not x
+
+
+def logical_and(a, b_thunk):
+    """Short-circuit-preserving AND for synthesized loop tests: ``b`` is a
+    thunk so the concrete path skips it when ``a`` is falsy — after a
+    lowered ``break`` fires, the original loop test must NOT be
+    re-evaluated (python's ``break`` exits without re-testing, and the
+    test may only be well-defined pre-break). A traced ``a`` evaluates
+    both and ands them (lax needs the value either way)."""
+    if isinstance(a, jax.core.Tracer):
+        return jnp.logical_and(a, b_thunk())
+    return a and b_thunk()
+
+
 def convert_if(pred, true_fn, false_fn, operands: tuple):
     """``if`` dispatch. ``true_fn``/``false_fn`` take the carried locals
     positionally and return their updated tuple."""
@@ -161,10 +182,13 @@ def convert_while(test_fn, body_fn, init: tuple, bound=None):
     """
     carry = tuple(init)
     first = test_fn(*carry)
+    # unroll while the condition stays concrete; the condition can BECOME
+    # traced mid-loop (e.g. a lowered break flag fed by a tensor
+    # comparison) — hand the current carry to the lax path then
+    while not _is_traced(first) and first:
+        carry = tuple(body_fn(*carry))
+        first = test_fn(*carry)
     if not _is_traced(first):
-        while first:
-            carry = tuple(body_fn(*carry))
-            first = test_fn(*carry)
         return carry
     if bound is not None:
         return _bounded_while(test_fn, body_fn, carry, int(bound))
@@ -448,6 +472,48 @@ def _lower_returns(fdef):
     return fdef
 
 
+# -------------------------------------------- break/continue lowering
+def _lower_loop_escapes(body, flag: str):
+    """Rewrite top-level ``if c: break`` / ``if c: continue`` statements
+    of a while body into flag/guard form (the reference's
+    BreakContinueTransformer, ``python/paddle/jit/dy2static/
+    break_continue_transformer.py``):
+
+    - ``if c: break``    -> ``flag = c`` + the remaining statements
+      wrapped in ``if not flag:`` (the loop test is augmented by the
+      caller to include ``not flag``);
+    - ``if c: continue`` -> the remaining statements wrapped in
+      ``if not c:``.
+
+    Only the exact one-statement pattern is handled; anything else (bare
+    break, break under else, break in a nested if) leaves the loop
+    unconvertible as before. Returns ``(new_body, used_break)``.
+    """
+    out, used_break = [], False
+    for i, st in enumerate(body):
+        if (isinstance(st, ast.If) and not st.orelse
+                and len(st.body) == 1
+                and isinstance(st.body[0], (ast.Break, ast.Continue))):
+            rest, rest_used = _lower_loop_escapes(body[i + 1:], flag)
+            used_break = used_break or rest_used
+            if isinstance(st.body[0], ast.Break):
+                used_break = True
+                out.append(ast.Assign(targets=[_name(flag, ast.Store())],
+                                      value=st.test))
+                guard = _jst_call("logical_not", [_name(flag)])
+            else:
+                guard = _jst_call("logical_not", [st.test])
+            if rest:
+                out.append(ast.If(test=guard, body=rest, orelse=[]))
+            elif isinstance(st.body[0], ast.Continue):
+                # trailing `if c: continue` is a no-op; keep the test's
+                # evaluation for side-effect parity
+                out.append(ast.Expr(value=st.test))
+            return out, used_break
+        out.append(st)
+    return out, used_break
+
+
 def _read_names(nodes) -> set:
     """Names READ anywhere in ``nodes`` (Load/Del contexts, augmented
     targets — ``y += 1`` reads y — plus global/nonlocal declarations);
@@ -562,6 +628,38 @@ class _CtrlFlowTransformer:
         return [tdef, fdef, _result_stmt(carried, call)]
 
     def _conv_while(self, node: ast.While, live):
+        import copy
+
+        # `if c: break` / `if c: continue` in the body lower to flag/guard
+        # form when that makes the loop convertible; otherwise the
+        # original body is kept (python loop, exact semantics)
+        prelude = []
+        # lowering must respect the same bail-outs as conversion itself:
+        # a while-else's else must NOT run after a break (the lowered loop
+        # exits via the test), and a walrus in the test would move its
+        # binding into the synthesized lambda's scope
+        if (not node.orelse
+                and not _contains([node.test], ast.NamedExpr)
+                and _contains(node.body, (ast.Break, ast.Continue))):
+            flag = f"__break_flag_{self._uid()}__"
+            lowered, used_break = _lower_loop_escapes(
+                copy.deepcopy(node.body), flag)
+            if not _unconvertible(lowered, loops_shield=True):
+                node.body = lowered
+                if used_break:
+                    # while (not flag) and (test): the thunk keeps the
+                    # original test un-evaluated once the break fired
+                    node.test = _jst_call("logical_and", [
+                        _jst_call("logical_not", [_name(flag)]),
+                        ast.Lambda(
+                            args=ast.arguments(
+                                posonlyargs=[], args=[], kwonlyargs=[],
+                                kw_defaults=[], defaults=[]),
+                            body=node.test)])
+                    prelude = [ast.Assign(
+                        targets=[_name(flag, ast.Store())],
+                        value=ast.Constant(value=False))]
+
         # body statements may be read by the NEXT iteration, the test, or
         # a while-else block (which runs after normal exit)
         loop_live = live | _read_names(node.body + node.orelse
@@ -572,11 +670,12 @@ class _CtrlFlowTransformer:
                 # test_fn and never reach the body/enclosing scope
                 or _contains([node.test], ast.NamedExpr)):
             node.orelse = self._block(node.orelse, live)
-            return [node]
+            return prelude + [node]
         carried = sorted((_assigned_names(node.body) |
                           _assigned_names([node.test])) & loop_live)
         if not carried:
-            return [node]  # stateless while: nothing to thread, leave as-is
+            # stateless while: nothing to thread, leave as-is
+            return prelude + [node]
         uid = self._uid()
         test_name, body_name = f"_d2s_wtest_{uid}", f"_d2s_wbody_{uid}"
         tdef = ast.FunctionDef(
@@ -592,7 +691,7 @@ class _CtrlFlowTransformer:
                       ctx=ast.Load()),
             _name("_d2s_loop_bound")])
         self.changed = True
-        return [tdef, bdef, _result_stmt(carried, call)]
+        return prelude + [tdef, bdef, _result_stmt(carried, call)]
 
     def _conv_for(self, node: ast.For, live):
         loop_live = live | _read_names(node.body + node.orelse

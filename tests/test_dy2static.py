@@ -604,3 +604,139 @@ def test_match_case_bodies_still_convert():
         np.asarray(g(jnp.asarray([-1.0]), "double")), [-2.0])
     np.testing.assert_allclose(np.asarray(g(jnp.asarray([5.0]), "other")),
                                [5.0])
+
+
+# --------------------------------- break/continue lowering (r5)
+def test_tensor_break_in_while_converts():
+    """`if c: break` with a tensor condition lowers to flag/guard form
+    and runs under jit (reference BreakContinueTransformer,
+    python/paddle/jit/dy2static/break_continue_transformer.py)."""
+    def f(x):
+        s = x
+        i = jnp.zeros(())
+        while i < 8.0:
+            s = s * 1.5
+            if jnp.sum(s) > 40.0:
+                break
+            i = i + 1.0
+        return s, i
+
+    # python reference semantics
+    def ref(x):
+        s = np.asarray(x, np.float32)
+        i = 0.0
+        while i < 8.0:
+            s = s * np.float32(1.5)
+            if s.sum() > 40.0:
+                break
+            i = i + 1.0
+        return s, i
+
+    g = jax.jit(to_static(f))
+    for start in ([4.0, 4.0], [0.1, 0.1]):
+        s_ref, i_ref = ref(np.asarray(start, np.float32))
+        s_got, i_got = g(jnp.asarray(start))
+        np.testing.assert_allclose(np.asarray(s_got), s_ref, rtol=1e-6)
+        assert float(i_got) == i_ref
+
+
+def test_tensor_continue_in_while_converts():
+    """`if c: continue` guards the remaining statements."""
+    def f(x):
+        total = jnp.zeros(())
+        i = jnp.zeros(())
+        while i < 6.0:
+            i = i + 1.0
+            if jnp.sum(x) * i % 2.0 < 1.0:
+                continue
+            total = total + i
+        return total
+
+    def ref(xsum):
+        total, i = 0.0, 0.0
+        while i < 6.0:
+            i += 1.0
+            if xsum * i % 2.0 < 1.0:
+                continue
+            total += i
+        return total
+
+    g = jax.jit(to_static(f))
+    assert float(g(jnp.asarray([1.0]))) == ref(1.0)
+    assert float(g(jnp.asarray([0.5]))) == ref(0.5)
+
+
+def test_bare_break_stays_python():
+    """Bare (unconditional) break is not the lowered pattern: the loop
+    stays a python loop and eager semantics are untouched."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x, n):
+        total = x
+        i = 0
+        while True:
+            if i >= n:
+                total = total + 100
+                break  # genuinely bare: not the one-statement pattern
+            total = total + 1
+            i += 1
+        return total
+
+    g = convert_control_flow(f)
+    assert g(1, 3) == 104
+
+    # while-else + break must keep python semantics: the else must NOT
+    # run when the break fires (lowering is skipped for while-else)
+    def fe(n):
+        i = 0
+        while i < 5:
+            if i == 2:
+                break
+            i += 1
+        else:
+            i = 100
+        return i
+
+    ge = convert_control_flow(fe)
+    assert ge(3) == 2 == fe(3)
+
+    # walrus in the test: lowering and conversion both bail; eager works
+    def fw(vals):
+        s = 0
+        k = 0
+        while (v := vals[k]) > 0:
+            if v > 100:
+                break
+            s += v
+            k += 1
+        return s
+
+    gw = convert_control_flow(fw)
+    assert gw([1, 2, 3, -1]) == 6 == fw([1, 2, 3, -1])
+    assert gw([1, 2, 500, -1]) == 3 == fw([1, 2, 500, -1])
+
+
+def test_break_mid_loop_concrete_matches_python():
+    """Concrete values through the lowered form: break semantics exact,
+    including NOT re-evaluating the loop test after the break fires."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    tests = []
+
+    def f(xs):
+        i = 0
+        out = []
+        while tests.append(i) or i < len(xs):
+            if xs[i] < 0:
+                break
+            out.append(xs[i])
+            i += 1
+        return out
+
+    g = convert_control_flow(f)
+    tests.clear()
+    assert g([1, 2, -1, 4]) == [1, 2]
+    n_evals = len(tests)
+    tests.clear()
+    assert f([1, 2, -1, 4]) == [1, 2]
+    assert n_evals == len(tests)  # test evaluated the same number of times
