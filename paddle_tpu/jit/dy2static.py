@@ -120,6 +120,30 @@ def logical_and(a, b_thunk):
     return a and b_thunk()
 
 
+def range_cond(i, stop, step):
+    """Loop-continuation test of a ``for _ in range(...)`` rewritten as a
+    while (break/continue lowering): direction-aware, traceable."""
+    if isinstance(step, jax.core.Tracer) or isinstance(i, jax.core.Tracer) \
+            or isinstance(stop, jax.core.Tracer):
+        return jnp.where(step > 0, i < stop, i > stop)
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return i < stop if step > 0 else i > stop
+
+
+def range_trip_bound(start, stop, step, default_bound):
+    """Natural iteration bound of a ``for-range`` lowered to a while: a
+    ``break`` can only SHORTEN the loop, so with concrete bounds the
+    range's own trip count is the exact bound — a user ``loop_bound``
+    sized for unbounded whiles must not truncate a statically-counted
+    for. Calling the builtin also restores python's argument validation
+    (``range(2.5)`` raises TypeError). Traced bounds fall back to
+    ``default_bound``."""
+    if any(isinstance(v, jax.core.Tracer) for v in (start, stop, step)):
+        return default_bound
+    return len(range(start, stop, step))
+
+
 def convert_if(pred, true_fn, false_fn, operands: tuple):
     """``if`` dispatch. ``true_fn``/``false_fn`` take the carried locals
     positionally and return their updated tuple."""
@@ -127,18 +151,34 @@ def convert_if(pred, true_fn, false_fn, operands: tuple):
         return true_fn(*operands) if pred else false_fn(*operands)
     # traced: UNDEF slots (defined only inside the branches) ride closure,
     # defined slots ride the cond operands so they are properly traced
-    defined = [i for i, op in enumerate(operands) if op is not UNDEF]
-
-    def _call(branch, dops):
-        full = list(operands)
-        for i, v in zip(defined, dops):
-            full[i] = v
-        return branch(*full)
-
+    defined, fill = _split_undef(operands)
     return lax.cond(_as_pred(pred),
-                    lambda dops: _call(true_fn, dops),
-                    lambda dops: _call(false_fn, dops),
+                    lambda dops: true_fn(*fill(dops)),
+                    lambda dops: false_fn(*fill(dops)),
                     tuple(operands[i] for i in defined))
+
+
+def _split_undef(init: tuple):
+    """UNDEF slots can't ride a lax loop carry (no dtype/shape). Split
+    them out: they stay closure-bound UNDEF on every iteration — correct
+    for body-local temporaries that are reassigned before being read each
+    iteration (``j = 0; while ...`` inside a converted loop), and a
+    read-before-assign still poisons loudly. Their post-loop value is
+    UNDEF (python parity holds for the zero-trip case; after >=1
+    iteration python would keep the last value — reads poison loudly
+    instead, the documented UNDEF contract).
+
+    Returns (defined_indices, fill) where ``fill(dvals)`` rebuilds the
+    full positional tuple."""
+    defined = [i for i, v in enumerate(init) if v is not UNDEF]
+
+    def fill(dvals):
+        full = list(init)
+        for i, v in zip(defined, dvals):
+            full[i] = v
+        return full
+
+    return defined, fill
 
 
 def _bounded_while(test_fn, body_fn, init: tuple, bound: int):
@@ -153,19 +193,20 @@ def _bounded_while(test_fn, body_fn, init: tuple, bound: int):
     evaluated on init by the first real step), so its zero cotangent
     stays zero.
     """
-    init_t = tuple(init)
+    defined, fill = _split_undef(tuple(init))
+    init_t = tuple(init[i] for i in defined)
 
     def step(state, _):
-        alive = _as_pred(test_fn(*state))
+        alive = _as_pred(test_fn(*fill(state)))
         safe = jax.tree_util.tree_map(
             lambda s, i: jnp.where(alive, s, i), tuple(state), init_t)
-        new_state = tuple(body_fn(*safe))
+        new_state = tuple(body_fn(*fill(safe))[i] for i in defined)
         sel = jax.tree_util.tree_map(
             lambda n, o: jnp.where(alive, n, o), new_state, tuple(state))
         return sel, None
 
     out, _ = lax.scan(step, init_t, None, length=bound)
-    return tuple(out)
+    return tuple(fill(out))
 
 
 def convert_while(test_fn, body_fn, init: tuple, bound=None):
@@ -192,9 +233,12 @@ def convert_while(test_fn, body_fn, init: tuple, bound=None):
         return carry
     if bound is not None:
         return _bounded_while(test_fn, body_fn, carry, int(bound))
-    return tuple(lax.while_loop(
-        lambda c: _as_pred(test_fn(*c)),
-        lambda c: tuple(body_fn(*c)), carry))
+    defined, fill = _split_undef(carry)
+    out = lax.while_loop(
+        lambda c: _as_pred(test_fn(*fill(c))),
+        lambda c: tuple(body_fn(*fill(c))[i] for i in defined),
+        tuple(carry[i] for i in defined))
+    return tuple(fill(out))
 
 
 @dataclass(frozen=True)
@@ -226,15 +270,20 @@ def convert_for(iterable, body_fn, init: tuple):
         # iteration count, correct for negative steps, clamped at 0
         n = jnp.maximum(0, (stop - start + step - jnp.sign(step))
                         // step).astype(jnp.int32)
-        return tuple(lax.fori_loop(
+        defined, fill = _split_undef(tuple(init))
+        out = lax.fori_loop(
             0, n,
-            lambda k, c: tuple(body_fn(start + k * step, *c)),
-            tuple(init)))
+            lambda k, c: tuple(
+                body_fn(start + k * step, *fill(c))[i] for i in defined),
+            tuple(init[i] for i in defined))
+        return tuple(fill(out))
     if _is_traced(iterable):
+        defined, fill = _split_undef(tuple(init))
         carry, _ = lax.scan(
-            lambda c, x: (tuple(body_fn(x, *c)), None),
-            tuple(init), iterable)
-        return tuple(carry)
+            lambda c, x: (tuple(body_fn(x, *fill(c))[i] for i in defined),
+                          None),
+            tuple(init[i] for i in defined), iterable)
+        return tuple(fill(carry))
     carry = tuple(init)
     for x in iterable:
         carry = tuple(body_fn(x, *carry))
@@ -473,6 +522,30 @@ def _lower_returns(fdef):
 
 
 # -------------------------------------------- break/continue lowering
+def _own_escapes(body) -> bool:
+    """True if ``body`` contains a break/continue BELONGING TO THIS LOOP
+    (nested loops shield theirs; nested scopes are opaque) — the trigger
+    for escape lowering. A nested loop's break must not trigger a
+    rewrite of the outer loop."""
+    found = False
+
+    def walk(node, shielded):
+        nonlocal found
+        if found or isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, (ast.Break, ast.Continue)) and not shielded:
+            found = True
+            return
+        nested = shielded or isinstance(node,
+                                        (ast.For, ast.AsyncFor, ast.While))
+        for child in ast.iter_child_nodes(node):
+            walk(child, nested)
+
+    for n in body:
+        walk(n, False)
+    return found
+
+
 def _lower_loop_escapes(body, flag: str):
     """Rewrite top-level ``if c: break`` / ``if c: continue`` statements
     of a while body into flag/guard form (the reference's
@@ -627,7 +700,7 @@ class _CtrlFlowTransformer:
         self.changed = True
         return [tdef, fdef, _result_stmt(carried, call)]
 
-    def _conv_while(self, node: ast.While, live):
+    def _conv_while(self, node: ast.While, live, bound_expr=None):
         import copy
 
         # `if c: break` / `if c: continue` in the body lower to flag/guard
@@ -640,7 +713,7 @@ class _CtrlFlowTransformer:
         # binding into the synthesized lambda's scope
         if (not node.orelse
                 and not _contains([node.test], ast.NamedExpr)
-                and _contains(node.body, (ast.Break, ast.Continue))):
+                and _own_escapes(node.body)):
             flag = f"__break_flag_{self._uid()}__"
             lowered, used_break = _lower_loop_escapes(
                 copy.deepcopy(node.body), flag)
@@ -689,11 +762,67 @@ class _CtrlFlowTransformer:
             _name(test_name), _name(body_name),
             ast.Tuple(elts=[_maybe_call(c) for c in carried],
                       ctx=ast.Load()),
-            _name("_d2s_loop_bound")])
+            bound_expr or _name("_d2s_loop_bound")])
         self.changed = True
         return prelude + [tdef, bdef, _result_stmt(carried, call)]
 
     def _conv_for(self, node: ast.For, live):
+        # `for i in range(...)` with break/continue: rewrite to the while
+        # form and reuse its escape lowering (paddle transforms for-range
+        # the same way). The counter pre-increments — `tgt = i; i += step`
+        # BEFORE the user body — so a lowered `continue` (which guards the
+        # remaining body) can never skip the advance:
+        #     i = start
+        #     while range_cond(i, stop, step):
+        #         tgt = i; i = i + step
+        #         <user body>
+        if (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range" and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3
+                and not any(isinstance(x, ast.Starred)
+                            for x in node.iter.args)
+                and not node.orelse and isinstance(node.target, ast.Name)
+                and _own_escapes(node.body)):
+            uid = self._uid()
+            i_n = f"__for_i_{uid}__"
+            stop_n = f"__for_stop_{uid}__"
+            step_n = f"__for_step_{uid}__"
+            a = node.iter.args
+            start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+            stop = a[1] if len(a) >= 2 else a[0]
+            step = a[2] if len(a) == 3 else ast.Constant(value=1)
+            bound_n = f"__for_bound_{uid}__"
+            # python evaluates range args left-to-right: start, stop, step
+            # (a walrus in start may bind a name stop reads). The natural
+            # trip bound is computed up front (i_n still holds start): a
+            # user loop_bound sized for unbounded whiles must not truncate
+            # this statically-counted loop, and calling range() here keeps
+            # python's argument validation
+            prelude = [
+                ast.Assign(targets=[_name(i_n, ast.Store())], value=start),
+                ast.Assign(targets=[_name(stop_n, ast.Store())], value=stop),
+                ast.Assign(targets=[_name(step_n, ast.Store())], value=step),
+                ast.Assign(targets=[_name(bound_n, ast.Store())],
+                           value=_jst_call("range_trip_bound", [
+                               _name(i_n), _name(stop_n), _name(step_n),
+                               _name("_d2s_loop_bound")])),
+            ]
+            advance = [
+                ast.Assign(targets=[ast.Name(id=node.target.id,
+                                             ctx=ast.Store())],
+                           value=_name(i_n)),
+                ast.Assign(targets=[_name(i_n, ast.Store())],
+                           value=ast.BinOp(left=_name(i_n), op=ast.Add(),
+                                           right=_name(step_n))),
+            ]
+            wnode = ast.While(
+                test=_jst_call("range_cond",
+                               [_name(i_n), _name(stop_n), _name(step_n)]),
+                body=advance + node.body, orelse=[])
+            return prelude + self._conv_while(wnode, live,
+                                              bound_expr=_name(bound_n))
+
         loop_live = live | _read_names(node.body + node.orelse
                                        + [node.iter])
         node.body = self._block(node.body, loop_live)

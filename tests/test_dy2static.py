@@ -740,3 +740,191 @@ def test_break_mid_loop_concrete_matches_python():
     tests.clear()
     assert f([1, 2, -1, 4]) == [1, 2]
     assert n_evals == len(tests)  # test evaluated the same number of times
+
+
+def test_for_range_with_tensor_break_converts():
+    """The canonical decode loop: `for i in range(n): ... if eos: break`
+    rewrites to the while form and lowers (reference transforms for-range
+    the same way before BreakContinueTransformer)."""
+    def f(x):
+        h = x
+        steps = jnp.zeros(())
+        for i in range(10):
+            h = h * 1.4
+            if jnp.sum(h) > 30.0:
+                break
+            steps = steps + 1.0
+        return h, steps
+
+    def ref(x):
+        h = np.asarray(x, np.float32)
+        steps = 0.0
+        for i in range(10):
+            h = h * np.float32(1.4)
+            if h.sum() > 30.0:
+                break
+            steps += 1.0
+        return h, steps
+
+    g = jax.jit(to_static(f))
+    for start in ([2.0, 2.0], [0.01, 0.01]):
+        h_ref, s_ref = ref(np.asarray(start, np.float32))
+        h_got, s_got = g(jnp.asarray(start))
+        np.testing.assert_allclose(np.asarray(h_got), h_ref, rtol=1e-5)
+        assert float(s_got) == s_ref
+
+
+def test_for_range_with_continue_and_step():
+    """continue + negative step through the while rewrite, eager parity."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(vals):
+        total = 0
+        for i in range(8, 0, -2):
+            if vals[i % len(vals)] < 0:
+                continue
+            total = total + i
+        return total, i
+
+    g = convert_control_flow(f)
+    for vals in ([1, -1, 1], [1, 1, 1], [-1, -1, -1]):
+        assert g(vals) == f(vals)
+
+
+def test_for_range_break_keeps_loop_var_semantics():
+    """After the loop the target holds the break-iteration value, exactly
+    as python leaves it."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(n, lim):
+        acc = 0
+        for i in range(n):
+            if acc >= lim:
+                break
+            acc += i
+        return acc, i
+
+    g = convert_control_flow(f)
+    assert g(10, 6) == f(10, 6)
+    assert g(10, 1000) == f(10, 1000)
+
+
+def test_for_range_arg_eval_order_and_side_effects():
+    """range args must evaluate left-to-right exactly once (start, stop,
+    step) — the rewrite's prelude must preserve python's order."""
+    def f(it):
+        total = 0
+        for i in range(next(it), next(it), -1):
+            if total > 1000:
+                break
+            total = total + i
+        return total
+
+    g = convert_control_flow(f)
+    assert g(iter([10, 3, 7])) == f(iter([10, 3, 7])) == 10+9+8+7+6+5+4
+
+
+def test_nested_break_does_not_rewrite_outer_for():
+    """A break belonging to a NESTED loop must not trigger the outer
+    for-range rewrite: the outer loop keeps the exact-count convert_for
+    path (under loop_bound a while would be truncated to the bound)."""
+    def f(n, x):
+        total = jnp.zeros(())
+        for i in range(n):
+            j = 0
+            while j < 5:
+                total = total + x
+                j += 1
+                if j >= 5:  # concrete: the inner loop's OWN break
+                    break
+        return total
+
+    g = to_static(f, loop_bound=3)
+    # 20 outer iterations x 5 inner: a while-rewritten outer loop would be
+    # truncated to loop_bound=3 outer steps (15.0) — must be 100.0
+    out = g(jnp.asarray(20), jnp.asarray(1.0))
+    assert float(out) == 100.0
+
+
+def test_starred_range_args_stay_python_but_function_still_converts():
+    """range(*bounds)+break can't rewrite; the loop stays python and the
+    REST of the function must still convert (no recompile failure)."""
+    def f(x, bounds):
+        if jnp.sum(x) > 0:  # must still lower to lax.cond
+            y = x * 2.0
+        else:
+            y = -x
+        total = 0
+        for i in range(*bounds):
+            if i > 2:
+                break
+            total = total + i
+        return y, total
+
+    g = convert_control_flow(f)
+    assert g.__d2s_converted__
+    y, total = g(jnp.asarray([1.0]), (0, 10))
+    np.testing.assert_allclose(np.asarray(y), [2.0])
+    assert total == 0 + 1 + 2
+
+
+def test_zero_trip_for_target_poisons_on_use():
+    """Zero-trip rewritten for-range: the unbound loop target follows the
+    documented UNDEF contract — poison on USE with a loud message (python
+    raises UnboundLocalError at the read; conversion defers to use)."""
+    def f(n, lim):
+        acc = 0
+        for i in range(n):
+            if acc >= lim:
+                break
+            acc += i
+        return acc, i
+
+    g = convert_control_flow(f)
+    acc, i = g(0, 5)
+    assert acc == 0
+    with pytest.raises(RuntimeError, match="not defined on every path"):
+        i + 1
+
+
+def test_for_range_break_not_truncated_by_loop_bound():
+    """A statically-counted for-range with a tensor break must run its
+    full trip count even when converted with a smaller loop_bound (the
+    bound is for unbounded whiles; a break only SHORTENS a for)."""
+    def f(x):
+        s = jnp.zeros(())
+        for i in range(10):
+            s = s + x
+            if jnp.sum(s) > 1e9:  # never fires
+                break
+        return s
+
+    g = jax.jit(to_static(f, loop_bound=3))
+    assert float(g(jnp.asarray(1.0))) == 10.0
+    # and the break itself still works at that exact count
+    def f2(x):
+        s = jnp.zeros(())
+        for i in range(10):
+            s = s + x
+            if jnp.sum(s) > 4.5:
+                break
+        return s
+
+    g2 = jax.jit(to_static(f2, loop_bound=3))
+    assert float(g2(jnp.asarray(1.0))) == 5.0
+
+
+def test_for_range_break_validates_range_args():
+    """The rewrite must keep python's range() argument validation."""
+    def f(x, n):
+        s = 0
+        for i in range(n):
+            if s > 100:
+                break
+            s += 1
+        return s
+
+    g = convert_control_flow(f)
+    with pytest.raises(TypeError):
+        g(1, 2.5)
+    assert g(1, 3) == 3
