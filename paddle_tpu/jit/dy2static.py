@@ -123,11 +123,13 @@ def logical_and(a, b_thunk):
 def range_cond(i, stop, step):
     """Loop-continuation test of a ``for _ in range(...)`` rewritten as a
     while (break/continue lowering): direction-aware, traceable."""
-    if isinstance(step, jax.core.Tracer) or isinstance(i, jax.core.Tracer) \
-            or isinstance(stop, jax.core.Tracer):
-        return jnp.where(step > 0, i < stop, i > stop)
-    if step == 0:
+    # a CONCRETE zero step must raise like python's range() even when the
+    # bounds are traced — jnp.where would read it as "negative direction"
+    # and silently run (or never advance)
+    if not _is_traced(step) and step == 0:
         raise ValueError("range() arg 3 must not be zero")
+    if _is_traced(step) or _is_traced(i) or _is_traced(stop):
+        return jnp.where(step > 0, i < stop, i > stop)
     return i < stop if step > 0 else i > stop
 
 
@@ -139,7 +141,9 @@ def range_trip_bound(start, stop, step, default_bound):
     for. Calling the builtin also restores python's argument validation
     (``range(2.5)`` raises TypeError). Traced bounds fall back to
     ``default_bound``."""
-    if any(isinstance(v, jax.core.Tracer) for v in (start, stop, step)):
+    if not _is_traced(step) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    if any(_is_traced(v) for v in (start, stop, step)):
         return default_bound
     return len(range(start, stop, step))
 

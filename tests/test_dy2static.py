@@ -928,3 +928,18 @@ def test_for_range_break_validates_range_args():
     with pytest.raises(TypeError):
         g(1, 2.5)
     assert g(1, 3) == 3
+
+
+def test_zero_step_range_raises_even_with_traced_bounds():
+    """range(a, b, 0) must raise like python even when a/b are traced."""
+    def f(a, b):
+        s = jnp.zeros(())
+        for i in range(a, b, 0):
+            s = s + 1.0
+            if jnp.sum(s) > 3.0:
+                break
+        return s
+
+    g = to_static(f)
+    with pytest.raises(ValueError, match="must not be zero"):
+        g(jnp.asarray(5), jnp.asarray(0))

@@ -2,8 +2,9 @@
 missing #2): two OS processes bootstrap one global 8-device mesh through
 ``init_parallel_env`` -> ``jax.distributed.initialize`` (the path a real
 multi-host TPU job takes), discover each other through the elastic KV
-store, train DP and dp x mp ``DistributedTrainStep``s, write a
-per-process sharded checkpoint, reload it sharded, and must match the
+store, train DP, dp x mp, and ZeRO-2 (sdp-sharded optimizer state +
+grad reduce-scatter) ``DistributedTrainStep``s, write a per-process
+sharded checkpoint, reload it sharded, and must match the
 single-process 8-device run loss-for-loss.
 
 Reference discipline:
@@ -57,10 +58,12 @@ if nproc > 1:
     assert kv.get("mc/0") == os.environ["PADDLE_MASTER"]
 
 results = {}
-for mode in ("dp", "dpmp"):
+for mode in ("dp", "dpmp", "zero2"):
     strategy = DistributedStrategy()
-    strategy.hybrid_configs = ({"dp_degree": 4, "mp_degree": 2}
-                               if mode == "dpmp" else {"dp_degree": 8})
+    strategy.hybrid_configs = (
+        {"dp_degree": 4, "mp_degree": 2} if mode == "dpmp"
+        else {"sharding_degree": 8} if mode == "zero2"
+        else {"dp_degree": 8})
     fleet.init(strategy=strategy)
     assert dist_env.get_world_size() == nproc, dist_env.get_world_size()
     assert dist_env.get_rank() == rank
@@ -77,8 +80,9 @@ for mode in ("dp", "dpmp"):
                              nn.Linear(32, 8))
 
     loss_fn = lambda out, b: F.mse_loss(out, b[1])
+    stage = 2 if mode == "zero2" else 0
     step = DistributedTrainStep(build(), AdamW(learning_rate=5e-3),
-                                loss_fn=loss_fn)
+                                loss_fn=loss_fn, sharding_stage=stage)
     rng = np.random.default_rng(0)
     # every process feeds the same GLOBAL batch; the dp sharding hands
     # each device its slice (the multi-controller data contract)
@@ -91,7 +95,7 @@ for mode in ("dp", "dpmp"):
     ckpt.save_state(step.state_dict(), d)
     dist_env.barrier()
     step2 = DistributedTrainStep(build(), AdamW(learning_rate=5e-3),
-                                 loss_fn=loss_fn)
+                                 loss_fn=loss_fn, sharding_stage=stage)
     restored = ckpt.load_state(d, shardings=step2.state_shardings(),
                                template=step2.state_dict())
     step2.set_state_dict(restored)
@@ -171,7 +175,7 @@ def test_two_process_mesh_loss_parity_with_single_process(tmp_path):
     r_ref = json.loads((tmp_path / "out1p.0").read_text())
     assert r0["world"] == 2 and r_ref["world"] == 1
 
-    for mode in ("dp", "dpmp"):
+    for mode in ("dp", "dpmp", "zero2"):
         # both controllers see the same loss stream (one SPMD program)
         np.testing.assert_allclose(r0[mode]["losses"], r1[mode]["losses"],
                                    rtol=1e-6)
