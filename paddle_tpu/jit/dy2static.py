@@ -19,12 +19,20 @@ So converted code behaves identically eagerly, and additionally compiles
 when the condition depends on tensor data — where the unconverted original
 would raise a ConcretizationTypeError.
 
-Known v1 limits (each degrades to the old trace-only behavior, never to
-silent wrongness): ``return``/``break``/``continue`` inside a converted
-block keep that block un-converted; a ``for`` loop's target variable read
-AFTER the loop sees its pre-loop value when the loop was converted;
-foreign decorators / generators / ``super()`` / walrus-in-while-test skip
-conversion. And one inherited from XLA itself: reverse-mode grad through
+Converted escape statements (r5): mid-function ``return`` inside
+if/elif chains lowers via branch folding into a single result variable
+(the ReturnTransformer analogue); ``if c: break`` / ``if c: continue``
+in while loops lower to flag/guard form, and for-range loops carrying
+their own escapes rewrite to that while form with the range's natural
+trip count as the bound.
+
+Remaining limits (each degrades to the old trace-only behavior, never to
+silent wrongness): ``return`` inside loops/try, bare ``break``, breaks
+under ``else`` or with extra statements in the same if-body, and
+loop-``else`` keep their block un-converted; a ``for`` loop's target
+variable read AFTER the loop sees its pre-loop value when the loop was
+converted (zero-trip targets poison on use); foreign decorators /
+generators / ``super()`` / walrus-in-while-test skip conversion. And one inherited from XLA itself: reverse-mode grad through
 a converted ``while`` (dynamic trip count) is unsupported by
 ``lax.while_loop`` — either bound the loop statically
 (``for i in range(k)``) or convert with ``to_static(fn, loop_bound=N)``,
