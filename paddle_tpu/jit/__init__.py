@@ -71,15 +71,48 @@ def to_static(fn=None, *, loop_bound=None, **kwargs):
         dispatch.__wrapped_layer__ = fn
         return dispatch
     if callable(fn):
-        compiled = jit(convert_control_flow(fn, loop_bound=loop_bound),
-                       **kwargs)
+        import functools
+        import inspect
+
+        converted = convert_control_flow(fn, loop_bound=loop_bound)
+        try:
+            first_param = next(iter(inspect.signature(fn).parameters), None)
+        except (TypeError, ValueError):
+            first_param = None
+        # method = first param named `self` AND defined in a CLASS body:
+        # the qualname's parent segment is the class (possibly itself
+        # nested, 'outer.<locals>.Cls.forward'). A free function — module
+        # level or a '<locals>' closure — that merely names its first arg
+        # `self` keeps the standalone-jit path.
+        parent = getattr(fn, "__qualname__", "").rsplit(".", 1)[0] \
+            if "." in getattr(fn, "__qualname__", "") else ""
+        if first_param == "self" and parent \
+                and not parent.endswith("<locals>"):
+            # method decoration — the canonical `@to_static` on `forward`
+            # in a class body (reference: decorating Layer.forward,
+            # python/paddle/jit/api.py to_static). `self` is a Layer, not
+            # an array, so no standalone jit wraps it: under TrainStep /
+            # any enclosing jit the converted control flow still lowers
+            # to lax ops at trace time; a direct eager call runs the
+            # converted code eagerly (compile when you have an instance:
+            # ``to_static(layer)``).
+            if kwargs:
+                import warnings
+
+                warnings.warn(
+                    "to_static on a method ignores jit options "
+                    f"{sorted(kwargs)}: no standalone jit wraps `self`. "
+                    "Apply them at the enclosing jit/TrainStep, or call "
+                    "to_static(layer, ...) on the instance.",
+                    stacklevel=2)
+            target = converted
+        else:
+            target = jit(converted, **kwargs)
 
         def dispatch(*args, **kw):
             if not ProgramTranslator.enable_to_static:
                 return fn(*args, **kw)
-            return compiled(*args, **kw)
-
-        import functools
+            return target(*args, **kw)
 
         functools.update_wrapper(dispatch, fn)
         return dispatch

@@ -21,15 +21,18 @@ would raise a ConcretizationTypeError.
 
 Converted escape statements (r5): mid-function ``return`` inside
 if/elif chains lowers via branch folding into a single result variable
-(the ReturnTransformer analogue); ``if c: break`` / ``if c: continue``
-in while loops lower to flag/guard form, and for-range loops carrying
-their own escapes rewrite to that while form with the range's natural
-trip count as the bound.
+(the ReturnTransformer analogue); every statement-level ``break`` /
+``continue`` in while and for-range loops — bare, with neighbouring
+statements, under ``else``, or in nested if/elif chains — lowers to
+two-flag (escaped/broke) guard form, for-range loops rewriting to the
+while form with the range's natural trip count as the bound; and
+loop-``else`` blocks detach to an epilogue (guarded by the break flag
+when the body can break).
 
 Remaining limits (each degrades to the old trace-only behavior, never to
-silent wrongness): ``return`` inside loops/try, bare ``break``, breaks
-under ``else`` or with extra statements in the same if-body, and
-loop-``else`` keep their block un-converted; a ``for`` loop's target
+silent wrongness): ``return`` inside loops/try and escapes buried in
+``try``/``with``/``match`` keep their block un-converted, as do escapes
+in a ``for`` over a non-``range`` iterable; a ``for`` loop's target
 variable read AFTER the loop sees its pre-loop value when the loop was
 converted (zero-trip targets poison on use); foreign decorators /
 generators / ``super()`` / walrus-in-while-test skip conversion. And one inherited from XLA itself: reverse-mode grad through
@@ -360,10 +363,16 @@ def _unconvertible(nodes, *, loops_shield: bool) -> bool:
         if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
             found = True
             return
-        nested = in_loop or (loops_shield
-                             and isinstance(node, (ast.For, ast.While)))
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            # the loop's BODY shields its own escapes, but an escape in
+            # its else clause binds to the loop ENCLOSING this one
+            for child in node.body:
+                walk(child, in_loop or loops_shield)
+            for child in node.orelse:
+                walk(child, in_loop)
+            return
         for child in ast.iter_child_nodes(node):
-            walk(child, nested)
+            walk(child, in_loop)
 
     for n in nodes:
         walk(n, False)
@@ -548,52 +557,74 @@ def _own_escapes(body) -> bool:
         if isinstance(node, (ast.Break, ast.Continue)) and not shielded:
             found = True
             return
-        nested = shielded or isinstance(node,
-                                        (ast.For, ast.AsyncFor, ast.While))
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            # the nested loop's BODY shields its escapes; escapes in its
+            # else clause bind to THIS loop and must be seen
+            for child in node.body:
+                walk(child, True)
+            for child in node.orelse:
+                walk(child, shielded)
+            return
         for child in ast.iter_child_nodes(node):
-            walk(child, nested)
+            walk(child, shielded)
 
     for n in body:
         walk(n, False)
     return found
 
 
-def _lower_loop_escapes(body, flag: str):
-    """Rewrite top-level ``if c: break`` / ``if c: continue`` statements
-    of a while body into flag/guard form (the reference's
-    BreakContinueTransformer, ``python/paddle/jit/dy2static/
-    break_continue_transformer.py``):
+class _Unliftable(Exception):
+    """An escape sits inside a construct the flag rewrite can't lift
+    (try/with/match/...) — the caller keeps the python loop."""
 
-    - ``if c: break``    -> ``flag = c`` + the remaining statements
-      wrapped in ``if not flag:`` (the loop test is augmented by the
-      caller to include ``not flag``);
-    - ``if c: continue`` -> the remaining statements wrapped in
-      ``if not c:``.
 
-    Only the exact one-statement pattern is handled; anything else (bare
-    break, break under else, break in a nested if) leaves the loop
-    unconvertible as before. Returns ``(new_body, used_break)``.
+def _flag_assign(name: str) -> ast.stmt:
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=True))
+
+
+def _lower_loop_escapes(body, brk: str, esc: str):
+    """Lower every statement-level ``break``/``continue`` belonging to
+    this loop — bare, with statements before/after it in the same branch,
+    under ``else``, or in arbitrarily nested if/elif chains — to two
+    flags (the reference's BreakContinueTransformer shapes,
+    ``python/paddle/jit/dy2static/break_continue_transformer.py``):
+
+    - ``break``     ->  ``esc = True; brk = True``
+    - ``continue``  ->  ``esc = True``
+
+    Statements after an escape are dropped (unreachable); statements
+    after an escape-CAPABLE ``if`` are wrapped in ``if not esc:`` so the
+    rest of the iteration is skipped once a flag fired. The caller
+    prepends ``esc = False`` to the body (per-iteration reset), augments
+    the loop test with ``not brk`` when any break exists, and guards a
+    loop-``else`` with ``not brk``.
+
+    Returns ``(new_body, used_break)``; raises ``_Unliftable`` for an
+    escape buried in a non-``if`` compound statement.
     """
     out, used_break = [], False
     for i, st in enumerate(body):
-        if (isinstance(st, ast.If) and not st.orelse
-                and len(st.body) == 1
-                and isinstance(st.body[0], (ast.Break, ast.Continue))):
-            rest, rest_used = _lower_loop_escapes(body[i + 1:], flag)
-            used_break = used_break or rest_used
-            if isinstance(st.body[0], ast.Break):
-                used_break = True
-                out.append(ast.Assign(targets=[_name(flag, ast.Store())],
-                                      value=st.test))
-                guard = _jst_call("logical_not", [_name(flag)])
-            else:
-                guard = _jst_call("logical_not", [st.test])
+        if isinstance(st, ast.Break):
+            out.append(_flag_assign(esc))
+            out.append(_flag_assign(brk))
+            return out, True  # anything after is unreachable
+        if isinstance(st, ast.Continue):
+            out.append(_flag_assign(esc))
+            return out, used_break
+        if _own_escapes([st]):
+            if not isinstance(st, ast.If):
+                raise _Unliftable
+            b_new, b_brk = _lower_loop_escapes(st.body, brk, esc)
+            o_new, o_brk = _lower_loop_escapes(st.orelse, brk, esc)
+            rest, r_brk = _lower_loop_escapes(body[i + 1:], brk, esc)
+            used_break = used_break or b_brk or o_brk or r_brk
+            out.append(ast.If(test=st.test, body=b_new,
+                              orelse=o_new))
             if rest:
-                out.append(ast.If(test=guard, body=rest, orelse=[]))
-            elif isinstance(st.body[0], ast.Continue):
-                # trailing `if c: continue` is a no-op; keep the test's
-                # evaluation for side-effect parity
-                out.append(ast.Expr(value=st.test))
+                out.append(ast.If(
+                    test=_jst_call("logical_not", [_name(esc)]),
+                    body=rest, orelse=[]))
             return out, used_break
         out.append(st)
     return out, used_break
@@ -659,8 +690,12 @@ class _CtrlFlowTransformer:
         live = set(live_after) | _deferred_reads(stmts)
         processed = []
         for st in reversed(stmts):
+            # capture reads BEFORE _stmt mutates the node: a detached
+            # loop-else (moved into a trailer list) must keep its reads
+            # visible to the liveness of earlier statements
+            reads = _read_names([st])
             processed.append(self._stmt(st, set(live)))
-            live |= _read_names([st])
+            live |= reads
         out = []
         for repl in reversed(processed):
             out.extend(repl)
@@ -715,22 +750,32 @@ class _CtrlFlowTransformer:
     def _conv_while(self, node: ast.While, live, bound_expr=None):
         import copy
 
-        # `if c: break` / `if c: continue` in the body lower to flag/guard
-        # form when that makes the loop convertible; otherwise the
-        # original body is kept (python loop, exact semantics)
-        prelude = []
-        # lowering must respect the same bail-outs as conversion itself:
-        # a while-else's else must NOT run after a break (the lowered loop
-        # exits via the test), and a walrus in the test would move its
-        # binding into the synthesized lambda's scope
-        if (not node.orelse
-                and not _contains([node.test], ast.NamedExpr)
-                and _own_escapes(node.body)):
-            flag = f"__break_flag_{self._uid()}__"
-            lowered, used_break = _lower_loop_escapes(
-                copy.deepcopy(node.body), flag)
-            if not _unconvertible(lowered, loops_shield=True):
-                node.body = lowered
+        # break/continue in the body lower to flag/guard form when that
+        # makes the loop convertible; otherwise the original body is kept
+        # (python loop, exact semantics). A loop-`else` detaches to a
+        # trailer: unconditional when the body cannot break, guarded by
+        # `not brk` when it can (python runs the else only on a
+        # non-break exit, including the zero-trip one).
+        prelude, trailer = [], []
+        has_escapes = _own_escapes(node.body)
+        # lowering must respect one conversion bail-out up front: a
+        # walrus in the test would move its binding into the synthesized
+        # lambda's scope
+        if has_escapes and not _contains([node.test], ast.NamedExpr):
+            uid = self._uid()
+            flag = f"__break_flag_{uid}__"
+            escf = f"__esc_flag_{uid}__"
+            try:
+                lowered, used_break = _lower_loop_escapes(
+                    copy.deepcopy(node.body), flag, escf)
+            except _Unliftable:
+                lowered = None
+            if lowered is not None and not _unconvertible(
+                    lowered, loops_shield=True):
+                # esc resets every iteration; brk persists across them
+                node.body = [ast.Assign(
+                    targets=[_name(escf, ast.Store())],
+                    value=ast.Constant(value=False))] + lowered
                 if used_break:
                     # while (not flag) and (test): the thunk keeps the
                     # original test un-evaluated once the break fired
@@ -744,23 +789,40 @@ class _CtrlFlowTransformer:
                     prelude = [ast.Assign(
                         targets=[_name(flag, ast.Store())],
                         value=ast.Constant(value=False))]
+                    if node.orelse:
+                        trailer = [ast.If(
+                            test=_jst_call("logical_not", [_name(flag)]),
+                            body=node.orelse, orelse=[])]
+                        node.orelse = []
+                elif node.orelse:
+                    # continue-only body: the else always runs on exit
+                    trailer = list(node.orelse)
+                    node.orelse = []
+        elif node.orelse and not has_escapes:
+            # no escapes at all: the else is an unconditional epilogue
+            # (an exception or return inside the body skips a real
+            # while-else AND a trailing statement identically)
+            trailer = list(node.orelse)
+            node.orelse = []
+        if trailer:
+            trailer = self._block(trailer, set(live))
 
         # body statements may be read by the NEXT iteration, the test, or
-        # a while-else block (which runs after normal exit)
+        # the (possibly detached) else block
         loop_live = live | _read_names(node.body + node.orelse
-                                       + [node.test])
+                                       + [node.test] + trailer)
         node.body = self._block(node.body, loop_live)
         if (node.orelse or _unconvertible(node.body, loops_shield=True)
                 # a walrus in the test would bind inside the extracted
                 # test_fn and never reach the body/enclosing scope
                 or _contains([node.test], ast.NamedExpr)):
             node.orelse = self._block(node.orelse, live)
-            return prelude + [node]
+            return prelude + [node] + trailer
         carried = sorted((_assigned_names(node.body) |
                           _assigned_names([node.test])) & loop_live)
         if not carried:
             # stateless while: nothing to thread, leave as-is
-            return prelude + [node]
+            return prelude + [node] + trailer
         uid = self._uid()
         test_name, body_name = f"_d2s_wtest_{uid}", f"_d2s_wbody_{uid}"
         tdef = ast.FunctionDef(
@@ -776,7 +838,7 @@ class _CtrlFlowTransformer:
                       ctx=ast.Load()),
             bound_expr or _name("_d2s_loop_bound")])
         self.changed = True
-        return prelude + [tdef, bdef, _result_stmt(carried, call)]
+        return prelude + [tdef, bdef, _result_stmt(carried, call)] + trailer
 
     def _conv_for(self, node: ast.For, live):
         # `for i in range(...)` with break/continue: rewrite to the while
@@ -794,7 +856,12 @@ class _CtrlFlowTransformer:
                 and 1 <= len(node.iter.args) <= 3
                 and not any(isinstance(x, ast.Starred)
                             for x in node.iter.args)
-                and not node.orelse and isinstance(node.target, ast.Name)
+                and isinstance(node.target, ast.Name)
+                # an else reading the loop target would see UNDEF on a
+                # zero-trip loop (python raises UnboundLocalError there) —
+                # same refusal as the non-range detach below
+                and not (node.orelse
+                         and node.target.id in _read_names(node.orelse))
                 and _own_escapes(node.body)):
             uid = self._uid()
             i_n = f"__for_i_{uid}__"
@@ -831,17 +898,30 @@ class _CtrlFlowTransformer:
             wnode = ast.While(
                 test=_jst_call("range_cond",
                                [_name(i_n), _name(stop_n), _name(step_n)]),
-                body=advance + node.body, orelse=[])
+                body=advance + node.body, orelse=node.orelse)
             return prelude + self._conv_while(wnode, live,
                                               bound_expr=_name(bound_n))
 
+        # a for-else with no break in the body is an unconditional
+        # epilogue — detach it so the loop itself stays convertible. NOT
+        # when the else reads the loop target: a converted loop's target
+        # is body-local (carried excludes it), so the else would see a
+        # stale pre-loop binding; keeping the else attached forces the
+        # exact python path instead
+        trailer = []
+        if node.orelse and not _own_escapes(node.body):
+            tgt = node.target.id if isinstance(node.target, ast.Name) \
+                else None
+            if tgt is None or tgt not in _read_names(node.orelse):
+                trailer = self._block(list(node.orelse), set(live))
+                node.orelse = []
         loop_live = live | _read_names(node.body + node.orelse
-                                       + [node.iter])
+                                       + [node.iter] + trailer)
         node.body = self._block(node.body, loop_live)
         if (node.orelse or not isinstance(node.target, ast.Name)
                 or _unconvertible(node.body, loops_shield=True)):
             node.orelse = self._block(node.orelse, live)
-            return [node]
+            return [node] + trailer
         target = node.target.id
         carried = sorted((_assigned_names(node.body) - {target}) & loop_live)
         uid = self._uid()
@@ -856,7 +936,7 @@ class _CtrlFlowTransformer:
             ast.Tuple(elts=[_maybe_call(c) for c in carried],
                       ctx=ast.Load())])
         self.changed = True
-        return [bdef, _result_stmt(carried, call)]
+        return [bdef, _result_stmt(carried, call)] + trailer
 
 
 # --------------------------------------------------------------- driver

@@ -666,9 +666,9 @@ def test_tensor_continue_in_while_converts():
     assert float(g(jnp.asarray([0.5]))) == ref(0.5)
 
 
-def test_bare_break_stays_python():
-    """Bare (unconditional) break is not the lowered pattern: the loop
-    stays a python loop and eager semantics are untouched."""
+def test_general_escape_shapes_keep_python_semantics():
+    """The r5 generalized lowering (break with neighbouring statements,
+    while-else, bare escapes) must keep exact eager semantics."""
     from paddle_tpu.jit.dy2static import convert_control_flow
 
     def f(x, n):
@@ -677,7 +677,7 @@ def test_bare_break_stays_python():
         while True:
             if i >= n:
                 total = total + 100
-                break  # genuinely bare: not the one-statement pattern
+                break  # break with a statement before it, same if-body
             total = total + 1
             i += 1
         return total
@@ -685,8 +685,8 @@ def test_bare_break_stays_python():
     g = convert_control_flow(f)
     assert g(1, 3) == 104
 
-    # while-else + break must keep python semantics: the else must NOT
-    # run when the break fires (lowering is skipped for while-else)
+    # while-else + break: the else must NOT run when the break fires
+    # (lowered to a `not brk` guard on the detached epilogue)
     def fe(n):
         i = 0
         while i < 5:
@@ -699,6 +699,20 @@ def test_bare_break_stays_python():
 
     ge = convert_control_flow(fe)
     assert ge(3) == 2 == fe(3)
+    assert ge(1) == 2 == fe(1)
+
+    # while-else without a break: else always runs
+    def fne(n):
+        i = 0
+        while i < n:
+            i += 1
+        else:
+            i = i + 1000
+        return i
+
+    gne = convert_control_flow(fne)
+    assert gne(3) == 1003 == fne(3)
+    assert gne(0) == 1000 == fne(0)
 
     # walrus in the test: lowering and conversion both bail; eager works
     def fw(vals):
@@ -714,6 +728,193 @@ def test_bare_break_stays_python():
     gw = convert_control_flow(fw)
     assert gw([1, 2, 3, -1]) == 6 == fw([1, 2, 3, -1])
     assert gw([1, 2, 500, -1]) == 3 == fw([1, 2, 500, -1])
+
+
+def test_break_with_statements_converts_under_jit():
+    """Break with neighbouring statements in the same if-body, plus
+    statements under else, lowers and compiles with a TENSOR condition
+    (the unconverted form would raise ConcretizationTypeError)."""
+    def f(x):
+        s = x
+        i = jnp.zeros(())
+        while i < 8.0:
+            if jnp.sum(s) > 40.0:
+                s = s - 5.0
+                break
+            else:
+                s = s * 1.5
+            i = i + 1.0
+        return s, i
+
+    def ref(x):
+        s = np.asarray(x, np.float32)
+        i = 0.0
+        while i < 8.0:
+            if s.sum() > 40.0:
+                s = s - np.float32(5.0)
+                break
+            else:
+                s = s * np.float32(1.5)
+            i = i + 1.0
+        return s, i
+
+    g = jax.jit(to_static(f))
+    for start in ([4.0, 4.0], [30.0, 30.0], [0.1, 0.1]):
+        s_ref, i_ref = ref(np.asarray(start, np.float32))
+        s_got, i_got = g(jnp.asarray(start))
+        np.testing.assert_allclose(np.asarray(s_got), s_ref, rtol=1e-6)
+        assert float(i_got) == i_ref
+
+
+def test_break_under_else_converts_under_jit():
+    def f(x):
+        s = x
+        i = jnp.zeros(())
+        while i < 6.0:
+            if jnp.sum(s) < 100.0:
+                s = s * 2.0
+            else:
+                break
+            i = i + 1.0
+        return s, i
+
+    def ref(x):
+        s = np.asarray(x, np.float32)
+        i = 0.0
+        while i < 6.0:
+            if s.sum() < 100.0:
+                s = s * np.float32(2.0)
+            else:
+                break
+            i = i + 1.0
+        return s, i
+
+    g = jax.jit(to_static(f))
+    for start in ([3.0, 3.0], [60.0, 60.0]):
+        s_ref, i_ref = ref(np.asarray(start, np.float32))
+        s_got, i_got = g(jnp.asarray(start))
+        np.testing.assert_allclose(np.asarray(s_got), s_ref, rtol=1e-6)
+        assert float(i_got) == i_ref
+
+
+def test_while_else_with_tensor_break_converts_under_jit():
+    """while-else with a tensor break: the else must run exactly when
+    the loop exits via its test — both paths, compiled."""
+    def f(x):
+        s = x
+        i = jnp.zeros(())
+        while i < 4.0:
+            s = s * 2.0
+            if jnp.sum(s) > 50.0:
+                break
+            i = i + 1.0
+        else:
+            s = s + 1000.0
+        return s
+
+    def ref(x):
+        s = np.asarray(x, np.float32)
+        i = 0.0
+        while i < 4.0:
+            s = s * np.float32(2.0)
+            if s.sum() > 50.0:
+                break
+            i = i + 1.0
+        else:
+            s = s + np.float32(1000.0)
+        return s
+
+    g = jax.jit(to_static(f))
+    for start in ([20.0, 20.0], [0.5, 0.5]):  # break taken / not taken
+        np.testing.assert_allclose(
+            np.asarray(g(jnp.asarray(start))),
+            ref(np.asarray(start, np.float32)), rtol=1e-6)
+
+
+def test_for_range_else_with_tensor_break_converts():
+    """for-range-else: the search-loop idiom — else runs only when no
+    break fired."""
+    def f(x):
+        found = jnp.zeros(())
+        for i in range(5):
+            if x[i] > 10.0:
+                found = jnp.zeros(()) + i
+                break
+        else:
+            found = jnp.asarray(-1.0)
+        return found
+
+    def ref(x):
+        for i in range(5):
+            if x[i] > 10.0:
+                return float(i)
+        return -1.0
+
+    g = jax.jit(to_static(f))
+    hit = np.asarray([1.0, 2.0, 50.0, 3.0, 4.0], np.float32)
+    miss = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+    assert float(g(jnp.asarray(hit))) == ref(hit)
+    assert float(g(jnp.asarray(miss))) == ref(miss)
+
+
+def test_mixed_break_continue_nested_ifs_convert():
+    """break and continue in one nested if/elif chain, both tensor-
+    dependent, with trailing statements guarded by the escape flag."""
+    def f(x):
+        total = jnp.zeros(())
+        i = jnp.zeros(())
+        while i < 10.0:
+            i = i + 1.0
+            v = jnp.sum(x) * i
+            if v % 3.0 < 1.0:
+                continue
+            elif v > 20.0:
+                total = total + 100.0
+                break
+            total = total + v
+        return total, i
+
+    def ref(xsum):
+        total, i = 0.0, 0.0
+        while i < 10.0:
+            i += 1.0
+            v = xsum * i
+            if v % 3.0 < 1.0:
+                continue
+            elif v > 20.0:
+                total += 100.0
+                break
+            total += v
+        return total, i
+
+    g = jax.jit(to_static(f))
+    for xv in (1.0, 2.5, 0.3):
+        t_ref, i_ref = ref(xv)
+        t_got, i_got = g(jnp.asarray([xv]))
+        np.testing.assert_allclose(float(t_got), t_ref, rtol=1e-6)
+        assert float(i_got) == i_ref
+
+
+def test_escape_inside_try_stays_python():
+    """An escape buried in a try block is unliftable: the loop stays a
+    python loop and eager semantics hold."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(vals):
+        s = 0
+        i = 0
+        while i < len(vals):
+            try:
+                if vals[i] < 0:
+                    break
+                s += vals[i]
+            finally:
+                i += 1
+        return s
+
+    g = convert_control_flow(f)
+    assert g([1, 2, -1, 5]) == 3 == f([1, 2, -1, 5])
+    assert g([1, 2, 3]) == 6 == f([1, 2, 3])
 
 
 def test_break_mid_loop_concrete_matches_python():
@@ -943,3 +1144,223 @@ def test_zero_step_range_raises_even_with_traced_bounds():
     g = to_static(f)
     with pytest.raises(ValueError, match="must not be zero"):
         g(jnp.asarray(5), jnp.asarray(0))
+
+
+def test_method_decoration_trains_under_trainstep():
+    """`@to_static(loop_bound=N)` directly on `forward` in the class body
+    (the canonical reference idiom): `self` must not fall into a
+    standalone jit, the converted control flow must lower under
+    TrainStep's enclosing jit, and the bounded while must be
+    differentiable end to end."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.optimizer import AdamW
+
+    class IterRefine(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(4, 4)
+
+        @to_static(loop_bound=6)
+        def forward(self, x):
+            h = self.proj(x)
+            i = jnp.zeros(())
+            while i < 4.0:
+                if jnp.mean(h * h) > 9.0:
+                    h = h * 0.5
+                    break
+                h = h + 0.2 * self.proj(h)
+                i = i + 1.0
+            else:
+                h = h + 0.01
+            return h
+
+    pt.seed(0)
+    model = IterRefine()
+    step = pt.TrainStep(model, AdamW(learning_rate=5e-3),
+                        loss_fn=lambda out, b: F.mse_loss(out, b[1]))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    t = rng.standard_normal((8, 4)).astype(np.float32)
+    losses = [float(step((x, t))) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+    # the dispatched forward is the converted function, not the original
+    step.sync_to_model()
+    out = np.asarray(model(pt.to_tensor(x)))
+    # python-semantics reference on the synced weights
+    h = np.asarray(model.proj(pt.to_tensor(x)))
+    i = 0.0
+    while i < 4.0:
+        if (h * h).mean() > 9.0:
+            h = h * 0.5
+            break
+        h = h + 0.2 * np.asarray(model.proj(pt.to_tensor(h)))
+        i += 1.0
+    else:
+        h = h + 0.01
+    np.testing.assert_allclose(out, h, rtol=1e-5)
+
+
+def test_break_in_nested_loop_else_binds_outer():
+    """A break in a NESTED loop's else clause belongs to the OUTER loop:
+    the outer loop's else must not run when it fires (review finding:
+    nested-orelse escapes were shielded with the nested body)."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(n):
+        out = 0
+        i = 0
+        while i < n:
+            j = 0
+            while j < 2:
+                j += 1
+            else:
+                if i == 2:
+                    break
+            i += 1
+        else:
+            out = 999
+        return out, i
+
+    g = convert_control_flow(f)
+    assert g(5) == (0, 2) == f(5)       # break fires: else skipped
+    assert g(2) == (999, 2) == f(2)     # no break: else runs
+
+
+def test_detached_loop_else_keeps_earlier_liveness():
+    """A detached loop-else's reads must stay visible to the liveness of
+    EARLIER converted statements (review finding: reads were collected
+    from the mutated node, losing the detached else)."""
+    def f(t):
+        if t > 0:
+            y = 1.0
+        else:
+            y = 2.0
+        i = 0
+        while i < 3:
+            i += 1
+        else:
+            z = y + 10.0
+        return z
+
+    from paddle_tpu.jit.dy2static import convert_control_flow
+    g = convert_control_flow(f)
+    assert g(1) == 11.0 == f(1)
+    assert g(-1) == 12.0 == f(-1)
+
+    # same through the for-else detach
+    def h(t):
+        if t > 0:
+            y = 1.0
+        else:
+            y = 2.0
+        for i in range(3):
+            pass
+        else:
+            z = y + 10.0
+        return z
+
+    g2 = convert_control_flow(h)
+    assert g2(1) == 11.0 == h(1)
+    assert g2(-1) == 12.0 == h(-1)
+
+    # and through the break-guarded while-else detach
+    def k(t, n):
+        if t > 0:
+            y = 1.0
+        else:
+            y = 2.0
+        i = 0
+        z = 0.0
+        while i < 5:
+            if i >= n:
+                break
+            i += 1
+        else:
+            z = y + 10.0
+        return z
+
+    g3 = convert_control_flow(k)
+    assert g3(1, 99) == 11.0 == k(1, 99)   # no break: else runs, reads y
+    assert g3(1, 2) == 0.0 == k(1, 2)      # break: else skipped
+
+
+def test_method_to_static_warns_on_dropped_jit_kwargs():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+
+        class M(nn.Layer):
+            @to_static(loop_bound=4, donate_argnums=1)
+            def forward(self, x):
+                return x
+
+    assert any("ignores jit options" in str(w.message) for w in rec)
+
+
+def test_for_else_reading_loop_target_stays_exact():
+    """A for-else that reads the loop target must keep python-exact
+    semantics (the converted loop's target is body-local, so the else is
+    left attached and the loop stays a python loop)."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x):
+        i = 99
+        s = x
+        for i in range(3):
+            s = s + 1.0
+        else:
+            z = i * 1.0
+        return s + z
+
+    g = convert_control_flow(f)
+    assert g(0.0) == 5.0 == f(0.0)
+
+    # without a pre-binding the else still sees the loop's last value
+    def h(x):
+        s = x
+        for i in range(3):
+            s = s + 1.0
+        else:
+            z = i * 1.0
+        return s + z
+
+    g2 = convert_control_flow(h)
+    assert g2(0.0) == 5.0 == h(0.0)
+
+    # non-range iterables too
+    def k(vals):
+        for v in vals:
+            pass
+        else:
+            t = v
+        return t
+
+    g3 = convert_control_flow(k)
+    assert g3([1, 2, 7]) == 7 == k([1, 2, 7])
+
+
+def test_for_range_else_reading_target_stays_python():
+    """for-range + break whose else reads the loop target must keep the
+    python path: a converted zero-trip loop would hand the else an UNDEF
+    target where python raises UnboundLocalError."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(n, x):
+        z = -1
+        for i in range(n):
+            if x > 10:
+                break
+        else:
+            z = i
+        return z
+
+    g = convert_control_flow(f)
+    assert g(3, 5) == 2 == f(3, 5)
+    assert g(3, 50) == -1 == f(3, 50)
+    with pytest.raises(UnboundLocalError):
+        f(0, 5)
+    with pytest.raises(UnboundLocalError):
+        g(0, 5)
