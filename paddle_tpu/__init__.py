@@ -71,7 +71,19 @@ from . import autograd  # noqa: F401  (PyLayer / hooks / backward)
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax
 
-grad = _jax.grad
+
+def grad(outputs, *args, **kwargs):
+    """Dual-form ``paddle.grad``: with a CALLABLE first argument this is
+    ``jax.grad`` (the TPU-native functional transform); with tensors it is
+    the reference's imperative partial-grad —
+    ``grad(outputs, inputs, grad_outputs=None, ...)`` over the eager tape
+    (``python/paddle/fluid/dygraph/base.py:468``), returning grads without
+    touching ``.grad``."""
+    if callable(outputs) and not isinstance(outputs, eager.Tensor):
+        return _jax.grad(outputs, *args, **kwargs)
+    return eager.grad(outputs, *args, **kwargs)
+
+
 value_and_grad = _jax.value_and_grad
 
 

@@ -15,9 +15,10 @@ from typing import Optional, Sequence
 
 from ..eager import (PyLayer, PyLayerContext, no_grad,  # noqa: F401
                      saved_tensors_hooks)
+from ..eager import grad  # noqa: F401  (partial grad, dygraph/base.py:468)
 
 __all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks", "backward",
-           "no_grad"]
+           "no_grad", "grad"]
 
 
 def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,

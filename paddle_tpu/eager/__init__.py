@@ -416,17 +416,14 @@ class Tensor:
         return method
 
 
-def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
-    """Joint reverse pass from one or more roots (reference
-    ``egr::RunBackward``): all seeds are planted up front, so a tensor
-    reachable from several roots accumulates its FULL cotangent before its
-    hooks fire and its vjp runs once — the multi-root semantics
-    ``paddle.autograd.backward`` promises (sequential per-root passes would
-    fire hooks with partial gradients).
-
-    Roots themselves do not receive ``.grad`` (they are seeded, not
-    computed); every other non-stop-gradient tensor does.
-    """
+def _reverse_walk(roots_and_seeds, retain_graph: bool,
+                  write_grads: bool, targets=None):
+    """Shared reverse pass over the tape: joint multi-root cotangent
+    accumulation in one topological sweep. ``write_grads=True`` deposits
+    ``.grad`` on every reached non-root tensor (``backward`` semantics);
+    ``targets`` (a dict ``id -> Tensor``) collects the accumulated
+    cotangent of those tensors instead (``paddle.grad`` partial-grad
+    semantics). Returns the collected ``{id: cotangent}``."""
     # topo order over tape NODES (a multi-output op is one node whose vjp
     # runs once with all of its outputs' cotangents)
     order: List[_Node] = []
@@ -449,6 +446,8 @@ def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
         root_ids.add(id(t))
         cur = cotangents.get(id(t))
         cotangents[id(t)] = seed if cur is None else cur + seed
+    targets = targets or {}
+    collected: Dict[int, Any] = {}
     leaves: Dict[int, "Tensor"] = {}
     for node in reversed(order):
         outs = [(r() if r is not None else None) for r in node.outputs]
@@ -460,7 +459,11 @@ def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
                 # hooks fire once per tensor with the FULLY accumulated
                 # grad (all consumer + root contributions merged)
                 ct = tout._run_hooks(ct)
-                if id(tout) not in root_ids and not tout.stop_gradient:
+                if id(tout) in targets:
+                    cur = collected.get(id(tout))
+                    collected[id(tout)] = ct if cur is None else cur + ct
+                if write_grads and id(tout) not in root_ids \
+                        and not tout.stop_gradient:
                     tout.grad = (ct if tout.grad is None
                                  else tout.grad + ct)
             cts.append(ct)
@@ -479,12 +482,13 @@ def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
             if pct is None:
                 continue
             if isinstance(p, _ParamSink):
-                p.deposit(pct)
+                if write_grads:
+                    p.deposit(pct)
             elif isinstance(p, Tensor):
                 if p._node is not None:
                     cur = cotangents.get(id(p))
                     cotangents[id(p)] = pct if cur is None else cur + pct
-                elif not p.stop_gradient:
+                elif not p.stop_gradient or id(p) in targets:
                     cur = cotangents.get(id(p))
                     cotangents[id(p)] = pct if cur is None else cur + pct
                     leaves[id(p)] = p
@@ -497,7 +501,89 @@ def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
         if ct is None:
             continue
         ct = p._run_hooks(ct)
-        p.grad = ct if p.grad is None else p.grad + ct
+        if pid in targets:
+            cur = collected.get(pid)
+            collected[pid] = ct if cur is None else cur + ct
+        if write_grads and not p.stop_gradient:
+            p.grad = ct if p.grad is None else p.grad + ct
+    # a target that is itself a node-less root (grad([x], [x])) was seeded
+    # but never popped at a node or as a leaf parent: its cotangent is the
+    # seed — the reference returns ones for an output differentiated
+    # w.r.t. itself
+    for tid, ct in cotangents.items():
+        if tid in targets and tid not in collected:
+            # hooks fire on this path like every other collection path
+            collected[tid] = targets[tid]._run_hooks(ct)
+    return collected
+
+
+def run_backward(roots_and_seeds, retain_graph: bool = False) -> None:
+    """Joint reverse pass from one or more roots (reference
+    ``egr::RunBackward``): all seeds are planted up front, so a tensor
+    reachable from several roots accumulates its FULL cotangent before its
+    hooks fire and its vjp runs once — the multi-root semantics
+    ``paddle.autograd.backward`` promises (sequential per-root passes would
+    fire hooks with partial gradients).
+
+    Roots themselves do not receive ``.grad`` (they are seeded, not
+    computed); every other non-stop-gradient tensor does.
+    """
+    _reverse_walk(roots_and_seeds, retain_graph, write_grads=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Partial gradients of ``outputs`` w.r.t. ``inputs`` (reference
+    ``python/paddle/fluid/dygraph/base.py:468`` ``paddle.grad``, the
+    ``GeneralGrad`` engine entry): returns the grads as a list WITHOUT
+    touching any tensor's ``.grad``. ``create_graph`` (higher-order via
+    taping the backward itself) is not supported on this tape — use the
+    functional transforms (``paddle_tpu.incubate.autograd`` jvp/vjp/
+    Hessian), which compose arbitrarily."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use the "
+            "functional autodiff in paddle_tpu.incubate.autograd "
+            "(jvp/vjp/Hessian) for higher-order gradients")
+    if not only_inputs:
+        raise NotImplementedError(
+            "only_inputs=False is deprecated in the reference and "
+            "unsupported here")
+    if no_grad_vars is not None:
+        raise NotImplementedError(
+            "no_grad_vars is unsupported; mark tensors stop_gradient "
+            "before building the graph instead")
+    single_out = isinstance(outputs, Tensor)
+    outputs = [outputs] if single_out else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    for t in outputs + inputs:
+        if not isinstance(t, Tensor):
+            raise TypeError("grad() outputs/inputs must be eager Tensors")
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = list(grad_outputs)
+    if len(grad_outputs) != len(outputs):
+        raise ValueError("grad_outputs must match outputs in length")
+    roots = []
+    for t, g in zip(outputs, grad_outputs):
+        seed = (jnp.ones_like(t._data) if g is None
+                else jnp.asarray(_unwrap(g)))
+        roots.append((t, seed))
+    targets = {id(t): t for t in inputs}
+    retain = bool(retain_graph) if retain_graph is not None else False
+    collected = _reverse_walk(roots, retain, write_grads=False,
+                              targets=targets)
+    results = []
+    for t in inputs:
+        ct = collected.get(id(t))
+        if ct is None and not allow_unused:
+            raise RuntimeError(
+                "one of the inputs is unreachable from outputs; pass "
+                "allow_unused=True to get None for it")
+        results.append(None if ct is None else Tensor(ct))
+    return results
 
 
 def _unwrap(x):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 
 import jax
+import jax.numpy as jnp
 
 
 class Generator:
@@ -49,7 +50,18 @@ class Generator:
             return self._key
 
     def set_state(self, key):
-        self._key = key
+        # only accept real PRNG key data: silently storing junk would
+        # poison every later random op with a confusing error far from
+        # the cause (typed keys pass; raw arrays must be uint32 key data)
+        arr = jnp.asarray(key)
+        if not (jnp.issubdtype(arr.dtype, jax.dtypes.prng_key)
+                or arr.dtype == jnp.uint32):
+            raise TypeError(
+                "rng state must be PRNG key data (a key from "
+                "get_rng_state()/jax.random.key, or uint32 key data); "
+                f"got dtype {arr.dtype}")
+        with self._lock:
+            self._key = key
 
 
 _default_generator = Generator(0)

@@ -1,10 +1,12 @@
 """Tensor creation ops (reference: ``python/paddle/tensor/creation.py``).
 
 Tensors are plain ``jax.Array``; creation ops are thin jnp wrappers with
-paddle-compatible signatures. ``stop_gradient`` is a no-op marker kept for API
-compatibility — gradient flow in this framework is decided by which pytree
-leaves are differentiated, not per-tensor flags (use ``jax.lax.stop_gradient``
-for in-graph cuts).
+paddle-compatible signatures. Gradient flow in this framework is decided
+by which pytree leaves are differentiated, not per-tensor flags (use
+``jax.lax.stop_gradient`` for in-graph cuts) — with ONE exception:
+``to_tensor(..., stop_gradient=False)`` opts into the eager tape and
+returns a :class:`paddle_tpu.eager.Tensor`, so the canonical dygraph
+snippet works from the front door.
 """
 from __future__ import annotations
 
@@ -20,15 +22,27 @@ def _maybe_default_float(dtype):
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
-    """``paddle.to_tensor`` analogue: anything array-like -> jax.Array."""
-    del place, stop_gradient
+    """``paddle.to_tensor`` analogue: anything array-like -> jax.Array.
+
+    ``stop_gradient=False`` — the canonical dygraph idiom
+    (``x = paddle.to_tensor(d, stop_gradient=False); y.backward();
+    x.grad``) — returns an EAGER tape Tensor instead, so tensor-level
+    autograd works from the front door; the default returns a plain
+    array (the functional fast path, where grad flow is decided by which
+    pytree leaves are differentiated)."""
+    del place
     dtype = convert_dtype(dtype)
     if dtype is None and isinstance(data, (list, tuple, int, float)):
         # match paddle: python floats default to the default float dtype
         probe = np.asarray(data)
         if probe.dtype == np.float64:
             dtype = get_default_dtype()
-    return jnp.asarray(data, dtype=dtype)
+    arr = jnp.asarray(getattr(data, "_data", data), dtype=dtype)
+    if not stop_gradient:
+        from ..eager import Tensor
+
+        return Tensor(arr, stop_gradient=False)
+    return arr
 
 
 def full(shape, fill_value, dtype=None):

@@ -407,3 +407,88 @@ def test_autograd_backward_joint_hooks():
     assert len(calls) == 1
     np.testing.assert_allclose(calls[0], [13.0])  # 3*1 + 5*2 at once
     np.testing.assert_allclose(np.asarray(x.grad), [26.0])
+
+
+def test_front_door_to_tensor_tape():
+    """paddle.to_tensor(d, stop_gradient=False) from the TOP-LEVEL
+    namespace must return a tape Tensor so the canonical dygraph snippet
+    works end to end (reference: paddle.to_tensor + Tensor.backward)."""
+    import paddle_tpu as pt
+
+    x = pt.to_tensor([[1.0, 2.0]], stop_gradient=False)
+    assert isinstance(x, eager.Tensor)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [[2.0, 4.0]])
+    # default stays the functional fast path: a plain array
+    import jax
+
+    assert isinstance(pt.to_tensor([[1.0, 2.0]]), jax.Array)
+
+
+def test_partial_grad_api():
+    """paddle.grad(outputs, inputs): partial grads without touching .grad
+    (reference python/paddle/fluid/dygraph/base.py:468)."""
+    import paddle_tpu as pt
+
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    w = pt.to_tensor([3.0, 4.0], stop_gradient=False)
+    y = (x * w).sum()
+    gx, gw = pt.grad([y], [x, w])
+    np.testing.assert_allclose(np.asarray(gx), [3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(gw), [1.0, 2.0])
+    assert x.grad is None and w.grad is None  # .grad untouched
+
+    # grad_outputs seeding
+    x2 = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y2 = x2 * 2.0
+    (g2,) = pt.grad([y2], [x2], grad_outputs=[pt.to_tensor([10.0, 100.0])])
+    np.testing.assert_allclose(np.asarray(g2), [20.0, 200.0])
+
+    # unreachable input: error by default, None under allow_unused
+    z = pt.to_tensor([5.0], stop_gradient=False)
+    with pytest.raises(RuntimeError, match="allow_unused"):
+        pt.grad([y2], [z])
+    x3 = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y3 = (x3 * x3).sum()
+    got = pt.grad([y3], [x3, z], allow_unused=True)
+    assert got[1] is None
+    np.testing.assert_allclose(np.asarray(got[0]), [2.0, 4.0])
+
+    # intermediate (non-leaf) input collects its full cotangent
+    x4 = pt.to_tensor([2.0], stop_gradient=False)
+    mid = x4 * 3.0
+    out = (mid * mid).sum()
+    (gmid,) = pt.grad([out], [mid], retain_graph=True)
+    np.testing.assert_allclose(np.asarray(gmid), [12.0])  # 2*mid
+
+    # higher-order points to the functional transforms
+    with pytest.raises(NotImplementedError, match="incubate.autograd"):
+        pt.grad([out], [x4], create_graph=True)
+
+    # callable first arg keeps the jax.grad functional form
+    import jax.numpy as jnp
+
+    f = pt.grad(lambda v: (v * v).sum())
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray([3.0]))), [6.0])
+
+
+def test_partial_grad_identity_and_mode_restore():
+    """grad([x], [x]) returns the seed (reference: an output
+    differentiated w.r.t. itself is ones); and the smoke battery's
+    static-mode flip must not leak (fixture restores dynamic mode)."""
+    import paddle_tpu as pt
+
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    (g,) = pt.grad([x], [x])
+    np.testing.assert_allclose(np.asarray(g), [1.0, 1.0])
+    assert pt.in_dynamic_mode()
+
+
+def test_partial_grad_identity_runs_hooks():
+    import paddle_tpu as pt
+
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (g,) = pt.grad([x], [x])
+    np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])

@@ -62,8 +62,101 @@ def _dists():
     }
 
 
+def _toplevel():
+    import paddle_tpu as pt
+
+    IDX2 = jnp.zeros((2, 1), jnp.int64)
+
+    def _grad_pair():
+        x = pt.to_tensor([[1.0, 2.0]], stop_gradient=False)
+        return x, (x * x).sum()
+
+    x_g, y_g = _grad_pair()
+    return {
+        # a valid dtype FIRST: the battery's generic ints would otherwise
+        # set a bogus global default dtype (paddle dtype enum ints are
+        # accepted) and poison every later creation op in the sweep
+        "set_default_dtype": [(("float32",), {})],
+        "set_cuda_rng_state": [((pt.get_cuda_rng_state(),), {})],
+        "bitwise_and": [((I8, I8), {})],
+        "bitwise_or": [((I8, I8), {})],
+        "bitwise_xor": [((I8, I8), {})],
+        "broadcast_shape": [(([2, 2], [2]), {})],
+        "full": [(([2, 2], 1.0), {})],
+        "grad": [(([y_g], [x_g]), {})],
+        "index_add": [((A, I8, 0, A), {})],
+        "index_add_": [((A, I8, 0, A), {})],
+        "linspace": [((0.0, 1.0, 5), {})],
+        "logspace": [((0.0, 1.0, 5), {})],
+        "moveaxis": [((A, 0, 1), {})],
+        "put_along_axis": [((A, IDX2, 1.0, 1), {})],
+        "renorm": [((A, 2.0, 0, 1.0), {})],
+        "reshape": [((A, [4]), {})],
+        "reshape_": [((A, [4]), {})],
+        "save": [((A, "/tmp/_smoke_save.pdparams"), {})],
+        "scatter": [((A, I8, A), {})],
+        "scatter_": [((A, I8, A), {})],
+        "scatter_nd": [((IDX2, jnp.asarray([1.0, 2.0]), [2]), {})],
+        "scatter_nd_add": [((jnp.zeros(2), IDX2,
+                             jnp.asarray([1.0, 2.0])), {})],
+        "shard_index": [((I8, 4, 2, 0), {})],
+        "slice": [((A, [0], [0], [1]), {})],
+        "standard_normal": [(([2, 2],), {})],
+        "strided_slice": [((A, [0], [0], [2], [1]), {})],
+        "tril_indices": [((2, 2, 0), {})],
+        "uniform": [(([2, 2],), {})],
+    }
+
+
+def _autograd():
+    import paddle_tpu as pt
+
+    x = pt.to_tensor([[1.0, 2.0]], stop_gradient=False)
+    return {"backward": [(([(x * x).sum()],), {})]}
+
+
+def _vision_ops():
+    B1 = jnp.asarray([[0.0, 0.0, 4.0, 4.0]], jnp.float32)
+    N1 = jnp.asarray([1], jnp.int32)
+    return {
+        "box_coder": [((jnp.ones((2, 4)), jnp.ones((2, 4)),
+                        jnp.ones((2, 4))), {})],
+        "distribute_fpn_proposals": [
+            ((jnp.asarray([[0, 0, 10, 10], [0, 0, 100, 100]], jnp.float32),
+              2, 5, 4, 224), {})],
+        "generate_proposals": [
+            ((jnp.ones((1, 2, 4, 4)) * 0.5, jnp.zeros((1, 8, 4, 4)),
+              jnp.asarray([[32.0, 32.0]]), jnp.ones((4, 4, 2, 4)),
+              jnp.ones((4, 4, 2, 4)) * 0.1), {})],
+        "matrix_nms": [((jnp.ones((1, 3, 4)), jnp.ones((1, 2, 3)) * 0.5,
+                         0.1, 0.1, 5, 5), {})],
+        "psroi_pool": [((jnp.ones((1, 4, 8, 8)), B1, N1, 2), {})],
+        "roi_align": [((jnp.ones((1, 2, 8, 8)), B1, N1, 2), {})],
+        "roi_pool": [((jnp.ones((1, 2, 8, 8)), B1, N1, 2), {})],
+        "yolo_box": [((jnp.ones((1, 14, 4, 4)),
+                       jnp.asarray([[32, 32]], jnp.int32),
+                       [10, 13, 16, 30], 2, 0.01, 8), {})],
+        "yolo_loss": [((jnp.ones((1, 14, 4, 4)), jnp.ones((1, 3, 4)) * 0.3,
+                        jnp.zeros((1, 3), jnp.int32), [10, 13, 16, 30],
+                        [0, 1], 2, 0.5, 8), {})],
+    }
+
+
+def _transforms():
+    img = jnp.ones((8, 8, 3), jnp.float32)
+    return {
+        "affine": [((img, 10.0, [1, 1], 1.0, [0.0, 0.0]), {})],
+        "crop": [((img, 1, 1, 4, 4), {})],
+        "erase": [((img, 1, 1, 2, 2, 0.0), {})],
+    }
+
+
 # per-name (args, kwargs) candidates where the battery's shapes won't do
 EXTRA = {
+    "paddle_tpu": _toplevel,
+    "paddle_tpu.vision.transforms": _transforms,
+    "paddle_tpu.autograd": _autograd,
+    "paddle_tpu.vision.ops": _vision_ops,
     "paddle_tpu.sparse": lambda: {
         "sparse_csr_tensor": [((jnp.asarray([0, 1, 2], jnp.int64),
                                 jnp.asarray([0, 1], jnp.int64),
@@ -110,15 +203,31 @@ INVOKE_ELSEWHERE = {
     },
 }
 
-# functions that legitimately return None (setters/config)
+# functions that legitimately return None (setters/config; get_worker_info
+# outside a DataLoader worker; backward writes .grad in place; save
+# writes its file)
 NONE_OK = {"run_check", "require_version",
            "set_code_level", "set_verbosity", "seed", "enable_operator_stats_collection",
            "disable_operator_stats_collection", "reset_profiler",
            "start_profiler", "stop_profiler", "disable_signal_handler",
            "set_flags", "set_device", "set_default_dtype",
-           "set_grad_enabled", "set_printoptions"}
+           "set_grad_enabled", "set_printoptions",
+           "disable_static", "enable_static", "set_cuda_rng_state",
+           "get_worker_info", "backward", "save"}
 
 TARGETS = [
+    ("/root/reference/python/paddle/__init__.py", "paddle_tpu"),
+    ("/root/reference/python/paddle/optimizer/__init__.py",
+     "paddle_tpu.optimizer"),
+    ("/root/reference/python/paddle/io/__init__.py", "paddle_tpu.io"),
+    ("/root/reference/python/paddle/metric/__init__.py", "paddle_tpu.metric"),
+    ("/root/reference/python/paddle/amp/__init__.py", "paddle_tpu.amp"),
+    ("/root/reference/python/paddle/autograd/__init__.py",
+     "paddle_tpu.autograd"),
+    ("/root/reference/python/paddle/signal.py", "paddle_tpu.signal"),
+    ("/root/reference/python/paddle/vision/ops.py", "paddle_tpu.vision.ops"),
+    ("/root/reference/python/paddle/vision/transforms/__init__.py",
+     "paddle_tpu.vision.transforms"),
     ("/root/reference/python/paddle/sparse/__init__.py", "paddle_tpu.sparse"),
     ("/root/reference/python/paddle/fft.py", "paddle_tpu.fft"),
     ("/root/reference/python/paddle/incubate/__init__.py",
@@ -148,20 +257,41 @@ def _ref_all(path):
         else []
 
 
+STUB = object()    # NotImplementedError: a stub pretending to exist
+RAISED = object()  # real code ran and rejected the canonical values
+
+
 def _try_call(obj, candidates):
-    """Returns (invoked, outcome): outcome is the value, 'raised' (real
-    code ran and rejected values), or 'stub' (NotImplementedError)."""
+    """Returns (invoked, outcome): outcome is the value, RAISED (real
+    code ran and rejected values), or STUB (NotImplementedError).
+    Sentinel objects, not strings: a returned ndarray must never be
+    `==`-compared against a sentinel (elementwise ambiguity)."""
     for args, kwargs in candidates:
         try:
             with contextlib.redirect_stdout(io.StringIO()):
                 return True, obj(*args, **kwargs)
         except NotImplementedError:
-            return True, "stub"
+            return True, STUB
         except TypeError:
             continue  # signature mismatch: try the next candidate
         except Exception:
-            return True, "raised"
+            return True, RAISED
     return False, None
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_defaults():
+    """The battery invokes setters with arbitrary values; whatever they
+    flip (default dtype, static mode, global seed) must not leak into
+    later tests — the reference ``__all__`` order ends with
+    ``enable_static`` after ``disable_static``, so without this the rest
+    of the suite would run in static mode."""
+    yield
+    import paddle_tpu as pt
+
+    pt.set_default_dtype("float32")
+    pt.disable_static()
+    pt.seed(0)
 
 
 @pytest.mark.parametrize("refpath,modname",
@@ -188,7 +318,7 @@ def test_audited_names_behave(refpath, modname):
                     shallow.append(f"{name}: empty enum")
                 continue
             invoked, out = _try_call(obj, candidates)
-            if out == "stub":
+            if out is STUB:
                 stubs.append(name)
             elif not invoked:
                 # constructor needs rich args: structural alias check —
@@ -203,7 +333,7 @@ def test_audited_names_behave(refpath, modname):
         if not callable(obj):
             continue  # constants: presence is all there is
         invoked, out = _try_call(obj, candidates)
-        if out == "stub":
+        if out is STUB:
             stubs.append(name)
         elif not invoked:
             unhandled.append(name)
