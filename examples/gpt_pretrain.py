@@ -1,0 +1,71 @@
+"""GPT pretraining with the fused TrainStep — the flagship workflow.
+
+Runs a tiny config by default (CPU-friendly, seconds); ``--bench`` runs
+the 350M-class configuration bench.py records on real TPU hardware.
+
+    python examples/gpt_pretrain.py
+    python examples/gpt_pretrain.py --bench   # needs a TPU-class chip
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import amp
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.optimizer import AdamW
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", action="store_true",
+                    help="350M-class TPU config instead of the tiny demo")
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    if args.bench:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position_embeddings=1024,
+                        use_flash_attention=True, loss_chunk=256,
+                        dtype="bfloat16")
+        batch, seq = 8, 1024
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=128)
+        batch, seq = 4, 64
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = AdamW(learning_rate=3e-4, weight_decay=0.01)
+    if args.bench:
+        # O2: bf16 compute, f32 master weights held by the optimizer
+        model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    # forward(ids, labels) returns the shifted LM loss itself (chunked and
+    # fused with the head projection when cfg.loss_chunk is set)
+    step = pt.TrainStep(model, opt, loss_fn=None)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = step((ids, ids))
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+    dt = time.perf_counter() - t0
+    print(f"{batch * seq * args.steps / dt:,.0f} tokens/s "
+          f"(incl. compile) on {pt.get_device()}")
+
+    # checkpoint + resume
+    step.sync_to_model()
+    pt.save(model.state_dict(), "/tmp/gpt_demo.pdparams")
+    print("saved /tmp/gpt_demo.pdparams")
+
+
+if __name__ == "__main__":
+    main()
