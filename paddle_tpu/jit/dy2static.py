@@ -29,10 +29,14 @@ while form with the range's natural trip count as the bound; and
 loop-``else`` blocks detach to an epilogue (guarded by the break flag
 when the body can break).
 
+A ``for`` over a non-``range`` iterable with escapes dispatches on
+indexability at runtime: positional sequences and arrays rewrite to the
+for-range form (iteration is indexing there); generators/dicts/custom
+iterables keep the exact python loop.
+
 Remaining limits (each degrades to the old trace-only behavior, never to
 silent wrongness): ``return`` inside loops/try and escapes buried in
-``try``/``with``/``match`` keep their block un-converted, as do escapes
-in a ``for`` over a non-``range`` iterable; a ``for`` loop's target
+``try``/``with``/``match`` keep their block un-converted; a ``for`` loop's target
 variable read AFTER the loop sees its pre-loop value when the loop was
 converted (zero-trip targets poison on use); foreign decorators /
 generators / ``super()`` / walrus-in-while-test skip conversion. And one inherited from XLA itself: reverse-mode grad through
@@ -274,6 +278,29 @@ def make_range(*args):
     if len(args) == 2:
         return _RangeSpec(args[0], args[1], 1)
     return _RangeSpec(*args)
+
+
+def can_index(seq) -> bool:
+    """Can ``for x in seq`` be replaced by ``for i in range(len(seq)):
+    x = seq[i]``? Conservative allowlist: LENGTH-IMMUTABLE positional
+    sequences and arrays, where iteration is exactly indexing. Lists are
+    deliberately excluded — python's list iterator tracks append/pop
+    during the loop, which a len-snapshot rewrite would silently miss —
+    as are generators, dicts (iterate keys), strings, and custom
+    iterables: they all keep the exact python loop."""
+    if isinstance(seq, (tuple, range)):
+        return True
+    import numpy as _np
+
+    if isinstance(seq, _np.ndarray):
+        return seq.ndim > 0
+    if isinstance(seq, jax.Array) or _is_traced(seq):
+        return getattr(seq, "ndim", 0) > 0
+    return False
+
+
+def seq_len(seq) -> int:
+    return len(seq)
 
 
 def convert_for(iterable, body_fn, init: tuple):
@@ -901,6 +928,47 @@ class _CtrlFlowTransformer:
                 body=advance + node.body, orelse=node.orelse)
             return prelude + self._conv_while(wnode, live,
                                               bound_expr=_name(bound_n))
+
+        # escapes over a NON-range iterable: dispatch on indexability at
+        # RUNTIME — length-immutable sequences/arrays rewrite to the
+        # for-range form above (iteration IS indexing there, and that
+        # form's escape lowering then applies); everything else keeps the
+        # exact python loop. The else-reads-target refusal matches the
+        # range branch (zero-trip UNDEF vs python's UnboundLocalError).
+        # COST: the body is emitted twice (indexed copy + python
+        # fallback), so K nested escape-bearing for-over-iterable loops
+        # grow the rebuilt source by 2^K copies of the innermost body —
+        # acceptable for the 1-2 deep loops models write.
+        if (_own_escapes(node.body) and isinstance(node.target, ast.Name)
+                and not getattr(node, "_d2s_no_dispatch", False)
+                and not (node.orelse
+                         and node.target.id in _read_names(node.orelse))):
+            import copy
+
+            uid = self._uid()
+            seq_n = f"__for_seq_{uid}__"
+            idx_n = f"__for_ix_{uid}__"
+            indexed = ast.For(
+                target=ast.Name(id=idx_n, ctx=ast.Store()),
+                iter=ast.Call(func=_name("range"),
+                              args=[_jst_call("seq_len", [_name(seq_n)])],
+                              keywords=[]),
+                body=[ast.Assign(
+                    targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+                    value=ast.Subscript(value=_name(seq_n),
+                                        slice=_name(idx_n),
+                                        ctx=ast.Load()))]
+                + copy.deepcopy(node.body),
+                orelse=copy.deepcopy(node.orelse))
+            fallback = ast.For(target=node.target, iter=_name(seq_n),
+                               body=node.body, orelse=node.orelse)
+            fallback._d2s_no_dispatch = True  # break the rewrite recursion
+            dispatch = ast.If(
+                test=_jst_call("can_index", [_name(seq_n)]),
+                body=[indexed], orelse=[fallback])
+            prelude = [ast.Assign(targets=[_name(seq_n, ast.Store())],
+                                  value=node.iter)]
+            return prelude + self._stmt(dispatch, live)
 
         # a for-else with no break in the body is an unconditional
         # epilogue — detach it so the loop itself stays convertible. NOT

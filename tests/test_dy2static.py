@@ -1364,3 +1364,80 @@ def test_for_range_else_reading_target_stays_python():
         f(0, 5)
     with pytest.raises(UnboundLocalError):
         g(0, 5)
+
+
+def test_for_over_tensor_with_break_converts():
+    """Escapes over a tensor iterable: the runtime indexability dispatch
+    rewrites to the for-range form, so a tensor-dependent break compiles
+    (scan-with-early-exit, the capability the plain scan path lacks)."""
+    def f(xs):
+        total = jnp.zeros(())
+        for row in xs:
+            s = jnp.sum(row)
+            if s > 10.0:
+                break
+            total = total + s
+        return total
+
+    def ref(xs):
+        total = 0.0
+        for row in np.asarray(xs):
+            s = row.sum()
+            if s > 10.0:
+                break
+            total += s
+        return total
+
+    g = jax.jit(to_static(f))
+    xs1 = np.asarray([[1, 2], [3, 4], [20, 1], [5, 5]], np.float32)
+    xs2 = np.asarray([[1, 2], [3, 4]], np.float32)
+    np.testing.assert_allclose(float(g(jnp.asarray(xs1))), ref(xs1))
+    np.testing.assert_allclose(float(g(jnp.asarray(xs2))), ref(xs2))
+
+
+def test_for_over_list_with_break_eager_parity():
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(vals, cap):
+        out = []
+        for v in vals:
+            if v > cap:
+                break
+            out.append(v)
+        else:
+            out.append(-1)
+        return out
+
+    g = convert_control_flow(f)
+    assert g([1, 2, 9, 3], 5) == [1, 2] == f([1, 2, 9, 3], 5)
+    assert g([1, 2, 3], 5) == [1, 2, 3, -1] == f([1, 2, 3], 5)
+
+
+def test_for_over_generator_with_break_stays_python():
+    """Non-indexable iterables (generators consume once, dicts iterate
+    keys) take the python fallback; eager semantics exact."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(n):
+        gen = (i * i for i in range(n))
+        total = 0
+        for v in gen:
+            if v > 9:
+                break
+            total += v
+        return total
+
+    g = convert_control_flow(f)
+    assert g(10) == f(10) == 0 + 1 + 4 + 9
+
+    def h(d):
+        keys = []
+        for k in d:
+            if k == "stop":
+                break
+            keys.append(k)
+        return keys
+
+    g2 = convert_control_flow(h)
+    d = {"a": 1, "stop": 2, "b": 3}
+    assert g2(d) == h(d) == ["a"]

@@ -20,6 +20,15 @@ import sys
 import threading
 import time
 
+# the graph engine is host-side C++; only the feed/train-overlap section
+# touches jax, and its skip-gram step measures HOST overlap — pin it to
+# CPU (and skip accelerator-plugin pool discovery, which can block when a
+# tunneled TPU is unreachable) unless the caller explicitly chose a
+# platform
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ["JAX_PLATFORMS"].startswith("cpu"):
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
