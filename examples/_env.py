@@ -1,0 +1,22 @@
+"""Shared example environment guard — import before anything touches a
+jax array.
+
+When the caller pins CPU (``JAX_PLATFORMS=cpu``), images that tunnel a
+TPU need two things BEFORE the first array op: the accelerator plugin's
+pool address cleared (its discovery can block indefinitely when the
+tunnel is down), and the jax platform config actually flipped —
+interpreter-startup hooks may have registered the accelerator platform
+already, so the env var alone is not enough. ``set_device("cpu")`` does
+the config flip the supported way.
+"""
+import os
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu
+
+    paddle_tpu.set_device("cpu")

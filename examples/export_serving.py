@@ -12,10 +12,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-# a CPU-pinned run must also skip accelerator-plugin pool discovery, or
-# backend init can block in environments with a tunneled TPU plugin
-if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+import _env  # noqa: F401,E402  (cpu-pinned runs skip accelerator discovery)
 
 import numpy as np
 

@@ -1,8 +1,9 @@
 """Hybrid parallelism as configuration: dp x mp (+ ZeRO-2) on a device mesh.
 
-Runs on ANY machine: without TPUs it builds an 8-device virtual CPU mesh,
-which is exactly how the test suite validates every sharding in CI. On a
-real pod slice the same code uses the physical chips.
+This demo builds an 8-device VIRTUAL CPU mesh — exactly how the test
+suite validates every sharding in CI, on any machine. On a real pod
+slice, drop the ``set_device("cpu")`` line and the same code lays the
+mesh over the physical chips.
 
     python examples/hybrid_parallel.py
 """
@@ -10,25 +11,21 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-# a CPU-pinned run must also skip accelerator-plugin pool discovery, or
-# backend init can block in environments with a tunneled TPU plugin
-if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
-
 
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
+import _env  # noqa: F401,E402  (cpu-pinned runs skip accelerator discovery)
 
 import numpy as np
 
 
 def main():
     import paddle_tpu as pt
-    import jax
 
-    if jax.default_backend() != "tpu":
-        pt.set_device("cpu")  # flip BEFORE any array touches a backend
+    # the demo mesh is the virtual CPU one; flip BEFORE any array op
+    # (on a real slice, remove this line)
+    pt.set_device("cpu")
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     from paddle_tpu.distributed import fleet

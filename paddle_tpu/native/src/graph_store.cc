@@ -28,72 +28,6 @@
 
 namespace {
 
-// Flat open-addressing id map (int64 key -> dense int32 index): linear
-// probing over power-of-2 slots with a splitmix64 hash. The per-query
-// node/hop lookup is the graph engine's hottest operation, and
-// std::unordered_map's bucket chasing costs ~2-3 cache misses per find;
-// this map costs one (plus probes at 0.5 max load). No deletion — clear =
-// rebuild, matching the store's COO->CSR lifecycle.
-class FlatIdMap {
- public:
-  void Clear() {
-    keys_.clear();
-    vals_.clear();
-    mask_ = 0;
-    size_ = 0;
-  }
-
-  // Insert key if absent; returns the dense index either way. `next_idx`
-  // is the index a NEW key receives (the caller's ids_.size()).
-  int32_t InsertOrGet(int64_t key, int32_t next_idx) {
-    if (size_ * 2 >= Capacity()) Grow();
-    uint64_t h = ptn::splitmix64(static_cast<uint64_t>(key)) & mask_;
-    while (vals_[h] >= 0) {
-      if (keys_[h] == key) return vals_[h];
-      h = (h + 1) & mask_;
-    }
-    keys_[h] = key;
-    vals_[h] = next_idx;
-    ++size_;
-    return next_idx;
-  }
-
-  // Dense index of key, or -1.
-  int32_t Find(int64_t key) const {
-    if (mask_ == 0) return -1;
-    uint64_t h = ptn::splitmix64(static_cast<uint64_t>(key)) & mask_;
-    while (vals_[h] >= 0) {
-      if (keys_[h] == key) return vals_[h];
-      h = (h + 1) & mask_;
-    }
-    return -1;
-  }
-
- private:
-  uint64_t Capacity() const { return vals_.empty() ? 0 : mask_ + 1; }
-
-  void Grow() {
-    uint64_t cap = vals_.empty() ? 1024 : (mask_ + 1) * 2;
-    std::vector<int64_t> old_k = std::move(keys_);
-    std::vector<int32_t> old_v = std::move(vals_);
-    keys_.assign(cap, 0);
-    vals_.assign(cap, -1);
-    mask_ = cap - 1;
-    for (size_t i = 0; i < old_v.size(); ++i) {
-      if (old_v[i] < 0) continue;
-      uint64_t h = ptn::splitmix64(static_cast<uint64_t>(old_k[i])) & mask_;
-      while (vals_[h] >= 0) h = (h + 1) & mask_;
-      keys_[h] = old_k[i];
-      vals_[h] = old_v[i];
-    }
-  }
-
-  std::vector<int64_t> keys_;
-  std::vector<int32_t> vals_;  // -1 = empty slot
-  uint64_t mask_ = 0;
-  uint64_t size_ = 0;
-};
-
 class GraphStore {
  public:
   // Edge ingestion happens pre-Build into COO buffers. Ingest ops take
@@ -488,7 +422,7 @@ class GraphStore {
   std::vector<float> csr_w_;   // aligned with col_ (clamped > 0)
   std::vector<double> cumw_;   // per-row cumulative csr_w_ (weighted only)
   bool weighted_ = false;
-  FlatIdMap id_of_;
+  ptn::FlatI64Map id_of_;
   std::vector<int64_t> ids_;       // dense idx -> original id
   std::vector<int64_t> row_ptr_;   // CSR offsets
   std::vector<int32_t> col_;       // CSR neighbor dense indices

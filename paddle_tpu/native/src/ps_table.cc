@@ -54,8 +54,9 @@ struct TableConfig {
 };
 
 struct Shard {
-  // key -> index into `values` arena (in units of value_width)
-  std::unordered_map<int64_t, uint32_t> index;
+  // key -> index into `values` arena (in units of value_width); flat
+  // open-addressing map — per-key find is the pull/push hot operation
+  ptn::FlatI64Map index;
   std::vector<float> values;
   std::mutex mu;
 };
@@ -157,7 +158,7 @@ class SparseTable {
     int64_t total = 0;
     for (auto& sh : shards_) {
       std::lock_guard<std::mutex> g(sh.mu);
-      total += static_cast<int64_t>(sh.index.size());
+      total += static_cast<int64_t>(sh.index.Size());
     }
     return total;
   }
@@ -167,10 +168,12 @@ class SparseTable {
     int64_t w = 0;
     for (auto& sh : shards_) {
       std::lock_guard<std::mutex> g(sh.mu);
-      for (auto& kv : sh.index) {
-        if (w >= cap) return w;
-        out[w++] = kv.first;
-      }
+      sh.index.ForEachUntil([&](int64_t key, int32_t) {
+        if (w >= cap) return false;
+        out[w++] = key;
+        return true;
+      });
+      if (w >= cap) return w;
     }
     return w;
   }
@@ -184,25 +187,25 @@ class SparseTable {
       for (size_t s = lo; s < hi; ++s) {
         Shard& sh = shards_[s];
         std::lock_guard<std::mutex> g(sh.mu);
-        std::unordered_map<int64_t, uint32_t> keep;
+        ptn::FlatI64Map keep;
+        keep.Reserve(sh.index.Size());  // survivors <= current rows
         std::vector<float> values;
-        keep.reserve(sh.index.size());
         const int32_t w = value_width();
-        for (auto& kv : sh.index) {
-          float* v = sh.values.data() + static_cast<size_t>(kv.second) * w;
+        sh.index.ForEach([&](int64_t key, int32_t at) {
+          float* v = sh.values.data() + static_cast<size_t>(at) * w;
           const float score = cfg_.show_coeff * v[show_offset()] +
                               cfg_.click_coeff * v[show_offset() + 1];
           if (score >= threshold) {
-            uint32_t idx = static_cast<uint32_t>(keep.size());
-            keep.emplace(kv.first, idx);
+            int32_t idx = static_cast<int32_t>(keep.Size());
+            keep.InsertOrGet(key, idx);
             values.insert(values.end(), v, v + w);
             values[static_cast<size_t>(idx) * w + show_offset()] *= 0.5f;
             values[static_cast<size_t>(idx) * w + show_offset() + 1] *= 0.5f;
           } else {
             dropped.fetch_add(1, std::memory_order_relaxed);
           }
-        }
-        sh.index.swap(keep);
+        });
+        sh.index = std::move(keep);
         sh.values.swap(values);
       }
     }, 1);
@@ -222,17 +225,17 @@ class SparseTable {
     uint64_t count = 0;
     for (auto& sh : shards_) {
       locks.emplace_back(sh.mu);
-      count += static_cast<uint64_t>(sh.index.size());
+      count += static_cast<uint64_t>(sh.index.Size());
     }
     std::fwrite(&magic, sizeof(magic), 1, f);
     std::fwrite(&w, sizeof(w), 1, f);
     std::fwrite(&count, sizeof(count), 1, f);
     for (auto& sh : shards_) {
-      for (auto& kv : sh.index) {
-        const float* v = sh.values.data() + static_cast<size_t>(kv.second) * w;
-        std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+      sh.index.ForEach([&](int64_t key, int32_t at) {
+        const float* v = sh.values.data() + static_cast<size_t>(at) * w;
+        std::fwrite(&key, sizeof(int64_t), 1, f);
         std::fwrite(v, sizeof(float), w, f);
-      }
+      });
     }
     std::fclose(f);
     return 0;
@@ -264,16 +267,16 @@ class SparseTable {
       }
       Shard& sh = shards_[shard_of(key)];
       std::lock_guard<std::mutex> g(sh.mu);
-      auto it = sh.index.find(key);
+      int32_t found = sh.index.Find(key);
       uint32_t idx;
-      if (it == sh.index.end()) {
-        idx = static_cast<uint32_t>(sh.index.size());
-        sh.index.emplace(key, idx);
+      if (found < 0) {
+        idx = static_cast<uint32_t>(sh.index.Size());
+        sh.index.InsertOrGet(key, static_cast<int32_t>(idx));
         sh.values.resize(static_cast<size_t>(idx + 1) * w);
       } else if (merge_only) {
         continue;  // live RAM row wins over snapshot
       } else {
-        idx = it->second;
+        idx = static_cast<uint32_t>(found);
       }
       std::memcpy(sh.values.data() + static_cast<size_t>(idx) * w, buf.data(),
                   sizeof(float) * w);
@@ -285,7 +288,7 @@ class SparseTable {
   void Clear() {
     for (auto& sh : shards_) {
       std::lock_guard<std::mutex> g(sh.mu);
-      sh.index.clear();
+      sh.index.Clear();
       sh.values.clear();
     }
   }
@@ -297,12 +300,12 @@ class SparseTable {
   // Adam scalar state lives at the tail: [beta1^t, beta2^t].
   float* FindOrInit(Shard& sh, int64_t key) {
     const int32_t w = value_width();
-    auto it = sh.index.find(key);
-    if (it != sh.index.end()) {
-      return sh.values.data() + static_cast<size_t>(it->second) * w;
+    const int32_t found = sh.index.Find(key);
+    if (found >= 0) {
+      return sh.values.data() + static_cast<size_t>(found) * w;
     }
-    uint32_t idx = static_cast<uint32_t>(sh.index.size());
-    sh.index.emplace(key, idx);
+    uint32_t idx = static_cast<uint32_t>(sh.index.Size());
+    sh.index.InsertOrGet(key, static_cast<int32_t>(idx));
     sh.values.resize(static_cast<size_t>(idx + 1) * w, 0.0f);
     float* v = sh.values.data() + static_cast<size_t>(idx) * w;
     ptn::XorShift128 rng(ptn::splitmix64(cfg_.seed) ^ static_cast<uint64_t>(key));

@@ -27,7 +27,8 @@ import time
 # platform
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if os.environ["JAX_PLATFORMS"].startswith("cpu"):
-    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    # override, not setdefault: TPU-tunnel images pre-set the pool address
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 import numpy as np
 

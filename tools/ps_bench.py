@@ -1,0 +1,73 @@
+"""Sparse-table pull/push throughput benchmark.
+
+The PS table's per-key find is the CTR-training hot operation (reference:
+``MemorySparseTable`` + accessor rules, ``table/memory_sparse_table.cc``);
+this measures cold pull (insert+init), hot pull (gather), and
+push-with-optimizer-rule throughput at the 2M-key scale, host-side.
+
+Usage:  python tools/ps_bench.py [--keys 2000000] [--save]
+Prints one JSON dict; --save writes tools/ps_bench_results.json.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=2_000_000)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--save", action="store_true")
+    args = ap.parse_args()
+
+    from paddle_tpu.distributed.ps import MemorySparseTable
+
+    t = MemorySparseTable(embed_dim=args.dim, optimizer="adagrad")
+    rng = np.random.default_rng(0)
+    universe = rng.integers(0, 2**40, args.keys).astype(np.int64)
+
+    batch = 8192
+    iters_fill = args.keys // batch
+    t0 = time.perf_counter()
+    for i in range(iters_fill):
+        t.pull(universe[i * batch:(i + 1) * batch])
+    cold = args.keys / (time.perf_counter() - t0)
+
+    iters = 100
+    batches = [rng.choice(universe, batch) for _ in range(iters)]
+    t0 = time.perf_counter()
+    for b in batches:
+        t.pull(b)
+    hot = batch * iters / (time.perf_counter() - t0)
+
+    grads = rng.standard_normal((batch, args.dim)).astype(np.float32)
+    t0 = time.perf_counter()
+    for b in batches:
+        t.push(b, grads)
+    push = batch * iters / (time.perf_counter() - t0)
+
+    result = {
+        "keys": args.keys, "dim": args.dim, "rows": len(t),
+        "host": {"cpu_count": os.cpu_count()},
+        "cold_pull_keys_per_sec": round(cold, 1),
+        "hot_pull_keys_per_sec": round(hot, 1),
+        "push_adagrad_keys_per_sec": round(push, 1),
+    }
+    print(json.dumps(result))
+    if args.save:
+        out = os.path.join(REPO, "tools", "ps_bench_results.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
