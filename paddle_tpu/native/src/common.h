@@ -7,6 +7,7 @@
 #pragma once
 
 #include <cstdint>
+#include <cstdlib>
 #include <functional>
 #include <thread>
 #include <vector>
@@ -83,6 +84,12 @@ class FlatI64Map {
   // Insert key if absent; returns the dense index either way. `next_idx`
   // is the index a NEW key receives (typically the caller's arena size).
   int32_t InsertOrGet(int64_t key, int32_t next_idx) {
+    // dense indices are int32 with -1-as-empty: past 2^31-1 rows a
+    // negative index would read as an empty slot and silently corrupt
+    // the map — fail loudly instead (a shard that big must be split)
+    if (next_idx < 0) {
+      std::abort();
+    }
     if (size_ * 2 >= Capacity()) Grow();
     uint64_t h = splitmix64(static_cast<uint64_t>(key)) & mask_;
     while (vals_[h] >= 0) {
