@@ -21,7 +21,6 @@
 #include <cstring>
 #include <mutex>
 #include <shared_mutex>
-#include <unordered_map>
 #include <vector>
 
 #include "common.h"
@@ -379,17 +378,13 @@ class GraphStore {
     if (feat_dim_ == 0) feat_dim_ = dim;
     if (dim != feat_dim_) return -1;
     for (int64_t i = 0; i < n; ++i) {
-      auto it = feat_of_.find(keys[i]);
-      size_t off;
-      if (it == feat_of_.end()) {
-        off = feat_data_.size();
-        feat_of_.emplace(keys[i], off);
-        feat_data_.resize(off + dim);
-      } else {
-        off = it->second;
-      }
-      std::memcpy(feat_data_.data() + off, vals + i * dim,
-                  sizeof(float) * dim);
+      // the map stores ROW indices (int32-bounded); byte offsets are
+      // row * dim, so the arena itself can exceed 2^31 floats
+      const int32_t rows = static_cast<int32_t>(feat_data_.size() / dim);
+      const int32_t row = feat_of_.InsertOrGet(keys[i], rows);
+      if (row == rows) feat_data_.resize(feat_data_.size() + dim);
+      std::memcpy(feat_data_.data() + static_cast<size_t>(row) * dim,
+                  vals + i * dim, sizeof(float) * dim);
     }
     return 0;
   }
@@ -406,9 +401,10 @@ class GraphStore {
     if (feat_dim_ == 0) return 0;
     ptn::parallel_for(static_cast<size_t>(n), [&](size_t lo, size_t hi) {
       for (size_t i = lo; i < hi; ++i) {
-        auto it = feat_of_.find(keys[i]);
-        if (it == feat_of_.end()) continue;
-        std::memcpy(out + i * dim, feat_data_.data() + it->second,
+        const int32_t row = feat_of_.Find(keys[i]);
+        if (row < 0) continue;
+        std::memcpy(out + i * dim,
+                    feat_data_.data() + static_cast<size_t>(row) * dim,
                     sizeof(float) * dim);
       }
     }, 256);
@@ -428,7 +424,7 @@ class GraphStore {
   std::vector<int32_t> col_;       // CSR neighbor dense indices
   mutable std::shared_mutex feat_mu_;  // writers exclusive, readers shared
   int32_t feat_dim_ = 0;
-  std::unordered_map<int64_t, size_t> feat_of_;  // key -> offset
+  ptn::FlatI64Map feat_of_;  // key -> feature ROW (offset = row * dim)
   std::vector<float> feat_data_;
 };
 

@@ -114,23 +114,28 @@ def test_walk_step_composes_to_random_walk():
 
 
 # ------------------------------------- sharded multi-host graph engine
+def random_coo(n_nodes=120, n_edges=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_nodes, n_edges).astype(np.int64),
+            rng.integers(0, n_nodes, n_edges).astype(np.int64))
+
+
 @pytest.fixture(scope="module")
 def graph_cluster():
-    """Two graph-shard server subprocesses + a connected client (the
-    reference's TestDistBase subprocess-cluster pattern, SURVEY §4)."""
+    """Two graph-shard server subprocesses + a connected client, BUILT
+    with the canonical random_coo graph so every dependent test is
+    self-sufficient (the reference's TestDistBase subprocess-cluster
+    pattern, SURVEY §4)."""
     procs, endpoints = launch_graph_servers(2)
     client = DistGraphClient(endpoints)
+    src, dst = random_coo()
+    client.add_edges(src, dst)
+    client.build(symmetric=True)
     yield client
     client.stop_servers()
     client.close()
     for p in procs:
         p.wait(timeout=10)
-
-
-def random_coo(n_nodes=120, n_edges=1500, seed=0):
-    rng = np.random.default_rng(seed)
-    return (rng.integers(0, n_nodes, n_edges).astype(np.int64),
-            rng.integers(0, n_nodes, n_edges).astype(np.int64))
 
 
 def test_dist_graph_parity_with_single_host(graph_cluster):
@@ -142,9 +147,6 @@ def test_dist_graph_parity_with_single_host(graph_cluster):
     local = GraphTable()
     local.add_edges(src, dst)
     local.build(symmetric=True)
-
-    graph_cluster.add_edges(src, dst)
-    graph_cluster.build(symmetric=True)
 
     assert graph_cluster.num_nodes == local.num_nodes
     assert graph_cluster.num_edges == local.num_edges
