@@ -51,23 +51,42 @@ def test_budget_exhaustion_emits_structured_failure():
 def test_sigterm_mid_retry_still_leaves_artifact():
     """SIGTERM during the retry loop (the round-4 scenario) must flush a
     killed_by_signal record naming the phase, then exit."""
+    import threading
+
     proc = subprocess.Popen(
         [sys.executable, BENCH],
         env=_env(BENCH_FORCE_PROBE_FAIL="1",
                  BENCH_TOTAL_BUDGET_SECONDS="600",
                  BENCH_TPU_RETRY_SECONDS="600"),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    # wait for the supervisor's OWN retry message before killing: a fixed
+    # grace flakes on a loaded host where the interpreter hasn't even
+    # installed its signal handlers yet
+    parked = threading.Event()
+    stderr_lines = []
+
+    def drain():
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            if "retrying in" in line:
+                parked.set()
+
+    th = threading.Thread(target=drain, daemon=True)
+    th.start()
     try:
-        # forced probe failure is instant, so after a short grace the
-        # supervisor is parked in its retry sleep — the round-4 state
-        time.sleep(3.0)
+        assert parked.wait(timeout=60.0), (
+            f"supervisor never reached its retry loop: {stderr_lines!r}")
         assert proc.poll() is None, "supervisor exited before the kill"
         proc.send_signal(signal.SIGTERM)
-        stdout, _ = proc.communicate(timeout=30)
+        # the drain thread owns stderr; read only stdout here (communicate
+        # would race it on the same pipe)
+        stdout = proc.stdout.read()
+        proc.wait(timeout=30)
     finally:
         if proc.poll() is None:
             proc.kill()
-            proc.communicate()
+            proc.wait(timeout=10)
+        th.join(timeout=10)
     rec = _metric_line(stdout)
     assert rec["error"] == "killed_by_signal"
     assert "probe" in rec["extra"]["detail"]
