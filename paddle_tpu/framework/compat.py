@@ -199,11 +199,15 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     to register names into (jaxprs name nothing)."""
     from ..eager import Tensor
     from ..framework.dtype import convert_dtype
-    from ..nn.initializer import Constant, XavierUniform
+    from ..nn.initializer import (Constant, XavierUniform,
+                                  _resolve_initializer)
     from ..nn.layer import take_rng_key
 
-    init = default_initializer or (Constant(0.0) if is_bias
-                                   else XavierUniform())
+    # same resolution chain as nn.Layer.create_parameter: an installed
+    # set_global_initializer outranks the built-in default here too
+    init = _resolve_initializer(None, default_initializer, is_bias=is_bias)
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierUniform()
     val = init(take_rng_key("params"), tuple(shape), convert_dtype(dtype))
     t = Tensor(val)
     t.stop_gradient = False

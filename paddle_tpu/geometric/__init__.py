@@ -9,12 +9,16 @@ Reference parity: ``python/paddle/geometric/`` (``message_passing/send_recv.py``
 the native C++ CSR store or over in-memory CSC arrays, returning padded
 static shapes.
 """
-from .message_passing import segment_pool, send_u_recv, send_ue_recv, send_uv
-from .sampling import (khop_sampler, khop_sampler_from_store,
+from .message_passing import (segment_max, segment_mean, segment_min,
+                              segment_pool, segment_sum, send_u_recv,
+                              send_ue_recv, send_uv)
+from .sampling import (reindex_heter_graph,  # noqa: F401
+                       khop_sampler, khop_sampler_from_store,
                        reindex_graph, sample_neighbors)
 
 __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv", "segment_pool",
-    "sample_neighbors", "reindex_graph", "khop_sampler",
-    "khop_sampler_from_store",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "sample_neighbors", "reindex_graph", "reindex_heter_graph",
+    "khop_sampler", "khop_sampler_from_store",
 ]

@@ -95,3 +95,26 @@ def segment_pool(x, segment_ids, pool_type: str = "sum", num_segments=None):
                 "inside jit (segment_ids is traced, so its max is not "
                 "static)") from e
     return _segment_reduce(x, segment_ids, n, pool_type)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """``paddle.geometric.segment_sum`` (reference ``geometric/math.py:23``):
+    out[i] = sum of rows whose segment id == i; result length is
+    ``max(segment_ids) + 1`` (pass through :func:`segment_pool` with an
+    explicit ``num_segments`` under jit)."""
+    return segment_pool(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    """``paddle.geometric.segment_mean``; empty segments yield 0."""
+    return segment_pool(data, segment_ids, "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    """``paddle.geometric.segment_min``; empty segments yield 0."""
+    return segment_pool(data, segment_ids, "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    """``paddle.geometric.segment_max``; empty segments yield 0."""
+    return segment_pool(data, segment_ids, "max")

@@ -52,6 +52,30 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
     return out.astype(np.int64), counts
 
 
+def _intern_ids(x):
+    """First-seen interning table seeded with ``x``: returns
+    ``(local: dict id->idx, out_nodes: list, map_ids)`` where
+    ``map_ids(ids) -> np.ndarray`` maps (and interns) a flat id array.
+    Shared by reindex_graph / reindex_heter_graph / _khop_core — the
+    single definition of the "x first, then first-seen" ordering."""
+    local = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(x)
+
+    def map_ids(ids):
+        out = np.empty(len(ids), np.int64)
+        for i, v in enumerate(ids):
+            vi = int(v)
+            idx = local.get(vi)
+            if idx is None:
+                idx = len(out_nodes)
+                local[vi] = idx
+                out_nodes.append(vi)
+            out[i] = idx
+        return out
+
+    return local, out_nodes, map_ids
+
+
 def reindex_graph(x, neighbors, count) -> Tuple[np.ndarray, np.ndarray,
                                                 np.ndarray]:
     """Relabel global ids to a compact local space.
@@ -65,17 +89,8 @@ def reindex_graph(x, neighbors, count) -> Tuple[np.ndarray, np.ndarray,
     x = np.asarray(x, np.int64).reshape(-1)
     neighbors = np.asarray(neighbors, np.int64).reshape(-1)
     count = np.asarray(count, np.int64).reshape(-1)
-    local = {int(v): i for i, v in enumerate(x)}
-    out_nodes = list(x)
-    src = np.empty(neighbors.size, np.int64)
-    for i, v in enumerate(neighbors):
-        vi = int(v)
-        idx = local.get(vi)
-        if idx is None:
-            idx = len(out_nodes)
-            local[vi] = idx
-            out_nodes.append(vi)
-        src[i] = idx
+    _, out_nodes, map_ids = _intern_ids(x)
+    src = map_ids(neighbors)
     dst = np.repeat(np.arange(x.size, dtype=np.int64), count)
     return src, dst, np.asarray(out_nodes, np.int64)
 
@@ -169,3 +184,25 @@ def khop_sampler_from_store(store, input_nodes, sample_sizes,
         feats = store.get_features(out[2])
         return out + (feats,)
     return out
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Multi-edge-type reindex (reference ``geometric/reindex.py:138``):
+    one shared local-id table over all graphs — ``x`` first, then new ids
+    in first-seen order across the graphs' neighbor lists — returning the
+    concatenated ``(reindex_src, reindex_dst, out_nodes)``. The optional
+    hash buffers of the reference's GPU kernel have no host-side meaning
+    and are accepted for signature parity."""
+    del value_buffer, index_buffer
+    x = np.asarray(x, np.int64).reshape(-1)
+    _, out_nodes, map_ids = _intern_ids(x)
+    srcs, dsts = [], []
+    for nb, ct in zip(neighbors, count):
+        nb = np.asarray(nb, np.int64).reshape(-1)
+        ct = np.asarray(ct, np.int64).reshape(-1)
+        srcs.append(map_ids(nb))
+        dsts.append(np.repeat(np.arange(x.size, dtype=np.int64), ct))
+    return (np.concatenate(srcs) if srcs else np.empty(0, np.int64),
+            np.concatenate(dsts) if dsts else np.empty(0, np.int64),
+            np.asarray(out_nodes, np.int64))

@@ -154,18 +154,62 @@ class Dirac(Initializer):
         return jnp.asarray(out, dtype=dtype)
 
 
-def _resolve_initializer(attr, default_initializer):
-    """Accept a ParamAttr-ish object, an Initializer, or None."""
-    if default_initializer is not None:
-        return default_initializer
-    if attr is None or attr is False:
-        return None
-    if isinstance(attr, Initializer):
-        return attr
-    init = getattr(attr, "initializer", None)
-    if isinstance(init, Initializer):
-        return init
-    return None
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    ``fluid/initializer.py`` ``BilinearInitializer``): every [kh, kw]
+    position of the 4-D weight gets ``(1-|x/f-c|)(1-|y/f-c|)`` with
+    ``f = ceil(k/2)``, ``c = (2f-1-f%2)/(2f)`` — a conv_transpose with
+    ``stride=factor``, ``kernel=2*factor-factor%2`` then upsamples by
+    ``factor`` exactly."""
+
+    def __call__(self, key, shape, dtype):
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        if shape[2] != shape[3]:
+            raise ValueError("Bilinear initializer needs square kernels")
+        k = shape[3]
+        f = math.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = np.arange(k)
+        filt = (1 - np.abs(x / f - c))
+        patt = np.outer(filt, filt).astype(np.float32)
+        return jnp.broadcast_to(jnp.asarray(patt), shape).astype(dtype)
+
+
+# global defaults installed by set_global_initializer: [weight, bias]
+_GLOBAL_INIT = [None, None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set the framework-wide default initializers (reference
+    ``fluid/initializer.py:1346``): they apply to parameters created
+    WITHOUT an explicit ``param_attr``/``bias_attr`` initializer (which
+    keeps priority), replacing each layer's built-in default. Pass
+    ``None`` to cancel."""
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+def _resolve_initializer(attr, default_initializer, is_bias: bool = False):
+    """Priority (the reference's contract): an initializer carried by
+    ``attr`` (ParamAttr-ish or a bare Initializer) wins; then the global
+    default installed by :func:`set_global_initializer`; then the
+    caller's ``default_initializer`` (the layer's built-in)."""
+    if attr is not None and attr is not False:
+        if isinstance(attr, Initializer):
+            return attr
+        init = getattr(attr, "initializer", None)
+        if isinstance(init, Initializer):
+            return init
+    ginit = _GLOBAL_INIT[1] if is_bias else _GLOBAL_INIT[0]
+    if ginit is not None:
+        return ginit
+    return default_initializer
 
 
 # paddle also exposes functional-style aliases

@@ -179,7 +179,8 @@ class Layer:
         from .initializer import Constant, XavierUniform, _resolve_initializer
 
         dtype = convert_dtype(dtype) or self._dtype
-        init = _resolve_initializer(attr, default_initializer)
+        init = _resolve_initializer(attr, default_initializer,
+                                    is_bias=is_bias)
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         key = framework_random.next_key()

@@ -37,6 +37,8 @@ X5 = jnp.ones((1, 2, 4, 8, 8), jnp.float32)
 W3 = jnp.ones((3, 2, 3), jnp.float32)
 W4 = jnp.ones((3, 2, 3, 3), jnp.float32)
 W5 = jnp.ones((3, 2, 3, 3, 3), jnp.float32)
+A2x3_GEO = jnp.ones((3, 4), jnp.float32)   # node features [num_nodes, d]
+E3_GEO = jnp.ones((3, 4), jnp.float32)     # edge features [num_edges, d]
 
 # generic candidates tried in order for every function/class
 BATTERY = [(), (A,), (A, A), (A, A, A), (I8,), (A, I8), (2,), (A, 2),
@@ -151,9 +153,40 @@ def _transforms():
     }
 
 
+def _geometric():
+    SRC = jnp.asarray([0, 1, 2], jnp.int64)
+    DST = jnp.asarray([1, 2, 0], jnp.int64)
+    return {
+        "send_u_recv": [((A2x3_GEO, SRC, DST), {})],
+        "send_ue_recv": [((A2x3_GEO, E3_GEO, SRC, DST), {})],
+        "send_uv": [((A2x3_GEO, A2x3_GEO, SRC, DST), {})],
+        "reindex_heter_graph": [(([0, 1, 2],
+                                  [[8, 9, 0], [0, 2]],
+                                  [[2, 1], [1, 1]]), {})],
+        "sample_neighbors": [((jnp.asarray([1, 2, 0, 2, 0, 1], jnp.int64),
+                               jnp.asarray([0, 2, 4, 6], jnp.int64),
+                               jnp.asarray([0, 1], jnp.int64)), {})],
+        "reindex_graph": [(([0, 1], [2, 0, 1], [2, 1]), {})],
+        "khop_sampler": [((jnp.asarray([1, 2, 0, 2, 0, 1], jnp.int64),
+                           jnp.asarray([0, 2, 4, 6], jnp.int64),
+                           jnp.asarray([0, 1], jnp.int64), [2]), {})],
+    }
+
+
+def _initializer():
+    import paddle_tpu.nn.initializer as I
+
+    return {
+        "set_global_initializer": [((I.Normal(0.0, 0.02),), {})],
+        "calculate_gain": [(("relu",), {})],
+    }
+
+
 # per-name (args, kwargs) candidates where the battery's shapes won't do
 EXTRA = {
     "paddle_tpu": _toplevel,
+    "paddle_tpu.geometric": _geometric,
+    "paddle_tpu.nn.initializer": _initializer,
     "paddle_tpu.vision.transforms": _transforms,
     "paddle_tpu.autograd": _autograd,
     "paddle_tpu.vision.ops": _vision_ops,
@@ -206,7 +239,7 @@ INVOKE_ELSEWHERE = {
 # functions that legitimately return None (setters/config; get_worker_info
 # outside a DataLoader worker; backward writes .grad in place; save
 # writes its file)
-NONE_OK = {"run_check", "require_version",
+NONE_OK = {"run_check", "require_version", "set_global_initializer",
            "set_code_level", "set_verbosity", "seed", "enable_operator_stats_collection",
            "disable_operator_stats_collection", "reset_profiler",
            "start_profiler", "stop_profiler", "disable_signal_handler",
@@ -225,6 +258,11 @@ TARGETS = [
     ("/root/reference/python/paddle/autograd/__init__.py",
      "paddle_tpu.autograd"),
     ("/root/reference/python/paddle/signal.py", "paddle_tpu.signal"),
+    ("/root/reference/python/paddle/linalg.py", "paddle_tpu.linalg"),
+    ("/root/reference/python/paddle/nn/initializer/__init__.py",
+     "paddle_tpu.nn.initializer"),
+    ("/root/reference/python/paddle/geometric/__init__.py",
+     "paddle_tpu.geometric"),
     ("/root/reference/python/paddle/vision/ops.py", "paddle_tpu.vision.ops"),
     ("/root/reference/python/paddle/vision/transforms/__init__.py",
      "paddle_tpu.vision.transforms"),
@@ -292,12 +330,29 @@ def _restore_global_defaults():
     pt.set_default_dtype("float32")
     pt.disable_static()
     pt.seed(0)
+    from paddle_tpu.nn.initializer import set_global_initializer
+
+    set_global_initializer(None)
+
+
+def _resolve_module(modname: str):
+    """import the target, falling back to attribute traversal for
+    namespaces exposed as attributes rather than import paths
+    (``paddle_tpu.linalg`` mirrors ``paddle.linalg``)."""
+    try:
+        return importlib.import_module(modname)
+    except ModuleNotFoundError:
+        parts = modname.split(".")
+        obj = importlib.import_module(parts[0])
+        for p in parts[1:]:
+            obj = getattr(obj, p)
+        return obj
 
 
 @pytest.mark.parametrize("refpath,modname",
                          TARGETS, ids=[t[1] for t in TARGETS])
 def test_audited_names_behave(refpath, modname):
-    mod = importlib.import_module(modname)
+    mod = _resolve_module(modname)
     extra = EXTRA.get(modname, dict)()
     elsewhere = INVOKE_ELSEWHERE.get(modname, {})
     stubs, shallow, unhandled = [], [], []

@@ -276,3 +276,54 @@ def test_initializers():
     w = np.asarray(XavierUniform()(key, (100, 100), np.float32))
     limit = np.sqrt(6 / 200)
     assert np.abs(w).max() <= limit + 1e-6
+
+
+def test_set_global_initializer_priority():
+    """Reference contract (fluid/initializer.py:1346): the global default
+    applies to params created without an explicit attr initializer,
+    REPLACING the layer's built-in default; an attr-carried initializer
+    keeps priority; None cancels."""
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.initializer import (Constant,
+                                           set_global_initializer)
+
+    try:
+        set_global_initializer(Constant(3.0), Constant(0.5))
+        lin = nn.Linear(4, 4)
+        np.testing.assert_allclose(np.asarray(lin.weight), 3.0)
+        np.testing.assert_allclose(np.asarray(lin.bias), 0.5)
+        # attr-carried initializer outranks the global
+        lin2 = nn.Linear(4, 4, weight_attr=Constant(7.0))
+        np.testing.assert_allclose(np.asarray(lin2.weight), 7.0)
+        np.testing.assert_allclose(np.asarray(lin2.bias), 0.5)
+        # wrong type rejected loudly
+        import pytest as _pytest
+
+        with _pytest.raises(TypeError):
+            set_global_initializer("xavier")
+    finally:
+        set_global_initializer(None)
+    lin3 = nn.Linear(4, 4)
+    assert float(np.abs(np.asarray(lin3.weight)).sum()) > 0  # xavier again
+    np.testing.assert_allclose(np.asarray(lin3.bias), 0.0)
+
+
+def test_bilinear_initializer_upsamples_exactly():
+    """A conv_transpose with Bilinear-initialized weights upsamples by the
+    factor exactly on a constant input (the initializer's whole contract)."""
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.initializer import Bilinear
+
+    factor = 2
+    k = 2 * factor - factor % 2
+    conv = nn.Conv2DTranspose(1, 1, k, stride=factor, padding=1,
+                              weight_attr=Bilinear(), bias_attr=False)
+    x = np.ones((1, 1, 4, 4), np.float32)
+    out = np.asarray(conv(x))
+    assert out.shape == (1, 1, 8, 8)
+    # interior of a constant map upsamples to the same constant
+    np.testing.assert_allclose(out[0, 0, 2:-2, 2:-2], 1.0, rtol=1e-6)
