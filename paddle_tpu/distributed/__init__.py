@@ -45,5 +45,11 @@ from ..io.slot_dataset import BoxPSDataset, QueueDataset  # noqa: F401
 from .ps.graph import GraphDataGenerator, GraphTable  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .checkpoint import (  # noqa: F401
-    AsyncSaver, AutoCheckpoint, latest_checkpoint, load_state, save_state,
+    AsyncSaver, AutoCheckpoint, CheckpointCorruptError, latest_checkpoint,
+    load_state, save_state, validate_checkpoint,
+)
+from . import resilience  # noqa: F401
+from .resilience import (  # noqa: F401
+    FaultPlan, FaultRule, InjectedFault, RetryPolicy, fault_point,
+    with_timeout,
 )

@@ -103,7 +103,7 @@ def measure_ici(mesh=None, size_mb: float = 64.0, iters: int = 5) -> float:
     ``ici_bandwidth``. Runs a psum inside shard_map and times it."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ...framework.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:

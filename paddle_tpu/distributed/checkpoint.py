@@ -15,9 +15,24 @@ and each shard's start offsets). Loading re-slices through
 onto any other mesh/sharding, reading only the bytes each device needs.
 Saving is optionally async (device->host copies happen on the caller thread,
 file IO on a background thread) — the orbax pattern, dependency-free.
+
+Crash safety (the resilience layer's contract): a checkpoint directory is
+either COMPLETE or INVISIBLE. Single-process saves stage everything in a
+``<dir>.tmp-pt*`` sibling — shards fsync'd, every shard crc32-checksummed
+into ``metadata.json``, the metadata fsync'd last — and publish with one
+``os.replace``; a SIGKILL at any point leaves only the staging dir, which
+:class:`AutoCheckpoint` sweeps on startup. Multi-process saves share the
+target directory, so publish is per-file (tmp + fsync + ``os.replace``)
+with each process's metadata written last as its commit marker.
+:func:`load_state` verifies checksums (raising
+:class:`CheckpointCorruptError` on torn/corrupt data) and
+:func:`latest_checkpoint` validates candidates, silently skipping
+incomplete or corrupt step dirs so restore falls back to the newest GOOD
+checkpoint.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
@@ -25,19 +40,29 @@ import shutil
 import tempfile
 import threading
 import time
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from .resilience import fault_point
+
 __all__ = [
     "save_state", "load_state", "AsyncSaver", "AutoCheckpoint",
-    "latest_checkpoint",
+    "latest_checkpoint", "validate_checkpoint", "CheckpointCorruptError",
 ]
 
 _METADATA = "metadata.json"
+_TMP_MARK = ".tmp-pt"  # staging dirs: <target>.tmp-pt<pid>
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (missing metadata,
+    missing shard file, size mismatch, or crc32 mismatch). The message
+    names the offending file and what differed."""
 
 
 def _flatten(tree):
@@ -74,24 +99,66 @@ def _leaf_record(key: str, arr) -> Dict[str, Any]:
     }
 
 
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse dir fsync; best effort
+    finally:
+        os.close(fd)
+
+
+def _write_file_durable(path: str, raw: bytes, atomic: bool) -> None:
+    """Write+fsync ``raw``; with ``atomic``, stage at ``path + ".tmp"`` and
+    ``os.replace`` so a concurrent reader never sees a torn file."""
+    target = path + ".tmp" if atomic else path
+    with open(target, "wb") as f:
+        f.write(raw)
+        f.flush()
+        os.fsync(f.fileno())
+    if atomic:
+        os.replace(target, path)
+
+
 def save_state(state: Any, directory: str, *, async_=False,
                io_threads: int = 8) -> Optional["_PendingSave"]:
     """Save a pytree of arrays as a sharded checkpoint directory.
 
     Each addressable shard of each leaf becomes one ``.npy`` file (a unique
     per-leaf index prefixes the name, so distinct keys never collide after
-    sanitisation); ``metadata.json`` records the tree. Multi-process: each
-    process writes only shards it owns (``replica_id == 0``) and its own
-    ``metadata[.<proc>].json``; :func:`load_state` merges them. With
-    ``async_=True`` the device->host copies happen on the caller thread and
-    the file IO on ``io_threads`` background threads; the returned handle's
-    ``.wait()`` joins the IO and reports/raises any IO error.
+    sanitisation); ``metadata.json`` records the tree plus each shard's
+    byte length and crc32. Multi-process: each process writes only shards
+    it owns (``replica_id == 0``) and its own ``metadata[.<proc>].json``;
+    :func:`load_state` merges them. With ``async_=True`` the device->host
+    copies happen on the caller thread and the file IO on ``io_threads``
+    background threads; the returned handle's ``.wait()`` joins the IO and
+    reports/raises any IO error.
+
+    Publication is crash-safe: single-process saves stage in a
+    ``.tmp-pt<pid>`` sibling directory and appear atomically via
+    ``os.replace``; multi-process saves write each file atomically into
+    the shared directory with metadata last as the commit marker. A
+    process killed mid-save never leaves a directory that
+    :func:`latest_checkpoint`/:func:`load_state` would accept.
     """
     flat, _ = _flatten(state)
-    os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
-    meta: Dict[str, Any] = {"format": "paddle_tpu.ckpt.v1", "leaves": {}}
-    jobs = []  # (filename, host numpy copy) — snapshotted before returning
+    nprocs = jax.process_count()
+    multiproc = nprocs > 1
+    directory = directory.rstrip(os.sep)
+    # single-writer: stage EVERYTHING in a sibling dir, publish by rename;
+    # multi-writer: processes share the target dir, so publish per-file
+    stage_dir = (directory if multiproc
+                 else f"{directory}{_TMP_MARK}{os.getpid()}")
+    if not multiproc and os.path.exists(stage_dir):
+        shutil.rmtree(stage_dir)
+    os.makedirs(stage_dir, exist_ok=True)
+    # process_count lets validators detect a MISSING peer metadata file
+    # (a peer killed pre-commit) instead of silently loading partial state
+    meta: Dict[str, Any] = {"format": "paddle_tpu.ckpt.v1",
+                            "process_count": nprocs, "leaves": {}}
+    jobs = []  # (filename, host numpy copy, shard record to patch)
     for leaf_i, (key, leaf) in enumerate(flat.items()):
         rec = _leaf_record(key, leaf)
         meta["leaves"][key] = rec
@@ -108,16 +175,18 @@ def save_state(state: Any, directory: str, *, async_=False,
                     for idx in shard.index) if shard.index else ()
                 data = np.asarray(shard.data)
                 fname = prefix + "__" + "_".join(map(str, start)) + ".npy"
-                shards.append({"file": fname, "start": list(start),
-                               "shape": list(data.shape)})
-                jobs.append((os.path.join(directory, fname), data))
+                srec = {"file": fname, "start": list(start),
+                        "shape": list(data.shape)}
+                shards.append(srec)
+                jobs.append((fname, data, srec))
         else:
             # copy: async IO must see a snapshot, not later in-place updates
             data = np.array(leaf, copy=True)
             fname = prefix + "__" + "_".join(["0"] * data.ndim) + ".npy"
-            shards.append({"file": fname, "start": [0] * data.ndim,
-                           "shape": list(data.shape)})
-            jobs.append((os.path.join(directory, fname), data))
+            srec = {"file": fname, "start": [0] * data.ndim,
+                    "shape": list(data.shape)}
+            shards.append(srec)
+            jobs.append((fname, data, srec))
         rec["shards"] = shards
 
     meta_name = _METADATA if proc == 0 else f"metadata.{proc}.json"
@@ -126,9 +195,15 @@ def save_state(state: Any, directory: str, *, async_=False,
         import concurrent.futures as cf
 
         def write(job):
-            path, data = job
-            with open(path, "wb") as f:
-                np.save(f, data)
+            fname, data, srec = job
+            buf = io.BytesIO()
+            np.save(buf, data)
+            raw = buf.getvalue()
+            srec["bytes"] = len(raw)
+            srec["crc32"] = zlib.crc32(raw) & 0xFFFFFFFF
+            fault_point("ckpt.shard_write")
+            _write_file_durable(os.path.join(stage_dir, fname), raw,
+                                atomic=multiproc)
 
         if len(jobs) > 1 and io_threads > 1:
             with cf.ThreadPoolExecutor(max_workers=io_threads) as pool:
@@ -137,9 +212,29 @@ def save_state(state: Any, directory: str, *, async_=False,
         else:
             for job in jobs:
                 write(job)
-        # metadata written last = commit marker for this process
-        with open(os.path.join(directory, meta_name), "w") as f:
-            json.dump(meta, f, indent=1)
+        # metadata written last = this process's commit marker (and, via
+        # the dir rename below, the single-process publish barrier)
+        fault_point("ckpt.publish")
+        _write_file_durable(os.path.join(stage_dir, meta_name),
+                            json.dumps(meta, indent=1).encode(),
+                            atomic=multiproc)
+        _fsync_dir(stage_dir)
+        if not multiproc:
+            trash = None
+            if os.path.exists(directory):
+                # same-name overwrite: POSIX replaces only EMPTY target
+                # dirs, so move the old checkpoint ASIDE first — a crash
+                # between the two renames leaves the old data recoverable
+                # under .old-pt rather than a window with nothing at all
+                trash = f"{directory}.old-pt{os.getpid()}"
+                if os.path.exists(trash):
+                    shutil.rmtree(trash)
+                os.replace(directory, trash)
+            os.replace(stage_dir, directory)
+            parent = os.path.dirname(os.path.abspath(directory))
+            _fsync_dir(parent)
+            if trash is not None:
+                shutil.rmtree(trash, ignore_errors=True)
 
     if async_:
         pending = _PendingSave(directory)
@@ -178,19 +273,54 @@ class _PendingSave:
         return not self._thread.is_alive()
 
 
+def _read_shard_file(directory: str, shard: Dict[str, Any],
+                     verify: bool = True) -> np.ndarray:
+    """Read one shard ``.npy``, verifying recorded size/crc32 when present
+    (older checkpoints without checksums load unverified). Verification
+    streams the file (1 MB chunks) so peak memory stays ~1x the decoded
+    array, not raw-bytes + array."""
+    path = os.path.join(directory, shard["file"])
+    try:
+        if verify:
+            want_len = shard.get("bytes")
+            if want_len is not None:
+                size = os.path.getsize(path)
+                if size != want_len:
+                    raise CheckpointCorruptError(
+                        f"checkpoint shard {path}: {size} bytes on disk, "
+                        f"metadata records {want_len} (truncated/torn "
+                        f"write)")
+            want_crc = shard.get("crc32")
+            if want_crc is not None:
+                got = _file_crc32(path)
+                if got != want_crc:
+                    raise CheckpointCorruptError(
+                        f"checkpoint shard {path}: crc32 {got:#010x} != "
+                        f"recorded {want_crc:#010x} (bit rot or torn write)")
+        return np.load(path)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"checkpoint shard missing: {path} (torn save?)") from None
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint shard {path}: undecodable npy: {e}") from e
+
+
 class _LeafReader:
     """Assembles arbitrary slices of one leaf from its shard files."""
 
-    def __init__(self, directory: str, rec: Dict[str, Any]):
+    def __init__(self, directory: str, rec: Dict[str, Any],
+                 verify: bool = True):
         self.directory = directory
         self.rec = rec
+        self.verify = verify
         self.shape = tuple(rec["shape"])
         self._cache: Dict[str, np.ndarray] = {}
 
     def _shard_data(self, shard) -> np.ndarray:
         f = shard["file"]
         if f not in self._cache:
-            raw = np.load(os.path.join(self.directory, f))
+            raw = _read_shard_file(self.directory, shard, self.verify)
             want = jnp.dtype(self.rec["dtype"])
             if raw.dtype != want:
                 # extended dtypes (bfloat16, fp8) round-trip npy as void
@@ -235,7 +365,7 @@ class _LeafReader:
 
 
 def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
-               template: Any = None) -> Dict[str, Any]:
+               template: Any = None, verify: bool = True) -> Dict[str, Any]:
     """Load a checkpoint directory.
 
     - plain load: returns a flat ``{key: np.ndarray}`` dict (or scalars).
@@ -244,18 +374,46 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
       ``make_array_from_callback`` — re-slicing happens per-device, so a
       checkpoint saved on mesh A loads onto mesh B without a full gather.
     - with ``template`` (a pytree): result is unflattened into that structure.
+
+    With ``verify`` (default), every shard file read is checked against the
+    byte length and crc32 recorded at save time; a missing/truncated/
+    corrupted shard or missing metadata raises
+    :class:`CheckpointCorruptError` naming the file and the mismatch.
     """
-    with open(os.path.join(directory, _METADATA)) as f:
-        meta = json.load(f)
-    # merge shard lists from other processes' metadata (multi-host save)
+    try:
+        with open(os.path.join(directory, _METADATA)) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"{directory}: no {_METADATA} — not a (complete) checkpoint "
+            "directory; the save may have been killed before publishing"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise CheckpointCorruptError(
+            f"{directory}/{_METADATA}: undecodable metadata: {e}") from e
+    # merge shard lists from other processes' metadata (multi-host save);
+    # files at or beyond process_count are STALE leftovers from an earlier
+    # larger-world save into the same path — merging them would mix shards
+    # from a different training trajectory into the restored state
+    nprocs = meta.get("process_count")
+    seen_procs = {0}
     for name in sorted(os.listdir(directory)):
-        if name != _METADATA and re.match(r"^metadata\.\d+\.json$", name):
+        proc_i = _meta_proc(name)
+        if proc_i is not None and (nprocs is None or proc_i < nprocs):
+            seen_procs.add(proc_i)
             with open(os.path.join(directory, name)) as f:
                 other = json.load(f)
             for key, rec in other.get("leaves", {}).items():
                 mine = meta["leaves"].setdefault(key, rec)
                 if rec.get("kind") == "array" and mine is not rec:
                     mine.setdefault("shards", []).extend(rec.get("shards", []))
+    if verify and nprocs is not None:
+        absent = set(range(nprocs)) - seen_procs
+        if absent:
+            raise CheckpointCorruptError(
+                f"{directory}: metadata missing for process(es) "
+                f"{sorted(absent)} — a peer was killed before committing; "
+                f"its shards are not recoverable from this directory")
     flat_out: Dict[str, Any] = {}
     for key, rec in meta["leaves"].items():
         if rec["kind"] == "scalar":
@@ -264,7 +422,7 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
         if rec["kind"] == "str":
             flat_out[key] = rec["value"]
             continue
-        reader = _LeafReader(directory, rec)
+        reader = _LeafReader(directory, rec, verify=verify)
         shape = tuple(rec["shape"])
         sharding = (shardings or {}).get(key)
         if sharding is not None:
@@ -286,17 +444,122 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
-def latest_checkpoint(root: str) -> Optional[str]:
+def _meta_proc(name: str) -> Optional[int]:
+    """Process index of a ``metadata.N.json`` file name (None for the
+    primary ``metadata.json``)."""
+    m = re.match(r"^metadata\.(\d+)\.json$", name)
+    return int(m.group(1)) if m else None
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    """Streaming crc32 — never materialises the file (crc32 is
+    incremental), so validating multi-GB shards costs one buffer."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def validate_checkpoint(directory: str,
+                        checksums: bool = True) -> Optional[str]:
+    """Integrity-check a checkpoint directory WITHOUT materialising arrays.
+
+    Returns ``None`` when every metadata file parses and every recorded
+    shard exists with matching byte length and (with ``checksums``) crc32;
+    otherwise a string describing the first problem found.
+    ``checksums=False`` is the cheap stat-only mode for housekeeping paths
+    (retention GC) that must not re-read every shard byte. Pre-checksum
+    checkpoints (no recorded crc) validate on existence/size only.
+    """
+    metas: List[str] = []
+    try:
+        for name in sorted(os.listdir(directory)):
+            if name == _METADATA or re.match(r"^metadata\.\d+\.json$", name):
+                metas.append(name)
+    except OSError as e:
+        return f"{directory}: unreadable: {e}"
+    if _METADATA not in metas:
+        return f"{directory}: no {_METADATA} (unpublished/torn save)"
+    try:
+        with open(os.path.join(directory, _METADATA)) as f:
+            nprocs = json.load(f).get("process_count")
+    except (OSError, json.JSONDecodeError) as e:
+        return f"{directory}/{_METADATA}: undecodable metadata: {e}"
+    if nprocs is not None:
+        # every process's commit marker must exist — a peer killed before
+        # its metadata write means its shards are silently absent
+        missing = [f"metadata.{p}.json" for p in range(1, nprocs)
+                   if f"metadata.{p}.json" not in metas]
+        if missing:
+            return (f"{directory}: missing {missing[0]} "
+                    f"({nprocs}-process save, peer killed pre-commit?)")
+        # ...and markers BEYOND process_count are stale leftovers from an
+        # earlier larger-world save into this path: skip them, exactly as
+        # load_state does (pre-process_count checkpoints check everything)
+        metas = [n for n in metas
+                 if _meta_proc(n) is None or _meta_proc(n) < nprocs]
+    for name in metas:
+        try:
+            with open(os.path.join(directory, name)) as f:
+                meta = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return f"{directory}/{name}: undecodable metadata: {e}"
+        for key, rec in meta.get("leaves", {}).items():
+            for shard in rec.get("shards", []):
+                path = os.path.join(directory, shard["file"])
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    return f"{path}: shard missing (leaf {key!r})"
+                want_len = shard.get("bytes")
+                if want_len is not None and size != want_len:
+                    return (f"{path}: {size} bytes, metadata records "
+                            f"{want_len}")
+                want_crc = shard.get("crc32")
+                if checksums and want_crc is not None:
+                    try:
+                        got = _file_crc32(path)
+                    except OSError:
+                        return f"{path}: shard unreadable (leaf {key!r})"
+                    if got != want_crc:
+                        return f"{path}: crc32 mismatch"
+    return None
+
+
+def latest_checkpoint(root: str, verify: bool = True) -> Optional[str]:
+    """Newest VALID ``step_*`` checkpoint under ``root`` (or ``None``).
+
+    With ``verify`` (default), candidates failing
+    :func:`validate_checkpoint` — torn saves, truncated or bit-flipped
+    shards, missing metadata — are skipped, so restore falls back to the
+    newest checkpoint that is actually loadable. This reads every shard of
+    the chosen candidate once (crc32); a subsequent :func:`load_state`
+    reads them again — the double pass is deliberate: fallback must reject
+    a bit-rotted-but-right-sized newest checkpoint BEFORE restore commits
+    to it. Pass ``verify=False`` to pick by metadata presence only.
+    """
     if not os.path.isdir(root):
         return None
-    best, best_step = None, -1
-    for name in os.listdir(root):
-        m = _STEP_DIR.match(name)
-        if m and os.path.exists(os.path.join(root, name, _METADATA)):
-            step = int(m.group(1))
-            if step > best_step:
-                best, best_step = os.path.join(root, name), step
-    return best
+    steps = sorted(
+        ((int(m.group(1)), name) for m, name in
+         ((_STEP_DIR.match(n), n) for n in os.listdir(root)) if m),
+        reverse=True)
+    for step, name in steps:
+        path = os.path.join(root, name)
+        if not os.path.exists(os.path.join(path, _METADATA)):
+            continue
+        if verify:
+            problem = validate_checkpoint(path)
+            if problem is not None:
+                print(f"[checkpoint] skipping {path}: {problem}",
+                      flush=True)
+                continue
+        return path
+    return None
 
 
 class AutoCheckpoint:
@@ -320,6 +583,29 @@ class AutoCheckpoint:
         self._last_step = -1
         self._pending: Optional[_PendingSave] = None
         os.makedirs(root, exist_ok=True)
+        self._sweep_orphans()
+
+    _ORPHAN = re.compile(r"^step_\d+\.tmp(-pt\d+)?$")
+    _TRASH = re.compile(r"^(step_\d+)\.old-pt\d+$")
+
+    def _sweep_orphans(self) -> None:
+        """Clean up after a killed process: ``step_N.tmp*`` staging dirs are
+        never valid restore targets (publish renames them away before they
+        count) and are deleted; a ``step_N.old-pt<pid>`` overwrite trash
+        copy whose ``step_N`` is MISSING is the old checkpoint caught
+        between save_state's two renames — restore it rather than lose the
+        only copy."""
+        for name in os.listdir(self.root):
+            path = os.path.join(self.root, name)
+            m = self._TRASH.match(name)
+            if m:
+                target = os.path.join(self.root, m.group(1))
+                if not os.path.exists(target):
+                    os.replace(path, target)
+                else:
+                    shutil.rmtree(path, ignore_errors=True)
+            elif self._ORPHAN.match(name):
+                shutil.rmtree(path, ignore_errors=True)
 
     def _due(self, step):
         if self.save_interval_seconds is not None:
@@ -336,28 +622,21 @@ class AutoCheckpoint:
         if self._pending is not None:
             self._pending.wait()
         directory = os.path.join(self.root, f"step_{step}")
-        tmp = directory + ".tmp"
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        pending = save_state(state, tmp, async_=self.async_save)
-
-        def finalize():
-            if os.path.exists(directory):
-                shutil.rmtree(directory)
-            os.rename(tmp, directory)
-            self._gc()
+        # save_state publishes atomically (staging dir + os.replace), so a
+        # kill mid-save leaves only a .tmp-pt orphan — never a half dir
+        pending = save_state(state, directory, async_=self.async_save)
 
         if pending is None:
-            finalize()
+            self._gc()
         else:
             orig_wait = pending.wait
 
-            def wait_and_finalize(timeout=None):
+            def wait_and_gc(timeout=None):
                 ok = orig_wait(timeout)
-                if ok and os.path.exists(tmp):
-                    finalize()
+                if ok:
+                    self._gc()
                 return ok
-            pending.wait = wait_and_finalize
+            pending.wait = wait_and_gc
             self._pending = pending
         self._last_save_time = time.monotonic()
         self._last_step = step
@@ -368,11 +647,23 @@ class AutoCheckpoint:
             self._pending = None
 
     def _gc(self):
+        """Retain the newest ``keep_max`` VALID checkpoints. Invalid dirs
+        (torn multi-host saves, corruption) never count toward the quota —
+        else they could push out the only loadable fallback — and invalid
+        dirs NEWER than the kept set are left alone (a peer may still be
+        committing its metadata). Cheap stat-only validation: _gc runs
+        after every save, so it must not re-read every shard byte."""
         steps = sorted(
             (int(m.group(1)) for m in map(_STEP_DIR.match, os.listdir(self.root)) if m),
             reverse=True)
-        for step in steps[self.keep_max:]:
-            shutil.rmtree(os.path.join(self.root, f"step_{step}"), ignore_errors=True)
+        kept_valid = 0
+        for step in steps:
+            path = os.path.join(self.root, f"step_{step}")
+            if kept_valid < self.keep_max:
+                if validate_checkpoint(path, checksums=False) is None:
+                    kept_valid += 1
+                continue
+            shutil.rmtree(path, ignore_errors=True)
 
     def restore(self, shardings=None, template=None):
         self.wait()

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..framework.jax_compat import axis_size as _axis_size
+
 
 class ReduceOp:
     SUM = "sum"
@@ -89,7 +91,7 @@ def broadcast(tensor, src=0, group=None):
 def alltoall(tensor, group=None, split_axis=0, concat_axis=0):
     """reference ``alltoall`` / MoE ``global_scatter`` building block."""
     axis = _axis(group)
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     return lax.all_to_all(tensor, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
@@ -102,14 +104,14 @@ def ppermute(tensor, perm, group=None):
 
 def shift_right(tensor, group=None):
     axis = _axis(group)
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return lax.ppermute(tensor, axis, perm=perm)
 
 
 def shift_left(tensor, group=None):
     axis = _axis(group)
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i - 1) % n) for i in range(n)]
     return lax.ppermute(tensor, axis, perm=perm)
 
@@ -119,14 +121,14 @@ def axis_index(group=None):
 
 
 def axis_size_of(group=None):
-    return lax.axis_size(_axis(group))
+    return _axis_size(_axis(group))
 
 
 # ----------------------------------------------------------------- eager API
 def eager_all_reduce(tensor, op=ReduceOp.SUM, group=None, mesh=None):
     """Paddle-style eager collective over a mesh axis: runs a tiny shard_map
     program. For testing/metric aggregation, not hot paths."""
-    from jax import shard_map
+    from ..framework.jax_compat import shard_map
     from .mesh import require_mesh, P
 
     m = mesh or require_mesh()

@@ -24,17 +24,29 @@ import threading
 import time
 from typing import List, Optional
 
+from ..resilience import RetryPolicy, fault_point
 from .kv_server import KVClient
 
 
 class ElasticManager:
     """One per launcher process. ``node_id`` must be unique per launcher
     incarnation (a rejoining host gets a fresh id, so membership hashes
-    never collide across generations)."""
+    never collide across generations).
+
+    Heartbeat health is OBSERVABLE: the thread never dies silently — any
+    exception (transport or otherwise) is recorded in ``last_error`` and
+    the tick keeps running; :meth:`is_healthy` reports whether a beat
+    landed recently enough for our lease to still be alive, and the
+    launcher polls it to warn before the rest of the cluster notices.
+    """
 
     def __init__(self, kv_endpoint: str, job_id: str, node_id: str,
                  ttl: float = 6.0):
-        self.kv = KVClient(kv_endpoint)
+        # per-request timeout of ttl/4: two heartbeat attempts + backoff
+        # always finish inside the lease TTL, so a slow-but-alive store
+        # can never stall the refresh long enough to expire our own lease
+        # (no fixed floor — it would break the invariant for small TTLs)
+        self.kv = KVClient(kv_endpoint, timeout=max(0.05, ttl / 4.0))
         self.job_id = job_id
         self.node_id = node_id
         self.ttl = ttl
@@ -42,20 +54,47 @@ class ElasticManager:
         self._key = f"{self._prefix}{node_id}"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.last_error: Optional[BaseException] = None
+        self._last_beat: Optional[float] = None  # monotonic, successful PUT
+        # one tick = a couple of quick attempts; the outer loop is the
+        # long-horizon retry, so a tick must never outlive its period
+        self._beat_policy = RetryPolicy(max_attempts=2, base_delay=0.1,
+                                        max_delay=0.5)
 
     # ------------------------------------------------------ lease lifecycle
-    def register(self) -> None:
-        """Write our lease and start the heartbeat thread."""
-        self.kv.put(self._key, "1", ttl=self.ttl)
+    def register(self, timeout: Optional[float] = None) -> None:
+        """Write our lease (retrying transport failures up to ``timeout``,
+        default one TTL) and start the heartbeat thread."""
+        policy = RetryPolicy(deadline=timeout or self.ttl, base_delay=0.2)
+        policy.call(lambda: self.kv.put(self._key, "1", ttl=self.ttl),
+                    what=f"elastic register {self.node_id}")
+        self._last_beat = time.monotonic()
         self._thread = threading.Thread(target=self._heartbeat, daemon=True)
         self._thread.start()
+
+    def _beat_once(self) -> None:
+        fault_point("elastic.heartbeat")
+        self.kv.put(self._key, "1", ttl=self.ttl)
 
     def _heartbeat(self) -> None:
         while not self._stop.wait(self.ttl / 3.0):
             try:
-                self.kv.put(self._key, "1", ttl=self.ttl)
-            except OSError:
-                pass  # KV briefly unreachable; retry next tick
+                self._beat_policy.call(self._beat_once,
+                                       what="elastic heartbeat")
+            except BaseException as e:  # surfaced, never silently fatal
+                self.last_error = e
+            else:
+                self.last_error = None
+                self._last_beat = time.monotonic()
+
+    def is_healthy(self) -> bool:
+        """True while the heartbeat thread is alive and a beat landed
+        within the lease TTL (i.e. our membership key cannot have expired
+        for lack of refreshes)."""
+        if self._thread is None or not self._thread.is_alive():
+            return self._stop.is_set()  # post-leave() is not "unhealthy"
+        return (self._last_beat is not None
+                and time.monotonic() - self._last_beat < self.ttl)
 
     def leave(self) -> None:
         self._stop.set()
@@ -80,25 +119,27 @@ class ElasticManager:
         Returns the FULL membership (may exceed max_nodes): the caller
         takes ``members[:max_nodes]`` as the active set and keeps overflow
         nodes as spares, so every node computes the same view."""
-        deadline = time.time() + timeout
-        last, last_change = None, time.time()
-        while time.time() < deadline:
-            try:
-                cur = self.members()
-            except OSError:
-                time.sleep(0.5)  # transient KV hiccup; keep polling
-                continue
-            if cur != last:
-                last, last_change = cur, time.time()
+        state = {"last": None, "changed": time.monotonic()}
+
+        def stable() -> Optional[List[str]]:
+            cur = self.members()  # OSError retries through the policy
+            if cur != state["last"]:
+                state["last"], state["changed"] = cur, time.monotonic()
             if len(cur) >= max_nodes:
                 return cur
             if (len(cur) >= min_nodes
-                    and time.time() - last_change >= settle):
+                    and time.monotonic() - state["changed"] >= settle):
                 return cur
-            time.sleep(0.2)
-        raise TimeoutError(
-            f"elastic rendezvous: {len(last or [])}/{min_nodes} nodes after "
-            f"{timeout}s")
+            return None
+
+        policy = RetryPolicy(deadline=timeout, base_delay=0.2,
+                             multiplier=1.0, max_delay=0.5)
+        try:
+            return policy.until(stable, what="elastic rendezvous")
+        except TimeoutError:
+            raise TimeoutError(
+                f"elastic rendezvous: {len(state['last'] or [])}/{min_nodes} "
+                f"nodes after {timeout}s") from None
 
     def watch(self, baseline: List[str], interval: float = 1.0,
               stop: Optional[threading.Event] = None) -> List[str]:
@@ -134,16 +175,19 @@ class ElasticManager:
         matches their view AND whose generation is >= ``min_gen`` (strictly
         newer than any coordinator this follower already used). Returns
         ``(addr, gen)``."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            try:
-                raw = self.kv.get(f"elastic/{self.job_id}/coord")
-            except OSError:
-                raw = None  # transient KV hiccup
+        def published():
+            raw = self.kv.get(f"elastic/{self.job_id}/coord")
             if raw:
                 data = json.loads(raw)
-                if data["members"] == members and data.get("gen", 0) >= min_gen:
+                if (data["members"] == members
+                        and data.get("gen", 0) >= min_gen):
                     return data["addr"], data["gen"]
-            time.sleep(0.2)
-        raise TimeoutError("elastic: coordinator for current membership "
-                           "never published")
+            return None
+
+        policy = RetryPolicy(deadline=timeout, base_delay=0.2,
+                             multiplier=1.0, max_delay=0.5)
+        try:
+            return policy.until(published, what="elastic coordinator")
+        except TimeoutError:
+            raise TimeoutError("elastic: coordinator for current membership "
+                               "never published") from None

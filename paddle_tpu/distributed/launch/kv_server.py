@@ -15,6 +15,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..resilience import RetryPolicy, fault_point
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu_kv/1"
@@ -107,54 +109,91 @@ class KVServer:
 
 
 class KVClient:
-    """Client with the TCPStore-style wait/barrier helpers."""
+    """Client with the TCPStore-style wait/barrier helpers.
 
-    def __init__(self, endpoint: str):
+    ``retry``: optional :class:`~paddle_tpu.distributed.resilience.
+    RetryPolicy` applied to every single-shot operation (put/get/list/
+    delete) — transport failures and injected faults back off through it.
+    ``retry=None`` (the default) keeps one-attempt semantics for callers
+    that run their own policy around the client. Every operation passes a
+    ``fault_point`` (``kv.put``/``kv.get``/``kv.list``/``kv.delete``), so a
+    :class:`~paddle_tpu.distributed.resilience.FaultPlan` can drop, delay
+    or crash any KV touch deterministically.
+
+    ``timeout`` bounds each HTTP request — deadline-sensitive callers
+    (elastic heartbeats, whose lease expires in seconds) pass a short one
+    so a slow-but-alive store cannot stall an attempt past its budget.
+    """
+
+    def __init__(self, endpoint: str, retry: Optional[RetryPolicy] = None,
+                 timeout: float = 10.0):
         if not endpoint.startswith("http"):
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
+        self.retry = retry
+        self.timeout = float(timeout)
+
+    def _op(self, fn, what: str):
+        if self.retry is None:
+            return fn()
+        return self.retry.call(fn, what=what)
 
     def put(self, key: str, value: str, ttl: Optional[float] = None) -> None:
         """``ttl``: lease seconds — the key vanishes unless re-PUT within
         that window (etcd-lease analogue for elastic membership)."""
-        req = urllib.request.Request(
-            f"{self.endpoint}/{key.lstrip('/')}",
-            data=value.encode(), method="PUT")
-        if ttl is not None:
-            req.add_header("X-TTL", str(ttl))
-        urllib.request.urlopen(req, timeout=10).read()
+        def once():
+            fault_point("kv.put")
+            req = urllib.request.Request(
+                f"{self.endpoint}/{key.lstrip('/')}",
+                data=value.encode(), method="PUT")
+            if ttl is not None:
+                req.add_header("X-TTL", str(ttl))
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        self._op(once, f"kv put {key!r}")
 
     def list(self, prefix: str = "") -> Dict[str, str]:
         """Live keys under ``prefix`` (expired leases excluded)."""
-        with urllib.request.urlopen(
-                f"{self.endpoint}/?prefix={prefix.lstrip('/')}",
-                timeout=10) as r:
-            return {k.lstrip("/"): v for k, v in json.loads(r.read()).items()}
+        def once():
+            fault_point("kv.list")
+            with urllib.request.urlopen(
+                    f"{self.endpoint}/?prefix={prefix.lstrip('/')}",
+                    timeout=self.timeout) as r:
+                return {k.lstrip("/"): v
+                        for k, v in json.loads(r.read()).items()}
+        return self._op(once, f"kv list {prefix!r}")
 
     def get(self, key: str) -> Optional[str]:
-        try:
-            with urllib.request.urlopen(
-                    f"{self.endpoint}/{key.lstrip('/')}", timeout=10) as r:
-                return r.read().decode()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        def once():
+            fault_point("kv.get")
+            try:
+                with urllib.request.urlopen(
+                        f"{self.endpoint}/{key.lstrip('/')}",
+                        timeout=self.timeout) as r:
+                    return r.read().decode()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None
+                raise
+        return self._op(once, f"kv get {key!r}")
 
     def delete(self, key: str) -> None:
-        req = urllib.request.Request(
-            f"{self.endpoint}/{key.lstrip('/')}", method="DELETE")
-        urllib.request.urlopen(req, timeout=10).read()
+        def once():
+            fault_point("kv.delete")
+            req = urllib.request.Request(
+                f"{self.endpoint}/{key.lstrip('/')}", method="DELETE")
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        self._op(once, f"kv delete {key!r}")
 
     def wait(self, key: str, timeout: float = 300.0,
              interval: float = 0.2) -> str:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            v = self.get(key)
-            if v is not None:
-                return v
-            time.sleep(interval)
-        raise TimeoutError(f"kv wait timed out on {key!r}")
+        """Poll until ``key`` exists (transport failures retry too — the
+        server may still be coming up on the other side of rendezvous)."""
+        policy = RetryPolicy(deadline=timeout, base_delay=interval,
+                             multiplier=1.0, max_delay=interval)
+        try:
+            return policy.until(lambda: self.get(key), what=f"kv key {key!r}")
+        except TimeoutError:
+            raise TimeoutError(f"kv wait timed out on {key!r}") from None
 
     def barrier(self, name: str, rank: int, world: int,
                 timeout: float = 300.0, gen: int = 0) -> None:
@@ -163,11 +202,16 @@ class KVClient:
         attempts) so stale marks from a previous generation can't satisfy
         the new barrier."""
         self.put(f"barrier/{name}/{gen}/{rank}", "1")
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+
+        def arrived():
             ok = all(self.get(f"barrier/{name}/{gen}/{r}") is not None
                      for r in range(world))
-            if ok:
-                return
-            time.sleep(0.2)
-        raise TimeoutError(f"barrier {name!r} (gen {gen}) timed out")
+            return True if ok else None
+
+        policy = RetryPolicy(deadline=timeout, base_delay=0.2,
+                             multiplier=1.0, max_delay=0.2)
+        try:
+            policy.until(arrived, what=f"barrier {name!r}")
+        except TimeoutError:
+            raise TimeoutError(
+                f"barrier {name!r} (gen {gen}) timed out") from None

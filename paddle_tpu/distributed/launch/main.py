@@ -261,6 +261,16 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
             resized = threading.Event()
             stop_watch = threading.Event()
 
+            def health_watch():
+                # surfaces silent heartbeat failure: if our own lease stops
+                # refreshing, the rest of the cluster will resize us out in
+                # one TTL — warn the operator BEFORE that happens
+                while not stop_watch.wait(max(1.0, args.elastic_ttl / 2)):
+                    if not mgr.is_healthy():
+                        print(f"[launch] WARNING: elastic heartbeat "
+                              f"unhealthy (last error: {mgr.last_error!r});"
+                              f" lease may expire", flush=True)
+
             def watch():
                 cur = members
                 while not stop_watch.is_set():
@@ -276,6 +286,8 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
 
             watcher = threading.Thread(target=watch, daemon=True)
             watcher.start()
+            health = threading.Thread(target=health_watch, daemon=True)
+            health.start()
             try:
                 status = pod.join(watcher_interval=5.0)
             finally:

@@ -19,7 +19,9 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from ...framework.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...nn.layer import Layer, buffer_state, functional_call, param_state

@@ -230,7 +230,7 @@ class MoELayer(Layer):
         """Sparse dispatch: explicit shard_map over "ep". Tokens are
         sharded over the batch dim; each device routes its S_local tokens
         into per-expert capacity buffers and all_to_all's ONLY those."""
-        from jax import shard_map
+        from ...framework.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         B, L, d = x.shape

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from ...framework.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...nn.layer import Layer, buffer_state, functional_call, param_state

@@ -23,7 +23,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ...framework.jax_compat import (axis_size as _axis_size,
+                                     pcast as _pcast, shard_map)
 from jax.sharding import PartitionSpec as P
 
 from ..mesh import require_mesh
@@ -49,14 +50,14 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     around the ring while each device accumulates its queries' output."""
     B, Lq, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     qf = q.astype(jnp.float32)
     # mark the fresh accumulators as device-varying over the sp axis so the
     # scan carry types line up (shard_map VMA rule)
-    _vary = lambda t: lax.pcast(t, (axis_name,), to="varying")  # noqa: E731
+    _vary = lambda t: _pcast(t, (axis_name,), to="varying")  # noqa: E731
     m0 = _vary(jnp.full((B, H, Lq), -1e30, jnp.float32))
     l0 = _vary(jnp.zeros((B, H, Lq), jnp.float32))
     acc0 = _vary(jnp.zeros((B, H, Lq, D), jnp.float32))
@@ -101,7 +102,7 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "sp", causal: bool = Tru
 
 # --------------------------------------------------------- Ulysses all2all
 def _ulysses_local(q, k, v, axis_name: str, causal: bool):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
 
     def seq_to_head(x):
         # [B, L/n, H, D] -> [B, L, H/n, D]

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ... import native
+from ..resilience import RetryPolicy, fault_point
 from .table import MemorySparseTable, SparseAccessorConfig
 
 __all__ = ["PsServer", "PsClient", "Communicator", "launch_servers"]
@@ -189,6 +190,13 @@ class PsClient:
         self.embed_dim = int(embed_dim)
         self.retries = int(retries)
         self.retry_delay = float(retry_delay)
+        # the brpc-client reconnect loop, expressed as the shared policy
+        # (resilience.RetryPolicy): retries+1 attempts, doubling delay
+        # capped at 2s — identical schedule to the previous inline loop
+        self._retry_policy = RetryPolicy(
+            max_attempts=self.retries + 1, base_delay=self.retry_delay,
+            max_delay=2.0, multiplier=2.0,
+            retryable=(ConnectionError, socket.timeout, OSError))
         self._conns: List[Optional[_Conn]] = [
             _Conn(h, p) for h, p in self.endpoints]
         self._locks = [threading.Lock() for _ in self._conns]
@@ -202,12 +210,16 @@ class PsClient:
     def _request(self, s: int, op: int, body: bytes = b"",
                  retry: bool = True) -> bytes:
         """One RPC to server ``s`` with reconnect + backoff on transport
-        errors. PsRpcError (status<0 reply) passes through unretried.
+        errors (through the shared :class:`RetryPolicy`). PsRpcError
+        (status<0 reply) is an application error — it is not in the
+        policy's retryable set and passes through unretried.
         ``retry=False`` for non-idempotent control ops (shrink): a lost
         reply must surface instead of silently re-applying the op."""
-        delay = self.retry_delay
-        retries = self.retries if retry else 0
-        for attempt in range(retries + 1):
+        def attempt() -> bytes:
+            # the fault point sits BEFORE any bytes hit the wire, so an
+            # injected drop/delay/crash models a connect-time fault and a
+            # retry is always protocol-safe
+            fault_point(f"ps.request.{s}")
             try:
                 with self._locks[s]:
                     if self._conns[s] is None:
@@ -220,11 +232,10 @@ class PsClient:
                     if self._conns[s] is not None:
                         self._conns[s].close()
                         self._conns[s] = None
-                if attempt == retries:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 2.0)
-        raise ConnectionError("unreachable")  # pragma: no cover
+                raise
+        if not retry:
+            return attempt()
+        return self._retry_policy.call(attempt, what=f"ps request srv{s}")
 
     # -- partitioned data plane -------------------------------------------
     def _scatter(self, keys: np.ndarray):

@@ -24,8 +24,14 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 from ..launch.kv_server import KVClient, KVServer
+from ..resilience import RetryPolicy, fault_point, with_timeout
 
 _DEFAULT_RPC_TIMEOUT = 120.0
+# transport-level retries for connection establishment to a peer service
+# (the peer may be mid-restart); the request itself is never re-sent — an
+# rpc'd fn is arbitrary python and re-execution is not ours to decide
+_CONNECT_RETRY = RetryPolicy(deadline=5.0, base_delay=0.1, max_delay=1.0,
+                             retryable=(ConnectionError, OSError))
 # rendezvous/barrier keys are leased: a crashed incarnation's stale entries
 # must not satisfy the next rendezvous on a long-lived KV store forever
 _KEY_TTL = 600.0
@@ -61,17 +67,15 @@ _cycle = 0
 
 def _kv_retry(fn, deadline, what):
     """Run a KV-store operation, retrying transport failures (server not up
-    yet / transient refusal) until ``deadline``."""
-    import urllib.error
-
-    while True:
-        try:
-            return fn()
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"rpc {what}: master store unreachable: {e}") from e
-            time.sleep(0.2)
+    yet / transient refusal) until ``deadline`` (an absolute time.time())."""
+    remaining = max(0.01, deadline - time.time())
+    policy = RetryPolicy(deadline=remaining, base_delay=0.2, multiplier=1.0,
+                         max_delay=0.2)
+    try:
+        return policy.call(fn, what=f"rpc {what}")
+    except TimeoutError as e:
+        raise TimeoutError(
+            f"rpc {what}: master store unreachable: {e.__cause__}") from e
 
 
 def _read_full(sock, n):
@@ -210,8 +214,15 @@ def _invoke(to: str, fn, args, kwargs, timeout):
         raise ValueError(f"unknown rpc worker {to!r}; known: {sorted(workers)}")
     info: WorkerInfo = workers[to]
     payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})))
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout or None) as conn:
+
+    def connect():
+        # retried: nothing has been sent yet, so a drop/refusal here is
+        # always safe to re-attempt (incl. injected rpc.connect faults)
+        fault_point(f"rpc.connect.{to}")
+        return socket.create_connection((info.ip, info.port),
+                                        timeout=timeout or None)
+
+    with _CONNECT_RETRY.call(connect, what=f"rpc connect {to}") as conn:
         conn.sendall(struct.pack("<Q", len(payload)) + payload)
         (size,) = struct.unpack("<Q", _read_full(conn, 8))
         ok, result = pickle.loads(_read_full(conn, size))
@@ -239,17 +250,16 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
 
 
 def _wait_keys(kv, keys, timeout, what):
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     for key in keys:
-        while True:
-            try:
-                if kv.get(key) is not None:
-                    break
-            except OSError:
-                pass  # transient store hiccup; retry
-            if time.time() > deadline:
-                raise TimeoutError(f"rpc {what} timed out waiting {key}")
-            time.sleep(0.05)
+        remaining = max(0.01, deadline - time.monotonic())
+        policy = RetryPolicy(deadline=remaining, base_delay=0.05,
+                             multiplier=1.0, max_delay=0.05)
+        try:
+            policy.until(lambda: kv.get(key), what=f"rpc {what}")
+        except TimeoutError:
+            raise TimeoutError(
+                f"rpc {what} timed out waiting {key}") from None
 
 
 def _barrier(timeout=_DEFAULT_RPC_TIMEOUT):
@@ -261,8 +271,13 @@ def _barrier(timeout=_DEFAULT_RPC_TIMEOUT):
                timeout, "shutdown barrier")
 
 
-def shutdown() -> None:
+def shutdown(timeout: float = _DEFAULT_RPC_TIMEOUT) -> None:
     """Barrier (so no in-flight request loses its executor), then stop.
+
+    Idempotent (a second call is a no-op) and bounded: every phase —
+    arrival barrier, executor drain, departure wait — fits inside
+    ``timeout``, so a DEAD peer degrades the exit into a timed-out barrier
+    plus local teardown instead of hanging this process forever.
 
     Two-phase: after the arrival barrier every rank posts a ``departed``
     key; the store host (rank 0) keeps the KV server alive until ALL peers
@@ -272,10 +287,25 @@ def shutdown() -> None:
     """
     if _state["workers"] is None:
         return
-    _barrier()
-    time.sleep(0.2)  # grace for requests accepted during the barrier
+    deadline = time.monotonic() + timeout
+    peers_alive = True
+    try:
+        _barrier(timeout=max(0.1, timeout / 2))
+    except (TimeoutError, OSError) as e:
+        # a crashed peer can't arrive; tear down locally instead of raising
+        # (the caller is exiting — there is nothing better it could do)
+        peers_alive = False
+        print(f"[rpc] shutdown barrier abandoned: {e}", flush=True)
+    if peers_alive:
+        time.sleep(0.2)  # grace for requests accepted during the barrier
     _state["server"].stop()
-    _state["pool"].shutdown(wait=True)
+    pool = _state["pool"]
+    try:
+        with_timeout(lambda: pool.shutdown(wait=True),
+                     max(0.1, deadline - time.monotonic()),
+                     "rpc executor drain")
+    except TimeoutError:
+        pool.shutdown(wait=False)  # in-flight calls to dead peers: abandon
     kv: KVClient = _state["kv"]
     me: WorkerInfo = _state["self"]
     ns = _namespace()
@@ -285,12 +315,14 @@ def shutdown() -> None:
     except OSError:
         pass
     if _state["kv_server"] is not None:
-        try:
-            _wait_keys(kv, [f"{ns}/departed/{r}"
-                            for r in range(_state["world"])],
-                       _DEFAULT_RPC_TIMEOUT, "departure")
-        except TimeoutError:
-            pass  # a crashed peer shouldn't wedge the host's exit
+        if peers_alive:
+            try:
+                _wait_keys(kv, [f"{ns}/departed/{r}"
+                                for r in range(_state["world"])],
+                           max(0.1, deadline - time.monotonic()),
+                           "departure")
+            except TimeoutError:
+                pass  # a crashed peer shouldn't wedge the host's exit
         _state["kv_server"].stop()
     _state.update(server=None, workers=None, self=None, kv=None,
                   kv_server=None, pool=None, world=0)

@@ -33,6 +33,12 @@ try:  # pallas TPU backend only exists on TPU-enabled jaxlibs
 except ImportError:  # pragma: no cover
     pltpu = None
 
+# older jax spells it TPUCompilerParams; a LOCAL alias (never mutate the
+# foreign pltpu namespace — other libraries version-sniff it)
+_CompilerParams = ((getattr(pltpu, "CompilerParams", None)
+                    or getattr(pltpu, "TPUCompilerParams", None))
+                   if pltpu is not None else None)
+
 def _operand_dtype(*refs):
     """Dot-operand dtype policy, decided over ALL of a kernel body's
     inputs at once: mixed-precision inputs (e.g. bf16 q/k with an f32
@@ -392,7 +398,7 @@ def _flash_fwd_impl(q, k, v, bias, seed, causal, dropout_p,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*args)
@@ -464,7 +470,7 @@ def _flash_bwd_impl(q, k, v, bias, seed, o, lse, do, causal, dropout_p,
         out_specs=dq_out_specs,
         out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*seed_args, q, k, v, *bias_args, do, lse, delta)
@@ -520,7 +526,7 @@ def _flash_bwd_impl(q, k, v, bias, seed, o, lse, do, causal, dropout_p,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*seed_args, q, k, v, *bias_args, do, lse, delta)

@@ -32,7 +32,7 @@ def test_groups_env_mode():
 
 
 def test_reduce_scatter_in_shard_map():
-    from jax import shard_map
+    from paddle_tpu.framework.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from paddle_tpu.distributed.mesh import init_mesh, set_mesh
@@ -116,7 +116,7 @@ def test_stream_module_and_entries():
     from paddle_tpu.distributed import stream
 
     # stream variants accept the knobs and delegate
-    from jax import shard_map
+    from paddle_tpu.framework.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from paddle_tpu.distributed.mesh import init_mesh, set_mesh

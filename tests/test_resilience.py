@@ -1,0 +1,399 @@
+"""Fault-tolerance layer tests: RetryPolicy semantics, deterministic fault
+injection through the KV store / RPC / PS client, elastic heartbeat health,
+and bounded rpc shutdown.
+
+Everything here is tier-1-safe by construction: seeded plans (no real
+randomness), deadline-bounded waits (no unbounded polls), and short
+injected delays (no sleep-and-hope synchronisation).
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch import KVClient, KVServer
+from paddle_tpu.distributed.launch.elastic import ElasticManager
+from paddle_tpu.distributed.resilience import (
+    CRASH_EXIT, FAULT_PLAN_ENV, FaultPlan, FaultRule, InjectedFault,
+    RetryPolicy, Unavailable, fault_point, with_timeout)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poll_until(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{what} not reached within {timeout}s")
+
+
+# ------------------------------------------------------------- RetryPolicy
+def test_retry_policy_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.01)
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_exhausted_attempts_reraises_original():
+    class MyError(ConnectionError):
+        pass
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.01)
+    calls = []
+    with pytest.raises(MyError):
+        policy.call(lambda: calls.append(1) or (_ for _ in ()).throw(
+            MyError("down")))
+    assert len(calls) == 3
+
+
+def test_retry_policy_deadline_raises_timeout_chained():
+    policy = RetryPolicy(deadline=0.15, base_delay=0.05)
+    with pytest.raises(TimeoutError) as ei:
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("dead")),
+                    what="unit op")
+    assert "unit op" in str(ei.value)
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_retry_policy_non_retryable_passes_through():
+    policy = RetryPolicy(max_attempts=5, base_delay=0.01,
+                         retryable=(ConnectionError,))
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("application error")
+
+    with pytest.raises(ValueError):
+        policy.call(bad)
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_policy_requires_a_bound():
+    with pytest.raises(ValueError):
+        RetryPolicy()  # unbounded loops are forbidden by construction
+
+
+def test_retry_policy_jitter_deterministic_given_seed():
+    a = RetryPolicy(max_attempts=9, base_delay=0.1, jitter=0.5, seed=42)
+    b = RetryPolicy(max_attempts=9, base_delay=0.1, jitter=0.5, seed=42)
+    sched_a = [d for d, _ in zip(a.delays(), range(8))]
+    sched_b = [d for d, _ in zip(b.delays(), range(8))]
+    assert sched_a == sched_b
+    assert max(sched_a) <= a.max_delay * 1.5  # jitter bounded
+    c = RetryPolicy(max_attempts=9, base_delay=0.1, jitter=0.5, seed=43)
+    assert sched_a != [d for d, _ in zip(c.delays(), range(8))]
+
+
+def test_retry_policy_until_polls_none_results():
+    state = {"n": 0}
+
+    def poll():
+        state["n"] += 1
+        return "ready" if state["n"] >= 3 else None
+
+    policy = RetryPolicy(deadline=5.0, base_delay=0.01, multiplier=1.0)
+    assert policy.until(poll) == "ready"
+    with pytest.raises(TimeoutError):
+        RetryPolicy(deadline=0.1, base_delay=0.02).until(lambda: None)
+
+
+def test_with_timeout():
+    assert with_timeout(lambda: 7, timeout=5.0) == 7
+    with pytest.raises(TimeoutError, match="slow thing"):
+        with_timeout(lambda: time.sleep(10), timeout=0.2, what="slow thing")
+    with pytest.raises(KeyError):
+        with_timeout(lambda: {}["missing"], timeout=5.0)
+
+
+# --------------------------------------------------------------- FaultPlan
+def test_fault_plan_counted_drops_and_site_matching():
+    plan = FaultPlan([FaultRule(site="kv.*", kind="drop", times=2)], seed=1)
+    with plan:
+        hits = 0
+        for _ in range(5):
+            try:
+                fault_point("kv.get")
+            except InjectedFault:
+                hits += 1
+        fault_point("rpc.connect.w0")  # non-matching site: never raises
+    assert hits == 2 and plan.fired[0] == 2
+    # outside the with-block the plan is inactive
+    fault_point("kv.get")
+
+
+def test_fault_plan_probabilistic_drops_replay_identically():
+    def run(seed):
+        plan = FaultPlan([{"site": "x", "kind": "drop", "times": None,
+                           "prob": 0.5}], seed=seed)
+        out = []
+        with plan:
+            for _ in range(32):
+                try:
+                    fault_point("x")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+        return out
+
+    a, b, c = run(7), run(7), run(8)
+    assert a == b          # same seed -> identical fault sequence
+    assert a != c          # different seed -> different sequence
+    assert 0 < sum(a) < 32  # actually probabilistic
+
+
+def test_fault_plan_partition_window():
+    plan = FaultPlan([{"site": "net", "kind": "partition", "after": 2,
+                       "times": 3}], seed=0)
+    outcomes = []
+    with plan:
+        for _ in range(8):
+            try:
+                fault_point("net")
+                outcomes.append("ok")
+            except InjectedFault:
+                outcomes.append("cut")
+    assert outcomes == ["ok", "ok", "cut", "cut", "cut", "ok", "ok", "ok"]
+
+
+def test_fault_plan_env_roundtrip_and_subprocess_inheritance(tmp_path):
+    """A plan active in the parent is inherited by subprocesses through
+    PT_FAULT_PLAN with identical deterministic behavior."""
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent("""
+        from paddle_tpu.distributed.resilience import (
+            InjectedFault, fault_point)
+        out = []
+        for _ in range(4):
+            try:
+                fault_point("kv.put")
+                out.append("ok")
+            except InjectedFault:
+                out.append("drop")
+        print(",".join(out), flush=True)
+    """))
+    plan = FaultPlan([{"site": "kv.put", "kind": "drop", "times": 2}],
+                     seed=5)
+    with plan:
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        assert FAULT_PLAN_ENV in env
+        out = subprocess.run([sys.executable, str(script)], env=env,
+                             capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip() == "drop,drop,ok,ok"
+
+
+def test_fault_plan_crash_kills_subprocess(tmp_path):
+    script = tmp_path / "crash.py"
+    script.write_text(textwrap.dedent("""
+        from paddle_tpu.distributed.resilience import fault_point
+        fault_point("boom")
+        print("survived", flush=True)
+    """))
+    plan = FaultPlan([{"site": "boom", "kind": "crash"}], seed=0)
+    with plan:
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, str(script)], env=env,
+                             capture_output=True, text=True, timeout=60)
+    assert out.returncode == CRASH_EXIT
+    assert "survived" not in out.stdout
+
+
+# ----------------------------------------------------- KV client under fault
+def test_kv_client_retries_injected_drops():
+    with KVServer(0, host="127.0.0.1") as server:
+        kv = KVClient(f"127.0.0.1:{server.port}",
+                      retry=RetryPolicy(max_attempts=4, base_delay=0.02))
+        plan = FaultPlan([{"site": "kv.put", "kind": "drop", "times": 2},
+                          {"site": "kv.get", "kind": "drop", "times": 1}],
+                         seed=3)
+        with plan:
+            kv.put("k", "v")          # 2 injected drops, then lands
+            assert kv.get("k") == "v"  # 1 injected drop, then lands
+        assert plan.fired == [2, 1]
+
+
+def test_kv_client_delay_fault_is_tolerated():
+    with KVServer(0, host="127.0.0.1") as server:
+        kv = KVClient(f"127.0.0.1:{server.port}",
+                      retry=RetryPolicy(max_attempts=3, base_delay=0.02))
+        plan = FaultPlan([{"site": "kv.get", "kind": "delay",
+                           "delay": 0.15, "times": 1}], seed=0)
+        with plan:
+            kv.put("d", "1")
+            t0 = time.monotonic()
+            assert kv.get("d") == "1"
+            assert time.monotonic() - t0 >= 0.15  # the delay really fired
+        assert plan.fired[0] == 1
+
+
+def test_kv_client_drop_beyond_retry_budget_surfaces():
+    with KVServer(0, host="127.0.0.1") as server:
+        kv = KVClient(f"127.0.0.1:{server.port}",
+                      retry=RetryPolicy(max_attempts=2, base_delay=0.02))
+        with FaultPlan([{"site": "kv.get", "kind": "partition",
+                         "times": None}], seed=0):
+            with pytest.raises(ConnectionError):
+                kv.get("anything")
+
+
+# ------------------------------------------------------------ RPC under fault
+def test_rpc_retries_injected_connect_drop():
+    from paddle_tpu.distributed import rpc
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{s.getsockname()[1]}"
+    rpc.init_rpc(name="solo", rank=0, world_size=1, master_endpoint=ep)
+    try:
+        plan = FaultPlan([{"site": "rpc.connect.*", "kind": "drop",
+                           "times": 1}], seed=2)
+        with plan:
+            assert rpc.rpc_sync("solo", int, args=(99,)) == 99
+        assert plan.fired[0] == 1  # the drop fired and was retried away
+    finally:
+        rpc.shutdown()
+
+
+def test_rpc_shutdown_idempotent():
+    from paddle_tpu.distributed import rpc
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{s.getsockname()[1]}"
+    rpc.init_rpc(name="solo", rank=0, world_size=1, master_endpoint=ep)
+    rpc.shutdown()
+    rpc.shutdown()  # second call: no-op, no error
+    rpc.shutdown(timeout=0.5)
+
+
+DEAD_PEER_WORKER = textwrap.dedent("""
+    import os, sys, time
+    from paddle_tpu.distributed import rpc
+
+    rank = int(sys.argv[1])
+    rpc.init_rpc(name=f"w{rank}", rank=rank, world_size=2,
+                 master_endpoint=sys.argv[2])
+    if rank == 1:
+        os._exit(0)  # dies without shutdown: no barrier key ever appears
+    t0 = time.monotonic()
+    rpc.shutdown(timeout=3.0)  # must NOT hang on the dead peer
+    took = time.monotonic() - t0
+    assert took < 20.0, f"shutdown took {took}s"
+    print(f"SHUTDOWN_OK {took:.2f}", flush=True)
+""")
+
+
+def test_rpc_shutdown_bounded_with_dead_peer(tmp_path):
+    """A peer that dies without reaching the exit barrier must not hang the
+    survivor: shutdown abandons the barrier at its deadline and tears down
+    locally."""
+    script = tmp_path / "w.py"
+    script.write_text(DEAD_PEER_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{s.getsockname()[1]}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), ep],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    out0, _ = procs[0].communicate(timeout=120)
+    procs[1].wait(timeout=10)
+    assert procs[0].returncode == 0, out0[-3000:]
+    assert "SHUTDOWN_OK" in out0
+
+
+# ------------------------------------------------------- PS client under fault
+def test_ps_client_retries_injected_drop():
+    from paddle_tpu.distributed.ps import (PsClient, PsServer,
+                                           SparseAccessorConfig)
+
+    server = PsServer(SparseAccessorConfig(embed_dim=4, optimizer="sgd",
+                                           learning_rate=1.0, seed=11))
+    client = PsClient([("127.0.0.1", server.port)], embed_dim=4,
+                      retries=3, retry_delay=0.02)
+    try:
+        import numpy as np
+
+        keys = np.array([1, 2, 3], np.int64)
+        plan = FaultPlan([{"site": "ps.request.*", "kind": "drop",
+                           "times": 2}], seed=9)
+        with plan:
+            rows = client.pull(keys)
+        assert rows.shape == (3, 4)
+        assert plan.fired[0] == 2
+        # beyond the budget the original transport error surfaces
+        with FaultPlan([{"site": "ps.request.*", "kind": "partition",
+                         "times": None}], seed=0):
+            with pytest.raises(ConnectionError):
+                client.pull(keys)
+    finally:
+        client.close()
+        server.stop()
+
+
+# ------------------------------------------------------ elastic heartbeat
+def test_elastic_heartbeat_health_and_recovery():
+    server = KVServer(0, host="127.0.0.1")
+    server.start()
+    port = server.port
+    mgr = ElasticManager(f"127.0.0.1:{port}", "hjob", "node-x", ttl=1.0)
+    try:
+        mgr.register()
+        assert mgr.is_healthy() and mgr.last_error is None
+        # KV store goes away: beats fail, health must flip within ~ttl
+        server.stop()
+        _poll_until(lambda: not mgr.is_healthy(), timeout=10.0,
+                    what="unhealthy after KV loss")
+        assert mgr.last_error is not None  # surfaced, not swallowed
+        # store returns on the same port: health recovers
+        server = KVServer(port, host="127.0.0.1")
+        server.start()
+        _poll_until(mgr.is_healthy, timeout=10.0,
+                    what="healthy after KV recovery")
+        assert mgr.last_error is None
+    finally:
+        mgr.leave()
+        try:
+            server.stop()
+        except Exception:
+            pass
+
+
+def test_elastic_heartbeat_survives_injected_faults():
+    """Counted heartbeat drops: the first tick fails both its attempts
+    (surfacing last_error — never a dead thread), the next tick absorbs
+    the remaining drop through its retry budget and heals."""
+    with KVServer(0, host="127.0.0.1") as server:
+        mgr = ElasticManager(f"127.0.0.1:{server.port}", "hjob2", "node-y",
+                             ttl=1.0)
+        plan = FaultPlan([{"site": "elastic.heartbeat", "kind": "drop",
+                           "times": 3}], seed=4)
+        with plan:
+            mgr.register()
+            # tick 1: drop+drop -> tick fails, error recorded, thread lives
+            _poll_until(lambda: mgr.last_error is not None, timeout=10.0,
+                        what="heartbeat error surfaced")
+            assert mgr._thread.is_alive()
+            # tick 2: drop+success -> healed, error cleared
+            _poll_until(lambda: mgr.last_error is None, timeout=10.0,
+                        what="heartbeat recovered")
+            assert plan.fired[0] == 3
+            assert mgr.is_healthy()
+        mgr.leave()

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Fault-matrix sweep: run each distributed scenario under every injected
+fault kind and print a pass/fail table.
+
+Scenarios (each runs in a fresh subprocess so ``crash`` faults can kill it):
+
+- ``kv``   — KV store put/get/delete through a retrying ``KVClient``
+- ``rpc``  — single-world ``init_rpc`` + ``rpc_sync`` + bounded shutdown
+- ``ckpt`` — two checkpoint saves + verified restore from the newest VALID
+  checkpoint (faults may fail a save; they must never corrupt the root)
+
+Expected outcomes by kind:
+
+- ``drop``/``delay`` — the scenario retries/absorbs the fault and exits 0
+  (for ``ckpt``, a failed save is fine as long as restore stays valid);
+- ``crash`` — the process dies with ``CRASH_EXIT``, and a clean re-run
+  against the same state recovers (resume-after-crash).
+
+Deterministic: seeded plans, counted faults, bounded deadlines. Exit code
+is non-zero iff any cell fails, so CI can gate on it. Usage::
+
+    python tools/fault_sweep.py            # the full matrix
+    python tools/fault_sweep.py --scenario kv   # internal: one scenario
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.distributed.resilience import CRASH_EXIT, FaultPlan  # noqa: E402
+
+
+# --------------------------------------------------------------- scenarios
+def scenario_kv() -> None:
+    from paddle_tpu.distributed.launch.kv_server import KVClient, KVServer
+    from paddle_tpu.distributed.resilience import RetryPolicy
+
+    with KVServer(0, host="127.0.0.1") as server:
+        kv = KVClient(f"127.0.0.1:{server.port}",
+                      retry=RetryPolicy(max_attempts=5, base_delay=0.05))
+        kv.put("sweep/a", "1")
+        assert kv.get("sweep/a") == "1"
+        kv.delete("sweep/a")
+        assert kv.get("sweep/a") is None
+
+
+def scenario_rpc() -> None:
+    import socket
+
+    from paddle_tpu.distributed import rpc
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{s.getsockname()[1]}"
+    rpc.init_rpc(name="solo", rank=0, world_size=1, master_endpoint=ep)
+    assert rpc.rpc_sync("solo", int, args=(7,)) == 7
+    rpc.shutdown(timeout=10.0)
+
+
+def scenario_ckpt() -> None:
+    import numpy as np
+
+    from paddle_tpu.distributed.checkpoint import (
+        latest_checkpoint, load_state, save_state)
+
+    root = os.environ["SWEEP_CKPT_ROOT"]
+    done = latest_checkpoint(root)
+    if done is None:  # first run (fault plans skip this save via "after")
+        save_state({"w": np.full((16, 16), 1.0, np.float32), "step": 1},
+                   os.path.join(root, "step_1"))
+    try:
+        save_state({"w": np.full((16, 16), 2.0, np.float32), "step": 2},
+                   os.path.join(root, "step_2"))
+    except ConnectionError:
+        pass  # an injected drop may fail the save — that is allowed...
+    best = latest_checkpoint(root)  # ...a corrupted/torn root is NOT
+    assert best is not None, "no valid checkpoint left behind"
+    state = load_state(best)        # checksum-verified
+    assert state["step"] in (1, 2)
+
+
+SCENARIOS = {"kv": scenario_kv, "rpc": scenario_rpc, "ckpt": scenario_ckpt}
+
+MATRIX = [
+    ("kv", "kv.put"),
+    ("kv", "kv.get"),
+    ("rpc", "rpc.connect.*"),
+    ("ckpt", "ckpt.shard_write"),
+    ("ckpt", "ckpt.publish"),
+]
+KINDS = ("drop", "delay", "crash")
+
+
+def _make_plan(site: str, kind: str) -> FaultPlan:
+    # ckpt rules skip the first save (1 shard write + 1 publish) so the
+    # fault lands on the SECOND checkpoint and fallback is observable
+    after = 1 if site.startswith("ckpt") else 0
+    return FaultPlan([{"site": site, "kind": kind,
+                       "times": 1 if kind == "crash" else 2,
+                       "delay": 0.2, "after": after}], seed=1234)
+
+
+def _run_child(scenario: str, env: dict) -> subprocess.CompletedProcess:
+    # stderr merged into stdout: failure details (tracebacks) land in the
+    # table instead of vanishing
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scenario", scenario],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300)
+
+
+def run_cell(scenario: str, site: str, kind: str):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env["PT_FAULT_PLAN"] = _make_plan(site, kind).to_json()
+    with tempfile.TemporaryDirectory(prefix="fault_sweep_") as workdir:
+        env["SWEEP_CKPT_ROOT"] = workdir
+        p = _run_child(scenario, env)
+        if kind == "crash":
+            if p.returncode != CRASH_EXIT:
+                return False, (f"expected crash exit {CRASH_EXIT}, got "
+                               f"{p.returncode}: {p.stdout[-200:]}")
+            env.pop("PT_FAULT_PLAN")
+            p2 = _run_child(scenario, env)  # same state dir: must recover
+            if p2.returncode != 0:
+                return False, (f"crashed but recovery failed "
+                               f"rc={p2.returncode}: {p2.stdout[-200:]}")
+            return True, "crashed with CRASH_EXIT, clean re-run recovered"
+        if p.returncode != 0:
+            return False, f"rc={p.returncode}: {p.stdout[-200:]}"
+        return True, "survived injected faults"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS))
+    args = ap.parse_args()
+    if args.scenario:  # child mode
+        SCENARIOS[args.scenario]()
+        return 0
+
+    rows, failed = [], 0
+    for scenario, site in MATRIX:
+        for kind in KINDS:
+            t0 = time.monotonic()
+            ok, detail = run_cell(scenario, site, kind)
+            rows.append((scenario, site, kind,
+                         "PASS" if ok else "FAIL",
+                         f"{time.monotonic() - t0:5.1f}s  {detail}"))
+            failed += 0 if ok else 1
+            print(f"[{len(rows)}/{len(MATRIX) * len(KINDS)}] "
+                  f"{scenario:5s} {site:18s} {kind:6s} "
+                  f"{'PASS' if ok else 'FAIL'}", flush=True)
+
+    print()
+    print(f"{'scenario':8s} {'site':18s} {'kind':6s} {'result':6s} detail")
+    print("-" * 78)
+    for r in rows:
+        print(f"{r[0]:8s} {r[1]:18s} {r[2]:6s} {r[3]:6s} {r[4]}")
+    print("-" * 78)
+    print(f"{len(rows) - failed}/{len(rows)} cells passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
