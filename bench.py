@@ -314,12 +314,37 @@ def bench_gpt_primary(on_tpu: bool):
     ids = np.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), np.int32)
     dt, final_loss = _timed_steps(step, (ids, ids), timed=timed_steps,
                                   warmup=warmup)
+
+    # input-pipeline probe: stream FRESH host buffers through the async
+    # H2D prefetch path (io/device_prefetch.py) so the JSON records whether
+    # the step is input-bound (stall ~ 0 <=> transfer fully overlapped) and
+    # shape-stable (compile_count must not grow while streaming)
+    from paddle_tpu.io.device_prefetch import prefetch_to_device
+
+    probe_steps = 8
+    pf = prefetch_to_device(
+        ((np.array(ids), np.array(ids)) for _ in range(probe_steps)),
+        depth=2)
+    for b in pf:
+        loss = step(b)
+    float(np.asarray(loss))
+    pf_stats = pf.stats()
+    pf.close()
+    pipeline = {
+        "compile_count": step.cache_stats()["compiles"],
+        "step_calls": step.cache_stats()["calls"],
+        "input_stall_s": round(pf_stats["consumer_stall_s"], 4),
+        "input_stall_per_step_ms": round(
+            pf_stats["consumer_stall_s"] / max(pf_stats["batches"], 1) * 1e3,
+            3),
+        "prefetch_batches": pf_stats["batches"],
+    }
     del step, model, opt
 
     tokens_per_sec = batch * seq * timed_steps / dt
     flops_per_token = gpt_flops_per_token(cfg, seq)
     mfu = tokens_per_sec * flops_per_token / _chip_peak_flops()
-    return tokens_per_sec, mfu, cfg, batch, seq, final_loss
+    return tokens_per_sec, mfu, cfg, batch, seq, final_loss, pipeline
 
 
 def _release_device_memory():
@@ -620,7 +645,7 @@ def _run_benches(backend: str):
         return child_deadline - time.monotonic()
 
     on_tpu = backend == "tpu"
-    tokens_per_sec, mfu, cfg, batch, seq, final_loss = \
+    tokens_per_sec, mfu, cfg, batch, seq, final_loss, pipeline = \
         bench_gpt_primary(on_tpu)
     _release_device_memory()
 
@@ -636,6 +661,10 @@ def _run_benches(backend: str):
             "config": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
                        "batch": batch, "seq": seq},
             "final_loss": final_loss,
+            # shape stability + input-boundness of the flagship step
+            # (framework/compile_cache.py + io/device_prefetch.py)
+            "compile_count": pipeline["compile_count"],
+            "input_pipeline": pipeline,
         },
     }
     # flush the primary record NOW: a tunnel hang inside a breadth bench

@@ -51,16 +51,55 @@ def main():
     # fused with the head projection when cfg.loss_chunk is set)
     step = pt.TrainStep(model, opt, loss_fn=None)
 
+    # recompile-proof input pipeline: documents yield VARIABLE-length token
+    # runs and the corpus size leaves a ragged tail batch — exactly the
+    # stream that would retrace XLA once per novel shape. The loader's
+    # pad_batches/length_buckets bound the shape set, and the async device
+    # prefetch overlaps the host->HBM hop with the running step.
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    n_docs = batch * args.steps + batch // 2      # ragged tail on purpose
+    lengths = (seq // 2, seq)   # two buckets: enough to show the policy
+                                # without a third demo-only XLA compile
+
+    class TokenDocs(pt.io.Dataset):
+        def __len__(self):
+            return n_docs
+
+        def __getitem__(self, i):
+            L = lengths[(i // batch) % len(lengths)]
+            ids = rng.integers(0, cfg.vocab_size, L).astype(np.int32)
+            return ids, ids  # (input ids, labels)
+
+    loader = pt.io.DataLoader(TokenDocs(), batch_size=batch, shuffle=False,
+                              pad_batches=True,
+                              length_buckets=lengths)
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        loss = step((ids, ids))
-        if i % 5 == 0 or i == args.steps - 1:
-            print(f"step {i:4d}  loss {float(loss):.4f}")
+    tokens = 0
+    i = 0
+    prefetch = pt.io.prefetch_to_device(iter(loader), depth=2)
+    from contextlib import ExitStack
+
+    with ExitStack() as stack:  # guard + prefetch released on ANY exit
+        stack.callback(prefetch.close)
+        for ids_b, labels_b, valid in prefetch:
+            loss = step((ids_b, labels_b))
+            tokens += int(np.prod(ids_b.shape))
+            if i % 5 == 0:
+                print(f"step {i:4d}  loss {float(loss):.4f}  "
+                      f"shape {tuple(ids_b.shape)}  "
+                      f"valid {int(np.asarray(valid).sum())}")
+            i += 1
+            if i == len(lengths):
+                # warmup traced one program per bucket; from here on any
+                # recompile is a pipeline bug — fail loudly
+                stack.enter_context(
+                    pt.framework.compile_cache.retrace_guard(max_compiles=0))
     dt = time.perf_counter() - t0
-    print(f"{batch * seq * args.steps / dt:,.0f} tokens/s "
-          f"(incl. compile) on {pt.get_device()}")
+    stats = step.cache_stats()
+    print(f"{tokens / dt:,.0f} tokens/s (incl. compile) on {pt.get_device()}")
+    print(f"compiled {stats['compiles']} program(s) over {stats['calls']} "
+          f"steps (cache hits {stats['cache_hits']}); "
+          f"h2d stall {prefetch.stats()['consumer_stall_s'] * 1e3:.0f}ms")
 
     # checkpoint + resume
     step.sync_to_model()

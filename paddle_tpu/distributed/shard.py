@@ -181,7 +181,12 @@ class DistributedTrainStep:
                     NamedSharding(self.mesh, self.specs[k]))
                 for k, v in self.params.items()}
         donate_argnums = (0, 1, 2, 3) if donate else ()
-        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums,
+        from ..framework import compile_cache
+
+        self._cc_name = compile_cache.register_name(
+            f"DistributedTrainStep:{type(model).__name__}")
+        self._traced = compile_cache.instrument(self._step, self._cc_name)
+        self._compiled = jax.jit(self._traced, donate_argnums=donate_argnums,
                                  static_argnames=("do_update",))
         self._donate_argnums = donate_argnums
         self._compiled_checked = None
@@ -191,9 +196,14 @@ class DistributedTrainStep:
 
         if self._compiled_checked is None:
             self._compiled_checked = jax.jit(
-                functools.partial(self._step, with_check=True),
+                functools.partial(self._traced, with_check=True),
                 donate_argnums=self._donate_argnums)
         return self._compiled_checked
+
+    def cache_stats(self) -> dict:
+        from ..framework import compile_cache
+
+        return compile_cache.cache_stats(self._cc_name)
 
     def _shard_opt_state(self, opt_state):
         out = {}
@@ -248,7 +258,7 @@ class DistributedTrainStep:
         return loss, new_params, new_buffers, new_opt_state, accum
 
     def __call__(self, batch):
-        from ..framework import flags
+        from ..framework import compile_cache, flags
         from ..framework.jit import raise_if_bad_step
 
         batch = jax.tree.map(
@@ -258,6 +268,7 @@ class DistributedTrainStep:
         self._count += 1
         do_update = (self.grad_accum_steps <= 1
                      or self._count % self.grad_accum_steps == 0)
+        compile_cache.record_call(self._cc_name)
         with self.mesh:
             if flags.flag("FLAGS_check_nan_inf") and do_update:
                 loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \

@@ -1,1 +1,1 @@
-from . import dtype, io, jit, random  # noqa: F401
+from . import compile_cache, dtype, io, jit, random  # noqa: F401

@@ -1,0 +1,273 @@
+"""Compile accounting + persistent XLA compilation cache.
+
+On TPU the executor is XLA, so the silent killer of steady-state
+throughput is the *retrace*: a novel input shape/dtype re-runs tracing and
+backend compilation (seconds to minutes) in the middle of what should be a
+microseconds dispatch. This module makes that cost visible and bounded:
+
+- every ``framework.jit`` / ``TrainStep`` / ``EvalStep`` program is
+  *instrumented*: each trace (== each distinct compiled specialization)
+  bumps a counter keyed by the function's registered name and records the
+  abstract ``(shape, dtype)`` signature that caused it;
+- :func:`cache_stats` exposes compiles / calls / cache hits / the last
+  trace signature, per function and in aggregate — the number BENCH and
+  the tier-1 tests assert on;
+- :func:`retrace_guard` is a context manager for the steady state: after
+  warmup, wrap the training loop and any recompile beyond the declared
+  budget warns or raises :class:`RetraceError` *at trace time*, naming the
+  offending function and signature;
+- :func:`enable_persistent_cache` wires jax's persistent compilation cache
+  (``FLAGS_persistent_compile_cache`` / ``FLAGS_compile_cache_dir``), so
+  a restarted process pays tracing but not backend compilation.
+
+Trace count is the retrace signal, not XLA's internal executable cache:
+a trace is exactly one new specialization from the framework's point of
+view, and it is observable portably (the Python body runs once per trace).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import itertools
+import os
+import threading
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "RetraceError", "cache_stats", "reset_stats", "instrument",
+    "register_name", "retrace_guard", "enable_persistent_cache",
+    "initialize_from_flags",
+]
+
+
+class RetraceError(RuntimeError):
+    """An XLA recompile happened inside a :func:`retrace_guard` window."""
+
+
+class _Entry:
+    __slots__ = ("compiles", "calls", "signatures", "last_trace_signature")
+
+    def __init__(self):
+        self.compiles = 0
+        self.calls = 0
+        self.signatures: Dict[str, int] = {}
+        self.last_trace_signature: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        return {"compiles": self.compiles, "calls": self.calls,
+                "cache_hits": max(self.calls - self.compiles, 0),
+                "signatures": dict(self.signatures),
+                "last_trace_signature": self.last_trace_signature}
+
+
+_lock = threading.RLock()
+_entries: Dict[str, _Entry] = {}
+_name_serial = itertools.count()
+_guards: list = []  # active retrace_guard frames (innermost last)
+_last_trace_signature: Optional[str] = None
+
+
+def register_name(base: str) -> str:
+    """A unique stats key (``base`` + serial) for per-instance tracking."""
+    return f"{base}#{next(_name_serial)}"
+
+
+def _leaf_sig(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}{tuple(shape)}"
+    return repr(x)
+
+
+def abstract_signature(args, kwargs) -> str:
+    """shape/dtype signature of a call — stable across values, sensitive to
+    exactly what forces a retrace (shapes, dtypes, static values)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    return "(" + ", ".join(_leaf_sig(leaf) for leaf in leaves) + ")"
+
+
+def _entry(name: str) -> _Entry:
+    with _lock:
+        e = _entries.get(name)
+        if e is None:
+            e = _entries[name] = _Entry()
+        return e
+
+
+def record_trace(name: str, signature: str) -> None:
+    """Called from inside a traced body: one new specialization exists."""
+    global _last_trace_signature
+    with _lock:
+        e = _entry(name)
+        e.compiles += 1
+        e.signatures[signature] = e.signatures.get(signature, 0) + 1
+        e.last_trace_signature = signature
+        _last_trace_signature = signature
+        guards = list(_guards)
+    for g in guards:
+        g._on_trace(name, signature)
+
+
+def record_call(name: str) -> None:
+    with _lock:
+        _entry(name).calls += 1
+
+
+def cache_stats(name: Optional[str] = None) -> dict:
+    """Compile/call counters.
+
+    ``cache_stats()`` aggregates every instrumented program:
+    ``{"compiles", "calls", "cache_hits", "last_trace_signature",
+    "functions": {name: per-function dict}}``. ``cache_stats(name)``
+    returns one function's dict (zeros if it never ran).
+    """
+    with _lock:
+        if name is not None:
+            e = _entries.get(name)
+            return e.as_dict() if e is not None else _Entry().as_dict()
+        compiles = sum(e.compiles for e in _entries.values())
+        calls = sum(e.calls for e in _entries.values())
+        return {"compiles": compiles, "calls": calls,
+                "cache_hits": max(calls - compiles, 0),
+                "last_trace_signature": _last_trace_signature,
+                "functions": {n: e.as_dict() for n, e in _entries.items()}}
+
+
+def reset_stats() -> None:
+    with _lock:
+        _entries.clear()
+        global _last_trace_signature
+        _last_trace_signature = None
+
+
+def instrument(fn: Callable, name: Optional[str] = None) -> Callable:
+    """Wrap ``fn`` for ``jax.jit`` so each TRACE is recorded.
+
+    The wrapper's body executes exactly once per specialization (that is
+    what tracing is), so it is the portable retrace probe. The trace runs
+    under a ``compile`` profiler span; pair with :func:`record_call` at the
+    dispatch site for hit-rate accounting. The stats key is attached as
+    ``wrapped.__cc_name__``.
+    """
+    key = name or register_name(getattr(fn, "__name__", "jit_fn"))
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        from ..profiler import RecordEvent
+
+        record_trace(key, abstract_signature(args, kwargs))
+        with RecordEvent("compile"):
+            return fn(*args, **kwargs)
+
+    wrapped.__cc_name__ = key
+    return wrapped
+
+
+class _Guard:
+    def __init__(self, max_compiles: int, action: str, label: str):
+        self.max_compiles = int(max_compiles)
+        self.action = action
+        self.label = label
+        self.seen: list = []  # (name, signature) of traces in the window
+
+    def _on_trace(self, name: str, signature: str):
+        self.seen.append((name, signature))
+        if len(self.seen) <= self.max_compiles:
+            return
+        msg = (f"retrace_guard({self.label}): {len(self.seen)} compile(s) "
+               f"inside a window budgeted for {self.max_compiles}; "
+               f"latest: {name} traced for {signature}. An unstable input "
+               f"shape is recompiling the step — pad/bucket the pipeline "
+               f"(DataLoader(pad_batches=..., length_buckets=...)).")
+        if self.action == "raise":
+            raise RetraceError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+
+@contextlib.contextmanager
+def retrace_guard(max_compiles: int = 0, action: str = "raise",
+                  label: str = "steady-state"):
+    """Bound compiles inside the ``with`` block.
+
+    Enter it AFTER warmup: any trace of an instrumented program beyond
+    ``max_compiles`` raises :class:`RetraceError` (``action="raise"``) or
+    emits a ``RuntimeWarning`` (``action="warn"``) the moment it happens,
+    naming the function and the shape signature that caused it.
+    """
+    if action not in ("raise", "warn"):
+        raise ValueError(f"action must be 'raise' or 'warn', got {action!r}")
+    g = _Guard(max_compiles, action, label)
+    with _lock:
+        _guards.append(g)
+    try:
+        yield g
+    finally:
+        with _lock:
+            _guards.remove(g)
+
+
+# ------------------------------------------------- persistent XLA cache
+_persistent_dir: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            min_compile_secs: Optional[float] = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Subsequent processes that compile an identical program (same HLO,
+    flags, backend) load the executable from disk instead of recompiling.
+    Returns the directory in use. Safe to call repeatedly.
+    """
+    global _persistent_dir
+    from . import flags
+
+    import jax
+
+    cache_dir = (cache_dir or flags.flag("FLAGS_compile_cache_dir")
+                 or os.path.join(os.path.expanduser("~"), ".cache",
+                                 "paddle_tpu", "xla"))
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    if min_compile_secs is None:
+        min_compile_secs = flags.flag(
+            "FLAGS_persistent_cache_min_compile_secs")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for opt, val in (
+            ("jax_persistent_cache_min_compile_time_secs",
+             float(min_compile_secs)),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except AttributeError:  # knob not present on this jax
+            pass
+    try:  # older jax needs the explicit initializer as well
+        from jax.experimental.compilation_cache import compilation_cache as cc
+
+        if hasattr(cc, "set_cache_dir"):
+            cc.set_cache_dir(cache_dir)
+    except Exception:
+        pass
+    _persistent_dir = cache_dir
+    return cache_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory wired by :func:`enable_persistent_cache`, else None."""
+    return _persistent_dir
+
+
+def initialize_from_flags() -> None:
+    """Honor ``FLAGS_persistent_compile_cache`` at import (env-settable:
+    ``FLAGS_persistent_compile_cache=1 python train.py``)."""
+    from . import flags
+
+    if flags.flag("FLAGS_persistent_compile_cache"):
+        enable_persistent_cache()
+
+
+initialize_from_flags()

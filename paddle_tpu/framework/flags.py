@@ -17,6 +17,11 @@ _DEFAULTS: Dict[str, Any] = {
     # live flags (consumed by the framework)
     "FLAGS_check_nan_inf": False,          # per-step numeric checks (TrainStep)
     "FLAGS_profile_host_events": True,     # host RecordEvent capture (profiler)
+    # persistent XLA compile cache (framework/compile_cache.py): warm
+    # processes skip backend compilation for programs already on disk
+    "FLAGS_persistent_compile_cache": False,
+    "FLAGS_compile_cache_dir": "",         # "" -> ~/.cache/paddle_tpu/xla
+    "FLAGS_persistent_cache_min_compile_secs": 0.0,
     # accepted-but-inert (XLA/jax own these concerns on TPU; XLA:TPU is
     # deterministic by default, verbosity goes through absl/glog env)
     "FLAGS_v": 0,

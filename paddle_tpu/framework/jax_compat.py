@@ -21,7 +21,8 @@ import inspect as _inspect
 _KWARG = ("check_vma" if "check_vma"
           in _inspect.signature(_shard_map).parameters else "check_rep")
 
-__all__ = ["shard_map", "axis_size", "pcast"]
+__all__ = ["shard_map", "axis_size", "pcast",
+           "make_array_from_process_local_data"]
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
@@ -54,3 +55,18 @@ except ImportError:
         """Pre-VMA jax has no varying/invariant distinction inside
         shard_map — the cast is the identity."""
         return t
+
+
+try:  # jax >= 0.4.26: per-host slice -> global sharded array, no
+    # replicated staging copy on the way to the GSPMD layout
+    from jax import (  # type: ignore[attr-defined]
+        make_array_from_process_local_data,
+    )
+except ImportError:
+    def make_array_from_process_local_data(sharding, local_data,
+                                           global_shape=None):
+        """Older jax: land the host batch through device_put — correct
+        (single-process: local IS global) but via a replicated copy."""
+        import jax
+
+        return jax.device_put(local_data, sharding)

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from . import compile_cache
 from . import random as framework_random
 from ..nn.layer import Layer, buffer_state, functional_call, param_state
 
@@ -77,22 +78,40 @@ def jit(fn=None, *, static_argnums=(), static_argnames=(), donate_argnums=()):
                                  donate_argnums=donate_argnums)
     if isinstance(fn, Layer):
         layer = fn
+        cc_name = compile_cache.register_name(
+            f"jit:{type(layer).__name__}")
 
-        params = param_state(layer)
-        buffers = buffer_state(layer)
-
-        @jax.jit
         def _run(p, b, *args, **kwargs):
             out, _ = functional_call(layer, p, b, *args, **kwargs)
             return out
 
+        _compiled = jax.jit(compile_cache.instrument(_run, cc_name))
+
         def wrapped(*args, **kwargs):
-            return _run(param_state(layer), buffer_state(layer), *args, **kwargs)
+            compile_cache.record_call(cc_name)
+            return _compiled(param_state(layer), buffer_state(layer),
+                             *args, **kwargs)
 
         wrapped.__wrapped_layer__ = layer
+        wrapped.__cc_name__ = cc_name
+        wrapped.cache_stats = lambda: compile_cache.cache_stats(cc_name)
         return wrapped
-    return jax.jit(fn, static_argnums=static_argnums, static_argnames=static_argnames,
-                   donate_argnums=donate_argnums)
+    cc_name = compile_cache.register_name(
+        f"jit:{getattr(fn, '__name__', 'fn')}")
+    compiled = jax.jit(compile_cache.instrument(fn, cc_name),
+                       static_argnums=static_argnums,
+                       static_argnames=static_argnames,
+                       donate_argnums=donate_argnums)
+
+    @functools.wraps(fn)
+    def dispatch(*args, **kwargs):
+        compile_cache.record_call(cc_name)
+        return dispatch.__jit__(*args, **kwargs)
+
+    dispatch.__jit__ = compiled   # escape hatch: .lower()/.eval_shape()
+    dispatch.__cc_name__ = cc_name
+    dispatch.cache_stats = lambda: compile_cache.cache_stats(cc_name)
+    return dispatch
 
 
 def finite_guard(grads, new_state, old_state):
@@ -168,8 +187,13 @@ class TrainStep:
             self._grad_accum = jax.tree.map(
                 lambda x: jnp.zeros(x.shape, _grad_dtype(x.dtype)), self.params)
         donate_argnums = (0, 1, 2, 3) if donate else ()
+        # retrace accounting: every new shape specialization of the step is
+        # recorded under this key (see framework/compile_cache.py)
+        self._cc_name = compile_cache.register_name(
+            f"{type(self).__name__}:{type(model).__name__}")
+        self._traced = compile_cache.instrument(self._step, self._cc_name)
         # two specializations when accumulating: accumulate-only / apply
-        self._compiled = jax.jit(self._step, donate_argnums=donate_argnums,
+        self._compiled = jax.jit(self._traced, donate_argnums=donate_argnums,
                                  static_argnames=("do_update",))
         # FLAGS_check_nan_inf variant: also reduces grads/params finiteness
         # in-graph (framework/debugging.py) — compiled on first use
@@ -213,31 +237,40 @@ class TrainStep:
     def _checked_compiled(self):
         if self._compiled_checked is None:
             self._compiled_checked = jax.jit(
-                functools.partial(self._step, with_check=True),
+                functools.partial(self._traced, with_check=True),
                 donate_argnums=self._donate_argnums)
         return self._compiled_checked
+
+    def cache_stats(self) -> dict:
+        """Compile/call counters for this step's program: ``{"compiles",
+        "calls", "cache_hits", "signatures", "last_trace_signature"}``."""
+        return compile_cache.cache_stats(self._cc_name)
 
     def __call__(self, batch):
         import numpy as np
 
         from . import flags
+        from ..profiler import RecordEvent
 
         count = np.uint32(self._count)
         self._count += 1
         do_update = (self.grad_accum_steps <= 1
                      or self._count % self.grad_accum_steps == 0)
-        if flags.flag("FLAGS_check_nan_inf") and do_update:
-            loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
-                self._checked_compiled()(self.params, self.buffers,
-                                         self.opt_state, self._grad_accum,
-                                         batch, self._base_key, count)
-            raise_if_bad_step(ok, loss)
+        compile_cache.record_call(self._cc_name)
+        with RecordEvent("step"):
+            if flags.flag("FLAGS_check_nan_inf") and do_update:
+                loss, self.params, self.buffers, self.opt_state, \
+                    self._grad_accum, ok = \
+                    self._checked_compiled()(self.params, self.buffers,
+                                             self.opt_state, self._grad_accum,
+                                             batch, self._base_key, count)
+                raise_if_bad_step(ok, loss)
+                return loss
+            loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
+                self._compiled(self.params, self.buffers, self.opt_state,
+                               self._grad_accum, batch, self._base_key, count,
+                               do_update=do_update)
             return loss
-        loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
-            self._compiled(self.params, self.buffers, self.opt_state,
-                           self._grad_accum, batch, self._base_key, count,
-                           do_update=do_update)
-        return loss
 
     # ----------------------------------------------------------- state sync
     def sync_to_model(self):
@@ -273,13 +306,19 @@ class TrainStep:
 class EvalStep:
     def __init__(self, model: Layer):
         self.model = model
+        self._cc_name = compile_cache.register_name(
+            f"EvalStep:{type(model).__name__}")
 
-        @jax.jit
         def _run(params, buffers, *args):
             out, _ = functional_call(model, params, buffers, *args)
             return out
 
-        self._compiled = _run
+        self._compiled = jax.jit(
+            compile_cache.instrument(_run, self._cc_name))
+
+    def cache_stats(self) -> dict:
+        return compile_cache.cache_stats(self._cc_name)
 
     def __call__(self, *args):
+        compile_cache.record_call(self._cc_name)
         return self._compiled(param_state(self.model), buffer_state(self.model), *args)

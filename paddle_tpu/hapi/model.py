@@ -81,34 +81,90 @@ class _HapiTrainStep(TrainStep):
         return loss, out, new_params, new_buffers, new_opt_state, accum
 
     def __call__(self, batch):
-        from ..framework import flags
+        from ..framework import compile_cache, flags
         from ..framework.jit import raise_if_bad_step
+        from ..profiler import RecordEvent
 
         count = np.uint32(self._count)
         self._count += 1
         do_update = (self.grad_accum_steps <= 1
                      or self._count % self.grad_accum_steps == 0)
-        if flags.flag("FLAGS_check_nan_inf") and do_update:
-            loss, out, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
-                self._checked_compiled()(self.params, self.buffers,
-                                         self.opt_state, self._grad_accum,
-                                         batch, self._base_key, count)
-            raise_if_bad_step(ok, loss)
+        compile_cache.record_call(self._cc_name)
+        with RecordEvent("step"):
+            if flags.flag("FLAGS_check_nan_inf") and do_update:
+                loss, out, self.params, self.buffers, self.opt_state, \
+                    self._grad_accum, ok = \
+                    self._checked_compiled()(self.params, self.buffers,
+                                             self.opt_state, self._grad_accum,
+                                             batch, self._base_key, count)
+                raise_if_bad_step(ok, loss)
+                return loss, out
+            loss, out, self.params, self.buffers, self.opt_state, self._grad_accum = \
+                self._compiled(self.params, self.buffers, self.opt_state,
+                               self._grad_accum, batch, self._base_key, count,
+                               do_update=do_update)
             return loss, out
-        loss, out, self.params, self.buffers, self.opt_state, self._grad_accum = \
-            self._compiled(self.params, self.buffers, self.opt_state,
-                           self._grad_accum, batch, self._base_key, count,
-                           do_update=do_update)
-        return loss, out
 
 
-def _as_loader(data, batch_size, shuffle, num_workers, drop_last=False):
+def _as_loader(data, batch_size, shuffle, num_workers, drop_last=False,
+               pad_batches=False, length_buckets=None):
     if data is None or isinstance(data, DataLoader):
         return data
     if isinstance(data, Dataset):
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                          num_workers=num_workers, drop_last=drop_last)
+                          num_workers=num_workers, drop_last=drop_last,
+                          pad_batches=pad_batches,
+                          length_buckets=length_buckets)
     return data  # any iterable of batches
+
+
+def _strip_mask(batch, loader):
+    """Pop the trailing validity mask a padding loader appends.
+
+    Returns ``(batch, mask-or-None)``; the mask filters metric updates so
+    the repeated filler rows of a padded tail batch don't skew them.
+    """
+    if (getattr(loader, "pad_batches", False)
+            and isinstance(batch, (tuple, list)) and len(batch) >= 2):
+        return tuple(batch[:-1]), np.asarray(batch[-1])
+    return batch, None
+
+
+def _iter_batches(loader, prefetch_depth=0):
+    """Iterate one epoch, optionally through the async device-prefetch
+    pipeline (``prefetch_depth`` > 0 enables it; the iterator is closed on
+    every exit path so no producer thread leaks)."""
+    if not prefetch_depth:
+        yield from loader
+        return
+    from ..io.device_prefetch import prefetch_to_device
+
+    it = prefetch_to_device(iter(loader), depth=prefetch_depth)
+    try:
+        yield from it
+    finally:
+        it.close()
+
+
+def _mask_leaf(a, mask):
+    arr = np.asarray(a)
+    if arr.ndim >= 1 and arr.shape[0] == mask.shape[0]:
+        return arr[mask]
+    return arr
+
+
+def _mask_rows(arrays, valid_mask):
+    """Drop padded rows (batch-dim filter) from every matching array.
+
+    No-op (no device->host copy) when nothing was actually padded — the
+    mask is a small host array by the time it gets here.
+    """
+    if valid_mask is None:
+        return arrays
+    mask = np.asarray(valid_mask)
+    if mask.all():
+        return arrays
+    return tuple(_mask_leaf(a, mask) for a in arrays)
 
 
 def _split_batch(batch, n_labels):
@@ -179,17 +235,17 @@ class Model:
         return self._train_step
 
     # ------------------------------------------------------- batch methods
-    def train_batch(self, inputs, labels=None):
+    def train_batch(self, inputs, labels=None, valid_mask=None):
         inputs = inputs if isinstance(inputs, (tuple, list)) else [inputs]
         labels = [] if labels is None else (
             labels if isinstance(labels, (tuple, list)) else [labels])
         batch = tuple(inputs) + tuple(labels)
         step = self._ensure_train_step()
         loss, out = step(batch)
-        metrics = self._update_metrics(out, tuple(labels))
+        metrics = self._update_metrics(out, tuple(labels), valid_mask)
         return [float(loss)] + metrics if metrics else [float(loss)]
 
-    def eval_batch(self, inputs, labels=None):
+    def eval_batch(self, inputs, labels=None, valid_mask=None):
         inputs = inputs if isinstance(inputs, (tuple, list)) else [inputs]
         labels = [] if labels is None else (
             labels if isinstance(labels, (tuple, list)) else [labels])
@@ -198,8 +254,14 @@ class Model:
         losses = []
         if self._loss is not None and labels:
             outs = out if isinstance(out, (tuple, list)) else (out,)
-            losses = [float(self._loss(*outs, *labels))]
-        metrics = self._update_metrics(out, tuple(labels))
+            # the compiled step ran the padded shape; the host-side loss
+            # drops the filler ROWS. Padded sequence POSITIONS (from
+            # length_buckets) are still in the loss — per-position tasks
+            # must ignore pad positions in their own loss/metrics.
+            outs = _mask_rows(outs, valid_mask)
+            lab = _mask_rows(tuple(labels), valid_mask)
+            losses = [float(self._loss(*outs, *lab))]
+        metrics = self._update_metrics(out, tuple(labels), valid_mask)
         return losses + metrics
 
     def predict_batch(self, inputs):
@@ -208,9 +270,14 @@ class Model:
         out = self._eval_step(*inputs)
         return jax.tree.map(np.asarray, out)
 
-    def _update_metrics(self, out, labels):
+    def _update_metrics(self, out, labels, valid_mask=None):
+        if not self._metrics:
+            # don't touch (= device-sync) the outputs on the loss-only path
+            return []
         vals = []
         outs = out if isinstance(out, (tuple, list)) else (out,)
+        outs = _mask_rows(outs, valid_mask)
+        labels = _mask_rows(labels, valid_mask)
         for m in self._metrics:
             computed = m.compute(*outs, *labels)
             if not isinstance(computed, (tuple, list)):
@@ -228,9 +295,17 @@ class Model:
     # ------------------------------------------------------------ fit/eval
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        loader = _as_loader(train_data, batch_size, shuffle, num_workers, drop_last)
-        eval_loader = _as_loader(eval_data, batch_size, False, num_workers)
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            pad_batches=False, length_buckets=None, prefetch_depth=0):
+        """``pad_batches``/``length_buckets`` stabilize batch shapes so the
+        compiled step is traced O(#buckets) times instead of once per novel
+        shape (see ``paddle_tpu.io.batching``); ``prefetch_depth`` > 0
+        streams batches to the device through the async H2D pipeline while
+        the previous step runs (``paddle_tpu.io.device_prefetch``)."""
+        loader = _as_loader(train_data, batch_size, shuffle, num_workers,
+                            drop_last, pad_batches, length_buckets)
+        eval_loader = _as_loader(eval_data, batch_size, False, num_workers,
+                                 False, pad_batches, length_buckets)
         self._save_dir = save_dir
         self.stop_training = False
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -252,11 +327,14 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step_i, batch in enumerate(loader):
+            for step_i, batch in enumerate(_iter_batches(loader,
+                                                         prefetch_depth)):
                 cbks.on_train_batch_begin(step_i)
-                vals = self.train_batch(*_split_batch(tuple(batch) if
-                                        isinstance(batch, (tuple, list)) else batch,
-                                        self._n_labels))
+                batch, mask = _strip_mask(batch, loader)
+                ins, labels = _split_batch(
+                    tuple(batch) if isinstance(batch, (tuple, list))
+                    else batch, self._n_labels)
+                vals = self.train_batch(ins, labels, valid_mask=mask)
                 logs = dict(zip(["loss"] + self._metrics_name(), vals))
                 cbks.on_train_batch_end(step_i, logs)
             if eval_loader is not None and (epoch % eval_freq == 0 or
@@ -270,8 +348,10 @@ class Model:
         return history.history if history is not None else None
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None, _callbacks=None):
-        loader = _as_loader(eval_data, batch_size, False, num_workers)
+                 num_workers=0, callbacks=None, _callbacks=None,
+                 pad_batches=False, length_buckets=None):
+        loader = _as_loader(eval_data, batch_size, False, num_workers,
+                            False, pad_batches, length_buckets)
         cbks = _callbacks or config_callbacks(
             callbacks, model=self, batch_size=batch_size,
             steps=len(loader) if hasattr(loader, "__len__") else None,
@@ -284,10 +364,11 @@ class Model:
         loss_sum, n = 0.0, 0
         for step_i, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step_i)
+            batch, mask = _strip_mask(batch, loader)
             ins, labels = _split_batch(
                 tuple(batch) if isinstance(batch, (tuple, list)) else batch,
                 self._n_labels)
-            vals = self.eval_batch(ins, labels)
+            vals = self.eval_batch(ins, labels, valid_mask=mask)
             names = (["loss"] if self._loss is not None and labels else []) + \
                 self._metrics_name()
             logs = dict(zip(names, vals))
@@ -306,11 +387,16 @@ class Model:
         outputs = []
         for batch in loader:
             batch = tuple(batch) if isinstance(batch, (tuple, list)) else (batch,)
+            batch, mask = _strip_mask(batch, loader)
             # with an inputs spec, anything beyond it (labels) is dropped,
             # as the reference does via self._inputs
             if self._inputs is not None:
                 batch = batch[: len(self._inputs)]
-            outputs.append(self.predict_batch(batch))
+            out = self.predict_batch(batch)
+            if mask is not None and not mask.all():
+                # drop the padded filler rows from the prediction
+                out = jax.tree.map(lambda a: _mask_leaf(a, mask), out)
+            outputs.append(out)
         if stack_outputs and outputs:
             outputs = jax.tree.map(lambda *xs: np.concatenate(xs, 0), *outputs)
         return outputs

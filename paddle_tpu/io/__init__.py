@@ -1,6 +1,10 @@
 """paddle_tpu.io — datasets and loading (reference: ``python/paddle/io/``)."""
 from .slot_dataset import InMemoryDataset  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .batching import PaddedBatcher, bucket_for, pad_to_length  # noqa: F401
+from .device_prefetch import (  # noqa: F401
+    DevicePrefetchIterator, prefetch_to_device,
+)
 from .worker import WorkerInfo, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, Dataset,

@@ -28,45 +28,160 @@ from .worker import _ExceptionWrapper, _ShardDone, worker_loop
 
 def default_collate_fn(batch):
     """Stack samples into batch arrays, mirroring paddle's default collate."""
+    if len(batch) == 0:
+        raise ValueError(
+            "default_collate_fn got an empty batch; check the dataset / "
+            "sampler (a batch must contain at least one sample)")
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
         return type(sample)(default_collate_fn([b[i] for b in batch])
                             for i in range(len(sample)))
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (bool, np.bool_)):
+        # before the int branch: bool IS an int subclass and would upcast
+        return np.asarray(batch, np.bool_)
+    if isinstance(sample, np.generic):
+        # numpy scalar: preserve its dtype instead of python-number rules
+        return np.asarray(batch, sample.dtype)
     if isinstance(sample, (int, float)):
         return np.asarray(batch)
     return np.stack([np.asarray(s) for s in batch])
 
 
-class _PrefetchIterator:
-    def __init__(self, producer: Iterable, depth: int):
-        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
-        self._sentinel = object()
-        self._err = None
+_PUT_POLL_S = 0.05
 
-        def run():
+
+class _PrefetchState:
+    """State shared between a prefetch iterator and its producer thread.
+
+    Split out so the THREAD never holds a reference to the iterator: an
+    abandoned iterator then actually becomes garbage, its ``__del__`` runs
+    ``close()``, and the thread (referencing only this state) unblocks.
+    """
+
+    __slots__ = ("err", "producer_busy_s", "closed")
+
+    def __init__(self):
+        self.err = None
+        self.producer_busy_s = 0.0   # producer time in next()+transform
+        self.closed = threading.Event()
+
+
+def _prefetch_worker(producer, q, sentinel, transform, state):
+    import time as _time
+
+    def put(item) -> bool:
+        # bounded put that aborts instead of blocking forever once the
+        # consumer has walked away (the close() handshake)
+        while not state.closed.is_set():
             try:
-                for item in producer:
-                    self._queue.put(item)
-            except BaseException as e:  # propagate into consumer
-                self._err = e
-            finally:
-                self._queue.put(self._sentinel)
+                q.put(item, timeout=_PUT_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-        self._thread = threading.Thread(target=run, daemon=True)
+    try:
+        it = iter(producer)
+        while not state.closed.is_set():
+            t0 = _time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            if transform is not None:
+                item = transform(item)
+            state.producer_busy_s += _time.perf_counter() - t0
+            if not put(item):
+                return
+    except BaseException as e:  # propagate into consumer
+        state.err = e
+    finally:
+        put(sentinel)
+
+
+class _PrefetchIterator:
+    """Bounded background-thread prefetch.
+
+    - ``transform`` (optional) runs in the producer thread — the hook
+      :class:`paddle_tpu.io.device_prefetch.DevicePrefetchIterator` uses to
+      overlap host->device transfer with consumer compute. It must not
+      close over this iterator (see :class:`_PrefetchState`).
+    - A producer exception is delivered on the consumer's NEXT ``__next__``
+      (already-queued good batches are dropped), not after the queue drains.
+    - ``close()`` unblocks and joins the thread; it runs from ``__del__``
+      and on exhaustion/error, so an abandoned iterator cannot leak a
+      thread parked on the bounded queue.
+    """
+
+    def __init__(self, producer: Iterable, depth: int, transform=None):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+        self._sentinel = object()
+        self._state = _PrefetchState()
+        self._done = False
+        self._batches = 0
+        self._stall_s = 0.0          # consumer time blocked waiting for data
+        self._thread = threading.Thread(
+            target=_prefetch_worker,
+            args=(producer, self._queue, self._sentinel, transform,
+                  self._state),
+            daemon=True)
         self._thread.start()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._queue.get()
-        if item is self._sentinel:
-            if self._err is not None:
-                raise self._err
+        import time as _time
+
+        if self._done:
             raise StopIteration
+        if self._state.err is not None:
+            # prompt delivery: don't make the consumer chew through queued
+            # batches before learning the epoch already failed
+            err, self._state.err = self._state.err, None
+            self.close()
+            raise err
+        t0 = _time.perf_counter()
+        item = self._queue.get()
+        self._stall_s += _time.perf_counter() - t0
+        if item is self._sentinel:
+            if self._state.err is not None:
+                err, self._state.err = self._state.err, None
+                self.close()
+                raise err
+            self.close()
+            raise StopIteration
+        self._batches += 1
         return item
+
+    def stats(self) -> dict:
+        """Pipeline health counters: batches delivered, consumer stall
+        seconds (input-bound time), producer busy seconds."""
+        return {"batches": self._batches,
+                "consumer_stall_s": self._stall_s,
+                "producer_busy_s": self._state.producer_busy_s}
+
+    def close(self):
+        """Unblock and join the producer thread (idempotent)."""
+        self._done = True
+        if self._state.closed.is_set():
+            return
+        self._state.closed.set()
+        # drain so a producer blocked mid-put observes the close flag
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=_PUT_POLL_S)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class _Hole:
@@ -271,7 +386,9 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, mp_context=None, seed=0):
+                 persistent_workers=False, mp_context=None, seed=0,
+                 pad_batches=False, length_buckets=None, length_fields=None,
+                 pad_value=0):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -300,6 +417,18 @@ class DataLoader:
                                               batch_size=batch_size, drop_last=drop_last)
             self.batch_size = batch_size
             self.drop_last = drop_last
+        self.pad_batches = bool(pad_batches)
+        self.length_buckets = tuple(length_buckets) if length_buckets else None
+        if self.pad_batches or self.length_buckets:
+            from .batching import PaddedBatcher
+
+            # shape-stable stream: the wrapper is picklable, so worker
+            # processes pad/bucket on their side of the queue too
+            self.collate_fn = PaddedBatcher(
+                self.collate_fn, batch_size=self.batch_size,
+                pad_batches=self.pad_batches,
+                length_buckets=self.length_buckets,
+                length_fields=length_fields, pad_value=pad_value)
 
     # ------------------------------------------------- worker lifecycle
     def _mp_ctx(self):

@@ -1,0 +1,123 @@
+"""Per-shape compile report for the input pipeline.
+
+Runs a short ``hapi.Model.fit`` loop over a deliberately hostile dataset —
+three sequence lengths plus a ragged tail batch — and prints the compile
+table from ``framework.compile_cache.cache_stats()``: one row per traced
+shape signature of the train step. Exits non-zero when the step compiled
+more programs than ``--budget``, so CI can pin the shape-stability
+guarantee.
+
+    python tools/retrace_report.py                  # padding+bucketing on
+    python tools/retrace_report.py --no-stabilize   # raw shapes (one
+                                                    # compile per shape)
+    python tools/retrace_report.py --budget 3
+
+Runs on any backend; tier-1 invokes it with JAX_PLATFORMS=cpu.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+LENGTHS = (12, 20, 28)
+BUCKETS = (16, 32)
+N_SAMPLES = 22        # not divisible by batch size -> ragged tail
+BATCH_SIZE = 4
+NUM_CLASSES = 4
+VOCAB = 64
+
+
+def build_model():
+    import paddle_tpu.nn as nn
+
+    class TinyClassifier(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(VOCAB, 16)
+            self.head = nn.Linear(16, NUM_CLASSES)
+
+        def forward(self, ids):
+            # mean-pool over the (padded) sequence axis; padding ids are 0
+            return self.head(self.embed(ids).mean(axis=1))
+
+    return TinyClassifier()
+
+
+class RaggedDataset:
+    """(ids[L], label) with L in length-sorted blocks (the usual layout a
+    length-grouping sampler produces), plus a ragged tail batch."""
+
+    def __len__(self):
+        return N_SAMPLES
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        L = LENGTHS[min(i // 8, len(LENGTHS) - 1)]  # blocks of 8 = 2 batches
+        return (np.asarray(rng.integers(1, VOCAB, L), np.int64),
+                np.int64(i % NUM_CLASSES))
+
+
+def run_fit(stabilize: bool, epochs: int):
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io.dataset import Dataset
+
+    class DS(RaggedDataset, Dataset):
+        pass
+
+    pt.seed(0)
+    model = Model(build_model())
+    model.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1),
+                  loss=lambda logits, label: F.cross_entropy(logits, label))
+    model.fit(DS(), batch_size=BATCH_SIZE, epochs=epochs, verbose=0,
+              shuffle=False,
+              pad_batches=stabilize,
+              length_buckets=BUCKETS if stabilize else None)
+    return model._train_step.cache_stats()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--budget", type=int, default=None,
+                    help="max train-step compiles before a non-zero exit "
+                         "(default: 1 + #buckets when stabilized, else off)")
+    ap.add_argument("--no-stabilize", action="store_true",
+                    help="disable pad_batches/length_buckets to show the "
+                         "per-shape recompile behavior")
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    stabilize = not args.no_stabilize
+    budget = args.budget
+    if budget is None and stabilize:
+        budget = 1 + len(BUCKETS)
+
+    stats = run_fit(stabilize, args.epochs)
+
+    mode = ("pad_batches=True length_buckets=%s" % (BUCKETS,)
+            if stabilize else "raw shapes (no padding/bucketing)")
+    print(f"retrace report — {mode}")
+    print(f"{'train-step trace signature':<72}{'compiles':>9}")
+    for sig, n in sorted(stats["signatures"].items()):
+        print(f"{sig:<72}{n:>9}")
+    print(f"{'TOTAL':<72}{stats['compiles']:>9}   "
+          f"(calls {stats['calls']}, cache hits {stats['cache_hits']})")
+
+    if budget is not None and stats["compiles"] > budget:
+        print(f"FAIL: {stats['compiles']} compiles > budget {budget} — "
+              f"the input pipeline is recompiling the step", file=sys.stderr)
+        return 1
+    if budget is not None:
+        print(f"OK: {stats['compiles']} compiles <= budget {budget}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
