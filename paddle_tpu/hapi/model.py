@@ -270,6 +270,18 @@ class Model:
         out = self._eval_step(*inputs)
         return jax.tree.map(np.asarray, out)
 
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        """Compiled KV-cache generation for causal-LM networks (GPT/Llama
+        families — anything exposing ``.generate``); trained weights from
+        a live fit loop are synced into the network first. See
+        ``paddle_tpu.models.generation.generate`` for the sampling knobs."""
+        if not hasattr(self.network, "generate"):
+            raise TypeError(
+                f"{type(self.network).__name__} has no generate(); only "
+                f"causal-LM networks support Model.generate")
+        self._sync_eval_weights()
+        return self.network.generate(input_ids, max_new_tokens, **kwargs)
+
     def _update_metrics(self, out, labels, valid_mask=None):
         if not self._metrics:
             # don't touch (= device-sync) the outputs on the loss-only path

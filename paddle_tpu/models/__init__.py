@@ -8,6 +8,7 @@ families live under ``paddle_tpu.models`` (vision re-exports them at
 """
 from . import bert  # noqa: F401
 from . import ernie  # noqa: F401
+from . import generation  # noqa: F401
 from . import gpt  # noqa: F401
 from . import llama  # noqa: F401
 from . import ppyoloe  # noqa: F401
@@ -19,6 +20,8 @@ from .bert import (BertConfig, BertForPretraining,  # noqa: F401
 from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
                     ErnieForSequenceClassification, ErnieModel,
                     ernie_3_base, ernie_tiny)
+from .generation import (GenerationEngine, generate, init_cache,  # noqa: F401
+                         sample_logits)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_1p3b, gpt_tiny  # noqa: F401
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
                     llama2_7b, llama_tiny)

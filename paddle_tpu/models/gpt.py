@@ -89,7 +89,8 @@ def gpt_1p3b(**overrides) -> "GPTConfig":
 
 
 # shared decoder plumbing lives in lm_utils; legacy names kept for callers
-from .lm_utils import causal_attention, constrain_seq as _constrain_seq  # noqa: E402
+from .lm_utils import (attend_with_cache, causal_attention,  # noqa: E402
+                       constrain_seq as _constrain_seq)
 
 
 class GPTAttention(Layer):
@@ -108,11 +109,17 @@ class GPTAttention(Layer):
             weight_attr=Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
             has_bias=True, input_is_parallel=True)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, position_offset=0):
         B, L, _ = x.shape
         qkv = self.qkv_proj(x)  # [B, L, 3*H*D] (mp-sharded feature dim)
         qkv = qkv.reshape(B, L, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            out, cache = attend_with_cache(
+                q, k, v, cache, position_offset,
+                use_flash=self.cfg.use_flash_attention)
+            out = out.reshape(B, L, self.num_heads * self.head_dim)
+            return self.out_proj(out), cache
         out = causal_attention(
             q, k, v, dropout_p=self.cfg.attention_dropout_prob,
             training=self.training, use_flash=self.cfg.use_flash_attention)
@@ -148,7 +155,13 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, position_offset=0):
+        if cache is not None:
+            a, cache = self.attn(self.ln_1(x), cache=cache,
+                                 position_offset=position_offset)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return _constrain_seq(x, self.cfg), cache
         attn = self.attn
         if self.cfg.recompute_attn_only and not self.cfg.use_recompute:
             attn = recompute_wrap(self.attn)
@@ -186,9 +199,13 @@ class GPTModel(Layer):
         self.h = _BlockList(cfg)
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
-    def forward(self, input_ids):
-        x = self.embeddings(input_ids)
+    def forward(self, input_ids, cache=None, position_offset=0):
+        x = self.embeddings(input_ids, position_offset=position_offset)
         x = _constrain_seq(x, self.cfg)
+        if cache is not None:
+            x, cache = self.h(x, caches=cache,
+                              position_offset=position_offset)
+            return self.ln_f(x), cache
         x = self.h(x)
         return self.ln_f(x)
 
@@ -219,22 +236,50 @@ class GPTForCausalLM(Layer):
             return self.gpt.embeddings.word_embeddings.weight
         return None
 
-    def forward(self, input_ids, labels=None):
+    def _logits(self, h):
+        if self.cfg.tie_word_embeddings:
+            return parallel_matmul(h, self._head_weight(), transpose_y=True)
+        return self.lm_head(h)
+
+    def cache_spec(self) -> dict:
+        """Static KV-cache geometry for ``models.generation.init_cache``."""
+        return {"num_layers": self.cfg.num_layers,
+                "num_kv_heads": self.cfg.num_heads,
+                "head_dim": self.cfg.hidden_size // self.cfg.num_heads,
+                "max_length": self.cfg.max_position_embeddings,
+                "dtype": self.cfg.dtype}
+
+    def forward(self, input_ids, labels=None, cache=None, position_offset=0,
+                gather_last=None):
         """Logits when ``labels`` is None; otherwise the LM loss directly —
         via the memory-fused chunked path when ``cfg.loss_chunk > 0`` (the
         full [B, L, vocab] logits tensor never exists; see
-        ``chunked_lm_loss``)."""
+        ``chunked_lm_loss``).
+
+        With ``cache`` (per-layer ``(k, v)`` pairs from
+        ``models.generation.init_cache``) runs the cached-decode path and
+        returns ``(logits, new_cache)``. ``gather_last`` (a traced scalar
+        index) slices the hidden states to that single position BEFORE the
+        head projection, so serving never materializes [B, L, vocab]."""
+        if cache is not None or gather_last is not None:
+            from .lm_utils import cached_lm_forward
+
+            return cached_lm_forward(self.gpt, self._logits, input_ids,
+                                     cache, position_offset, gather_last)
         if labels is not None and self.cfg.loss_chunk:
             return self.chunked_lm_loss(self.gpt(input_ids), labels,
                                         chunk=self.cfg.loss_chunk)
-        h = self.gpt(input_ids)
-        if self.cfg.tie_word_embeddings:
-            logits = parallel_matmul(h, self._head_weight(), transpose_y=True)
-        else:
-            logits = self.lm_head(h)
+        logits = self._logits(self.gpt(input_ids))
         if labels is None:
             return logits
         return self.loss(logits, labels)
+
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        """Compiled KV-cache generation — see
+        :func:`paddle_tpu.models.generation.generate`."""
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, **kwargs)
 
     def loss(self, logits, labels):
         """Shifted LM loss: predict token t+1 from prefix ..t."""

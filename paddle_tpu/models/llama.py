@@ -34,7 +34,8 @@ from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..nn.layer import Layer
 from ..nn.layers.norm import RMSNorm
-from .lm_utils import causal_attention, constrain_seq as _constrain_seq
+from .lm_utils import (attend_with_cache, causal_attention,
+                       constrain_seq as _constrain_seq, repeat_kv)
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
            "llama2_7b", "llama_loss_fn", "llama_flops_per_token"]
@@ -126,12 +127,9 @@ def apply_rotary(q, k, cos, sin, position_offset: int = 0):
     return q * c + _rotate_half(q) * s, k * c + _rotate_half(k) * s
 
 
-def _repeat_kv(x, groups: int):
-    """[B, L, Hkv, D] -> [B, L, Hkv*groups, D] for GQA (each kv head
-    serves `groups` query heads)."""
-    if groups == 1:
-        return x
-    return jnp.repeat(x, groups, axis=2)
+# GQA head repetition now lives in lm_utils (shared with the KV-cache
+# decode path); the private name stays for existing callers
+_repeat_kv = repeat_kv
 
 
 # ------------------------------------------------------------------ layers
@@ -157,7 +155,7 @@ class LlamaAttention(Layer):
             cfg.hidden_size, cfg.hidden_size, weight_attr=out_init,
             has_bias=False, input_is_parallel=True)
 
-    def forward(self, x, position_offset: int = 0):
+    def forward(self, x, cache=None, position_offset=0):
         B, L, _ = x.shape
         cfg = self.cfg
         q = self.q_proj(x).reshape(B, L, cfg.num_heads, self.head_dim)
@@ -165,7 +163,14 @@ class LlamaAttention(Layer):
         v = self.v_proj(x).reshape(B, L, cfg.num_kv_heads, self.head_dim)
         cos, sin = _rope_tables(self.head_dim, cfg.max_position_embeddings,
                                 cfg.rope_theta)
+        # RoPE indexes its tables at position_offset (traced for cached
+        # decode steps), so the cache stores POST-rotation keys
         q, k = apply_rotary(q, k, cos, sin, position_offset)
+        if cache is not None:
+            out, cache = attend_with_cache(
+                q, k, v, cache, position_offset,
+                use_flash=cfg.use_flash_attention)
+            return self.o_proj(out.reshape(B, L, cfg.hidden_size)), cache
         groups = cfg.num_heads // cfg.num_kv_heads
         k, v = _repeat_kv(k, groups), _repeat_kv(v, groups)
         out = causal_attention(q, k, v, dropout_p=0.0,
@@ -207,7 +212,13 @@ class LlamaBlock(Layer):
                                                 epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, position_offset=0):
+        if cache is not None:
+            a, cache = self.self_attn(self.input_layernorm(x), cache=cache,
+                                      position_offset=position_offset)
+            x = x + a
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return _constrain_seq(x, self.cfg), cache
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return _constrain_seq(x, self.cfg)
@@ -225,9 +236,13 @@ class LlamaModel(Layer):
         self.layers = DecoderBlockList(cfg, LlamaBlock)
         self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, position_offset=0):
         x = self.embed_tokens(input_ids)
         x = _constrain_seq(x, self.cfg)
+        if cache is not None:
+            x, cache = self.layers(x, caches=cache,
+                                   position_offset=position_offset)
+            return self.norm(x), cache
         x = self.layers(x)
         return self.norm(x)
 
@@ -254,7 +269,22 @@ class LlamaForCausalLM(Layer):
                                    transpose_y=True)
         return self.lm_head(h)
 
-    def forward(self, input_ids, labels=None):
+    def cache_spec(self) -> dict:
+        """Static KV-cache geometry for ``models.generation.init_cache``
+        (GQA: the cache stores ``num_kv_heads``, not ``num_heads``)."""
+        return {"num_layers": self.cfg.num_layers,
+                "num_kv_heads": self.cfg.num_kv_heads,
+                "head_dim": self.cfg.hidden_size // self.cfg.num_heads,
+                "max_length": self.cfg.max_position_embeddings,
+                "dtype": self.cfg.dtype}
+
+    def forward(self, input_ids, labels=None, cache=None, position_offset=0,
+                gather_last=None):
+        if cache is not None or gather_last is not None:
+            from .lm_utils import cached_lm_forward
+
+            return cached_lm_forward(self.model, self._logits, input_ids,
+                                     cache, position_offset, gather_last)
         if labels is not None and self.cfg.loss_chunk:
             from .lm_utils import chunked_lm_loss
 
@@ -265,6 +295,13 @@ class LlamaForCausalLM(Layer):
         if labels is None:
             return logits
         return self.loss(logits, labels)
+
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        """Compiled KV-cache generation — see
+        :func:`paddle_tpu.models.generation.generate`."""
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, **kwargs)
 
     def loss(self, logits, labels):
         shift_logits = logits[:, :-1, :]

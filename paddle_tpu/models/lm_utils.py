@@ -21,7 +21,8 @@ from ..nn import functional as F
 from ..nn.layer import Layer
 
 __all__ = ["chunked_lm_loss", "DecoderBlockList", "constrain_seq",
-           "causal_attention"]
+           "causal_attention", "repeat_kv", "update_kv_cache",
+           "cached_attention", "attend_with_cache", "cached_lm_forward"]
 
 
 def constrain_seq(x, cfg):
@@ -63,10 +64,100 @@ def causal_attention(q, k, v, dropout_p=0.0, training=True, use_flash=True):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+# ------------------------------------------------------------- KV cache
+def repeat_kv(x, groups: int):
+    """[B, L, Hkv, D] -> [B, L, Hkv*groups, D] for GQA (each kv head
+    serves ``groups`` query heads)."""
+    if groups == 1:
+        return x
+    return jnp.repeat(x, groups, axis=2)
+
+
+def update_kv_cache(cache, k_new, v_new, position_offset):
+    """Write ``k_new``/``v_new`` [B, L, Hkv, D] into the preallocated
+    ``(k, v)`` cache pair at ``position_offset`` along the length axis.
+
+    ``position_offset`` may be a traced scalar (the single-token decode
+    step passes the running position as a device int32, so ONE compiled
+    program serves every position)."""
+    k_cache, v_cache = cache
+    zero = jnp.zeros((), jnp.int32)
+    start = (zero, jnp.asarray(position_offset, jnp.int32), zero, zero)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), start)
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), start)
+    return k_cache, v_cache
+
+
+def cached_attention(q, k_cache, v_cache, position_offset):
+    """Dot-product attention of ``q`` [B, L, H, D] against the FULL cache
+    [B, S, Hkv, D] with a position mask: query at absolute position
+    ``position_offset + i`` sees keys at positions ``<= position_offset + i``
+    only, so stale/unwritten cache slots beyond the current position never
+    leak in. GQA is a grouped einsum — the kv heads are never repeated
+    into [B, S, H, D]."""
+    B, L, H, D = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    groups = H // Hkv
+    qg = q.reshape(B, L, Hkv, groups, D)
+    s = jnp.einsum("blhgd,bshd->bhgls", qg, k_cache.astype(q.dtype))
+    s = s * (1.0 / math.sqrt(D))
+    qpos = jnp.asarray(position_offset, jnp.int32) + jnp.arange(L, dtype=jnp.int32)
+    allowed = jnp.arange(S, dtype=jnp.int32)[None, :] <= qpos[:, None]  # [L, S]
+    s = jnp.where(allowed[None, None, None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgls,bshd->blhgd", p, v_cache.astype(q.dtype))
+    return out.reshape(B, L, H, D)
+
+
+def attend_with_cache(q, k_new, v_new, cache, position_offset,
+                      use_flash=True):
+    """The cached-decode attention dispatch shared by GPT and Llama.
+
+    Always writes ``k_new``/``v_new`` into the cache. The PREFILL shape
+    (multi-token at static offset 0) attends block-locally via
+    :func:`causal_attention` — flash-eligible, no O(S) mask work; every
+    other shape (single-token decode, chunked continuation) runs
+    :func:`cached_attention` against the full cache with the position
+    mask. Returns ``(out, (k_cache, v_cache))``.
+    """
+    cache = update_kv_cache(cache, k_new, v_new, position_offset)
+    is_prefill = (q.shape[1] > 1 and isinstance(position_offset, int)
+                  and position_offset == 0)
+    if is_prefill:
+        groups = q.shape[2] // k_new.shape[2]
+        out = causal_attention(q, repeat_kv(k_new, groups),
+                               repeat_kv(v_new, groups), dropout_p=0.0,
+                               training=False, use_flash=use_flash)
+    else:
+        out = cached_attention(q, cache[0], cache[1], position_offset)
+    return out, cache
+
+
+def cached_lm_forward(backbone, logits_fn, input_ids, cache,
+                      position_offset, gather_last):
+    """The serving-side CausalLM forward shared by GPT and Llama: run the
+    backbone (cache-threaded when given), optionally slice the hidden
+    states to the single ``gather_last`` position BEFORE the head
+    projection (so serving never materializes [B, L, vocab]), and return
+    ``logits`` or ``(logits, new_cache)``."""
+    h = backbone(input_ids, cache=cache, position_offset=position_offset)
+    if cache is not None:
+        h, cache = h
+    if gather_last is not None:
+        h = jax.lax.dynamic_slice_in_dim(h, gather_last, 1, axis=1)
+    logits = logits_fn(h)
+    return logits if cache is None else (logits, cache)
+
+
 class DecoderBlockList(Layer):
     """Shared N-block decoder stack with per-block recompute dispatch
     (GPT/Llama): ``cfg`` provides ``num_layers``/``use_recompute``/
-    ``recompute_policy``; ``block_cls(cfg)`` builds one block."""
+    ``recompute_policy``; ``block_cls(cfg)`` builds one block. With
+    ``caches`` (a per-layer tuple of ``(k, v)`` pairs) each block runs its
+    cached-decode path and the updated caches ride back alongside the
+    activations."""
 
     def __init__(self, cfg, block_cls):
         super().__init__()
@@ -74,12 +165,18 @@ class DecoderBlockList(Layer):
         for i in range(cfg.num_layers):
             self.add_sublayer(str(i), block_cls(cfg))
 
-    def forward(self, x):
-        for blk in self._sub_layers.values():
-            fn = (recompute_wrap(blk, policy=self.cfg.recompute_policy)
-                  if self.cfg.use_recompute else blk)
-            x = fn(x)
-        return x
+    def forward(self, x, caches=None, position_offset=0):
+        if caches is None:
+            for blk in self._sub_layers.values():
+                fn = (recompute_wrap(blk, policy=self.cfg.recompute_policy)
+                      if self.cfg.use_recompute else blk)
+                x = fn(x)
+            return x
+        new_caches = []
+        for blk, cache in zip(self._sub_layers.values(), caches):
+            x, cache = blk(x, cache=cache, position_offset=position_offset)
+            new_caches.append(cache)
+        return x, tuple(new_caches)
 
 
 def chunked_lm_loss(h, labels, logits_fn, ce, chunk: int = 256):

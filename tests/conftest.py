@@ -31,6 +31,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP): long decode/bench subprocess
+    # tests opt out of the 870 s budget with this marker
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 time budget")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu

@@ -48,6 +48,41 @@ def test_budget_exhaustion_emits_structured_failure():
     assert "forced probe failure" in rec["extra"]["detail"]
 
 
+def test_probe_timeout_env_override_and_retry_accounting():
+    """PT_BENCH_PROBE_TIMEOUT must bound each probe attempt (round r05
+    burned ~20 min at the fixed 180 s cap before tpu_unavailable), and the
+    failure record must account for the wall clock burned in retries."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    old = os.environ.pop("PT_BENCH_PROBE_TIMEOUT", None)
+    try:
+        assert bench._probe_timeout_default() == 180.0
+        os.environ["PT_BENCH_PROBE_TIMEOUT"] = "7.5"
+        assert bench._probe_timeout_default() == 7.5
+        os.environ["PT_BENCH_PROBE_TIMEOUT"] = "not-a-number"
+        assert bench._probe_timeout_default() == 180.0
+    finally:
+        os.environ.pop("PT_BENCH_PROBE_TIMEOUT", None)
+        if old is not None:
+            os.environ["PT_BENCH_PROBE_TIMEOUT"] = old
+
+    # end-to-end: a forced-down probe with a small budget must leave the
+    # retry accounting in the artifact's extra
+    out = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(BENCH_FORCE_PROBE_FAIL="1", BENCH_TOTAL_BUDGET_SECONDS="3",
+                 BENCH_TPU_RETRY_SECONDS="3", PT_BENCH_PROBE_TIMEOUT="5"),
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    rec = _metric_line(out.stdout)
+    assert rec["error"] == "tpu_unavailable"
+    assert rec["extra"]["probe_retry_s"] >= 0.0
+    assert rec["extra"]["probe_attempts"] >= 1
+
+
 def test_sigterm_mid_retry_still_leaves_artifact():
     """SIGTERM during the retry loop (the round-4 scenario) must flush a
     killed_by_signal record naming the phase, then exit."""

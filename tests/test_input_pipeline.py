@@ -528,7 +528,8 @@ class TestRetraceReportTool:
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "OK:" in out
-        assert "train-step trace signature" in out
+        assert "trace signature" in out
+        assert "train" in out  # per-row kind labels (train/prefill/decode)
 
     def test_unstabilized_busts_budget(self, capsys):
         tool = _load_retrace_report()

@@ -1,0 +1,131 @@
+"""Decode-throughput bench for the compiled KV-cache generation engine.
+
+Measures the two serving numbers that matter — tokens/s and
+time-to-first-token — for batched greedy decode through
+``models.generation``, plus the compile discipline (prefill/decode
+program counts must be ``#buckets_used + 1``). Prints ONE JSON line:
+
+    {"metric": "gpt_decode_tokens_per_sec", "value": N, "unit":
+     "tokens/s", "extra": {"ttft_ms": ..., "decode_tokens_per_sec": ...,
+     "prefill_compiles": ..., "decode_compiles": ..., ...}}
+
+Runs on any backend (tier-1 invokes it with JAX_PLATFORMS=cpu on the
+tiny config; on TPU pass --preset serving for a 350M-class model).
+
+    python tools/decode_bench.py
+    python tools/decode_bench.py --model llama --batch 8 --new-tokens 128
+    python tools/decode_bench.py --preset serving   # TPU-sized config
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_model(family: str, preset: str):
+    import paddle_tpu as pt
+
+    pt.seed(0)
+    if family == "gpt":
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+
+        if preset == "serving":
+            cfg = GPTConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, num_heads=16,
+                            max_position_embeddings=1024,
+                            hidden_dropout_prob=0.0,
+                            attention_dropout_prob=0.0, dtype="bfloat16")
+        else:
+            cfg = gpt_tiny(hidden_dropout_prob=0.0,
+                           attention_dropout_prob=0.0,
+                           use_flash_attention=False)
+        return GPTForCausalLM(cfg), cfg
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+
+    if preset == "serving":
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, num_layers=24,
+                          num_heads=16, num_kv_heads=4,
+                          max_position_embeddings=1024, dtype="bfloat16")
+    else:
+        cfg = llama_tiny(use_flash_attention=False)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=("gpt", "llama"), default="gpt")
+    ap.add_argument("--preset", choices=("tiny", "serving"), default="tiny",
+                    help="tiny: CPU-safe smoke config; serving: 350M-class")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--buckets", type=int, nargs="+", default=None,
+                    help="prefill length buckets (default: engine default)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from paddle_tpu.framework import compile_cache
+    from paddle_tpu.models.generation import GenerationEngine
+
+    model, cfg = build_model(args.model, args.preset)
+    model.eval()
+    max_length = min(cfg.max_position_embeddings,
+                     args.prompt_len + args.new_tokens + 8)
+    engine = GenerationEngine(model, max_length=max_length,
+                              prefill_buckets=args.buckets)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size,
+                       (args.batch, args.prompt_len)).astype(np.int32)
+
+    # warmup: pays the #buckets_used + 1 compiles; the timed run must be
+    # pure dispatch (cache hits only)
+    t_warm = time.perf_counter()
+    engine.generate(ids, max_new_tokens=args.new_tokens)
+    warmup_s = time.perf_counter() - t_warm
+    compiles_before = compile_cache.cache_stats()["compiles"]
+
+    out, stats = engine.generate(ids, max_new_tokens=args.new_tokens,
+                                 return_stats=True)
+    compiles_after = compile_cache.cache_stats()["compiles"]
+
+    cc = stats["compile_stats"]
+    record = {
+        "metric": f"{args.model}_decode_tokens_per_sec",
+        "value": round(stats["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "extra": {
+            "ttft_ms": round(stats["ttft_s"] * 1e3, 2),
+            "decode_tokens_per_sec": round(stats["decode_tokens_per_sec"], 1),
+            "new_tokens": int(out.shape[1]),
+            "batch": args.batch,
+            "prompt_len": args.prompt_len,
+            "prefill_bucket": stats["prefill_bucket"],
+            "prefill_compiles": cc["prefill"]["compiles"],
+            "decode_compiles": cc["decode"]["compiles"],
+            "steady_state_recompiles": compiles_after - compiles_before,
+            "warmup_s": round(warmup_s, 2),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "preset": args.preset,
+        },
+    }
+    print(json.dumps(record))
+    if compiles_after != compiles_before:
+        print(f"FAIL: timed run recompiled "
+              f"({compiles_after - compiles_before} new programs) — the "
+              f"decode step is not shape-stable", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

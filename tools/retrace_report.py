@@ -1,16 +1,21 @@
-"""Per-shape compile report for the input pipeline.
+"""Per-shape compile report for the input pipeline AND the decode engine.
 
 Runs a short ``hapi.Model.fit`` loop over a deliberately hostile dataset —
 three sequence lengths plus a ragged tail batch — and prints the compile
 table from ``framework.compile_cache.cache_stats()``: one row per traced
-shape signature of the train step. Exits non-zero when the step compiled
-more programs than ``--budget``, so CI can pin the shape-stability
-guarantee.
+shape signature, labeled by KIND (``train`` / ``prefill`` / ``decode``).
+Exits non-zero when the train step compiled more programs than
+``--budget``, so CI can pin the shape-stability guarantee.
+
+With ``--generate`` it also drives the compiled KV-cache generation
+engine (``models/generation.py``) over prompts spanning two prefill
+buckets and appends the prefill/decode rows to the table, budget-checked
+at ``#buckets_used + 1`` programs.
 
     python tools/retrace_report.py                  # padding+bucketing on
     python tools/retrace_report.py --no-stabilize   # raw shapes (one
                                                     # compile per shape)
-    python tools/retrace_report.py --budget 3
+    python tools/retrace_report.py --budget 3 --generate
 
 Runs on any backend; tier-1 invokes it with JAX_PLATFORMS=cpu.
 """
@@ -83,6 +88,39 @@ def run_fit(stabilize: bool, epochs: int):
     return model._train_step.cache_stats()
 
 
+GEN_PROMPT_LENS = (12, 24)   # spans both GEN_BUCKETS
+GEN_BUCKETS = (16, 32)
+GEN_NEW_TOKENS = 8
+
+
+def run_generate():
+    """Drive the compiled generation engine across two prefill buckets and
+    return its per-step compile stats (prefill keyed per bucket shape,
+    decode exactly once)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.generation import GenerationEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    pt.seed(0)
+    model = GPTForCausalLM(gpt_tiny(hidden_dropout_prob=0.0,
+                                    attention_dropout_prob=0.0,
+                                    use_flash_attention=False))
+    model.eval()
+    engine = GenerationEngine(model, max_length=64,
+                              prefill_buckets=GEN_BUCKETS)
+    for plen in GEN_PROMPT_LENS:
+        ids = np.random.default_rng(plen).integers(
+            1, VOCAB, (2, plen)).astype(np.int32)
+        engine.generate(ids, max_new_tokens=GEN_NEW_TOKENS)
+    return engine.cache_stats()
+
+
+def _print_rows(kind: str, signatures: dict):
+    for sig, n in sorted(signatures.items()):
+        sig = sig if len(sig) <= 62 else sig[:59] + "..."
+        print(f"{kind:<9}{sig:<63}{n:>9}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--budget", type=int, default=None,
@@ -91,6 +129,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-stabilize", action="store_true",
                     help="disable pad_batches/length_buckets to show the "
                          "per-shape recompile behavior")
+    ap.add_argument("--generate", action="store_true",
+                    help="also run the KV-cache generation engine and "
+                         "report its prefill/decode compile rows")
     ap.add_argument("--epochs", type=int, default=2)
     args = ap.parse_args(argv)
 
@@ -104,11 +145,27 @@ def main(argv=None) -> int:
     mode = ("pad_batches=True length_buckets=%s" % (BUCKETS,)
             if stabilize else "raw shapes (no padding/bucketing)")
     print(f"retrace report — {mode}")
-    print(f"{'train-step trace signature':<72}{'compiles':>9}")
-    for sig, n in sorted(stats["signatures"].items()):
-        print(f"{sig:<72}{n:>9}")
-    print(f"{'TOTAL':<72}{stats['compiles']:>9}   "
+    print(f"{'kind':<9}{'trace signature':<63}{'compiles':>9}")
+    _print_rows("train", stats["signatures"])
+    print(f"{'TOTAL':<9}{'train step':<63}{stats['compiles']:>9}   "
           f"(calls {stats['calls']}, cache hits {stats['cache_hits']})")
+
+    gen_fail = False
+    if args.generate:
+        gen = run_generate()
+        for kind in ("prefill", "decode"):
+            _print_rows(kind, gen[kind]["signatures"])
+        gen_compiles = gen["prefill"]["compiles"] + gen["decode"]["compiles"]
+        gen_calls = gen["prefill"]["calls"] + gen["decode"]["calls"]
+        gen_budget = len(GEN_BUCKETS) + 1
+        print(f"{'TOTAL':<9}{'generate (prefill+decode)':<63}"
+              f"{gen_compiles:>9}   (calls {gen_calls}, budget "
+              f"{gen_budget} = #buckets + 1)")
+        if gen_compiles > gen_budget:
+            print(f"FAIL: generation compiled {gen_compiles} programs > "
+                  f"{gen_budget} (#prefill buckets + one decode step)",
+                  file=sys.stderr)
+            gen_fail = True
 
     if budget is not None and stats["compiles"] > budget:
         print(f"FAIL: {stats['compiles']} compiles > budget {budget} — "
@@ -116,7 +173,7 @@ def main(argv=None) -> int:
         return 1
     if budget is not None:
         print(f"OK: {stats['compiles']} compiles <= budget {budget}")
-    return 0
+    return 1 if gen_fail else 0
 
 
 if __name__ == "__main__":
