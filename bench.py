@@ -283,6 +283,46 @@ def bench_gpt_decode(on_tpu: bool) -> dict:
     }
 
 
+def bench_gpt_serve(on_tpu: bool) -> dict:
+    """Continuous-batching serving throughput via
+    ``tools/serve_bench.py --check`` (Poisson open-loop load against
+    ``paddle_tpu.serving.InferenceServer``). Runs as a SUBPROCESS under
+    the probe-timeout cap and the supervisor's child registry — a hung
+    serving loop is killed and reported, never silently eats the round —
+    and its non-zero exit on steady-state recompiles surfaces here as an
+    error field instead of a fake number."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "serve_bench.py"), "--check"]
+    if on_tpu:
+        cmd += ["--preset", "serving", "--slots", "8"]
+    # same per-attempt cap discipline as the backend probe
+    # (PT_BENCH_PROBE_TIMEOUT overrides), with headroom for the two
+    # serving-program compiles the warmup pays
+    timeout_s = max(300.0, _probe_timeout_default())
+    try:
+        rc, stdout, stderr = _run_subprocess(cmd, timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"serve_bench timed out after {timeout_s:.0f}s"}
+    line = _last_metric_line(stdout)
+    if line is None:
+        tail = (stderr or stdout or "").strip().splitlines()
+        return {"error": f"serve_bench rc={rc}: "
+                         f"{tail[-1] if tail else 'no output'}"[:400]}
+    rec = json.loads(line)
+    extra = rec.get("extra", {})
+    out = {"requests_per_sec": rec.get("value", 0.0)}
+    for k in ("goodput", "tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+              "inter_token_p50_ms", "inter_token_p99_ms", "slot_occupancy",
+              "prefill_compiles", "decode_compiles",
+              "steady_state_recompiles"):
+        if k in extra:
+            out[k] = extra[k]
+    if rc != 0:
+        out["error"] = "steady-state recompiles in the serving loop"
+    return out
+
+
 def bench_resnet50(on_tpu: bool) -> dict:
     """ResNet-50 train-step imgs/sec (BASELINE.md row 1)."""
     import paddle_tpu
@@ -783,13 +823,15 @@ def _run_benches(backend: str):
     g13 = breadth(
         "gpt_1p3b", lambda: bench_gpt_1p3b(_chip_peak_flops(), on_tpu), 300.0)
     decode = breadth("gpt_decode", lambda: bench_gpt_decode(on_tpu), 180.0)
+    serve = breadth("gpt_serve", lambda: bench_gpt_serve(on_tpu), 320.0)
     r50 = breadth("resnet50", lambda: bench_resnet50(on_tpu), 120.0)
 
     primary["extra"].update(
         {"long_context": long_ctx, "gpt_1p3b": g13, "gpt_decode": decode,
-         "resnet50": r50,
-         # the serving-side secondary metric, hoisted for trend tracking
-         "gpt_decode_tokens_per_sec": decode.get("tokens_per_sec", 0.0)})
+         "gpt_serve": serve, "resnet50": r50,
+         # the serving-side secondary metrics, hoisted for trend tracking
+         "gpt_decode_tokens_per_sec": decode.get("tokens_per_sec", 0.0),
+         "gpt_serve_requests_per_sec": serve.get("requests_per_sec", 0.0)})
     print(json.dumps(primary))
 
 

@@ -162,3 +162,15 @@ from .eager import Tensor  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 
 bool = bool_  # noqa: F401,A001  — paddle.bool dtype name
+
+
+def __getattr__(name):
+    # lazy subpackages: serving pulls the generation/KV-cache stack,
+    # which plain `import paddle_tpu` users (every subprocess test, the
+    # launcher workers) shouldn't pay for
+    if name == "serving":
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -43,10 +43,34 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple, Type, Union
 
 __all__ = [
-    "RetryPolicy", "Unavailable", "with_timeout",
+    "RetryPolicy", "Unavailable", "with_timeout", "Deadline",
     "FaultPlan", "FaultRule", "InjectedFault", "fault_point",
     "active_plan", "CRASH_EXIT", "FAULT_PLAN_ENV",
 ]
+
+
+class Deadline:
+    """A monotonic wall-clock budget stamped once at creation (the same
+    single-budget discipline ``bench.py``'s supervisor applies to its
+    probe + bench retries). The serving scheduler stamps one per request
+    at submit: a request that waits out its budget in the queue is
+    expired with ``TimeoutError``, never admitted.
+    """
+
+    __slots__ = ("expires_at", "total")
+
+    def __init__(self, seconds: float):
+        self.total = float(seconds)
+        self.expires_at = time.monotonic() + self.total
+
+    def remaining(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"
 
 
 class Unavailable(ConnectionError):

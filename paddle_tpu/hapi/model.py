@@ -282,6 +282,22 @@ class Model:
         self._sync_eval_weights()
         return self.network.generate(input_ids, max_new_tokens, **kwargs)
 
+    def serve(self, slots=4, **kwargs):
+        """Continuous-batching server over this network (causal-LM
+        families exposing ``cache_spec``): trained weights from a live
+        fit loop are synced in first. Returns a started
+        ``paddle_tpu.serving.InferenceServer`` — ``submit()`` requests,
+        ``shutdown(drain=True)`` when done (or use as a context
+        manager). See the README "Serving" section."""
+        if not hasattr(self.network, "cache_spec"):
+            raise TypeError(
+                f"{type(self.network).__name__} has no cache_spec(); only "
+                f"causal-LM networks support Model.serve")
+        self._sync_eval_weights()
+        from ..serving import InferenceServer
+
+        return InferenceServer(self.network, slots=slots, **kwargs).start()
+
     def _update_metrics(self, out, labels, valid_mask=None):
         if not self._metrics:
             # don't touch (= device-sync) the outputs on the loss-only path

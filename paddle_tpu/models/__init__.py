@@ -21,7 +21,8 @@ from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
                     ErnieForSequenceClassification, ErnieModel,
                     ernie_3_base, ernie_tiny)
 from .generation import (GenerationEngine, generate, init_cache,  # noqa: F401
-                         sample_logits)
+                         per_row_keys, sample_logits, sample_logits_rows,
+                         scatter_cache_rows, slice_cache_rows)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_1p3b, gpt_tiny  # noqa: F401
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
                     llama2_7b, llama_tiny)

@@ -46,7 +46,9 @@ from ..nn.layer import buffer_state, functional_call, param_state
 from ..io.batching import bucket_for
 
 __all__ = ["GenerationEngine", "generate", "init_cache", "sample_logits",
-           "cache_sharding_spec", "DEFAULT_PREFILL_BUCKETS"]
+           "sample_logits_rows", "per_row_keys", "slice_cache_rows",
+           "scatter_cache_rows", "cache_sharding_spec",
+           "DEFAULT_PREFILL_BUCKETS"]
 
 # prompt lengths round up to the smallest of these (clipped to the
 # model's max_length) — the serving analogue of DataLoader length_buckets
@@ -104,6 +106,34 @@ def _constrain_cache(cache, batch: int, n_kv_heads: int):
         lambda x: jax.lax.with_sharding_constraint(x, shd), cache)
 
 
+def slice_cache_rows(cache, index, rows: int = 1):
+    """Slice ``rows`` batch rows starting at (possibly traced) ``index``
+    out of a cache pytree: ``[B, S, Hkv, D]`` leaves -> ``[rows, ...]``.
+    Jit-safe — the continuous-batching engine uses it to lift one slot's
+    cache out of the live batch."""
+    idx = jnp.asarray(index, jnp.int32)
+    return jax.tree.map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, idx, rows, axis=0), cache)
+
+
+def scatter_cache_rows(cache, row_cache, index):
+    """Write ``row_cache`` (``[r, S, Hkv, D]`` leaves) into ``cache``
+    (``[B, ...]`` leaves) at batch row ``index`` (may be traced).
+
+    This is the slot-scatter primitive of continuous batching: a freshly
+    prefilled single-slot cache lands in the live B-slot decode batch
+    without the batch's shape ever changing — same program for every slot
+    index."""
+    zero = jnp.zeros((), jnp.int32)
+    idx = jnp.asarray(index, jnp.int32)
+
+    def up(live, row):
+        return jax.lax.dynamic_update_slice(
+            live, row.astype(live.dtype), (idx, zero, zero, zero))
+
+    return jax.tree.map(up, cache, row_cache)
+
+
 # -------------------------------------------------------------- sampling
 def sample_logits(logits, key=None, temperature=1.0, top_k: int = 0,
                   top_p=1.0, greedy: bool = False,
@@ -138,8 +168,55 @@ def sample_logits(logits, key=None, temperature=1.0, top_k: int = 0,
         keep = (cum - probs) < top_p
         cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
                          keepdims=True)
-        l = jnp.where(l < cutoff, -jnp.inf, l)
+        # top_p >= 1.0 must be an EXACT no-op (cumsum rounding could
+        # otherwise mask a tail token): the serving engine compiles the
+        # filter in unconditionally and relies on value-level equality
+        # with the unfiltered solo graph
+        l = jnp.where(top_p >= 1.0, l, jnp.where(l < cutoff, -jnp.inf, l))
     return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+
+def per_row_keys(key, batch: int, position=None):
+    """Derive one PRNG key per batch row from a base ``key``: fold in the
+    (possibly traced) ``position`` first, then the row index. Two
+    properties the sampled paths rely on:
+
+    - *steps differ*: the position fold gives every decode step fresh
+      randomness under a fixed seed;
+    - *rows differ*: the row fold gives every row its own stream, so
+      identical prompts in one batch sample independent continuations.
+
+    Row 0's key is the derivation the continuous-batching engine replays
+    per slot, which is why a served request's sampled tokens match a solo
+    batch-1 ``generate()`` with the same seed."""
+    k = key if position is None else jax.random.fold_in(key, position)
+    return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        k, jnp.arange(batch, dtype=jnp.uint32))
+
+
+def sample_logits_rows(logits, row_keys, temperature=1.0, top_k: int = 0,
+                       top_p=1.0, *, use_top_p: bool = False,
+                       greedy_mask=None):
+    """Next-token selection on ``logits`` [B, V] with one key PER ROW.
+
+    ``temperature``/``top_p`` may be scalars or per-row ``[B]`` vectors
+    (traced — sweeping values never recompiles); ``top_k``/``use_top_p``
+    stay static. ``greedy_mask`` ([B] bool, may be traced) selects argmax
+    per row — a mixed greedy/sampled batch is ONE program, which is what
+    lets the serving decode step hold heterogeneous requests."""
+    B = logits.shape[0]
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    tp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+
+    def row(l, k, t, p):
+        return sample_logits(l[None], k, t, top_k, p, greedy=False,
+                             use_top_p=use_top_p)[0]
+
+    sampled = jax.vmap(row)(logits, row_keys, temp, tp)
+    if greedy_mask is None:
+        return sampled
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.asarray(greedy_mask), greedy_tok, sampled)
 
 
 # ---------------------------------------------------------------- engine
@@ -196,8 +273,14 @@ class GenerationEngine:
         cache = _constrain_cache(cache, ids.shape[0],
                                  self.spec["num_kv_heads"])
         logits = logits[:, 0, :]
-        next_tok = sample_logits(logits, key, temperature, top_k, top_p,
-                                 greedy=greedy, use_top_p=use_top_p)
+        if greedy:
+            next_tok = sample_logits(logits, None, greedy=True)
+        else:
+            # one key per row (not one shared key): identical prompts in a
+            # batch must sample independent first tokens
+            rows = per_row_keys(key, logits.shape[0])
+            next_tok = sample_logits_rows(logits, rows, temperature, top_k,
+                                          top_p, use_top_p=use_top_p)
         done = next_tok == eos_id
         return next_tok, done, jnp.all(done), cache
 
@@ -210,9 +293,14 @@ class GenerationEngine:
         cache = _constrain_cache(cache, token.shape[0],
                                  self.spec["num_kv_heads"])
         logits = logits[:, -1, :]
-        step_key = jax.random.fold_in(key, pos) if key is not None else None
-        next_tok = sample_logits(logits, step_key, temperature, top_k,
-                                 top_p, greedy=greedy, use_top_p=use_top_p)
+        if greedy:
+            next_tok = sample_logits(logits, None, greedy=True)
+        else:
+            # fold the traced position THEN the row index into the key:
+            # every (step, row) pair draws from its own stream
+            rows = per_row_keys(key, logits.shape[0], position=pos)
+            next_tok = sample_logits_rows(logits, rows, temperature, top_k,
+                                          top_p, use_top_p=use_top_p)
         # finished sequences keep emitting eos (or 0) — the done-mask is
         # the early-stop mechanism; shapes never change
         fill = jnp.maximum(eos_id, 0).astype(jnp.int32)
@@ -231,7 +319,8 @@ class GenerationEngine:
                  top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  seed: Optional[int] = None,
-                 return_stats: bool = False):
+                 return_stats: bool = False,
+                 done_check_interval: int = 4):
         """Autoregressively extend ``input_ids`` [B, prompt_len].
 
         Returns the GENERATED ids ``[B, n]`` (``n <= max_new_tokens``;
@@ -239,6 +328,13 @@ class GenerationEngine:
         and finished rows are filled with eos). With ``return_stats``
         also returns ``{"ttft_s", "total_s", "new_tokens",
         "tokens_per_sec", "decode_tokens_per_sec", "compile_stats"}``.
+
+        ``done_check_interval``: the all-done early-stop flag is read on
+        the host (a device round-trip that serializes dispatch) only every
+        k-th decode step; any overshoot columns — all rows were already
+        done, so they contain only eos fill — are trimmed on the host
+        afterwards, so the OUTPUT is identical to checking every step
+        (``done_check_interval=1`` restores the per-step check).
         """
         from ..profiler import RecordEvent
 
@@ -283,6 +379,8 @@ class GenerationEngine:
             buffers = buffer_state(self.model)
             cache = init_cache(self.model, B, self.max_length)
             tokens = []
+            dones = []
+            interval = max(1, int(done_check_interval))
             t0 = time.perf_counter()
             with RecordEvent("decode"):
                 compile_cache.record_call(self._cc_prefill)
@@ -291,15 +389,17 @@ class GenerationEngine:
                     np.int32(prompt_len - 1), key, eos_id, temp, top_p_,
                     top_k=int(top_k), greedy=greedy, use_top_p=use_top_p)
                 tokens.append(tok)
+                dones.append(done)
                 jax.block_until_ready(tok)  # honest TTFT: token IS ready
                 ttft = time.perf_counter() - t0
                 pos = prompt_len
                 # the early-stop host read serializes dispatch (one device
                 # round-trip per token) — only pay it when an eos id makes
-                # stopping possible at all
+                # stopping possible at all, and then only every
+                # ``interval``-th step; overshoot columns are trimmed below
                 check_done = eos_token_id is not None
-                for _ in range(max_new_tokens - 1):
-                    if check_done and bool(all_done):
+                for i in range(max_new_tokens - 1):
+                    if check_done and i % interval == 0 and bool(all_done):
                         break
                     compile_cache.record_call(self._cc_decode)
                     tok, done, all_done, cache = self._decode_compiled(
@@ -308,8 +408,17 @@ class GenerationEngine:
                         top_k=int(top_k), greedy=greedy,
                         use_top_p=use_top_p)
                     tokens.append(tok)
+                    dones.append(done)
                     pos += 1
             out = np.stack([np.asarray(t) for t in tokens], axis=1)
+            if check_done and out.shape[1] > 1:
+                # trim the overshoot: columns past the first all-done one
+                # are pure eos fill (the done-mask holds finished rows), so
+                # the result equals a per-step-checked run
+                col_done = np.stack([np.asarray(d) for d in dones],
+                                    axis=1).all(axis=0)
+                if col_done.any():
+                    out = out[:, :int(col_done.argmax()) + 1]
             total = time.perf_counter() - t0
         finally:
             if was_training:

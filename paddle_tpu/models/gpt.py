@@ -184,8 +184,15 @@ class GPTEmbeddings(Layer):
     def forward(self, input_ids, position_offset=0):
         L = input_ids.shape[1]
         h = self.word_embeddings(input_ids)
-        pos = jax.lax.dynamic_slice_in_dim(
-            self.position_embeddings, position_offset, L, axis=0)
+        if getattr(position_offset, "ndim", 0) == 1:
+            # per-row offsets [B] (continuous-batching decode: every slot
+            # sits at its own position): gather rows [B, L, H]
+            idx = (jnp.asarray(position_offset, jnp.int32)[:, None]
+                   + jnp.arange(L, dtype=jnp.int32)[None, :])
+            pos = jnp.take(self.position_embeddings, idx, axis=0)
+        else:
+            pos = jax.lax.dynamic_slice_in_dim(
+                self.position_embeddings, position_offset, L, axis=0)
         return self.dropout(h + pos)
 
 

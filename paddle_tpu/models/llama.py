@@ -116,14 +116,24 @@ def _rotate_half(x):
     return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
 
 
-def apply_rotary(q, k, cos, sin, position_offset: int = 0):
+def apply_rotary(q, k, cos, sin, position_offset=0):
     """Rotary position embedding on [B, L, H, D] (llama rotate-half
-    convention)."""
+    convention). ``position_offset`` may be a scalar or a per-row ``[B]``
+    vector (continuous-batching decode: each slot rotates at its own
+    position)."""
     L = q.shape[1]
-    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, L, axis=0)
-    s = jax.lax.dynamic_slice_in_dim(sin, position_offset, L, axis=0)
-    c = c[None, :, None, :].astype(q.dtype)
-    s = s[None, :, None, :].astype(q.dtype)
+    if getattr(position_offset, "ndim", 0) == 1:
+        idx = (jnp.asarray(position_offset, jnp.int32)[:, None]
+               + jnp.arange(L, dtype=jnp.int32)[None, :])
+        c = jnp.take(jnp.asarray(cos), idx, axis=0)  # [B, L, D]
+        s = jnp.take(jnp.asarray(sin), idx, axis=0)
+        c = c[:, :, None, :].astype(q.dtype)
+        s = s[:, :, None, :].astype(q.dtype)
+    else:
+        c = jax.lax.dynamic_slice_in_dim(cos, position_offset, L, axis=0)
+        s = jax.lax.dynamic_slice_in_dim(sin, position_offset, L, axis=0)
+        c = c[None, :, None, :].astype(q.dtype)
+        s = s[None, :, None, :].astype(q.dtype)
     return q * c + _rotate_half(q) * s, k * c + _rotate_half(k) * s
 
 

@@ -79,10 +79,24 @@ def update_kv_cache(cache, k_new, v_new, position_offset):
 
     ``position_offset`` may be a traced scalar (the single-token decode
     step passes the running position as a device int32, so ONE compiled
-    program serves every position)."""
+    program serves every position) or a traced ``[B]`` vector — the
+    continuous-batching decode step, where every slot of the live batch
+    sits at its own position (one per-row windowed write, still one
+    program)."""
     k_cache, v_cache = cache
+    pos = jnp.asarray(position_offset, jnp.int32)
+    if pos.ndim == 1:
+        zero = jnp.zeros((), jnp.int32)
+
+        def write(c, n, p):
+            return jax.lax.dynamic_update_slice(
+                c, n.astype(c.dtype), (p, zero, zero))
+
+        k_cache = jax.vmap(write)(k_cache, k_new, pos)
+        v_cache = jax.vmap(write)(v_cache, v_new, pos)
+        return k_cache, v_cache
     zero = jnp.zeros((), jnp.int32)
-    start = (zero, jnp.asarray(position_offset, jnp.int32), zero, zero)
+    start = (zero, pos, zero, zero)
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k_new.astype(k_cache.dtype), start)
     v_cache = jax.lax.dynamic_update_slice(
@@ -95,7 +109,9 @@ def cached_attention(q, k_cache, v_cache, position_offset):
     [B, S, Hkv, D] with a position mask: query at absolute position
     ``position_offset + i`` sees keys at positions ``<= position_offset + i``
     only, so stale/unwritten cache slots beyond the current position never
-    leak in. GQA is a grouped einsum — the kv heads are never repeated
+    leak in. ``position_offset`` may be a scalar or a per-row ``[B]``
+    vector (continuous-batching decode: each slot masks at its own
+    position). GQA is a grouped einsum — the kv heads are never repeated
     into [B, S, H, D]."""
     B, L, H, D = q.shape
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
@@ -103,9 +119,13 @@ def cached_attention(q, k_cache, v_cache, position_offset):
     qg = q.reshape(B, L, Hkv, groups, D)
     s = jnp.einsum("blhgd,bshd->bhgls", qg, k_cache.astype(q.dtype))
     s = s * (1.0 / math.sqrt(D))
-    qpos = jnp.asarray(position_offset, jnp.int32) + jnp.arange(L, dtype=jnp.int32)
-    allowed = jnp.arange(S, dtype=jnp.int32)[None, :] <= qpos[:, None]  # [L, S]
-    s = jnp.where(allowed[None, None, None], s, jnp.finfo(s.dtype).min)
+    # qpos [B|1, L]: scalar offsets broadcast over the batch, vector
+    # offsets give every row its own mask frontier
+    off = jnp.asarray(position_offset, jnp.int32).reshape(-1, 1)
+    qpos = off + jnp.arange(L, dtype=jnp.int32)[None, :]
+    allowed = (jnp.arange(S, dtype=jnp.int32)[None, None, :]
+               <= qpos[:, :, None])                      # [B|1, L, S]
+    s = jnp.where(allowed[:, None, None], s, jnp.finfo(s.dtype).min)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bhgls,bshd->blhgd", p, v_cache.astype(q.dtype))
     return out.reshape(B, L, H, D)

@@ -43,16 +43,24 @@ class ProfilerTarget(enum.Enum):
 
 
 # ------------------------------------------------------------- host events
+# Bounded: a long-lived process with spans enabled (a serving loop emits
+# serve:prefill/serve:decode per admission/step, indefinitely) must not
+# grow the recorder without bound — oldest spans roll off past the cap.
+_MAX_HOST_SPANS = 200_000
+
+
 class _HostEventRecorder:
     """Lock-free-ish per-process span store (HostEventRecorder analogue,
     ``host_event_recorder.h``)."""
 
     def __init__(self):
-        self.spans = []  # (name, t0, t1)
+        from collections import deque
+
+        self.spans = deque(maxlen=_MAX_HOST_SPANS)  # (name, t0, t1)
         self.enabled = False
 
     def clear(self):
-        self.spans = []
+        self.spans.clear()
 
 
 _recorder = _HostEventRecorder()

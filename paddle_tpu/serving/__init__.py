@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — continuous-batching inference.
+
+The layer between ``models.generation`` (two compiled programs, one
+closed batch) and an open request stream: a fixed ``B``-slot decode
+batch whose slots admit/free independently (``engine``), FIFO admission
+control with backpressure and deadlines (``scheduler``), a threaded
+front end with per-request streaming and crash recovery (``server``),
+and operator metrics (``metrics``). See README "Serving" for the
+architecture sketch and slot lifecycle.
+
+    from paddle_tpu.serving import InferenceServer
+
+    with InferenceServer(lm, slots=8, max_length=1024) as srv:
+        h = srv.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+        for tok in h.stream():
+            ...
+"""
+from .engine import ContinuousBatchingEngine, SlotEvent  # noqa: F401
+from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
+from .scheduler import (Backpressure, FifoScheduler, QueueFull,  # noqa: F401
+                        Request, SchedulerClosed)
+from .server import InferenceServer, RequestHandle  # noqa: F401
+
+__all__ = [
+    "ContinuousBatchingEngine", "SlotEvent", "InferenceServer",
+    "RequestHandle", "FifoScheduler", "Request", "Backpressure",
+    "QueueFull", "SchedulerClosed", "ServingMetrics", "LatencyHistogram",
+]

@@ -1,0 +1,325 @@
+"""Slot-based continuous batcher: a fixed-shape decode batch under an
+open request stream.
+
+``models.generation.GenerationEngine`` serves one CLOSED batch: every
+request in it prefills together and the batch drains together, so a
+request arriving mid-decode waits out the whole batch and finished rows
+burn decode FLOPs as eos filler. This engine keeps the SAME fixed cache
+shape ``[B, max_length, n_kv_heads, head_dim]`` but treats the batch
+dimension as ``B`` independent *slots*:
+
+- **admit** runs the existing bucketed prefill at batch 1 against a fresh
+  zero single-slot cache and — inside the same compiled program —
+  scatters the resulting cache rows into the live batch at a *traced*
+  slot index (``generation.scatter_cache_rows``) and samples the
+  request's first token. One program per prefill bucket, for every slot.
+- **step** advances ALL slots one token with a *vector* of per-slot
+  positions (the ``[B]`` ``position_offset`` path through
+  ``lm_utils.cached_attention`` / ``update_kv_cache`` and the models'
+  position tables), per-slot PRNG keys / eos ids / sampling params, and a
+  traced greedy mask. Exactly ONE compiled program, regardless of which
+  requests currently share the batch.
+
+Steady state therefore holds at ``#prefill_buckets + 1`` compiled
+programs — the generation engine's compile discipline, now under
+multi-tenant traffic. Freed slots are reusable immediately: stale cache
+rows are harmless because the per-row position mask never lets a query
+see beyond its own request's frontier, and every position is rewritten
+before it first becomes visible.
+
+Per-request sampled streams are *placement-invariant*: slot keys fold
+``(position, row=0)`` exactly like a solo batch-1 ``generate()``, so a
+request's tokens don't depend on which slot it landed in or who shares
+the batch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import compile_cache
+from ..framework.dtype import convert_dtype
+from ..io.batching import bucket_for
+from ..models.generation import (DEFAULT_PREFILL_BUCKETS, _constrain_cache,
+                                 init_cache, per_row_keys, sample_logits_rows,
+                                 scatter_cache_rows)
+from ..nn.layer import buffer_state, functional_call, param_state
+
+__all__ = ["ContinuousBatchingEngine", "SlotEvent"]
+
+
+@dataclass
+class SlotEvent:
+    """One slot's outcome of a decode step (host-side)."""
+
+    slot: int
+    token: int
+    done: bool
+
+
+class ContinuousBatchingEngine:
+    """The compiled slot-scatter prefill + vector-position decode pair and
+    the host-side slot table for one model.
+
+    ``top_k``/``allow_top_p`` are engine-level statics (they change the
+    compiled sampling graph); everything else — temperature, top_p value,
+    greedy-vs-sample, eos id, seed — is per-request and traced, so a
+    heterogeneous batch still runs the single decode program.
+    """
+
+    def __init__(self, model, slots: int = 4,
+                 max_length: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 top_k: int = 0, allow_top_p: bool = True):
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        self.model = model
+        spec = model.cache_spec()
+        self.spec = spec
+        self.slots = int(slots)
+        self.max_length = int(max_length or spec["max_length"])
+        if self.max_length > spec["max_length"]:
+            raise ValueError(
+                f"max_length {self.max_length} exceeds the model's position "
+                f"table ({spec['max_length']} positions)")
+        buckets = tuple(sorted(int(b) for b in
+                               (prefill_buckets or DEFAULT_PREFILL_BUCKETS)
+                               if int(b) <= self.max_length))
+        self.prefill_buckets = buckets or (self.max_length,)
+        self.top_k = int(top_k)
+        self.allow_top_p = bool(allow_top_p)
+        model_name = type(model).__name__
+        self._cc_prefill = compile_cache.register_name(
+            f"serve:prefill:{model_name}")
+        self._cc_decode = compile_cache.register_name(
+            f"serve:decode:{model_name}")
+        donate = (2,) if jax.default_backend() != "cpu" else ()
+        self._prefill_compiled = jax.jit(
+            compile_cache.instrument(self._prefill_fn, self._cc_prefill),
+            donate_argnums=donate)
+        self._decode_compiled = jax.jit(
+            compile_cache.instrument(self._decode_fn, self._cc_decode),
+            donate_argnums=donate)
+        self.reset()
+
+    # ------------------------------------------------------------- state
+    def reset(self) -> None:
+        """(Re)build the live batch: fresh cache, all slots free, weights
+        re-snapshotted. Also the crash-recovery path — a fault mid-step
+        may leave donated buffers half-written, so recovery starts clean."""
+        self._params = param_state(self.model)
+        self._buffers = buffer_state(self.model)
+        self.live_cache = init_cache(self.model, self.slots, self.max_length)
+        B = self.slots
+        self._positions = np.zeros(B, np.int32)
+        self._tokens = np.zeros(B, np.int32)
+        self._done = np.ones(B, bool)          # free slots sit "done"
+        self._keys = np.zeros((B, 2), np.uint32)
+        self._eos = np.full(B, -1, np.int32)
+        self._temp = np.ones(B, np.float32)
+        self._top_p = np.ones(B, np.float32)
+        self._greedy = np.ones(B, bool)
+        self.requests: List[Optional[object]] = [None] * B
+
+    def sync_weights(self) -> None:
+        """Re-snapshot the model's parameters/buffers (e.g. after a fit
+        loop updated them). Shape-stable, so no recompile."""
+        self._params = param_state(self.model)
+        self._buffers = buffer_state(self.model)
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.requests) if r is None]
+
+    @property
+    def active_count(self) -> int:
+        return sum(r is not None for r in self.requests)
+
+    def occupancy(self) -> float:
+        return self.active_count / self.slots
+
+    # ----------------------------------------------------- compiled fns
+    def _eval_mode(self):
+        """Serving must trace the EVAL graph (dropout off) even if the
+        model is mid-fit; the flag is read at trace time only, so every
+        dispatch site (a novel bucket may trace at any admit) flips it
+        and restores — same discipline as GenerationEngine.generate."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            was_training = self.model.training
+            self.model.eval()
+            try:
+                yield
+            finally:
+                if was_training:
+                    self.model.train()
+
+        return guard()
+
+    def _slot_zero_cache(self):
+        shape = (1, self.max_length, self.spec["num_kv_heads"],
+                 self.spec["head_dim"])
+        dtype = convert_dtype(self.spec["dtype"])
+        return tuple((jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                     for _ in range(self.spec["num_layers"]))
+
+    def _prefill_fn(self, params, buffers, live_cache, ids, slot,
+                    last_index, key, eos_id, temperature, top_p, greedy):
+        """Bucketed batch-1 prefill FUSED with the slot scatter: the fresh
+        single-slot cache never exists outside this program, so admission
+        costs one compile per bucket — not per bucket per slot, and no
+        separate scatter program."""
+        slot_cache = self._slot_zero_cache()
+        (logits, slot_cache), _ = functional_call(
+            self.model, params, buffers, ids, cache=slot_cache,
+            position_offset=0, gather_last=last_index)
+        logits = logits[:, 0, :]
+        rows = per_row_keys(key, 1)
+        next_tok = sample_logits_rows(
+            logits, rows, temperature, self.top_k, top_p,
+            use_top_p=self.allow_top_p,
+            greedy_mask=jnp.asarray(greedy).reshape(1))
+        live_cache = scatter_cache_rows(live_cache, slot_cache, slot)
+        live_cache = _constrain_cache(live_cache, self.slots,
+                                      self.spec["num_kv_heads"])
+        done = next_tok[0] == eos_id
+        return next_tok[0], done, live_cache
+
+    def _decode_fn(self, params, buffers, live_cache, tokens, positions,
+                   keys, done, eos, temperature, top_p, greedy_mask):
+        (logits, live_cache), _ = functional_call(
+            self.model, params, buffers, tokens, cache=live_cache,
+            position_offset=positions)
+        live_cache = _constrain_cache(live_cache, self.slots,
+                                      self.spec["num_kv_heads"])
+        logits = logits[:, -1, :]
+        # per-slot streams: each slot replays the batch-1 generate() key
+        # derivation (per_row_keys at batch=1 — ONE shared definition), so
+        # a served request's sampled tokens are identical to a solo run
+        # with the same seed no matter its slot or batch companions
+        step_keys = jax.vmap(
+            lambda k, p: per_row_keys(k, 1, position=p)[0])(keys, positions)
+        next_tok = sample_logits_rows(
+            logits, step_keys, temperature, self.top_k, top_p,
+            use_top_p=self.allow_top_p, greedy_mask=greedy_mask)
+        fill = jnp.maximum(eos, 0)
+        next_tok = jnp.where(done, fill, next_tok)
+        done = done | (next_tok == eos)
+        return next_tok, done, live_cache
+
+    # -------------------------------------------------------- host API
+    def bucket_for_prompt(self, prompt_len: int) -> int:
+        return min(bucket_for(prompt_len, self.prefill_buckets),
+                   self.max_length)
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt_len + max_new_tokens > self.max_length:
+            raise ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+                f"exceeds the engine's max_length {self.max_length}")
+
+    def admit(self, request, slot: int) -> Tuple[int, bool]:
+        """Prefill ``request`` into free ``slot``; returns the first
+        sampled token and whether the request finished at prefill (eos on
+        the first token). The live batch keeps decoding other slots'
+        requests before/after this call — only this call itself runs the
+        prefill program."""
+        from ..profiler import RecordEvent
+
+        if self.requests[slot] is not None:
+            raise RuntimeError(f"slot {slot} is occupied")
+        prompt = np.asarray(request.prompt, np.int32).ravel()
+        L = int(prompt.shape[0])
+        self.validate(L, int(request.max_new_tokens))
+        bucket = self.bucket_for_prompt(L)
+        ids_p = np.zeros((1, bucket), np.int32)
+        ids_p[0, :L] = prompt
+        seed = getattr(request, "seed", None)
+        if seed is None:
+            # fresh randomness per request — matching solo
+            # generate(do_sample=True, seed=None); two unseeded requests
+            # with the same prompt must NOT sample identical streams
+            from ..framework import random as framework_random
+
+            key = np.asarray(
+                jax.random.key_data(framework_random.next_key()),
+                np.uint32)
+        else:
+            key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        eos = np.int32(-1 if request.eos_token_id is None
+                       else request.eos_token_id)
+        with RecordEvent("serve:prefill"), self._eval_mode():
+            compile_cache.record_call(self._cc_prefill)
+            tok, done0, self.live_cache = self._prefill_compiled(
+                self._params, self._buffers, self.live_cache, ids_p,
+                np.int32(slot), np.int32(L - 1), key, eos,
+                np.float32(request.temperature),
+                np.float32(request.top_p), np.bool_(request.greedy))
+        first = int(np.asarray(tok))
+        fin = bool(np.asarray(done0))
+        self.requests[slot] = request
+        self._positions[slot] = L
+        self._tokens[slot] = first
+        self._done[slot] = fin
+        self._keys[slot] = key
+        self._eos[slot] = eos
+        self._temp[slot] = request.temperature
+        self._top_p[slot] = request.top_p
+        self._greedy[slot] = request.greedy
+        return first, fin
+
+    def step(self) -> List[SlotEvent]:
+        """One decode iteration over the WHOLE live batch. Returns one
+        event per occupied, not-yet-done slot (its new token and done
+        flag); free slots decode as masked filler. The per-step host read
+        of ``[B]`` tokens is what streams results out — continuous
+        batching's equivalent of the generate() loop's done-check."""
+        from ..profiler import RecordEvent
+
+        with RecordEvent("serve:decode"), self._eval_mode():
+            compile_cache.record_call(self._cc_decode)
+            tok, done, self.live_cache = self._decode_compiled(
+                self._params, self._buffers, self.live_cache,
+                self._tokens[:, None], self._positions, self._keys,
+                self._done, self._eos, self._temp, self._top_p,
+                self._greedy)
+        toks = np.array(tok)   # writable copies: admit() scribbles slots
+        dns = np.array(done)
+        events: List[SlotEvent] = []
+        for i, req in enumerate(self.requests):
+            if req is None:
+                continue
+            if self._done[i]:
+                # finished but not yet released (server frees it right
+                # after dispatching events) — nothing new to report
+                continue
+            events.append(SlotEvent(i, int(toks[i]), bool(dns[i])))
+            self._positions[i] += 1
+        self._tokens = toks
+        self._done = dns | ~np.asarray(
+            [r is not None for r in self.requests])
+        return events
+
+    def release(self, slot: int) -> None:
+        """Free ``slot`` immediately — no batch drain. The stale cache
+        rows stay; the position mask keeps them invisible to whoever is
+        admitted next."""
+        self.requests[slot] = None
+        self._done[slot] = True
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+
+    def cache_stats(self) -> dict:
+        """Compile/call counters of the two serving programs — steady
+        state must hold at ``#buckets_used`` prefill + 1 decode."""
+        return {"prefill": compile_cache.cache_stats(self._cc_prefill),
+                "decode": compile_cache.cache_stats(self._cc_decode)}

@@ -1,0 +1,164 @@
+"""Serving observability: gauges, counters, latency histograms.
+
+The four signals a serving operator actually pages on:
+
+- **queue depth / slot occupancy** (gauges + a time-weighted occupancy
+  integral — "are we over/under-provisioned?"),
+- **TTFT** (time to first token: queue wait + prefill),
+- **inter-token latency** (the decode-loop heartbeat users feel),
+- **goodput** (tokens/s, requests/s, and the reject/expire/requeue
+  counts that explain the gap from offered load).
+
+Histograms use reservoir sampling (bounded memory under unbounded
+traffic) with exact counts/sums; ``snapshot()`` returns one plain dict —
+the shape ``tools/serve_bench.py`` emits as JSON. Device-free and
+import-light on purpose: the profiler's ``RecordEvent`` spans
+(``serve:admit`` / ``serve:prefill`` / ``serve:decode``) carry the
+per-phase timing into trace tooling; this module carries the fleet-level
+numbers.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["LatencyHistogram", "ServingMetrics"]
+
+
+class LatencyHistogram:
+    """Reservoir-sampled latency distribution with exact count/sum.
+
+    Percentiles are computed over the reservoir (uniform sample of the
+    stream — Vitter's algorithm R), so memory stays ``O(max_samples)``
+    no matter how long the server runs."""
+
+    def __init__(self, max_samples: int = 4096, seed: int = 0):
+        self.max_samples = int(max_samples)
+        self._rng = random.Random(seed)
+        self._samples: List[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        self.count += 1
+        self.total += s
+        if s > self.max:
+            self.max = s
+        if len(self._samples) < self.max_samples:
+            self._samples.append(s)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.max_samples:
+                self._samples[j] = s
+
+    def percentile(self, p: float) -> float:
+        if not self._samples:
+            return 0.0
+        srt = sorted(self._samples)
+        idx = min(len(srt) - 1, max(0, int(round((p / 100.0)
+                                                 * (len(srt) - 1)))))
+        return srt[idx]
+
+    def summary(self) -> Dict[str, float]:
+        mean = self.total / self.count if self.count else 0.0
+        return {"count": self.count,
+                "mean_ms": round(mean * 1e3, 3),
+                "p50_ms": round(self.percentile(50) * 1e3, 3),
+                "p99_ms": round(self.percentile(99) * 1e3, 3),
+                "max_ms": round(self.max * 1e3, 3)}
+
+
+class ServingMetrics:
+    """Thread-safe counters/gauges/histograms for one serving loop."""
+
+    def __init__(self, slots: int):
+        self.slots = int(slots)
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = time.monotonic()
+            self.requests_submitted = 0
+            self.requests_completed = 0
+            self.requests_rejected = 0
+            self.requests_expired = 0
+            self.requests_failed = 0
+            self.requests_requeued = 0
+            self.tokens_emitted = 0
+            self.prefills = 0
+            self.decode_steps = 0
+            self.queue_depth = 0
+            self.active_slots = 0
+            self._occ_integral = 0.0     # slot-seconds of occupancy
+            self._occ_last_t = self._t0
+            self.ttft = LatencyHistogram()
+            self.inter_token = LatencyHistogram()
+            self.queue_wait = LatencyHistogram()
+
+    # ------------------------------------------------------------ events
+    def _advance_occupancy(self, now: float) -> None:
+        self._occ_integral += self.active_slots * (now - self._occ_last_t)
+        self._occ_last_t = now
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + by)
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+
+    def set_active_slots(self, active: int) -> None:
+        with self._lock:
+            self._advance_occupancy(time.monotonic())
+            self.active_slots = int(active)
+
+    def observe_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self.ttft.observe(seconds)
+
+    def observe_inter_token(self, seconds: float) -> None:
+        with self._lock:
+            self.inter_token.observe(seconds)
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        with self._lock:
+            self.queue_wait.observe(seconds)
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self, compile_stats: Optional[dict] = None) -> dict:
+        """One plain dict of everything — the serve_bench JSON shape."""
+        with self._lock:
+            now = time.monotonic()
+            self._advance_occupancy(now)
+            elapsed = max(now - self._t0, 1e-9)
+            return {
+                "elapsed_s": round(elapsed, 3),
+                "slots": self.slots,
+                "queue_depth": self.queue_depth,
+                "active_slots": self.active_slots,
+                "slot_occupancy": round(
+                    self._occ_integral / (elapsed * self.slots), 4),
+                "requests_submitted": self.requests_submitted,
+                "requests_completed": self.requests_completed,
+                "requests_rejected": self.requests_rejected,
+                "requests_expired": self.requests_expired,
+                "requests_failed": self.requests_failed,
+                "requests_requeued": self.requests_requeued,
+                "tokens_emitted": self.tokens_emitted,
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+                "tokens_per_sec": round(self.tokens_emitted / elapsed, 2),
+                "requests_per_sec": round(
+                    self.requests_completed / elapsed, 3),
+                "ttft": self.ttft.summary(),
+                "inter_token": self.inter_token.summary(),
+                "queue_wait": self.queue_wait.summary(),
+                **({"compile_stats": compile_stats}
+                   if compile_stats is not None else {}),
+            }

@@ -1,0 +1,161 @@
+"""FIFO request queue with admission control for the serving loop.
+
+Admission control is the serving layer's backpressure story: the queue
+has a hard depth cap, and an over-capacity ``submit`` raises
+:class:`QueueFull` *immediately* — a bounded, observable reject beats an
+unbounded queue whose tail latency quietly explodes. :class:`QueueFull`
+subclasses ``ConnectionError`` (via :class:`Backpressure`), so clients
+that WANT to wait retry it through the stack's standard
+``distributed.resilience.RetryPolicy`` — backpressure rides the exact
+machinery transport failures do.
+
+Per-request deadlines use ``resilience.Deadline``: one monotonic budget
+stamped at submit covers queue wait (checked when the scheduler pops).
+Expired requests are handed back to the server to fail with
+``TimeoutError`` instead of burning prefill FLOPs on an answer nobody is
+waiting for.
+
+The prefill/decode interleaving policy also lives here:
+``max_prefills_per_step`` bounds how many admissions (each one compiled
+prefill dispatch) may run between consecutive decode iterations, so a
+burst of arrivals cannot starve in-flight requests' inter-token latency.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..distributed.resilience import Deadline
+
+__all__ = ["Backpressure", "QueueFull", "SchedulerClosed", "Request",
+           "FifoScheduler"]
+
+_req_serial = itertools.count()
+
+
+class Backpressure(ConnectionError):
+    """The server is over capacity RIGHT NOW; retrying later is expected
+    to succeed. Subclasses ``ConnectionError`` so a
+    ``resilience.RetryPolicy`` retries it like any transport failure."""
+
+
+class QueueFull(Backpressure):
+    """The admission queue is at its depth cap."""
+
+
+class SchedulerClosed(RuntimeError):
+    """Submit after shutdown began — not retryable."""
+
+
+@dataclass
+class Request:
+    """One generation request plus its per-slot sampling state.
+
+    ``greedy``/``temperature``/``top_p``/``eos_token_id``/``seed`` map
+    onto the engine's per-slot traced inputs; ``top_k`` (and whether
+    top-p filtering exists at all) are engine statics chosen at server
+    construction. ``attempts`` counts admissions — the crash-recovery
+    requeue budget.
+    """
+
+    prompt: object
+    max_new_tokens: int = 32
+    greedy: bool = True
+    temperature: float = 1.0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: Optional[int] = None
+    deadline: Optional[Deadline] = None
+    id: int = field(default_factory=lambda: next(_req_serial))
+    attempts: int = 0
+    handle: object = None  # back-pointer set by the server
+
+
+class FifoScheduler:
+    """Thread-safe bounded FIFO with deadline expiry and an admission-rate
+    cap. All methods are safe to call from any thread; the serving worker
+    is the only consumer."""
+
+    def __init__(self, max_queue_depth: int = 64,
+                 max_prefills_per_step: int = 2):
+        if max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        if max_prefills_per_step < 1:
+            raise ValueError("max_prefills_per_step must be >= 1")
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_prefills_per_step = int(max_prefills_per_step)
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, request: Request) -> None:
+        with self._lock:
+            if self._closed:
+                raise SchedulerClosed("scheduler is shut down")
+            if len(self._q) >= self.max_queue_depth:
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue_depth} "
+                    f"requests waiting); retry with backoff")
+            self._q.append(request)
+
+    def requeue(self, request: Request) -> None:
+        """Put a request BACK at the head (crash recovery / preemption).
+        Bypasses the depth cap — the request was already admitted once and
+        rejecting it now would turn a recoverable fault into data loss."""
+        with self._lock:
+            self._q.appendleft(request)
+
+    def take(self, free_slots: int) -> Tuple[List[Request], List[Request]]:
+        """Pop up to ``min(free_slots, max_prefills_per_step)`` admittable
+        requests. Returns ``(admit, expired)`` — expired requests (queue
+        wait exceeded their deadline) are popped but handed back for the
+        caller to fail, never admitted."""
+        admit: List[Request] = []
+        expired: List[Request] = []
+        budget = min(int(free_slots), self.max_prefills_per_step)
+        with self._lock:
+            while self._q and len(admit) < budget:
+                req = self._q.popleft()
+                if req.deadline is not None and req.deadline.expired():
+                    expired.append(req)
+                    continue
+                admit.append(req)
+        return admit, expired
+
+    def pop_expired(self) -> List[Request]:
+        """Sweep expired requests out of the queue without admitting
+        anything (called even when no slot is free, so a doomed request
+        fails at its deadline, not at its turn)."""
+        expired: List[Request] = []
+        with self._lock:
+            keep = deque()
+            for req in self._q:
+                if req.deadline is not None and req.deadline.expired():
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._q = keep
+        return expired
+
+    def seal(self) -> None:
+        """Refuse new submits but KEEP the queue — the graceful-shutdown
+        first half (the worker drains what was already accepted)."""
+        with self._lock:
+            self._closed = True
+
+    def close(self) -> List[Request]:
+        """Refuse new submits; return whatever is still queued (the
+        caller decides: drain them or fail them)."""
+        with self._lock:
+            self._closed = True
+            rest = list(self._q)
+            self._q.clear()
+        return rest

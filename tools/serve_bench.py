@@ -1,0 +1,162 @@
+"""Latency-percentile load bench for the continuous-batching server.
+
+Open-loop Poisson load (arrivals don't wait for completions — the honest
+way to measure a server: closed-loop generators self-throttle and hide
+queueing collapse) against ``paddle_tpu.serving.InferenceServer``,
+reporting the serving numbers that matter and the compile discipline.
+Prints ONE JSON line:
+
+    {"metric": "gpt_serve_requests_per_sec", "value": N, "unit": "req/s",
+     "extra": {"goodput": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
+               "inter_token_p50_ms": ..., "inter_token_p99_ms": ...,
+               "tokens_per_sec": ..., "slot_occupancy": ...,
+               "prefill_compiles": ..., "decode_compiles": ...,
+               "steady_state_recompiles": ...}}
+
+Warmup requests touch every prefill bucket first; the measured window
+must then hold at ``#buckets + 1`` programs — ANY steady-state recompile
+exits non-zero (the serving analogue of ``tools/retrace_report.py``).
+
+    python tools/serve_bench.py                  # CPU-safe tiny config
+    python tools/serve_bench.py --check          # quick CI/bench probe
+    python tools/serve_bench.py --preset serving --slots 8 --rate 4
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=("gpt", "llama"), default="gpt")
+    ap.add_argument("--preset", choices=("tiny", "serving"), default="tiny")
+    ap.add_argument("--slots", type=int, default=3)
+    ap.add_argument("--rate", type=float, default=2.0,
+                    help="offered load, requests/s (Poisson arrivals)")
+    ap.add_argument("--requests", type=int, default=16,
+                    help="measured requests after warmup")
+    ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--buckets", type=int, nargs="+", default=(16, 32))
+    ap.add_argument("--max-queue-depth", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-request completion wait cap (s)")
+    ap.add_argument("--check", action="store_true",
+                    help="small fixed workload for CI / bench.py probing")
+    args = ap.parse_args(argv)
+    if args.check:
+        args.requests = min(args.requests, 8)
+        args.rate = min(args.rate, 4.0)
+        args.new_tokens = min(args.new_tokens, 10)
+
+    import jax
+
+    from decode_bench import build_model
+    from paddle_tpu.framework import compile_cache
+    from paddle_tpu.serving import InferenceServer, QueueFull
+
+    model, cfg = build_model(args.model, args.preset)
+    max_length = min(cfg.max_position_embeddings,
+                     max(args.buckets) + args.new_tokens + 8)
+    srv = InferenceServer(model, slots=args.slots, max_length=max_length,
+                          prefill_buckets=args.buckets,
+                          max_queue_depth=args.max_queue_depth)
+    rng = np.random.default_rng(args.seed)
+    lens = sorted(b - 2 for b in srv.engine.prefill_buckets)
+
+    def prompt(n):
+        return rng.integers(0, cfg.vocab_size, (int(n),)).astype(np.int32)
+
+    # ---- warmup: touch every bucket (and the decode program) once ----
+    t_warm = time.perf_counter()
+    for L in lens:
+        srv.submit(prompt(L), max_new_tokens=4).result(timeout=args.timeout)
+    srv.submit(prompt(lens[0]), max_new_tokens=4, do_sample=True,
+               temperature=0.9, top_p=0.9, seed=1).result(
+                   timeout=args.timeout)
+    warmup_s = time.perf_counter() - t_warm
+    compiles_before = compile_cache.cache_stats()["compiles"]
+    srv.metrics.reset()
+
+    # ---- measured open-loop window ----
+    interarrival = rng.exponential(1.0 / max(args.rate, 1e-6),
+                                   args.requests)
+    max_len = max(lens)
+    handles, rejected = [], 0
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        target = t0 + float(interarrival[:i + 1].sum())
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        L = int(rng.integers(4, max_len + 1))
+        sampled = bool(i % 2)
+        try:
+            handles.append(srv.submit(
+                prompt(L), max_new_tokens=args.new_tokens,
+                do_sample=sampled, temperature=0.8, top_p=0.95,
+                seed=args.seed + i))
+        except QueueFull:
+            rejected += 1  # open loop: a reject is goodput lost, not a wait
+    completed = 0
+    for h in handles:
+        try:
+            h.result(timeout=args.timeout)
+            completed += 1
+        except Exception:
+            pass
+    compiles_after = compile_cache.cache_stats()["compiles"]
+    steady = compiles_after - compiles_before
+    snap = srv.snapshot()
+    srv.shutdown(drain=True, timeout=60.0)
+
+    cc = snap["compile_stats"]
+    record = {
+        "metric": f"{args.model}_serve_requests_per_sec",
+        "value": snap["requests_per_sec"],
+        "unit": "req/s",
+        "extra": {
+            "goodput": round(completed / max(args.requests, 1), 4),
+            "offered_requests": args.requests,
+            "completed": completed,
+            "rejected": rejected,
+            "offered_rate_per_sec": args.rate,
+            "tokens_per_sec": snap["tokens_per_sec"],
+            "ttft_p50_ms": snap["ttft"]["p50_ms"],
+            "ttft_p99_ms": snap["ttft"]["p99_ms"],
+            "inter_token_p50_ms": snap["inter_token"]["p50_ms"],
+            "inter_token_p99_ms": snap["inter_token"]["p99_ms"],
+            "queue_wait_p99_ms": snap["queue_wait"]["p99_ms"],
+            "slot_occupancy": snap["slot_occupancy"],
+            "slots": args.slots,
+            "new_tokens": args.new_tokens,
+            "prefill_compiles": cc["prefill"]["compiles"],
+            "decode_compiles": cc["decode"]["compiles"],
+            "steady_state_recompiles": steady,
+            "warmup_s": round(warmup_s, 2),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "preset": args.preset,
+            "check": bool(args.check),
+        },
+    }
+    print(json.dumps(record))
+    if steady:
+        print(f"FAIL: {steady} recompile(s) during the measured window — "
+              f"the serving loop is not shape-stable (see "
+              f"compile_cache.cache_stats() signatures)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
