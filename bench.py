@@ -524,19 +524,57 @@ def _check_backend(probe_timeout: Optional[float] = None):
 _RETRY_STATS = {"probe_retry_s": 0.0, "probe_attempts": 0}
 
 
+def _probe_budget_default() -> float:
+    """TOTAL probe wall-clock cap across every probe attempt and retry
+    sleep of the round: 600 s unless PT_BENCH_PROBE_BUDGET overrides.
+    Round r05 burned ~20 min inside 180 s-per-attempt probe retries before
+    reporting tpu_unavailable; the per-attempt cap
+    (PT_BENCH_PROBE_TIMEOUT) cannot bound that sum — this does, and its
+    default sits well under the tier-1 870 s window so a dead tunnel
+    yields its error artifact while the driver is still listening."""
+    try:
+        return float(os.environ.get("PT_BENCH_PROBE_BUDGET", "600"))
+    except ValueError:
+        return 600.0
+
+
+# remaining probe wall-clock for THIS process (both _wait_for_backend
+# calls — initial and post-bench-failure — draw from the one pot)
+_PROBE_BUDGET = {"remaining": None}
+
+
 def _wait_for_backend(deadline: float):
-    """Retry the backend probe with backoff until it succeeds or the shared
-    ``deadline`` (time.monotonic()-based) runs out. Tunnel outages last
-    hours; one failed init must not cost the round's perf evidence. The
-    deadline is computed ONCE in main() so that probe-retries before the
-    first attempt and before the retry attempt draw from the same window.
+    """Retry the backend probe with backoff until it succeeds, the shared
+    ``deadline`` (time.monotonic()-based) runs out, or the TOTAL probe
+    budget (PT_BENCH_PROBE_BUDGET) is exhausted. Tunnel outages last
+    hours; one failed init must not cost the round's perf evidence — but
+    probing must also never eat the whole round: on budget exhaustion this
+    returns immediately so the supervisor can emit the error artifact
+    while the driver is still listening. The deadline is computed ONCE in
+    main() so that probe-retries before the first attempt and before the
+    retry attempt draw from the same window.
     """
+    if _PROBE_BUDGET["remaining"] is None:
+        _PROBE_BUDGET["remaining"] = _probe_budget_default()
+    t_start = time.monotonic()
+    budget_deadline = t_start + _PROBE_BUDGET["remaining"]
+    eff_deadline = min(deadline, budget_deadline)
+
+    def spend() -> None:
+        _PROBE_BUDGET["remaining"] = max(
+            0.0, _PROBE_BUDGET["remaining"] - (time.monotonic() - t_start))
+
     def probe_timeout() -> float:
         # each probe attempt is clipped to the remaining window so a hung
         # probe can never push the supervisor past its budget
         return min(_probe_timeout_default(),
-                   max(15.0, deadline - time.monotonic()))
+                   max(15.0, eff_deadline - time.monotonic()))
 
+    if _PROBE_BUDGET["remaining"] <= 0:
+        return None, (f"probe budget exhausted "
+                      f"(PT_BENCH_PROBE_BUDGET={_probe_budget_default():.0f}s"
+                      f" spent across {_RETRY_STATS['probe_attempts']} "
+                      f"attempts)")
     if deadline - time.monotonic() <= 0:
         return None, "budget exhausted before probe"
     delay = 60.0
@@ -545,9 +583,16 @@ def _wait_for_backend(deadline: float):
     backend, err = _check_backend(probe_timeout())
     retry_t0 = time.monotonic()
     while backend is None:
-        remaining = deadline - time.monotonic()
+        remaining = eff_deadline - time.monotonic()
         if remaining <= 0:
             _RETRY_STATS["probe_retry_s"] += time.monotonic() - retry_t0
+            spend()
+            if budget_deadline < deadline:
+                return None, (
+                    f"probe budget exhausted after "
+                    f"{_RETRY_STATS['probe_attempts']} attempts "
+                    f"(PT_BENCH_PROBE_BUDGET="
+                    f"{_probe_budget_default():.0f}s); last error: {err}")
             return None, err
         _set_status("probe-retry", f"{err}; {remaining:.0f}s left in window")
         sys.stderr.write(
@@ -559,6 +604,7 @@ def _wait_for_backend(deadline: float):
         _RETRY_STATS["probe_attempts"] += 1
         backend, err = _check_backend(probe_timeout())
     _RETRY_STATS["probe_retry_s"] += time.monotonic() - retry_t0
+    spend()
     return backend, None
 
 

@@ -67,6 +67,11 @@ from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
 from . import eager  # noqa: F401  (Tensor.backward dygraph facade)
 from . import autograd  # noqa: F401  (PyLayer / hooks / backward)
+# self-healing training (numerics watchdog / auto-rollback / preemption);
+# imported late: the supervisor pulls in distributed.checkpoint
+from .framework.supervisor import (  # noqa: F401
+    RecoveryPolicy, TrainingPreempted, TrainingSupervisor,
+)
 
 # autodiff: the reference's eager GradNode engine collapses to jax.grad
 import jax as _jax

@@ -63,8 +63,19 @@ def update_scale(state, found_inf):
 
 class GradScaler:
     """Paddle-shaped wrapper. In a jitted TrainStep, prefer the functional
-    helpers; this class packages them for the eager/hapi path and provides
-    ``minimize``-style semantics."""
+    helpers (or pass the scaler to ``TrainStep(scaler=...)`` /
+    ``Model.prepare(amp_configs={"scaler": ...})`` which fuses them); this
+    class packages them for the eager path and provides ``minimize``-style
+    semantics.
+
+    Skip accounting: :attr:`skipped_step_count` / :attr:`last_overflow_step`
+    report how many optimizer updates the scaler suppressed on overflow and
+    the 1-based index of the latest one — so user code and the numerics
+    watchdog can tell ordinary scaler inf-skips from watchdog anomaly
+    skips. A fused TrainStep records its overflow flags LAZILY (device
+    scalars, no per-step host sync); reading either property forces the
+    pending flags.
+    """
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
                  decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
@@ -73,6 +84,10 @@ class GradScaler:
         self.use_dynamic = use_dynamic_loss_scaling
         self.state = init_scale_state(init_loss_scaling, incr_ratio, decr_ratio,
                                       incr_every_n_steps, decr_every_n_nan_or_inf)
+        self._step_counter = 0     # update steps observed (eager or fused)
+        self._skipped = 0
+        self._last_overflow = None
+        self._pending = []         # [(step_idx, lazy found_inf flag)]
 
     def scale(self, loss):
         if not self.enable:
@@ -94,7 +109,46 @@ class GradScaler:
         rolled = jax.tree.map(lambda old, new: jnp.where(found, old, new), params, new_params)
         if self.use_dynamic:
             self.state = update_scale(self.state, found)
+        self._note_step(found)
         return rolled
+
+    # ------------------------------------------------------ skip accounting
+    # bounded: a long run that never reads the counters must not retain one
+    # device scalar per step — past this many pending flags they are forced
+    # (one host sync per _PENDING_MAX update steps, negligible)
+    _PENDING_MAX = 256
+
+    def _note_step(self, found_inf) -> None:
+        """Record one update step's overflow flag (may be a lazy device
+        scalar; forced when the counters are read or the buffer fills)."""
+        self._step_counter += 1
+        self._pending.append((self._step_counter, found_inf))
+        if len(self._pending) >= self._PENDING_MAX:
+            self._sync_pending()
+
+    def _sync_pending(self) -> None:
+        if not self._pending:
+            return
+        # one transfer for the whole buffer, not one round-trip per flag
+        flags = jax.device_get([flag for _, flag in self._pending])
+        for (idx, _), flag in zip(self._pending, flags):
+            if bool(flag):
+                self._skipped += 1
+                self._last_overflow = idx
+        self._pending.clear()
+
+    @property
+    def skipped_step_count(self) -> int:
+        """Optimizer updates suppressed because unscaled grads overflowed."""
+        self._sync_pending()
+        return self._skipped
+
+    @property
+    def last_overflow_step(self):
+        """1-based index of the most recent overflow-skipped step (None if
+        no step has ever overflowed)."""
+        self._sync_pending()
+        return self._last_overflow
 
     def is_enable(self):
         return self.enable

@@ -432,6 +432,15 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
             flat_out[key] = reader.read(tuple(slice(0, d) for d in shape))
     if template is not None:
         flat_t, treedef = _flatten(template)
+        missing = [k for k in flat_t if k not in flat_out]
+        if missing:
+            raise CheckpointCorruptError(
+                f"{directory}: checkpoint lacks {len(missing)} leaf/leaves "
+                f"the template expects (first: {missing[0]!r}). A checkpoint "
+                f"written before the state tree gained new leaves (e.g. "
+                f"base_key/scaler_state) loads fine WITHOUT a template, or "
+                f"through TrainingSupervisor.restore(), which treats those "
+                f"leaves as optional.")
         ordered = [flat_out[k] for k in flat_t]
         return jax.tree_util.tree_unflatten(treedef, ordered)
     return flat_out
@@ -573,12 +582,14 @@ class AutoCheckpoint:
 
     def __init__(self, root: str, save_interval_steps: int = 100,
                  save_interval_seconds: Optional[float] = None,
-                 keep_max: int = 3, async_save: bool = True):
+                 keep_max: int = 3, async_save: bool = True,
+                 staging_ttl_seconds: float = 3600.0):
         self.root = root
         self.save_interval_steps = save_interval_steps
         self.save_interval_seconds = save_interval_seconds
         self.keep_max = keep_max
         self.async_save = async_save
+        self.staging_ttl_seconds = float(staging_ttl_seconds)
         self._last_save_time = time.monotonic()
         self._last_step = -1
         self._pending: Optional[_PendingSave] = None
@@ -588,23 +599,48 @@ class AutoCheckpoint:
     _ORPHAN = re.compile(r"^step_\d+\.tmp(-pt\d+)?$")
     _TRASH = re.compile(r"^(step_\d+)\.old-pt\d+$")
 
-    def _sweep_orphans(self) -> None:
+    def _sweep_orphans(self, ttl: float = 0.0) -> None:
         """Clean up after a killed process: ``step_N.tmp*`` staging dirs are
         never valid restore targets (publish renames them away before they
         count) and are deleted; a ``step_N.old-pt<pid>`` overwrite trash
         copy whose ``step_N`` is MISSING is the old checkpoint caught
         between save_state's two renames — restore it rather than lose the
-        only copy."""
+        only copy.
+
+        ``ttl`` > 0 reaps only staging dirs whose mtime is older than that
+        many seconds. The startup sweep runs with ttl=0 (the restarting
+        process owns the root); the PERIODIC sweep (from ``_gc``, so a
+        long-lived trainer also heals) uses ``staging_ttl_seconds`` — a
+        sibling process SIGKILLed mid-save must not leak its staging dir
+        until the next restart, while a live peer's in-flight save (fresh
+        mtime) is left alone."""
+        now = time.time()
+
+        def fresh(path: str) -> bool:
+            # under a TTL, anything recently touched may belong to a LIVE
+            # sibling mid-save (including the window between save_state's
+            # two overwrite renames) — leave it alone
+            if ttl <= 0.0:
+                return False
+            try:
+                return now - os.path.getmtime(path) < ttl
+            except OSError:
+                return True  # raced with its publish rename: not stale
+
         for name in os.listdir(self.root):
             path = os.path.join(self.root, name)
             m = self._TRASH.match(name)
             if m:
+                if fresh(path):
+                    continue
                 target = os.path.join(self.root, m.group(1))
                 if not os.path.exists(target):
                     os.replace(path, target)
                 else:
                     shutil.rmtree(path, ignore_errors=True)
             elif self._ORPHAN.match(name):
+                if fresh(path):
+                    continue
                 shutil.rmtree(path, ignore_errors=True)
 
     def _due(self, step):
@@ -664,6 +700,9 @@ class AutoCheckpoint:
                     kept_valid += 1
                 continue
             shutil.rmtree(path, ignore_errors=True)
+        # periodic staging sweep: a SIGKILLed sibling's .tmp-pt dir would
+        # otherwise leak until the next process restart
+        self._sweep_orphans(ttl=self.staging_ttl_seconds)
 
     def restore(self, shardings=None, template=None):
         self.wait()

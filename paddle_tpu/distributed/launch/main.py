@@ -22,6 +22,30 @@ from typing import List, Optional
 
 from .job import Container, Pod
 from .kv_server import KVClient, KVServer
+from ..resilience import EXIT_PREEMPTED
+
+# preemption exits restart for free (they checkpointed under their grace
+# deadline and resume exactly where they left off), but a worker that
+# "preempts" in a tight loop is a bug, not the scheduler — cap the free
+# restarts so it cannot spin forever
+_MAX_PREEMPT_RESTARTS = 16
+
+
+def _note_preemption(args, status: int) -> bool:
+    """True when ``status`` is a supervisor checkpoint-and-exit that should
+    restart WITHOUT charging --max_restarts (bounded per launcher)."""
+    if status != EXIT_PREEMPTED:
+        return False
+    count = getattr(args, "_preempt_restarts", 0) + 1
+    args._preempt_restarts = count
+    if count > _MAX_PREEMPT_RESTARTS:
+        print(f"[launch] {count} preemption exits — treating further ones "
+              f"as failures", flush=True)
+        return False
+    print(f"[launch] worker preempted (exit {status}); restarting to resume "
+          f"from checkpoint ({count}/{_MAX_PREEMPT_RESTARTS} free restarts)",
+          flush=True)
+    return True
 
 
 def _free_port() -> int:
@@ -148,8 +172,9 @@ def launch(argv: Optional[List[str]] = None) -> int:
             if kv_server:
                 kv_server.stop()
 
-    attempt = 0
-    coordinator = rendezvous(attempt)
+    attempt = 0   # failures charged against --max_restarts
+    gen = 0       # rendezvous generation: bumps on EVERY relaunch
+    coordinator = rendezvous(gen)
     try:
         while True:
             pod = _build_pod(args, args.node_rank, world, nproc, coordinator,
@@ -162,6 +187,14 @@ def launch(argv: Optional[List[str]] = None) -> int:
             if status == 0:
                 print(f"[launch] job {args.job_id} finished", flush=True)
                 return 0
+            gen += 1
+            if _note_preemption(args, status):
+                # graceful checkpoint-and-exit (supervisor EXIT_PREEMPTED):
+                # restart to resume from the recorded step WITHOUT charging
+                # --max_restarts (bounded by _MAX_PREEMPT_RESTARTS)
+                time.sleep(1.0)
+                coordinator = rendezvous(gen)
+                continue
             attempt += 1
             if attempt > args.max_restarts:
                 print(f"[launch] job {args.job_id} FAILED (exit {status}) "
@@ -172,7 +205,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
             print(f"[launch] worker failed (exit {status}); restart "
                   f"{attempt}/{args.max_restarts}", flush=True)
             time.sleep(1.0)
-            coordinator = rendezvous(attempt)
+            coordinator = rendezvous(gen)
     finally:
         if kv_server:
             kv_server.stop()
@@ -310,6 +343,10 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
                     except OSError:
                         pass
                 return 0
+            if _note_preemption(args, status):
+                # self-reported checkpoint-and-exit: resume immediately,
+                # no need to wait out a lease TTL diagnosing a dead peer
+                continue
             # a worker failure is often the echo of a peer node dying: its
             # collectives error within seconds, long before the dead lease
             # expires (ttl). Wait one TTL and recheck membership BEFORE

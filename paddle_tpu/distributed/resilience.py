@@ -17,7 +17,11 @@ them so ``rpc``, ``ps.service``, ``launch.kv_server`` and
   calls that are safe to orphan, e.g. during shutdown).
 - :class:`FaultPlan` — deterministic fault injection. A plan is a list of
   :class:`FaultRule`\\ s keyed by call-site tag (``kv.put``,
-  ``rpc.connect.worker1``, ``ps.request.0``, ``ckpt.shard_write``, ...);
+  ``rpc.connect.worker1``, ``ps.request.0``, ``ckpt.shard_write``; the
+  self-healing train loop adds ``train.step`` / ``train.ckpt`` /
+  ``train.data`` — a ``drop`` at ``train.data`` is interpreted by the
+  supervisor as a poisoned/NaN batch, ``delay`` at ``train.step`` as a
+  step stall, ``crash`` anywhere as a SIGKILL);
   instrumented call sites invoke :func:`fault_point` which consults the
   active plan. Kinds: ``drop`` (raise :class:`InjectedFault`, a
   ``ConnectionError`` — production retry paths treat it as a transport
@@ -46,7 +50,18 @@ __all__ = [
     "RetryPolicy", "Unavailable", "with_timeout", "Deadline",
     "FaultPlan", "FaultRule", "InjectedFault", "fault_point",
     "active_plan", "CRASH_EXIT", "FAULT_PLAN_ENV",
+    "EXIT_PREEMPTED", "EXIT_HANG",
 ]
+
+# Exit codes of the self-healing training layer (framework/supervisor.py).
+# ``distributed.launch`` recognises them: a worker that exits with
+# EXIT_PREEMPTED checkpointed cleanly under its grace deadline and is
+# restarted WITHOUT charging --max_restarts (resume lands on the recorded
+# step via AutoCheckpoint + the data cursor); EXIT_HANG is the hang
+# watchdog's hard exit after a step exceeded step_timeout (restart charges
+# the budget — a hang may be a real bug, not an infra blip).
+EXIT_PREEMPTED = 44
+EXIT_HANG = 45
 
 
 class Deadline:

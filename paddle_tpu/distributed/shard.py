@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..nn.layer import Layer, buffer_state, functional_call, param_state
 from ..framework import random as framework_random
+from ..framework.jit import StepSeams
 from .mesh import get_mesh, require_mesh
 
 P = PartitionSpec
@@ -122,7 +123,7 @@ def opt_state_specs(opt_state, params_specs: Dict[str, PartitionSpec],
     return out
 
 
-class DistributedTrainStep:
+class DistributedTrainStep(StepSeams):
     """pjit'd hybrid-parallel train step.
 
     Composition by configuration (the ``DistributedStrategy`` analogue):
@@ -137,7 +138,8 @@ class DistributedTrainStep:
     def __init__(self, model: Layer, optimizer, loss_fn=None, inputs_fn=None,
                  mesh=None, batch_axes=("dp", "sdp"), sharding_stage: int = 0,
                  grad_transform=None, donate: bool = True,
-                 grad_accum_steps: int = 1, grad_accum_avg: bool = True):
+                 grad_accum_steps: int = 1, grad_accum_avg: bool = True,
+                 scaler=None):
         from ..framework.jit import (DEFAULT_RNG_STREAMS, _grad_dtype,
                                      resolve_inputs_fn)
 
@@ -180,6 +182,13 @@ class DistributedTrainStep:
                     jnp.zeros(v.shape, _grad_dtype(v.dtype)),
                     NamedSharding(self.mesh, self.specs[k]))
                 for k, v in self.params.items()}
+        self._init_seams(scaler, self.grad_accum_steps)
+        # scale state is replicated: every device applies the same skip/grow
+        # decision, so the rolled-back state stays consistent across shards
+        self.scaler_state = (
+            {k: jax.device_put(jnp.asarray(v), NamedSharding(self.mesh, P()))
+             for k, v in dict(self.scaler.state).items()}
+            if self.scaler is not None else None)
         donate_argnums = (0, 1, 2, 3) if donate else ()
         from ..framework import compile_cache
 
@@ -218,8 +227,8 @@ class DistributedTrainStep:
                 out[slot] = val
         return out
 
-    def _step(self, params, buffers, opt_state, accum, batch, key, count,
-              with_check=False, do_update=True):
+    def _step(self, params, buffers, opt_state, accum, scaler_state, batch,
+              key, count, poison, with_check=False, do_update=True):
         from ..framework.jit import (accumulate_grads, finite_guard,
                                      merge_accumulated, split_rng_streams)
 
@@ -227,6 +236,7 @@ class DistributedTrainStep:
         # TPU-tunnel slow path (see framework/jit.py _step)
         rngs = split_rng_streams(jax.random.fold_in(key, count),
                                  self._rng_streams)
+        use_scaler = scaler_state is not None
 
         def compute_loss(p):
             # keep params at their declared shardings inside the traced fn
@@ -236,51 +246,110 @@ class DistributedTrainStep:
             if not isinstance(inputs, (tuple, list)):
                 inputs = (inputs,)
             out, new_buf = functional_call(self.model, p, buffers, *inputs, rngs=rngs)
-            loss = out if self.loss_fn is None else self.loss_fn(out, batch)
-            return jnp.asarray(loss, jnp.float32), (new_buf, out)
+            raw = out if self.loss_fn is None else self.loss_fn(out, batch)
+            loss = jnp.asarray(raw, jnp.float32) * poison
+            scaled = loss * scaler_state["scale"] if use_scaler else loss
+            return scaled, (new_buf, loss)
 
-        (loss, (new_buffers, _)), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        (_, (new_buffers, loss)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
         accum = accumulate_grads(accum, grads)
         if not do_update:
-            return loss, params, new_buffers, opt_state, accum
+            return loss, params, new_buffers, opt_state, accum, scaler_state
         grads, accum = merge_accumulated(accum, grads, self.grad_accum_steps,
                                          self.grad_accum_avg)
         if self.grad_transform is not None:
             grads = self.grad_transform(grads)
+        if use_scaler:
+            from ..amp.grad_scaler import unscale_and_check
+
+            grads, found = unscale_and_check(grads, scaler_state)
         new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
         new_params = {k: jax.lax.with_sharding_constraint(
             v, NamedSharding(self.mesh, self.specs[k])) for k, v in new_params.items()}
+        if use_scaler:
+            from ..framework.jit import scaler_guard
+
+            # the skip/grow decision is a replicated scalar, so every shard
+            # of the GSPMD state takes the same branch — rollback-consistent
+            (new_params, new_buffers, new_opt_state), new_scaler_state, \
+                ok, found_inf = scaler_guard(
+                    loss, found, scaler_state,
+                    (new_params, new_buffers, new_opt_state),
+                    (params, buffers, opt_state))
+            return (loss, new_params, new_buffers, new_opt_state, accum,
+                    new_scaler_state, ok, found_inf)
         if with_check:
             ok, (new_params, new_buffers, new_opt_state) = finite_guard(
                 grads, (new_params, new_buffers, new_opt_state),
-                (params, buffers, opt_state))
-            return loss, new_params, new_buffers, new_opt_state, accum, ok
-        return loss, new_params, new_buffers, new_opt_state, accum
+                (params, buffers, opt_state), extra_ok=jnp.isfinite(loss))
+            return (loss, new_params, new_buffers, new_opt_state, accum,
+                    scaler_state, ok, jnp.zeros((), jnp.bool_))
+        return loss, new_params, new_buffers, new_opt_state, accum, scaler_state
+
+    def _put_batch(self, batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
+            if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
+
+    def _checked_call(self, batch, count, poison):
+        if self.scaler_state is not None:
+            (loss, self.params, self.buffers, self.opt_state,
+             self._grad_accum, self.scaler_state, ok, found) = \
+                self._compiled(self.params, self.buffers, self.opt_state,
+                               self._grad_accum, self.scaler_state, batch,
+                               self._base_key, count, poison)
+            if self.scaler is not None:
+                self.scaler._note_step(found)
+                self.scaler.state = dict(self.scaler_state)
+            return loss, ok, found
+        (loss, self.params, self.buffers, self.opt_state, self._grad_accum,
+         _, ok, found) = \
+            self._checked_compiled()(self.params, self.buffers,
+                                     self.opt_state, self._grad_accum, None,
+                                     batch, self._base_key, count, poison)
+        return loss, ok, found
+
+    def watchdog_call(self, batch):
+        """``(loss, ok, found_inf)``, flags LAZY (no host sync); ``None``
+        flags on accumulate-only calls. See TrainStep.watchdog_call."""
+        from ..framework import compile_cache
+
+        batch = self._put_batch(batch)
+        count, do_update = self._next_count()
+        compile_cache.record_call(self._cc_name)
+        poison = self._take_poison()
+        with self.mesh:
+            if not do_update:
+                loss, self.params, self.buffers, self.opt_state, \
+                    self._grad_accum, _ = \
+                    self._compiled(self.params, self.buffers, self.opt_state,
+                                   self._grad_accum, None, batch,
+                                   self._base_key, count, poison,
+                                   do_update=False)
+                return loss, None, None
+            return self._checked_call(batch, count, poison)
 
     def __call__(self, batch):
         from ..framework import compile_cache, flags
         from ..framework.jit import raise_if_bad_step
 
-        batch = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
-            if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
-        count = np.uint32(self._count)
-        self._count += 1
-        do_update = (self.grad_accum_steps <= 1
-                     or self._count % self.grad_accum_steps == 0)
+        batch = self._put_batch(batch)
+        count, do_update = self._next_count()
         compile_cache.record_call(self._cc_name)
+        poison = self._take_poison()
         with self.mesh:
-            if flags.flag("FLAGS_check_nan_inf") and do_update:
-                loss, self.params, self.buffers, self.opt_state, self._grad_accum, ok = \
-                    self._checked_compiled()(self.params, self.buffers,
-                                             self.opt_state, self._grad_accum,
-                                             batch, self._base_key, count)
-                raise_if_bad_step(ok, loss)
+            if do_update and (self.scaler_state is not None
+                              or flags.flag("FLAGS_check_nan_inf")):
+                loss, ok, found = self._checked_call(batch, count, poison)
+                if flags.flag("FLAGS_check_nan_inf"):
+                    raise_if_bad_step(ok, loss)
                 return loss
-            loss, self.params, self.buffers, self.opt_state, self._grad_accum = \
+            loss, self.params, self.buffers, self.opt_state, \
+                self._grad_accum, _ = \
                 self._compiled(self.params, self.buffers, self.opt_state,
-                               self._grad_accum, batch, self._base_key, count,
-                               do_update=do_update)
+                               self._grad_accum, None, batch, self._base_key,
+                               count, poison, do_update=do_update)
         return loss
 
     def sync_to_model(self):
@@ -292,9 +361,12 @@ class DistributedTrainStep:
 
     def state_dict(self):
         sd = {"params": self.params, "buffers": self.buffers,
-              "opt_state": self.opt_state, "count": self._count}
+              "opt_state": self.opt_state, "count": self._count,
+              "base_key": np.asarray(jax.random.key_data(self._base_key))}
         if self._grad_accum is not None:
             sd["grad_accum"] = self._grad_accum
+        if self.scaler_state is not None:
+            sd["scaler_state"] = self.scaler_state
         return sd
 
     def state_shardings(self):
@@ -318,6 +390,10 @@ class DistributedTrainStep:
         if self._grad_accum is not None:
             for k, spec in self.specs.items():
                 out[f"grad_accum/{k}"] = NamedSharding(self.mesh, spec)
+        out["base_key"] = NamedSharding(self.mesh, P())
+        if self.scaler_state is not None:
+            for k in self.scaler_state:
+                out[f"scaler_state/{k}"] = NamedSharding(self.mesh, P())
         return out
 
     def set_state_dict(self, state):
@@ -353,8 +429,15 @@ class DistributedTrainStep:
                 new_opt[slot] = sval
         self.opt_state = new_opt
         self._count = int(state.get("count", self._count))
+        if state.get("base_key") is not None:
+            self._base_key = jax.random.wrap_key_data(
+                jnp.asarray(np.asarray(state["base_key"]), jnp.uint32))
         if self._grad_accum is not None and "grad_accum" in state:
             self._grad_accum = {
                 k: put(state["grad_accum"][k],
                        NamedSharding(self.mesh, self.specs[k]))
                 for k in self._grad_accum}
+        if self.scaler_state is not None and "scaler_state" in state:
+            self.scaler_state = {
+                k: put(state["scaler_state"][k], NamedSharding(self.mesh, P()))
+                for k in self.scaler_state}

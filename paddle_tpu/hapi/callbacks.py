@@ -45,6 +45,12 @@ class Callback:
     def on_epoch_end(self, epoch, logs=None): pass
     def on_train_batch_begin(self, step, logs=None): pass
     def on_train_batch_end(self, step, logs=None): pass
+    # self-healing events (Model.fit(recovery=...)): a skipped non-finite
+    # step, a watchdog-triggered checkpoint rollback, and a preemption
+    # notice honored by checkpoint-and-exit
+    def on_train_anomaly(self, logs=None): pass
+    def on_rollback(self, logs=None): pass
+    def on_preemption(self, logs=None): pass
     def on_eval_batch_begin(self, step, logs=None): pass
     def on_eval_batch_end(self, step, logs=None): pass
     def on_predict_batch_begin(self, step, logs=None): pass

@@ -15,7 +15,6 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from .. import framework
 from ..framework import io as framework_io
@@ -23,7 +22,7 @@ from ..framework.jit import EvalStep, TrainStep, resolve_inputs_fn
 from ..io.dataloader import DataLoader
 from ..io.dataset import Dataset
 from ..metric import Metric
-from ..nn.layer import Layer, buffer_state, functional_call, param_state
+from ..nn.layer import Layer, buffer_state, param_state
 from .callbacks import config_callbacks
 
 __all__ = ["Model", "InputSpec"]
@@ -43,67 +42,44 @@ class InputSpec:
 
 class _HapiTrainStep(TrainStep):
     """TrainStep variant that also returns the model outputs (for train-time
-    metric updates, as the reference's ``DynamicGraphAdapter.train_batch``)."""
+    metric updates, as the reference's ``DynamicGraphAdapter.train_batch``).
+    The step body is shared with :class:`TrainStep` via ``_return_out``."""
 
-    def _step(self, params, buffers, opt_state, accum, batch, key, count,
-              with_check=False, do_update=True):
-        from ..framework.jit import (accumulate_grads, finite_guard,
-                                     merge_accumulated, split_rng_streams)
-
-        # fold_in inside the program: a lazy key input trips the
-        # TPU-tunnel slow path (see framework/jit.py _step)
-        rngs = split_rng_streams(jax.random.fold_in(key, count),
-                                 self._rng_streams)
-
-        def compute_loss(p):
-            inputs = self.inputs_fn(batch)
-            if not isinstance(inputs, (tuple, list)):
-                inputs = (inputs,)
-            out, new_buf = functional_call(self.model, p, buffers, *inputs, rngs=rngs)
-            loss = out if self.loss_fn is None else self.loss_fn(out, batch)
-            return jnp.asarray(loss, jnp.float32), (new_buf, out)
-
-        (loss, (new_buffers, out)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(params)
-        accum = accumulate_grads(accum, grads)
-        if not do_update:
-            return loss, out, params, new_buffers, opt_state, accum
-        grads, accum = merge_accumulated(accum, grads, self.grad_accum_steps,
-                                         self.grad_accum_avg)
-        if self.grad_transform is not None:
-            grads = self.grad_transform(grads)
-        new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
-        if with_check:
-            ok, (new_params, new_buffers, new_opt_state) = finite_guard(
-                grads, (new_params, new_buffers, new_opt_state),
-                (params, buffers, opt_state))
-            return loss, out, new_params, new_buffers, new_opt_state, accum, ok
-        return loss, out, new_params, new_buffers, new_opt_state, accum
+    _return_out = True
 
     def __call__(self, batch):
         from ..framework import compile_cache, flags
         from ..framework.jit import raise_if_bad_step
         from ..profiler import RecordEvent
 
-        count = np.uint32(self._count)
-        self._count += 1
-        do_update = (self.grad_accum_steps <= 1
-                     or self._count % self.grad_accum_steps == 0)
+        count, do_update = self._next_count()
         compile_cache.record_call(self._cc_name)
+        poison = self._take_poison()
         with RecordEvent("step"):
-            if flags.flag("FLAGS_check_nan_inf") and do_update:
-                loss, out, self.params, self.buffers, self.opt_state, \
-                    self._grad_accum, ok = \
-                    self._checked_compiled()(self.params, self.buffers,
-                                             self.opt_state, self._grad_accum,
-                                             batch, self._base_key, count)
-                raise_if_bad_step(ok, loss)
+            if do_update and (self.scaler_state is not None
+                              or flags.flag("FLAGS_check_nan_inf")):
+                loss, out, ok, found = self._checked_call(batch, count, poison)
+                if flags.flag("FLAGS_check_nan_inf"):
+                    raise_if_bad_step(ok, loss)
                 return loss, out
-            loss, out, self.params, self.buffers, self.opt_state, self._grad_accum = \
-                self._compiled(self.params, self.buffers, self.opt_state,
-                               self._grad_accum, batch, self._base_key, count,
-                               do_update=do_update)
+            loss, out = self._plain_call(batch, count, poison, do_update)
             return loss, out
+
+    def watchdog_call(self, batch):
+        """``(loss, out, ok, found_inf)`` with flags LAZY (no host sync);
+        ``ok``/``found_inf`` are ``None`` on accumulate-only calls."""
+        from ..framework import compile_cache
+        from ..profiler import RecordEvent
+
+        count, do_update = self._next_count()
+        compile_cache.record_call(self._cc_name)
+        poison = self._take_poison()
+        with RecordEvent("step"):
+            if not do_update:
+                loss, out = self._plain_call(batch, count, poison, False)
+                return loss, out, None, None
+            loss, out, ok, found = self._checked_call(batch, count, poison)
+            return loss, out, ok, found
 
 
 def _as_loader(data, batch_size, shuffle, num_workers, drop_last=False,
@@ -228,10 +204,15 @@ class Model:
                 ins, _ = _split_batch(batch, n_lab)
                 return ins
 
+            # amp_configs={"scaler": GradScaler(...)} fuses dynamic loss
+            # scaling (scale / unscale / skip-on-overflow / grow-backoff)
+            # into the compiled step — see framework/jit.py
+            amp = getattr(self, "_amp_configs", None)
+            scaler = amp.get("scaler") if isinstance(amp, dict) else None
             self._train_step = _HapiTrainStep(
                 self.network, self._optimizer,
                 loss_fn=self._loss_on_batch if self._loss else None,
-                inputs_fn=inputs_fn)
+                inputs_fn=inputs_fn, scaler=scaler)
         return self._train_step
 
     # ------------------------------------------------------- batch methods
@@ -324,12 +305,22 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            pad_batches=False, length_buckets=None, prefetch_depth=0):
+            pad_batches=False, length_buckets=None, prefetch_depth=0,
+            recovery=None):
         """``pad_batches``/``length_buckets`` stabilize batch shapes so the
         compiled step is traced O(#buckets) times instead of once per novel
         shape (see ``paddle_tpu.io.batching``); ``prefetch_depth`` > 0
         streams batches to the device through the async H2D pipeline while
-        the previous step runs (``paddle_tpu.io.device_prefetch``)."""
+        the previous step runs (``paddle_tpu.io.device_prefetch``).
+
+        ``recovery`` (a :class:`paddle_tpu.framework.supervisor.
+        RecoveryPolicy` or its kwargs as a dict) turns on self-healing
+        training: a numerics watchdog skips non-finite steps in-graph and
+        escalates to checkpoint rollback with data replay, crash/preemption
+        resume via AutoCheckpoint + data cursor, an optional hang watchdog,
+        and SIGTERM checkpoint-and-exit (raises ``TrainingPreempted`` after
+        the state is durably saved). See the README "Self-healing training"
+        section."""
         loader = _as_loader(train_data, batch_size, shuffle, num_workers,
                             drop_last, pad_batches, length_buckets)
         eval_loader = _as_loader(eval_data, batch_size, False, num_workers,
@@ -348,6 +339,10 @@ class Model:
         for cb in cbks:
             if cb.__class__.__name__ == "History":
                 history = cb
+        if recovery is not None:
+            return self._fit_supervised(loader, eval_loader, epochs,
+                                        eval_freq, num_workers, cbks,
+                                        history, recovery, prefetch_depth)
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -373,6 +368,127 @@ class Model:
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_train_end(logs if 'logs' in dir() else None)
+        return history.history if history is not None else None
+
+    def _fit_supervised(self, loader, eval_loader, epochs, eval_freq,
+                        num_workers, cbks, history, recovery, prefetch_depth):
+        """The self-healing variant of the fit loop (``recovery=...``).
+
+        Differences from the plain loop: steps dispatch through
+        ``watchdog_call`` (lazy numerics flags, host-synced every
+        ``check_interval`` batches), the epoch/batch position is tracked as
+        a :class:`DataCursor` recorded into every checkpoint, a rollback
+        rewinds ``(epoch, batch)`` to the checkpoint's cursor (optionally
+        jumping a ``skip_window`` of offending batches), and a SIGTERM
+        checkpoints then raises :class:`TrainingPreempted`.
+        """
+        from ..framework.supervisor import (RecoveryPolicy, RollbackRequested,
+                                            TrainingPreempted,
+                                            TrainingSupervisor)
+        from ..io.cursor import DataCursor, resume_batches
+
+        policy = (recovery if isinstance(recovery, RecoveryPolicy)
+                  else RecoveryPolicy(**recovery))
+        step = self._ensure_train_step()
+        sup = TrainingSupervisor(step, policy)
+        sup.on_anomaly = lambda info: cbks.on_train_anomaly(info)
+        sup.on_rollback = lambda info: cbks.on_rollback(info)
+        sup.on_preemption = lambda info: cbks.on_preemption(info)
+        sup.start()
+        logs = {}
+        epoch, start_batch = 0, 0
+        preempted = False
+        try:
+            cursor = sup.restore()
+            if cursor is not None:
+                epoch, start_batch = cursor.epoch, cursor.batch_index
+                if hasattr(loader, "_epoch_seed"):
+                    loader._epoch_seed = cursor.epoch_seed
+            while epoch < epochs:
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                step_i = start_batch - 1
+                try:
+                    # the seed stream THIS epoch consumes: _epoch_seed is
+                    # read-then-incremented when the iterator builds its
+                    # worker pool, so snapshot it BEFORE iter() — recording
+                    # the post-increment value would replay a resumed epoch
+                    # with the NEXT epoch's augmentation streams
+                    epoch_seed = getattr(loader, "_epoch_seed", 0)
+                    # a resumed/rolled-back epoch fast-forwards at the
+                    # sampler level where possible (io/cursor.py); fresh
+                    # epochs keep the async prefetch pipeline
+                    if start_batch > 0:
+                        it = resume_batches(loader, start_batch)
+                    else:
+                        it = _iter_batches(loader, prefetch_depth)
+                    offset, start_batch = start_batch, 0
+                    for rel_i, batch in enumerate(it):
+                        step_i = offset + rel_i
+                        if sup.should_skip(epoch, step_i):
+                            continue
+                        cbks.on_train_batch_begin(step_i)
+                        batch, mask = _strip_mask(batch, loader)
+                        ins, labels = _split_batch(
+                            tuple(batch) if isinstance(batch, (tuple, list))
+                            else batch, self._n_labels)
+                        next_cursor = DataCursor(
+                            epoch=epoch, batch_index=step_i + 1,
+                            epoch_seed=epoch_seed,
+                            global_step=step._count + 1)
+                        sup.before_batch()
+                        loss, out, ok, found = step.watchdog_call(
+                            tuple(ins) + tuple(labels))
+                        metrics = self._update_metrics(out, tuple(labels),
+                                                       mask)
+                        # the loss stays LAZY in the logs — forcing it every
+                        # step would defeat the batched watchdog sync; it
+                        # materialises when a callback formats it
+                        logs = dict(zip(["loss"] + self._metrics_name(),
+                                        [loss] + metrics))
+                        sup.after_batch(epoch, step_i, loss, ok, found,
+                                        cursor=next_cursor)
+                        cbks.on_train_batch_end(step_i, logs)
+                    sup.finish_epoch()  # drains flags; may request rollback
+                except RollbackRequested as rb:
+                    if rb.cursor is not None:
+                        epoch, start_batch = (rb.cursor.epoch,
+                                              rb.cursor.batch_index)
+                        if hasattr(loader, "_epoch_seed"):
+                            loader._epoch_seed = rb.cursor.epoch_seed
+                    else:
+                        # no checkpoint to return to: the in-graph guard
+                        # preserved the state, so continue past the anomaly
+                        start_batch = step_i + 1
+                    continue
+                logs = {k: (float(np.asarray(v))
+                            if hasattr(v, "dtype") or hasattr(v, "item")
+                            else v) for k, v in logs.items()}
+                if eval_loader is not None and (epoch % eval_freq == 0 or
+                                                epoch == epochs - 1):
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              num_workers=num_workers,
+                                              _callbacks=cbks)
+                    logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                cbks.on_epoch_end(epoch, logs)
+                epoch += 1
+            # a final snapshot whose cursor points past the end, so a
+            # restarted job notices the run is complete instead of
+            # re-training the last window
+            sup.save_now(cursor=DataCursor(epoch=epoch, batch_index=0,
+                                           epoch_seed=getattr(
+                                               loader, "_epoch_seed", 0),
+                                           global_step=step._count))
+        except TrainingPreempted:
+            preempted = True
+            raise
+        finally:
+            sup.stop()
+            if not preempted:
+                cbks.on_train_end(logs or None)
         return history.history if history is not None else None
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -6,6 +6,7 @@ from .device_prefetch import (  # noqa: F401
     DevicePrefetchIterator, prefetch_to_device,
 )
 from .worker import WorkerInfo, get_worker_info  # noqa: F401
+from .cursor import DataCursor, resume_batches  # noqa: F401
 from .dataset import (  # noqa: F401
     BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, Dataset,
     DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,

@@ -112,6 +112,39 @@ class RecordEvent:
         return wrapped
 
 
+# ------------------------------------------------------------- counters
+# Monotonic event counters for rare-but-important events (numerics
+# anomalies, rollbacks, preemptions, hang detections, scaler skips) — the
+# self-healing layer bumps these so operators can alert on them without
+# parsing logs. Unlike spans they are always on: a counter bump is a dict
+# update under a lock, cheap even in the train loop's rare branches.
+import threading as _threading
+
+_counters_lock = _threading.Lock()
+_counters: dict = defaultdict(int)
+
+
+def bump_counter(name: str, n: int = 1) -> int:
+    """Increment and return the named monotonic counter."""
+    with _counters_lock:
+        _counters[name] += n
+        return _counters[name]
+
+
+def counter_values() -> dict:
+    """Snapshot of every counter bumped so far."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
+__all__ += ["bump_counter", "counter_values", "reset_counters"]
+
+
 def host_event_summary(sort_by: str = "total"):
     """Aggregate host spans: {name: (calls, total_s, avg_s, max_s)} —
     the op-summary table of ``profiler_statistic.py`` for host phases."""

@@ -83,6 +83,46 @@ def test_probe_timeout_env_override_and_retry_accounting():
     assert rec["extra"]["probe_attempts"] >= 1
 
 
+def test_probe_budget_caps_total_probe_wall_clock():
+    """PT_BENCH_PROBE_BUDGET must cap the TOTAL wall clock spent probing
+    (round r05 burned ~20 min of per-attempt retries before
+    tpu_unavailable): with a huge retry window but a tiny probe budget,
+    _wait_for_backend must give up promptly, naming the budget, with the
+    attempt accounting intact — and the pot is SHARED, so the post-bench
+    re-probe gets nothing once it is empty. In-module (no subprocess):
+    tier-1 is tight on wall clock."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_budget_test", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._check_backend = lambda timeout=None: (None, "tunnel down (stub)")
+    bench._RETRY_STATS.update(probe_retry_s=0.0, probe_attempts=0)
+    bench._PROBE_BUDGET["remaining"] = None
+    old = os.environ.get("PT_BENCH_PROBE_BUDGET")
+    os.environ["PT_BENCH_PROBE_BUDGET"] = "1"
+    try:
+        t0 = time.monotonic()
+        backend, err = bench._wait_for_backend(time.monotonic() + 3600)
+        elapsed = time.monotonic() - t0
+        assert backend is None
+        assert "probe budget exhausted" in err
+        assert "PT_BENCH_PROBE_BUDGET" in err
+        assert bench._RETRY_STATS["probe_attempts"] >= 1
+        attempts = bench._RETRY_STATS["probe_attempts"]
+        assert elapsed < 30, f"budget-capped probe took {elapsed:.0f}s"
+        # second call (the supervisor's post-bench-failure re-probe) finds
+        # the pot empty and returns WITHOUT probing again
+        backend, err = bench._wait_for_backend(time.monotonic() + 3600)
+        assert backend is None and "probe budget exhausted" in err
+        assert bench._RETRY_STATS["probe_attempts"] == attempts
+    finally:
+        if old is None:
+            os.environ.pop("PT_BENCH_PROBE_BUDGET", None)
+        else:
+            os.environ["PT_BENCH_PROBE_BUDGET"] = old
+
+
 def test_sigterm_mid_retry_still_leaves_artifact():
     """SIGTERM during the retry loop (the round-4 scenario) must flush a
     killed_by_signal record naming the phase, then exit."""

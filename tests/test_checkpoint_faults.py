@@ -170,6 +170,33 @@ def test_orphaned_staging_dirs_swept_on_startup(tmp_path):
     assert latest_checkpoint(root).endswith("step_3")
 
 
+def test_stale_staging_dirs_reaped_by_ttl_mid_run(tmp_path):
+    """Regression: a SIGKILLed sibling's staging dir used to leak until the
+    next process restart (the sweep only ran at __init__). The periodic
+    sweep (_gc, after every save) reaps staging older than
+    staging_ttl_seconds while leaving a FRESH dir (a live peer's in-flight
+    save) alone."""
+    root = str(tmp_path)
+    ac = AutoCheckpoint(root, save_interval_steps=1, async_save=False,
+                        staging_ttl_seconds=600.0)
+    stale = os.path.join(root, "step_9.tmp-pt4242")   # killed sibling
+    fresh = os.path.join(root, "step_8.tmp-pt4343")   # live peer, mid-save
+    for d in (stale, fresh):
+        os.makedirs(d)
+        with open(os.path.join(d, "junk.npy"), "wb") as f:
+            f.write(b"x")
+    hours_ago = time.time() - 7200
+    os.utime(stale, (hours_ago, hours_ago))
+    ac.save(1, _state(1))                             # triggers _gc + sweep
+    names = sorted(os.listdir(root))
+    assert os.path.basename(stale) not in names       # reaped (past TTL)
+    assert os.path.basename(fresh) in names           # spared (fresh mtime)
+    assert "step_1" in names
+    # a restart still reaps everything unconditionally (ttl=0 startup sweep)
+    AutoCheckpoint(root)
+    assert os.path.basename(fresh) not in os.listdir(root)
+
+
 def test_overwrite_trash_restored_when_target_missing(tmp_path):
     """A crash between save_state's two overwrite renames leaves the OLD
     checkpoint as step_N.old-pt<pid>; the startup sweep must restore it,

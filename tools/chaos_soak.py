@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Chaos soak: a short GPT pretrain under injected NaN batches, step
+stalls, and a mid-training SIGKILL — asserting the self-healing layer
+(``Model.fit(recovery=...)``, ``framework/supervisor.py``) recovers to the
+SAME answer as an undisturbed run.
+
+Three child runs (each a fresh interpreter, like ``tools/fault_sweep.py``):
+
+1. **baseline** — no faults; records the final eval loss.
+2. **chaos #1** — a seeded FaultPlan poisons 2 consecutive batches with NaN
+   (``drop`` @ ``train.data`` → the step's NaN seam), stalls one step past
+   the hang watchdog's ``step_timeout`` (``delay`` @ ``train.step``), and
+   kills the process cold at the 3rd checkpoint attempt (``crash`` @
+   ``train.ckpt``, as hard as SIGKILL). The run must die with CRASH_EXIT
+   after logging >=1 anomaly, >=1 rollback and >=1 hang detection to its
+   event log.
+3. **chaos #2** — a clean restart against the same checkpoint root resumes
+   from the last published snapshot + data cursor and runs to completion.
+
+Pass criteria (exit 0 iff all hold):
+
+- chaos final eval loss within ``--tol`` (default 1%) of the baseline;
+- every injected fault observed (anomaly/rollback/hang events + the kill);
+- no steady-state recompiles: each child enters ``retrace_guard(0)`` after
+  warmup, so a rollback/replay or resume that retraced the step would have
+  failed the child outright.
+
+Usage::
+
+    python tools/chaos_soak.py            # full soak
+    python tools/chaos_soak.py --quick    # CI-sized (robustness_gate)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.distributed.resilience import CRASH_EXIT, FaultPlan  # noqa: E402
+
+SEQ = 32
+BATCH = 4
+
+
+def _config(quick: bool):
+    """(docs, epochs): enough steps to reach the random-token plateau, so
+    the 1% tolerance compares converged runs, not transients."""
+    return (64, 2) if quick else (64, 4)
+
+
+# --------------------------------------------------------------------- child
+def run_child(args) -> int:
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import profiler
+    from paddle_tpu.framework import compile_cache
+    from paddle_tpu.framework.supervisor import RecoveryPolicy
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.optimizer import AdamW
+
+    n_docs, epochs = _config(args.quick)
+    pt.seed(args.seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=SEQ, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0, use_flash_attention=False)
+    model = Model(GPTForCausalLM(cfg), labels=[])  # forward(ids, labels)->loss
+    model.prepare(AdamW(learning_rate=1e-3))
+
+    rng = np.random.default_rng(args.seed)
+    ids = rng.integers(0, cfg.vocab_size, (n_docs, SEQ)).astype(np.int32)
+    train = pt.io.TensorDataset([ids, ids])
+    eval_rng = np.random.default_rng(args.seed + 1)
+    eval_ids = eval_rng.integers(0, cfg.vocab_size,
+                                 (4, BATCH, SEQ)).astype(np.int32)
+
+    events_path = os.path.join(args.workdir, "events.jsonl")
+
+    class EventLog(pt.hapi.Callback):
+        """Crash-surviving record of what the supervisor observed (the
+        killed incarnation cannot write a result file)."""
+
+        def __init__(self):
+            super().__init__()
+            self._fh = open(events_path, "a")
+            self._hangs = 0
+
+        def _emit(self, event, **kw):
+            self._fh.write(json.dumps({"event": event, "pid": os.getpid(),
+                                       **kw}) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+        def on_train_anomaly(self, logs=None):
+            self._emit("anomaly", **(logs or {}))
+
+        def on_rollback(self, logs=None):
+            info = dict(logs or {})
+            info.pop("cursor", None)  # not JSON-serializable
+            self._emit("rollback", **info)
+
+        def on_preemption(self, logs=None):
+            self._emit("preemption", **(logs or {}))
+
+        def on_train_batch_end(self, step, logs=None):
+            hangs = profiler.counter_values().get("train.hang", 0)
+            if hangs > self._hangs:
+                self._emit("hang", count=hangs)
+                self._hangs = hangs
+
+    class GuardAfterWarmup(pt.hapi.Callback):
+        """retrace_guard(0) once the step program is traced: any recompile
+        caused by rollback/replay/resume fails the child loudly."""
+
+        def __init__(self, warmup=3):
+            super().__init__()
+            self.warmup = warmup
+            self._cm = None
+
+        def on_train_batch_end(self, step, logs=None):
+            if self._cm is None and step + 1 >= self.warmup:
+                self._cm = compile_cache.retrace_guard(
+                    0, label="chaos-steady")
+                self._cm.__enter__()
+
+        def release(self):
+            if self._cm is not None:
+                self._cm.__exit__(None, None, None)
+                self._cm = None
+
+    guard = GuardAfterWarmup()
+    policy = RecoveryPolicy(
+        checkpoint_dir=os.path.join(args.workdir, "ckpt"),
+        save_interval_steps=5, check_interval=2, max_consecutive=2,
+        skip_window=2, step_timeout=0.5, hang_action="warn",
+        preemption=True, grace_seconds=20.0, async_save=False)
+    import warnings
+
+    t0 = time.monotonic()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            hist = model.fit(train, batch_size=BATCH, epochs=epochs,
+                             shuffle=False, verbose=0,
+                             callbacks=[EventLog(), guard],
+                             recovery=policy)
+    finally:
+        guard.release()   # EvalStep below compiles legitimately
+
+    eval_losses = [float(np.asarray(model.predict_batch((b, b))))
+                   for b in eval_ids]
+    step = model._train_step
+    result = {
+        "final_eval_loss": float(np.mean(eval_losses)),
+        "train_loss": float(hist["loss"][-1]),
+        "step_compiles": step.cache_stats()["compiles"],
+        "counters": profiler.counter_values(),
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    out = os.path.join(args.workdir, "result.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out + ".tmp", out)
+    print(json.dumps(result))
+    return 0
+
+
+# ------------------------------------------------------------------- harness
+def _fault_plan(seed: int) -> FaultPlan:
+    return FaultPlan([
+        # two CONSECUTIVE NaN batches -> skip_step escalates to rollback
+        {"site": "train.data", "kind": "drop", "times": 2, "after": 5},
+        # one stall past step_timeout=0.5 -> hang watchdog detection
+        {"site": "train.step", "kind": "delay", "delay": 1.2, "after": 9,
+         "times": 1},
+        # SIGKILL-hard death at the 3rd checkpoint attempt
+        {"site": "train.ckpt", "kind": "crash", "times": 1, "after": 2},
+    ], seed=seed)
+
+
+def _spawn(workdir: str, args, plan: FaultPlan | None):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if plan is not None:
+        env["PT_FAULT_PLAN"] = plan.to_json()
+    else:
+        env.pop("PT_FAULT_PLAN", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--workdir", workdir, "--seed", str(args.seed)]
+    if args.quick:
+        cmd.append("--quick")
+    return subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True, timeout=900)
+
+
+def _events(workdir: str) -> list:
+    path = os.path.join(workdir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized soak (fewer steps)")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--tol", type=float, default=0.01,
+                    help="relative final-loss tolerance vs the clean run")
+    ap.add_argument("--child", action="store_true", help="internal")
+    ap.add_argument("--workdir", default=None, help="internal")
+    args = ap.parse_args()
+    if args.child:
+        return run_child(args)
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as root:
+        base_dir = os.path.join(root, "baseline")
+        chaos_dir = os.path.join(root, "chaos")
+        os.makedirs(base_dir)
+        os.makedirs(chaos_dir)
+        ns = argparse.Namespace(**vars(args))
+
+        print("[chaos_soak] baseline run...", flush=True)
+        p = _spawn(base_dir, ns, plan=None)
+        if p.returncode != 0:
+            print(p.stdout[-2000:])
+            print("[chaos_soak] FAIL: baseline run failed")
+            return 1
+        baseline = json.load(open(os.path.join(base_dir, "result.json")))
+        print(f"[chaos_soak] baseline eval loss "
+              f"{baseline['final_eval_loss']:.4f} "
+              f"({baseline['elapsed_s']}s)", flush=True)
+
+        print("[chaos_soak] chaos run #1 (NaN x2, stall x1, kill x1)...",
+              flush=True)
+        p1 = _spawn(chaos_dir, ns, plan=_fault_plan(args.seed))
+        if p1.returncode != CRASH_EXIT:
+            failures.append(
+                f"chaos #1: expected CRASH_EXIT {CRASH_EXIT}, got "
+                f"{p1.returncode}: {p1.stdout[-500:]}")
+        events = _events(chaos_dir)
+        kinds = {e["event"] for e in events}
+        for want in ("anomaly", "rollback", "hang"):
+            if want not in kinds:
+                failures.append(f"chaos #1: no {want!r} event logged "
+                                f"(got {sorted(kinds)})")
+
+        print("[chaos_soak] chaos run #2 (clean restart, resume)...",
+              flush=True)
+        p2 = _spawn(chaos_dir, ns, plan=None)
+        if p2.returncode != 0:
+            failures.append(f"chaos #2: restart failed rc={p2.returncode}: "
+                            f"{p2.stdout[-500:]}")
+        result_path = os.path.join(chaos_dir, "result.json")
+        chaos = None
+        if os.path.exists(result_path):
+            chaos = json.load(open(result_path))
+        elif not failures:
+            failures.append("chaos #2: no result.json")
+
+        if chaos is not None:
+            base_loss = baseline["final_eval_loss"]
+            rel = abs(chaos["final_eval_loss"] - base_loss) / abs(base_loss)
+            print(f"[chaos_soak] chaos eval loss "
+                  f"{chaos['final_eval_loss']:.4f} vs baseline "
+                  f"{base_loss:.4f} (rel diff {rel * 100:.2f}%, "
+                  f"tol {args.tol * 100:.0f}%)", flush=True)
+            if rel > args.tol:
+                failures.append(
+                    f"final eval loss diverged: {chaos['final_eval_loss']}"
+                    f" vs {base_loss} (rel {rel:.4f} > tol {args.tol})")
+            # one specialization of the checked step per incarnation; the
+            # in-run guard already failed the child on mid-run retraces
+            if chaos["step_compiles"] > 2:
+                failures.append(
+                    f"steady-state recompiles: {chaos['step_compiles']} "
+                    f"train-step compiles in the resumed run")
+
+    if failures:
+        print("[chaos_soak] FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("[chaos_soak] PASS: recovered from NaN/stall/kill to within "
+          "tolerance, no steady-state recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

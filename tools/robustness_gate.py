@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Robustness gate: ONE command CI can block on for the fault-tolerance
+story. Runs, in order:
+
+1. ``tools/chaos_soak.py --quick`` — the self-healing train loop under
+   NaN batches, a step stall, and a kill-and-restart (fails on any
+   unrecovered fault, loss divergence beyond tolerance, or a steady-state
+   recompile — the soak children run under ``retrace_guard(0)``);
+2. ``tools/fault_sweep.py`` — the distributed-primitive fault matrix
+   (kv/rpc/checkpoint under drop/delay/crash).
+
+Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
+``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
+nightly full matrix)::
+
+    python tools/robustness_gate.py
+    python tools/robustness_gate.py --skip-sweep   # soak only
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _run(name: str, cmd: list) -> bool:
+    print(f"[robustness_gate] === {name}: {' '.join(cmd[1:])}", flush=True)
+    t0 = time.monotonic()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    p = subprocess.run(cmd, env=env, timeout=2400)
+    ok = p.returncode == 0
+    print(f"[robustness_gate] === {name}: "
+          f"{'PASS' if ok else f'FAIL (rc={p.returncode})'} "
+          f"in {time.monotonic() - t0:.0f}s", flush=True)
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-soak", action="store_true")
+    ap.add_argument("--skip-sweep", action="store_true")
+    ap.add_argument("--full-soak", action="store_true",
+                    help="run the soak without --quick")
+    args = ap.parse_args()
+
+    results = {}
+    if not args.skip_soak:
+        cmd = [sys.executable, os.path.join(TOOLS, "chaos_soak.py")]
+        if not args.full_soak:
+            cmd.append("--quick")
+        results["chaos_soak"] = _run("chaos_soak", cmd)
+    if not args.skip_sweep:
+        results["fault_sweep"] = _run(
+            "fault_sweep", [sys.executable,
+                            os.path.join(TOOLS, "fault_sweep.py")])
+
+    print()
+    for name, ok in results.items():
+        print(f"[robustness_gate] {name:12s} {'PASS' if ok else 'FAIL'}")
+    if not results:
+        print("[robustness_gate] nothing ran (both stages skipped)")
+        return 2
+    return 0 if all(results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
