@@ -21,7 +21,7 @@ from .mesh import (  # noqa: F401
 )
 from .shard import (  # noqa: F401
     DistributedTrainStep, buffer_specs, opt_state_specs, param_specs,
-    shard_params,
+    put_global, shard_params,
 )
 from .parallel import (  # noqa: F401
     mp_layers, moe, pipeline, recompute as recompute_mod, sequence_parallel,
@@ -45,8 +45,13 @@ from ..io.slot_dataset import BoxPSDataset, QueueDataset  # noqa: F401
 from .ps.graph import GraphDataGenerator, GraphTable  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .checkpoint import (  # noqa: F401
-    AsyncSaver, AutoCheckpoint, CheckpointCorruptError, latest_checkpoint,
-    load_state, save_state, validate_checkpoint,
+    AsyncSaver, AutoCheckpoint, CheckpointCorruptError, last_load_stats,
+    latest_checkpoint, load_state, mesh_info, save_state,
+    validate_checkpoint,
+)
+from . import elastic_mesh  # noqa: F401
+from .elastic_mesh import (  # noqa: F401
+    plan_mesh_shape, rescale_batch, reshaped_mesh,
 )
 from . import resilience  # noqa: F401
 from .resilience import (  # noqa: F401

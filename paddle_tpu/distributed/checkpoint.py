@@ -41,7 +41,7 @@ import tempfile
 import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -53,6 +53,7 @@ from .resilience import fault_point
 __all__ = [
     "save_state", "load_state", "AsyncSaver", "AutoCheckpoint",
     "latest_checkpoint", "validate_checkpoint", "CheckpointCorruptError",
+    "mesh_info", "last_load_stats",
 ]
 
 _METADATA = "metadata.json"
@@ -92,11 +93,41 @@ def _leaf_record(key: str, arr) -> Dict[str, Any]:
     if isinstance(arr, str):
         return {"kind": "str", "value": arr}
     arr_j = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
-    return {
+    rec = {
         "kind": "array",
         "shape": list(arr_j.shape),
         "dtype": str(arr_j.dtype),
     }
+    spec = _spec_of(arr)
+    if spec is not None:
+        rec["spec"] = spec
+    return rec
+
+
+def _spec_of(arr) -> Optional[list]:
+    """JSON-serializable PartitionSpec of a NamedSharding-ed array (None
+    for host values / non-named shardings). Axis tuples become lists."""
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return [list(s) if isinstance(s, (tuple, list)) else s for s in spec]
+
+
+def _mesh_of(state_leaves) -> Optional[Dict[str, Any]]:
+    """Mesh axes/device-count of the first NamedSharding-ed leaf — the
+    topology this checkpoint was WRITTEN on, recorded so a restore onto a
+    different mesh can report/plan the re-slice (elastic shrink/grow)."""
+    for leaf in state_leaves:
+        mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+        if mesh is not None and hasattr(mesh, "shape"):
+            try:
+                return {"axes": {str(k): int(v)
+                                 for k, v in dict(mesh.shape).items()},
+                        "devices": int(np.prod(list(mesh.shape.values())))}
+            except Exception:
+                return None
+    return None
 
 
 def _fsync_dir(path: str) -> None:
@@ -110,9 +141,13 @@ def _fsync_dir(path: str) -> None:
 
 
 def _write_file_durable(path: str, raw: bytes, atomic: bool) -> None:
-    """Write+fsync ``raw``; with ``atomic``, stage at ``path + ".tmp"`` and
-    ``os.replace`` so a concurrent reader never sees a torn file."""
-    target = path + ".tmp" if atomic else path
+    """Write+fsync ``raw``; with ``atomic``, stage at a process-unique
+    ``path + ".tmp<pid>"`` and ``os.replace`` so a concurrent reader never
+    sees a torn file. The pid suffix matters in multi-process saves: a
+    REPLICATED host leaf (e.g. ``base_key``) is written by every process
+    to the same target, and a shared ``.tmp`` name would let one writer's
+    rename steal another's staging file mid-flight."""
+    target = f"{path}.tmp{os.getpid()}" if atomic else path
     with open(target, "wb") as f:
         f.write(raw)
         f.flush()
@@ -158,6 +193,13 @@ def save_state(state: Any, directory: str, *, async_=False,
     # (a peer killed pre-commit) instead of silently loading partial state
     meta: Dict[str, Any] = {"format": "paddle_tpu.ckpt.v1",
                             "process_count": nprocs, "leaves": {}}
+    # the mesh this checkpoint was written on (axes + device count): enough
+    # for a restore onto a DIFFERENT topology to plan/report the re-slice
+    # (elastic shrink/grow). Absent for host-only state; old checkpoints
+    # without it restore through the same-topology path unchanged.
+    written_mesh = _mesh_of(flat.values())
+    if written_mesh is not None:
+        meta["mesh"] = written_mesh
     jobs = []  # (filename, host numpy copy, shard record to patch)
     for leaf_i, (key, leaf) in enumerate(flat.items()):
         rec = _leaf_record(key, leaf)
@@ -175,8 +217,10 @@ def save_state(state: Any, directory: str, *, async_=False,
                     for idx in shard.index) if shard.index else ()
                 data = np.asarray(shard.data)
                 fname = prefix + "__" + "_".join(map(str, start)) + ".npy"
+                # "process" = writer rank: after a host loss, validators
+                # can say exactly WHOSE shards are gone
                 srec = {"file": fname, "start": list(start),
-                        "shape": list(data.shape)}
+                        "shape": list(data.shape), "process": proc}
                 shards.append(srec)
                 jobs.append((fname, data, srec))
         else:
@@ -184,7 +228,7 @@ def save_state(state: Any, directory: str, *, async_=False,
             data = np.array(leaf, copy=True)
             fname = prefix + "__" + "_".join(["0"] * data.ndim) + ".npy"
             srec = {"file": fname, "start": [0] * data.ndim,
-                    "shape": list(data.shape)}
+                    "shape": list(data.shape), "process": proc}
             shards.append(srec)
             jobs.append((fname, data, srec))
         rec["shards"] = shards
@@ -280,6 +324,8 @@ def _read_shard_file(directory: str, shard: Dict[str, Any],
     streams the file (1 MB chunks) so peak memory stays ~1x the decoded
     array, not raw-bytes + array."""
     path = os.path.join(directory, shard["file"])
+    rank = shard.get("process")
+    whose = f" (written by rank {rank})" if rank is not None else ""
     try:
         if verify:
             want_len = shard.get("bytes")
@@ -300,33 +346,82 @@ def _read_shard_file(directory: str, shard: Dict[str, Any],
         return np.load(path)
     except FileNotFoundError:
         raise CheckpointCorruptError(
-            f"checkpoint shard missing: {path} (torn save?)") from None
+            f"checkpoint shard missing: {path}{whose} (torn save or lost "
+            f"host?)") from None
     except ValueError as e:
         raise CheckpointCorruptError(
             f"checkpoint shard {path}: undecodable npy: {e}") from e
 
 
+# default per-leaf shard-cache bound for streaming (re-sliced) loads: big
+# enough that small/medium checkpoints never evict, small enough that a
+# multi-GB param tree restored onto a reshaped mesh stays bounded on host
+DEFAULT_SHARD_CACHE_BYTES = 1 << 28  # 256 MiB
+
+# accounting for the most recent load_state call (single-threaded loads;
+# see last_load_stats)
+_LOAD_STATS = {"peak_resident_bytes": 0, "bytes_read": 0,
+               "shard_reads": 0, "evictions": 0, "leaves": 0}
+
+
+def last_load_stats() -> Dict[str, int]:
+    """Host-memory accounting of the most recent :func:`load_state`:
+    ``peak_resident_bytes`` is the maximum decoded shard bytes any single
+    leaf's reader held at once — the restore path's working set, which a
+    bounded-memory (elastic reshard) restore asserts stays far below the
+    full tree size. ``bytes_read``/``shard_reads`` count shard file
+    decodes (a shard evicted under the cache bound and needed again is
+    re-read — memory is the bounded resource, IO the price)."""
+    return dict(_LOAD_STATS)
+
+
+def _reset_load_stats() -> None:
+    for k in _LOAD_STATS:
+        _LOAD_STATS[k] = 0
+
+
 class _LeafReader:
-    """Assembles arbitrary slices of one leaf from its shard files."""
+    """Assembles arbitrary slices of one leaf from its shard files,
+    holding at most ``max_cache_bytes`` of decoded shards at a time (LRU;
+    the shard being served is never evicted)."""
 
     def __init__(self, directory: str, rec: Dict[str, Any],
-                 verify: bool = True):
+                 verify: bool = True,
+                 max_cache_bytes: Optional[int] = DEFAULT_SHARD_CACHE_BYTES):
         self.directory = directory
         self.rec = rec
         self.verify = verify
         self.shape = tuple(rec["shape"])
+        self.max_cache_bytes = max_cache_bytes
         self._cache: Dict[str, np.ndarray] = {}
+        self._resident = 0
 
     def _shard_data(self, shard) -> np.ndarray:
         f = shard["file"]
-        if f not in self._cache:
-            raw = _read_shard_file(self.directory, shard, self.verify)
-            want = jnp.dtype(self.rec["dtype"])
-            if raw.dtype != want:
-                # extended dtypes (bfloat16, fp8) round-trip npy as void
-                raw = raw.view(want) if raw.dtype.itemsize == want.itemsize \
-                    else raw.astype(want)
-            self._cache[f] = raw
+        if f in self._cache:
+            self._cache[f] = self._cache.pop(f)  # LRU: move to back
+            return self._cache[f]
+        raw = _read_shard_file(self.directory, shard, self.verify)
+        want = jnp.dtype(self.rec["dtype"])
+        if raw.dtype != want:
+            # extended dtypes (bfloat16, fp8) round-trip npy as void
+            raw = raw.view(want) if raw.dtype.itemsize == want.itemsize \
+                else raw.astype(want)
+        self._cache[f] = raw
+        self._resident += raw.nbytes
+        _LOAD_STATS["shard_reads"] += 1
+        _LOAD_STATS["bytes_read"] += raw.nbytes
+        # peak is taken BEFORE eviction: at the decode moment the new
+        # shard and the full cache coexist — that transient is the true
+        # working set the bound must be judged against
+        _LOAD_STATS["peak_resident_bytes"] = max(
+            _LOAD_STATS["peak_resident_bytes"], self._resident)
+        while (self.max_cache_bytes is not None
+               and self._resident > self.max_cache_bytes
+               and len(self._cache) > 1):
+            oldest = next(iter(self._cache))
+            self._resident -= self._cache.pop(oldest).nbytes
+            _LOAD_STATS["evictions"] += 1
         return self._cache[f]
 
     def read(self, index) -> np.ndarray:
@@ -365,21 +460,30 @@ class _LeafReader:
 
 
 def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
-               template: Any = None, verify: bool = True) -> Dict[str, Any]:
+               template: Any = None, verify: bool = True,
+               max_shard_cache_bytes: Optional[int] =
+               DEFAULT_SHARD_CACHE_BYTES) -> Dict[str, Any]:
     """Load a checkpoint directory.
 
     - plain load: returns a flat ``{key: np.ndarray}`` dict (or scalars).
     - with ``shardings`` (flat ``{key: jax.sharding.Sharding}``): each leaf is
       materialised directly onto its target sharding via
       ``make_array_from_callback`` — re-slicing happens per-device, so a
-      checkpoint saved on mesh A loads onto mesh B without a full gather.
+      checkpoint saved on mesh A loads onto mesh B (different shape, axis
+      layout, or host count — the elastic shrink/grow restore) without ever
+      assembling a full global array on one host. Peak host memory per leaf
+      is bounded by ``max_shard_cache_bytes`` of decoded source shards
+      (LRU; an evicted shard needed again is re-read — see
+      :func:`last_load_stats`). ``None`` disables the bound.
     - with ``template`` (a pytree): result is unflattened into that structure.
 
     With ``verify`` (default), every shard file read is checked against the
     byte length and crc32 recorded at save time; a missing/truncated/
     corrupted shard or missing metadata raises
-    :class:`CheckpointCorruptError` naming the file and the mismatch.
+    :class:`CheckpointCorruptError` naming the file, the writer rank, and
+    the mismatch.
     """
+    _reset_load_stats()
     try:
         with open(os.path.join(directory, _METADATA)) as f:
             meta = json.load(f)
@@ -422,7 +526,9 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
         if rec["kind"] == "str":
             flat_out[key] = rec["value"]
             continue
-        reader = _LeafReader(directory, rec, verify=verify)
+        reader = _LeafReader(directory, rec, verify=verify,
+                             max_cache_bytes=max_shard_cache_bytes)
+        _LOAD_STATS["leaves"] += 1
         shape = tuple(rec["shape"])
         sharding = (shardings or {}).get(key)
         if sharding is not None:
@@ -479,10 +585,17 @@ def validate_checkpoint(directory: str,
 
     Returns ``None`` when every metadata file parses and every recorded
     shard exists with matching byte length and (with ``checksums``) crc32;
-    otherwise a string describing the first problem found.
-    ``checksums=False`` is the cheap stat-only mode for housekeeping paths
-    (retention GC) that must not re-read every shard byte. Pre-checksum
-    checkpoints (no recorded crc) validate on existence/size only.
+    otherwise a string describing the problem. Missing shard files and
+    missing per-process commit markers are AGGREGATED and attributed to
+    writer ranks — after a host loss the report says exactly which ranks'
+    shards are gone (and names example keys) rather than the first missing
+    file. ``checksums=False`` is the cheap stat-only mode for housekeeping
+    paths (retention GC) that must not re-read every shard byte.
+    Pre-checksum checkpoints (no recorded crc) validate on existence/size
+    only. Completeness is topology-agnostic: a directory that validates
+    restores onto ANY target mesh (the re-slice plans itself from the
+    recorded offsets), so ``latest_checkpoint`` falling back to the newest
+    valid candidate is exactly "newest complete for the target topology".
     """
     metas: List[str] = []
     try:
@@ -501,16 +614,21 @@ def validate_checkpoint(directory: str,
     if nprocs is not None:
         # every process's commit marker must exist — a peer killed before
         # its metadata write means its shards are silently absent
-        missing = [f"metadata.{p}.json" for p in range(1, nprocs)
-                   if f"metadata.{p}.json" not in metas]
-        if missing:
-            return (f"{directory}: missing {missing[0]} "
-                    f"({nprocs}-process save, peer killed pre-commit?)")
+        lost = [p for p in range(1, nprocs)
+                if f"metadata.{p}.json" not in metas]
+        if lost:
+            names = ", ".join(f"metadata.{p}.json" for p in lost)
+            return (f"{directory}: missing {names} — rank(s) {lost} of a "
+                    f"{nprocs}-process save never committed (killed "
+                    f"pre-commit or host lost); their shards are not "
+                    f"recoverable from this directory")
         # ...and markers BEYOND process_count are stale leftovers from an
         # earlier larger-world save into this path: skip them, exactly as
         # load_state does (pre-process_count checkpoints check everything)
         metas = [n for n in metas
                  if _meta_proc(n) is None or _meta_proc(n) < nprocs]
+    gone: List[tuple] = []  # (rank-or-None, key, file) of missing shards
+    bad: List[str] = []     # size/crc/readability problems
     for name in metas:
         try:
             with open(os.path.join(directory, name)) as f:
@@ -523,42 +641,110 @@ def validate_checkpoint(directory: str,
                 try:
                     size = os.path.getsize(path)
                 except OSError:
-                    return f"{path}: shard missing (leaf {key!r})"
+                    gone.append((shard.get("process"), key, shard["file"]))
+                    continue
                 want_len = shard.get("bytes")
                 if want_len is not None and size != want_len:
-                    return (f"{path}: {size} bytes, metadata records "
-                            f"{want_len}")
+                    bad.append(f"{path}: {size} bytes, metadata records "
+                               f"{want_len}")
+                    continue
                 want_crc = shard.get("crc32")
-                if checksums and want_crc is not None:
+                # the first corruption settles the verdict — keep scanning
+                # for MISSING files (cheap stats, they drive the rank
+                # postmortem) but don't re-read further shard bytes
+                if checksums and want_crc is not None and not bad:
                     try:
                         got = _file_crc32(path)
                     except OSError:
-                        return f"{path}: shard unreadable (leaf {key!r})"
+                        bad.append(f"{path}: shard unreadable "
+                                   f"(leaf {key!r})")
+                        continue
                     if got != want_crc:
-                        return f"{path}: crc32 mismatch"
+                        bad.append(f"{path}: crc32 mismatch")
+    if gone:
+        # dedup by FILE: a replicated leaf is recorded by every rank's
+        # metadata under the same filename, and one lost file must not
+        # read as "every host died"
+        by_file: Dict[str, set] = {}
+        keys_set = set()
+        for r, k, f in gone:
+            by_file.setdefault(f, set()).add(r)
+            keys_set.add(k)
+        # attribute a rank only when the file belongs to exactly one
+        # (a multi-rank file is replicated — no single host to blame)
+        ranks = sorted({next(iter(rs)) for rs in by_file.values()
+                        if len(rs) == 1 and None not in rs})
+        keys = sorted(keys_set)
+        return (f"{directory}: {len(by_file)} shard file(s) missing"
+                + (f" from rank(s) {ranks}" if ranks else "")
+                + f" — lost host? affected leaves: "
+                + ", ".join(repr(k) for k in keys[:4])
+                + (f" (+{len(keys) - 4} more)" if len(keys) > 4 else "")
+                + (f"; also {bad[0]}" if bad else ""))
+    if bad:
+        return bad[0]
     return None
 
 
-def latest_checkpoint(root: str, verify: bool = True) -> Optional[str]:
+def mesh_info(directory: str) -> Optional[Dict[str, Any]]:
+    """Topology a checkpoint was WRITTEN on: ``{"axes": {name: size},
+    "devices": N, "process_count": M}``. ``None`` for unreadable
+    directories, host-only state, or checkpoints predating the elastic
+    metadata (which restore through the same-topology path unchanged).
+    Restores never REQUIRE this — re-slicing plans itself from per-shard
+    offsets — it exists so an elastic restore can report the shrink/grow
+    (``saved 8 devices -> restoring onto 4``) and so
+    :func:`paddle_tpu.distributed.elastic_mesh.reshaped_mesh` can rebuild
+    a compatible mesh on surviving capacity."""
+    if directory is None:
+        # empty checkpoint root (no checkpoint yet) — the fresh-start path
+        return None
+    try:
+        with open(os.path.join(directory, _METADATA)) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    mesh = meta.get("mesh")
+    if mesh is None:
+        return None
+    out = dict(mesh)
+    if meta.get("process_count") is not None:
+        out["process_count"] = int(meta["process_count"])
+    return out
+
+
+def latest_checkpoint(root: str, verify: bool = True,
+                      exclude: Sequence[str] = (),
+                      on_invalid: Optional[Callable[[str], None]] = None,
+                      ) -> Optional[str]:
     """Newest VALID ``step_*`` checkpoint under ``root`` (or ``None``).
 
     With ``verify`` (default), candidates failing
     :func:`validate_checkpoint` — torn saves, truncated or bit-flipped
-    shards, missing metadata — are skipped, so restore falls back to the
-    newest checkpoint that is actually loadable. This reads every shard of
-    the chosen candidate once (crc32); a subsequent :func:`load_state`
-    reads them again — the double pass is deliberate: fallback must reject
-    a bit-rotted-but-right-sized newest checkpoint BEFORE restore commits
-    to it. Pass ``verify=False`` to pick by metadata presence only.
+    shards, a lost host's missing rank shards — are skipped, so restore
+    falls back to the newest checkpoint that is complete (and completeness
+    is topology-agnostic: a complete directory restores onto any target
+    mesh). This reads every shard of the chosen candidate once (crc32); a
+    subsequent :func:`load_state` reads them again — the double pass is
+    deliberate: fallback must reject a bit-rotted-but-right-sized newest
+    checkpoint BEFORE restore commits to it. Pass ``verify=False`` to pick
+    by metadata presence only. ``exclude`` paths are skipped outright —
+    the restore loop's "this one failed to LOAD, give me the next" hook.
+    ``on_invalid`` is called with each path that FAILED validation; a
+    retry loop that feeds those back into ``exclude`` avoids re-reading
+    every shard byte of already-rejected candidates on each iteration.
     """
     if not os.path.isdir(root):
         return None
+    excluded = {os.path.abspath(p) for p in exclude}
     steps = sorted(
         ((int(m.group(1)), name) for m, name in
          ((_STEP_DIR.match(n), n) for n in os.listdir(root)) if m),
         reverse=True)
     for step, name in steps:
         path = os.path.join(root, name)
+        if os.path.abspath(path) in excluded:
+            continue
         if not os.path.exists(os.path.join(path, _METADATA)):
             continue
         if verify:
@@ -566,6 +752,8 @@ def latest_checkpoint(root: str, verify: bool = True) -> Optional[str]:
             if problem is not None:
                 print(f"[checkpoint] skipping {path}: {problem}",
                       flush=True)
+                if on_invalid is not None:
+                    on_invalid(path)
                 continue
         return path
     return None
@@ -598,6 +786,7 @@ class AutoCheckpoint:
 
     _ORPHAN = re.compile(r"^step_\d+\.tmp(-pt\d+)?$")
     _TRASH = re.compile(r"^(step_\d+)\.old-pt\d+$")
+    _TMPFILE = re.compile(r"\.tmp\d+$")
 
     def _sweep_orphans(self, ttl: float = 0.0) -> None:
         """Clean up after a killed process: ``step_N.tmp*`` staging dirs are
@@ -605,7 +794,11 @@ class AutoCheckpoint:
         count) and are deleted; a ``step_N.old-pt<pid>`` overwrite trash
         copy whose ``step_N`` is MISSING is the old checkpoint caught
         between save_state's two renames — restore it rather than lose the
-        only copy.
+        only copy. Inside step dirs, ``*.tmp<pid>`` FILES are a
+        multi-process writer SIGKILLed between staging a shard and its
+        ``os.replace`` publish — each crashed incarnation leaves a
+        uniquely-named file that no later save overwrites, so they are
+        reaped here too.
 
         ``ttl`` > 0 reaps only staging dirs whose mtime is older than that
         many seconds. The startup sweep runs with ttl=0 (the restarting
@@ -616,7 +809,7 @@ class AutoCheckpoint:
         mtime) is left alone."""
         now = time.time()
 
-        def fresh(path: str) -> bool:
+        def fresh(path: str, ttl: float = ttl) -> bool:
             # under a TTL, anything recently touched may belong to a LIVE
             # sibling mid-save (including the window between save_state's
             # two overwrite renames) — leave it alone
@@ -626,6 +819,15 @@ class AutoCheckpoint:
                 return now - os.path.getmtime(path) < ttl
             except OSError:
                 return True  # raced with its publish rename: not stale
+
+        # in-step-dir staging FILES sit in a root SHARED with multi-process
+        # peers, and peers do not restart atomically: a straggler rank's
+        # startup sweep (ttl=0) must not reap an earlier-restarted peer's
+        # in-flight shard, so the FILE reap keeps the staging TTL whenever
+        # other writer processes may be live.
+        file_ttl = ttl
+        if jax.process_count() > 1:
+            file_ttl = max(ttl, self.staging_ttl_seconds)
 
         for name in os.listdir(self.root):
             path = os.path.join(self.root, name)
@@ -642,6 +844,21 @@ class AutoCheckpoint:
                 if fresh(path):
                     continue
                 shutil.rmtree(path, ignore_errors=True)
+            elif _STEP_DIR.match(name) and os.path.isdir(path):
+                try:
+                    members = os.listdir(path)
+                except OSError:
+                    continue  # raced with retention GC
+                for fn in members:
+                    if not self._TMPFILE.search(fn):
+                        continue
+                    fpath = os.path.join(path, fn)
+                    if fresh(fpath, file_ttl):
+                        continue
+                    try:
+                        os.remove(fpath)
+                    except OSError:
+                        pass  # raced with its publish rename
 
     def _due(self, step):
         if self.save_interval_seconds is not None:

@@ -84,7 +84,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _worker_env(args, local_rank: int, world: int, rank: int,
-                coordinator: str, kv_endpoint: Optional[str]) -> dict:
+                coordinator: str, kv_endpoint: Optional[str],
+                elastic: bool = False) -> dict:
     # workers must resolve the same paddle_tpu the launcher runs from
     # (python <script> does not add the launcher cwd to sys.path)
     import paddle_tpu
@@ -106,6 +107,13 @@ def _worker_env(args, local_rank: int, world: int, rank: int,
     }
     if kv_endpoint:
         env["PADDLE_KV_ENDPOINT"] = kv_endpoint
+    if elastic:
+        # the worker-side hint that THIS world size is provisional: meshes
+        # should be rebuilt per incarnation from the newest checkpoint's
+        # recorded topology (distributed.elastic_mesh.reshaped_mesh), so a
+        # resume on N-k hosts reshard-restores instead of demanding the
+        # exact mesh that wrote the snapshot
+        env["PADDLE_ELASTIC"] = "1"
     if args.devices_per_proc:
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
@@ -212,13 +220,14 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
 
 def _build_pod(args, node_rank: int, world: int, nproc: int,
-               coordinator: str, kv_endpoint: Optional[str]) -> "Pod":
+               coordinator: str, kv_endpoint: Optional[str],
+               elastic: bool = False) -> "Pod":
     """Shared by static and elastic paths so worker spawning can't drift."""
     pod = Pod()
     for local_rank in range(nproc):
         rank = node_rank * nproc + local_rank
         env = _worker_env(args, local_rank, world, rank, coordinator,
-                          kv_endpoint)
+                          kv_endpoint, elastic=elastic)
         log = (os.path.join(args.log_dir, f"worker.{rank}.log")
                if args.log_dir else None)
         pod.add(Container(
@@ -287,7 +296,7 @@ def _launch_elastic(args, min_nodes: int, max_nodes: int, nproc: int,
                   f"procs (rank {node_rank})", flush=True)
 
             pod = _build_pod(args, node_rank, world, nproc, coordinator,
-                             kv_endpoint)
+                             kv_endpoint, elastic=True)
             pod.deploy()
 
             # watch the ACTIVE set while the pod runs; on change, kill it

@@ -73,12 +73,32 @@ def buffer_specs(model: Layer, mesh=None) -> Dict[str, PartitionSpec]:
     return {name: PartitionSpec() for name, _ in model.named_buffers()}
 
 
+def put_global(x, sharding: NamedSharding):
+    """Place a host value onto ``sharding``, valid on meshes spanning
+    multiple processes.
+
+    Single-process: plain ``device_put``. Multi-process: ``device_put``
+    onto a non-addressable sharding first runs a broadcast to assert every
+    process passed the same value — a collective per leaf, and one the CPU
+    backend may not even implement — so the global array is assembled with
+    ``make_array_from_callback`` instead: each process materialises only
+    its addressable shards, no communication. The multi-controller data
+    contract (every process passes the same global value) is assumed, the
+    same contract ``device_put`` would have verified.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def shard_params(params: Dict[str, Any], specs: Dict[str, PartitionSpec], mesh=None):
-    """device_put each param to its NamedSharding (host->mesh scatter).
-    Goes through numpy so the result never aliases the input buffer (the
-    train step donates its params; the source Layer must stay valid)."""
+    """Scatter each param to its NamedSharding (host->mesh). Goes through
+    numpy so the result never aliases the input buffer (the train step
+    donates its params; the source Layer must stay valid)."""
     mesh = mesh or require_mesh()
-    return {name: jax.device_put(np.asarray(p), NamedSharding(mesh, specs.get(name, PartitionSpec())))
+    return {name: put_global(np.asarray(p), NamedSharding(mesh, specs.get(name, PartitionSpec())))
             for name, p in params.items()}
 
 
@@ -159,7 +179,7 @@ class DistributedTrainStep(StepSeams):
         zero3 = "sdp" if sharding_stage >= 3 else None
         self.specs = param_specs(model, self.mesh, zero3_axis=zero3)
         self.params = shard_params(param_state(model), self.specs, self.mesh)
-        self.buffers = {k: jax.device_put(np.asarray(v), NamedSharding(self.mesh, P()))
+        self.buffers = {k: put_global(np.asarray(v), NamedSharding(self.mesh, P()))
                         for k, v in buffer_state(model).items()}
         opt_state = optimizer.init(self.params)
         shard_axis = "sdp" if sharding_stage >= 1 else None
@@ -178,15 +198,15 @@ class DistributedTrainStep(StepSeams):
         self._grad_accum = None
         if self.grad_accum_steps > 1:
             self._grad_accum = {
-                k: jax.device_put(
-                    jnp.zeros(v.shape, _grad_dtype(v.dtype)),
+                k: put_global(
+                    np.zeros(v.shape, _grad_dtype(v.dtype)),
                     NamedSharding(self.mesh, self.specs[k]))
                 for k, v in self.params.items()}
         self._init_seams(scaler, self.grad_accum_steps)
         # scale state is replicated: every device applies the same skip/grow
         # decision, so the rolled-back state stays consistent across shards
         self.scaler_state = (
-            {k: jax.device_put(jnp.asarray(v), NamedSharding(self.mesh, P()))
+            {k: put_global(np.asarray(v), NamedSharding(self.mesh, P()))
              for k, v in dict(self.scaler.state).items()}
             if self.scaler is not None else None)
         donate_argnums = (0, 1, 2, 3) if donate else ()
@@ -219,10 +239,10 @@ class DistributedTrainStep(StepSeams):
         for slot, val in opt_state.items():
             spec = self.opt_specs.get(slot)
             if isinstance(val, dict) and isinstance(spec, dict):
-                out[slot] = {k: jax.device_put(v, NamedSharding(self.mesh, spec[k]))
+                out[slot] = {k: put_global(v, NamedSharding(self.mesh, spec[k]))
                              for k, v in val.items()}
             elif hasattr(val, "ndim"):
-                out[slot] = jax.device_put(val, NamedSharding(self.mesh, P()))
+                out[slot] = put_global(val, NamedSharding(self.mesh, P()))
             else:
                 out[slot] = val
         return out
@@ -288,9 +308,17 @@ class DistributedTrainStep(StepSeams):
         return loss, new_params, new_buffers, new_opt_state, accum, scaler_state
 
     def _put_batch(self, batch):
-        return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
-            if hasattr(x, "ndim") or isinstance(x, (np.ndarray, list)) else x, batch)
+        def put(x):
+            if not (hasattr(x, "ndim") or isinstance(x, (np.ndarray, list))):
+                return x
+            if isinstance(x, jax.Array):
+                # already on device (prefetch pipeline): reshard in place —
+                # np.asarray here would block on a D2H copy (and raise
+                # outright for non-addressable multi-process batches)
+                return jax.device_put(x, self._batch_sharding)
+            return put_global(np.asarray(x), self._batch_sharding)
+
+        return jax.tree.map(put, batch)
 
     def _checked_call(self, batch, count, poison):
         if self.scaler_state is not None:
@@ -407,7 +435,7 @@ class DistributedTrainStep(StepSeams):
             if isinstance(v, jax.Array) and not v.is_fully_addressable:
                 # already a global array on another sharding: reshard
                 return jax.device_put(v, sharding)
-            return jax.device_put(np.asarray(v), sharding)
+            return put_global(np.asarray(v), sharding)
 
         self.params = {k: put(state["params"][k],
                               NamedSharding(self.mesh, self.specs[k]))

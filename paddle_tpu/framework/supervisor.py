@@ -403,17 +403,44 @@ class TrainingSupervisor:
         torn/corrupt candidates are skipped by ``latest_checkpoint``).
         Returns the recorded :class:`DataCursor`, ``None`` when there is no
         checkpoint or it predates cursors (old checkpoints still load; the
-        data stream then restarts at epoch 0)."""
+        data stream then restarts at epoch 0).
+
+        Topology-agnostic: when the checkpoint was written on a DIFFERENT
+        mesh (elastic shrink/grow — the step was rebuilt on surviving
+        capacity via ``distributed.elastic_mesh.reshaped_mesh``), every
+        leaf is re-sliced onto this step's shardings while loading —
+        streaming, bounded host memory, never a full global array — and
+        the resize is reported (``train.reshard`` counter). A candidate
+        that fails to LOAD (corruption surfacing between validation and
+        read, e.g. a rank's shards lost to a dying host) is skipped and
+        the next newest complete checkpoint is tried; candidates that
+        failed VALIDATION are remembered too, so each retry does not
+        re-crc every shard of already-rejected newer checkpoints."""
         import jax
 
-        from ..distributed.checkpoint import _STEP_DIR, latest_checkpoint, \
-            load_state
+        from ..distributed.checkpoint import (_STEP_DIR,
+                                              CheckpointCorruptError,
+                                              latest_checkpoint, load_state)
         from ..io.cursor import DataCursor
 
-        path = latest_checkpoint(self.checkpoint.root)
-        if path is None:
-            return None
-        flat = load_state(path, shardings=self._shardings())
+        tried = []
+        while True:
+            path = latest_checkpoint(self.checkpoint.root, exclude=tried,
+                                     on_invalid=tried.append)
+            if path is None:
+                return None
+            try:
+                flat = load_state(path, shardings=self._shardings())
+                # only a load that SUCCEEDED counts as a reshard — skipped
+                # candidates must not bump the counter or log a resize
+                self._report_reshard(path)
+                break
+            except CheckpointCorruptError as e:
+                warnings.warn(
+                    f"checkpoint {path} failed to load ({e}); falling back "
+                    f"to the next newest complete checkpoint",
+                    RuntimeWarning)
+                tried.append(path)
         template = self._template(with_cursor=True)
         flat_t, treedef = _flatten_template(template)
         missing = [k for k in flat_t if k not in flat]
@@ -438,6 +465,24 @@ class TrainingSupervisor:
         if cursor_missing:
             return None
         return DataCursor.from_state(cursor_state)
+
+    def _report_reshard(self, path: str) -> None:
+        """Log + count a cross-topology restore (checkpoint mesh != the
+        step's live mesh). Purely observational: the re-slice itself needs
+        no planning input — per-shard offsets in the metadata drive it."""
+        from .. import profiler
+        from ..distributed.checkpoint import mesh_info
+
+        info = mesh_info(path)
+        mesh = getattr(self.step, "mesh", None)
+        if not info or mesh is None or not info.get("axes"):
+            return
+        cur = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        if cur != info["axes"]:
+            profiler.bump_counter("train.reshard")
+            print(f"[supervisor] elastic reshard: checkpoint written on "
+                  f"mesh {info['axes']} ({info.get('devices')} devices); "
+                  f"restoring onto {cur} ({mesh.size} devices)", flush=True)
 
     def save_now(self, cursor=None) -> None:
         """Cut a checkpoint at the current step, recording the cursor."""

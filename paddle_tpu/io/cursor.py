@@ -47,6 +47,29 @@ class DataCursor:
                    epoch_seed=int(state.get("epoch_seed", 0)),
                    global_step=int(state.get("global_step", 0)))
 
+    def rescale(self, old_global_batch: int,
+                new_global_batch: int) -> "DataCursor":
+        """Re-express this cursor under a CHANGED global batch size.
+
+        The elastic default keeps the global batch constant across a
+        shrink/grow (``distributed.elastic_mesh.rescale_batch``), in which
+        case the cursor is already valid. When a resize deliberately
+        changes the global batch, the invariant to preserve is the number
+        of SAMPLES consumed: ``batch_index * old_global_batch``. The new
+        index rounds DOWN to a batch boundary, so a partial batch's worth
+        of samples is replayed rather than skipped — replaying a few
+        samples perturbs nothing, skipping them silently drops data.
+        """
+        if old_global_batch <= 0 or new_global_batch <= 0:
+            raise ValueError("batch sizes must be positive")
+        if old_global_batch == new_global_batch:
+            return DataCursor(**self.as_state())
+        consumed = self.batch_index * old_global_batch
+        return DataCursor(epoch=self.epoch,
+                          batch_index=consumed // new_global_batch,
+                          epoch_seed=self.epoch_seed,
+                          global_step=self.global_step)
+
 
 def resume_batches(loader, start_batch: int) -> Iterator:
     """One epoch of ``loader`` starting at ``start_batch``.

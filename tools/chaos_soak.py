@@ -25,15 +25,33 @@ Pass criteria (exit 0 iff all hold):
   warmup, so a rollback/replay or resume that retraced the step would have
   failed the child outright.
 
+**Elastic scenario** (``--elastic``): the shrink/grow-on-preemption proof.
+Four child runs against ONE checkpoint root, each a fresh interpreter with
+its own simulated device count:
+
+1. **baseline** — 8 devices (dp4 x mp2), uninterrupted; records the final
+   eval loss.
+2. **elastic #1** — 8 devices, killed cold (``crash`` @ ``train.ckpt``)
+   mid-training: the "preemption notice never arrived" case.
+3. **elastic #2 (shrink)** — only 4 devices survive: the child rebuilds a
+   dp2 x mp2 mesh via ``elastic_mesh.reshaped_mesh``, reshard-restores the
+   newest complete checkpoint (must log ``elastic reshard``), trains on,
+   and is killed again.
+4. **elastic #3 (grow)** — capacity returns (8 devices): reshard back up,
+   run to completion. Final eval loss must match the baseline within
+   ``--tol`` — training effectively never stopped.
+
 Usage::
 
     python tools/chaos_soak.py            # full soak
     python tools/chaos_soak.py --quick    # CI-sized (robustness_gate)
+    python tools/chaos_soak.py --elastic --quick   # shrink/grow scenario
 """
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -47,6 +65,11 @@ from paddle_tpu.distributed.resilience import CRASH_EXIT, FaultPlan  # noqa: E40
 
 SEQ = 32
 BATCH = 4
+
+# elastic scenario: a dp x mp2 teacher-fit MLP, global batch constant
+# across resizes (divisible by every dp degree the job can shrink to)
+ELASTIC_DIM = 16
+ELASTIC_BATCH = 8
 
 
 def _config(quick: bool):
@@ -173,6 +196,104 @@ def run_child(args) -> int:
     return 0
 
 
+# ------------------------------------------------------------- elastic child
+def run_elastic_child(args) -> int:
+    """One incarnation of the elastic trainer.
+
+    Builds the mesh for THIS device count from the newest checkpoint's
+    recorded topology (``elastic_mesh.reshaped_mesh``), reshard-restores
+    through the supervisor, and trains to ``--total-steps`` with periodic
+    checkpoints — where the fault plan's ``train.ckpt`` crash kills the
+    process cold. The data stream is a pure function of the global step,
+    so every incarnation (any topology) sees the same batches: final loss
+    is comparable across baseline and shrink/grow sequences.
+    """
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import elastic_mesh
+    from paddle_tpu.distributed.checkpoint import last_load_stats
+    from paddle_tpu.distributed.parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+    from paddle_tpu.distributed.shard import DistributedTrainStep
+    from paddle_tpu.framework.supervisor import (RecoveryPolicy,
+                                                 TrainingSupervisor)
+    from paddle_tpu.optimizer import AdamW
+
+    assert len(jax.devices()) == args.devices, \
+        f"expected {args.devices} simulated devices, got {len(jax.devices())}"
+    root = os.path.join(args.workdir, "ckpt")
+    # topology-agnostic bootstrap: the recorded mesh reshaped onto the
+    # live devices; a fresh start falls back to dp x mp2 over whatever
+    # capacity exists. First launch, resume, shrink and grow all take
+    # this same line.
+    mesh = elastic_mesh.reshaped_mesh(root, default_axes={"dp": -1, "mp": 2})
+    per_replica = elastic_mesh.rescale_batch(ELASTIC_BATCH, dict(mesh.shape))
+
+    pt.seed(args.seed)
+    model = nn.Sequential(
+        ColumnParallelLinear(ELASTIC_DIM, 4 * ELASTIC_DIM,
+                             gather_output=False),
+        nn.ReLU(),
+        RowParallelLinear(4 * ELASTIC_DIM, ELASTIC_DIM,
+                          input_is_parallel=True))
+    step = DistributedTrainStep(
+        model, AdamW(learning_rate=1e-2),
+        loss_fn=lambda out, b: F.mse_loss(out, b[1]))
+
+    rng = np.random.default_rng(args.seed)
+    w_true = rng.standard_normal(
+        (ELASTIC_DIM, ELASTIC_DIM)).astype(np.float32)
+
+    def batch_at(i: int):
+        r = np.random.default_rng(args.seed * 100003 + i)
+        x = r.standard_normal((ELASTIC_BATCH, ELASTIC_DIM)).astype(np.float32)
+        return x, x @ w_true
+
+    policy = RecoveryPolicy(checkpoint_dir=root, save_interval_steps=4,
+                            keep_max=4, async_save=False, preemption=False)
+    sup = TrainingSupervisor(step, policy)
+    losses = []
+    with sup:
+        sup.restore()
+        start = int(step._count)
+        # crash-surviving record of this incarnation (a killed child
+        # cannot write its result file)
+        with open(os.path.join(args.workdir, "incarnations.jsonl"),
+                  "a") as f:
+            f.write(json.dumps({
+                "pid": os.getpid(), "devices": args.devices,
+                "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+                "start_step": start, "per_replica_batch": per_replica,
+                "restore": last_load_stats()}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        print(f"[elastic-child] devices={args.devices} "
+              f"mesh={dict(mesh.shape)} per_replica_batch={per_replica} "
+              f"start_step={start}", flush=True)
+        for i in range(start, args.total_steps):
+            losses.append(float(np.asarray(step(batch_at(i)))))
+            sup.maybe_save()
+    result = {
+        # mean over the final plateau steps: every run (baseline or
+        # shrink/grow sequence) computes these on the SAME batches
+        "final_eval_loss": float(np.mean(losses[-4:])),
+        "start_step": start,
+        "end_step": int(step._count),
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+    }
+    out = os.path.join(args.workdir, "result.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out + ".tmp", out)
+    print(json.dumps(result))
+    return 0
+
+
 # ------------------------------------------------------------------- harness
 def _fault_plan(seed: int) -> FaultPlan:
     return FaultPlan([
@@ -201,6 +322,143 @@ def _spawn(workdir: str, args, plan: FaultPlan | None):
                           stderr=subprocess.STDOUT, text=True, timeout=900)
 
 
+def _kill_plan(seed: int) -> FaultPlan:
+    """Die cold (as hard as SIGKILL) at the 3rd checkpoint attempt — no
+    preemption notice, no final snapshot: the restore must fall back to
+    the last PUBLISHED checkpoint."""
+    return FaultPlan([{"site": "train.ckpt", "kind": "crash", "times": 1,
+                       "after": 2}], seed=seed)
+
+
+def _spawn_elastic(workdir: str, args, devices: int, plan: FaultPlan | None):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    # forced (not setdefault): the scenario IS a simulated N-device CPU
+    # mesh, and the device-count flag only applies to the host platform
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={devices}"
+                        ).strip()
+    if plan is not None:
+        env["PT_FAULT_PLAN"] = plan.to_json()
+    else:
+        env.pop("PT_FAULT_PLAN", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--elastic-child",
+           "--workdir", workdir, "--seed", str(args.seed),
+           "--devices", str(devices), "--total-steps",
+           str(args.total_steps)]
+    if args.quick:
+        cmd.append("--quick")
+    return subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True, timeout=900)
+
+
+def _incarnations(workdir: str) -> list:
+    path = os.path.join(workdir, "incarnations.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def run_elastic(args) -> int:
+    """The shrink/grow-on-preemption proof (see module docstring)."""
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="chaos_elastic_") as root:
+        base_dir = os.path.join(root, "baseline")
+        el_dir = os.path.join(root, "elastic")
+        os.makedirs(base_dir)
+        os.makedirs(el_dir)
+
+        print("[chaos_soak] elastic baseline (8 devices, uninterrupted)...",
+              flush=True)
+        p = _spawn_elastic(base_dir, args, 8, plan=None)
+        if p.returncode != 0:
+            print(p.stdout[-2000:])
+            print("[chaos_soak] FAIL: elastic baseline failed")
+            return 1
+        baseline = json.load(open(os.path.join(base_dir, "result.json")))
+        print(f"[chaos_soak] baseline loss "
+              f"{baseline['final_eval_loss']:.5f} "
+              f"mesh={baseline['mesh']}", flush=True)
+
+        print("[chaos_soak] elastic #1 (8 devices, killed mid-run)...",
+              flush=True)
+        p1 = _spawn_elastic(el_dir, args, 8, plan=_kill_plan(args.seed))
+        if p1.returncode != CRASH_EXIT:
+            failures.append(f"elastic #1: expected CRASH_EXIT {CRASH_EXIT},"
+                            f" got {p1.returncode}: {p1.stdout[-500:]}")
+
+        print("[chaos_soak] elastic #2 (shrink: 4 devices survive)...",
+              flush=True)
+        p2 = _spawn_elastic(el_dir, args, 4, plan=_kill_plan(args.seed))
+        if p2.returncode != CRASH_EXIT:
+            failures.append(f"elastic #2: expected CRASH_EXIT {CRASH_EXIT},"
+                            f" got {p2.returncode}: {p2.stdout[-500:]}")
+        if "elastic reshard" not in p2.stdout:
+            failures.append("elastic #2: no 'elastic reshard' logged — the "
+                            "shrunk incarnation did not reshard-restore")
+
+        print("[chaos_soak] elastic #3 (grow: back to 8 devices)...",
+              flush=True)
+        p3 = _spawn_elastic(el_dir, args, 8, plan=None)
+        if p3.returncode != 0:
+            failures.append(f"elastic #3: grow run failed "
+                            f"rc={p3.returncode}: {p3.stdout[-800:]}")
+        elif "elastic reshard" not in p3.stdout:
+            failures.append("elastic #3: no 'elastic reshard' logged — the "
+                            "regrown incarnation did not reshard-restore")
+
+        incs = _incarnations(el_dir)
+        if len(incs) == 3:
+            shrunk_dp = incs[1]["mesh"].get("dp")
+            shrunk_mp = incs[1]["mesh"].get("mp")
+            # a missing axis key is itself the anomaly — record it, don't
+            # TypeError out of the gate harness
+            if (shrunk_dp is None or shrunk_mp is None
+                    or shrunk_dp * shrunk_mp != 4):
+                failures.append(
+                    f"elastic #2 did not shrink to 4 devices: "
+                    f"mesh={incs[1]['mesh']}")
+            if incs[1]["mesh"].get("mp") != incs[0]["mesh"].get("mp"):
+                failures.append("elastic resize changed the frozen mp axis")
+            # progress must carry ACROSS topologies: each incarnation
+            # resumes from checkpoints the previous one published
+            if not (0 < incs[1]["start_step"] <= incs[2]["start_step"]):
+                failures.append(
+                    f"no cross-topology progress: start steps "
+                    f"{[i['start_step'] for i in incs]}")
+        else:
+            failures.append(f"expected 3 elastic incarnations, saw "
+                            f"{len(incs)}")
+
+        result_path = os.path.join(el_dir, "result.json")
+        if os.path.exists(result_path):
+            final = json.load(open(result_path))
+            base_loss = baseline["final_eval_loss"]
+            rel = abs(final["final_eval_loss"] - base_loss) / abs(base_loss)
+            print(f"[chaos_soak] elastic loss {final['final_eval_loss']:.5f}"
+                  f" vs baseline {base_loss:.5f} (rel diff {rel * 100:.2f}%,"
+                  f" tol {args.tol * 100:.0f}%)", flush=True)
+            # NaN (e.g. an incarnation that resumed at/past total_steps and
+            # trained zero steps) must fail CLOSED: `NaN > tol` is False
+            if not math.isfinite(rel) or rel > args.tol:
+                failures.append(
+                    f"final loss diverged across shrink/grow: "
+                    f"{final['final_eval_loss']} vs {base_loss} "
+                    f"(rel {rel:.4f} > tol {args.tol})")
+        elif not failures:
+            failures.append("elastic #3: no result.json")
+
+    if failures:
+        print("[chaos_soak] FAIL (elastic)")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("[chaos_soak] PASS (elastic): trained through kill -> shrink to "
+          "4 devices -> regrow to 8 with loss parity")
+    return 0
+
+
 def _events(workdir: str) -> list:
     path = os.path.join(workdir, "events.jsonl")
     if not os.path.exists(path):
@@ -216,11 +474,23 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--tol", type=float, default=0.01,
                     help="relative final-loss tolerance vs the clean run")
+    ap.add_argument("--elastic", action="store_true",
+                    help="shrink/grow-on-preemption scenario")
     ap.add_argument("--child", action="store_true", help="internal")
+    ap.add_argument("--elastic-child", action="store_true", help="internal")
     ap.add_argument("--workdir", default=None, help="internal")
+    ap.add_argument("--devices", type=int, default=8, help="internal")
+    ap.add_argument("--total-steps", type=int, default=None,
+                    help="elastic scenario optimizer-step budget")
     args = ap.parse_args()
+    if args.total_steps is None:
+        args.total_steps = 24 if args.quick else 48
     if args.child:
         return run_child(args)
+    if args.elastic_child:
+        return run_elastic_child(args)
+    if args.elastic:
+        return run_elastic(args)
 
     failures = []
     with tempfile.TemporaryDirectory(prefix="chaos_soak_") as root:

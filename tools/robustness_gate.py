@@ -7,7 +7,13 @@ story. Runs, in order:
    unrecovered fault, loss divergence beyond tolerance, or a steady-state
    recompile — the soak children run under ``retrace_guard(0)``);
 2. ``tools/fault_sweep.py`` — the distributed-primitive fault matrix
-   (kv/rpc/checkpoint under drop/delay/crash).
+   (kv/rpc/checkpoint under drop/delay/crash);
+3. with ``--elastic``, ``tools/chaos_soak.py --elastic --quick`` — the
+   shrink/grow-on-preemption scenario: kill a run mid-training, resume on
+   HALF the devices via reshard-restore, kill again, regrow to the full
+   topology, and demand final-loss parity with an uninterrupted run
+   (fails on any unrecovered shrink, a resize that never resharded, or
+   loss divergence).
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -15,6 +21,7 @@ nightly full matrix)::
 
     python tools/robustness_gate.py
     python tools/robustness_gate.py --skip-sweep   # soak only
+    python tools/robustness_gate.py --elastic      # + shrink/grow proof
 """
 from __future__ import annotations
 
@@ -47,6 +54,8 @@ def main() -> int:
     ap.add_argument("--skip-sweep", action="store_true")
     ap.add_argument("--full-soak", action="store_true",
                     help="run the soak without --quick")
+    ap.add_argument("--elastic", action="store_true",
+                    help="also run the shrink/grow-on-preemption scenario")
     args = ap.parse_args()
 
     results = {}
@@ -55,6 +64,12 @@ def main() -> int:
         if not args.full_soak:
             cmd.append("--quick")
         results["chaos_soak"] = _run("chaos_soak", cmd)
+    if args.elastic:
+        cmd = [sys.executable, os.path.join(TOOLS, "chaos_soak.py"),
+               "--elastic"]
+        if not args.full_soak:
+            cmd.append("--quick")
+        results["elastic"] = _run("elastic", cmd)
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,
