@@ -130,6 +130,7 @@ class GradScaler:
         if not self._pending:
             return
         # one transfer for the whole buffer, not one round-trip per flag
+        # tpu-lint: disable=R1(deliberate batched flush — one device_get per _PENDING_MAX update steps, only when counters are read)
         flags = jax.device_get([flag for _, flag in self._pending])
         for (idx, _), flag in zip(self._pending, flags):
             if bool(flag):

@@ -1,0 +1,84 @@
+"""tpu_lint: trace-discipline static analysis for the TPU-native stack.
+
+Runtime guards (``retrace_guard``, the numerics watchdog, the serving
+compile counters) catch compile-discipline violations *after* the
+recompile/sync already burned time. This package catches the same classes
+of bug at review time, from source alone — no jax import, no backend:
+
+==== =================================================================
+R1   host sync in trace-reachable or hot dispatch code
+R2   retrace hazards (branch on tracer, tracer formatting, jit-in-loop)
+R3   donation-after-use of a donated buffer
+R4   PRNG key reuse without split/fold_in
+R5   shared state bypassing its majority-use lock in threaded classes
+==== =================================================================
+
+Entry point::
+
+    from paddle_tpu.analysis import analyze
+    result = analyze("/repo", ["paddle_tpu", "tools"])
+    for f in result.findings: print(f.render())
+
+CLI: ``tools/tpu_lint.py`` (human + ``--json``, baseline gate). See the
+README's "Static analysis (tpu_lint)" section for the rule catalog and
+the suppression / baseline-update policy.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .baseline import diff_baseline, load_baseline, save_baseline
+from .callgraph import CallGraph, build_callgraph
+from .model import Finding, Project, load_project
+from .rules import RULE_DOCS, run_rules
+
+__all__ = ["analyze", "AnalysisResult", "Finding", "RULE_DOCS",
+           "load_baseline", "save_baseline", "diff_baseline"]
+
+
+@dataclass
+class AnalysisResult:
+    project: Project
+    callgraph: CallGraph
+    findings: List[Finding] = field(default_factory=list)
+
+    @property
+    def by_rule(self) -> Dict[str, List[Finding]]:
+        out: Dict[str, List[Finding]] = {}
+        for f in self.findings:
+            out.setdefault(f.rule, []).append(f)
+        return out
+
+    def stats(self) -> dict:
+        fns = self.project.functions.values()
+        return {
+            "files": len(self.project.files),
+            "functions": len(self.project.functions),
+            "trace_roots": len(self.callgraph.trace_roots),
+            "trace_reachable": sum(f.trace_reachable for f in fns),
+            "thread_roots": len(self.callgraph.thread_roots),
+            "thread_reachable": sum(f.thread_reachable for f in fns),
+            "findings": {r: len(v) for r, v in sorted(
+                self.by_rule.items())},
+        }
+
+
+def analyze(root: str, paths: List[str]) -> AnalysisResult:
+    """Run every rule over the .py files under ``paths`` (relative to
+    ``root``). Suppressed findings are dropped here; baseline filtering is
+    the caller's second stage (``diff_baseline``)."""
+    abs_paths = [p if os.path.isabs(p) else os.path.join(root, p)
+                 for p in paths]
+    project, findings = load_project(root, abs_paths)
+    cg = build_callgraph(project)
+    raw = run_rules(project, cg)
+    kept = list(findings)   # R0 policy findings are never suppressible
+    for f in raw:
+        sf = next((s for s in project.files if s.rel == f.path), None)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return AnalysisResult(project, cg, kept)

@@ -1,0 +1,65 @@
+"""Checked-in finding baseline: accepted findings pass, NEW findings fail.
+
+The baseline is a JSON map of finding *keys* (rule|path|symbol|snippet —
+line numbers deliberately excluded, so unrelated edits don't churn it) to
+occurrence counts. The gate semantics:
+
+- a finding whose key count is within the baseline count is *accepted*
+  (pre-existing, triaged);
+- any finding beyond its baselined count is *new* and fails the build;
+- baselined keys that no longer occur are *stale* — reported for hygiene
+  but never failing (``--update-baseline`` prunes them).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .model import Finding
+
+__all__ = ["load_baseline", "save_baseline", "diff_baseline"]
+
+_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}; this "
+            f"tool writes version {_VERSION} — regenerate with "
+            f"--update-baseline")
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(path: str, findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": _VERSION,
+                   "findings": dict(sorted(counts.items()))}, fh, indent=1,
+                  sort_keys=False)
+        fh.write("\n")
+    return counts
+
+
+def diff_baseline(findings: List[Finding],
+                  baseline: Dict[str, int]) -> Tuple[List[Finding],
+                                                     List[str]]:
+    """``(new_findings, stale_keys)`` — new = beyond the baselined count
+    for that key (R0 policy findings are never baselinable)."""
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        allowed = 0 if f.rule == "R0" else baseline.get(k, 0)
+        if seen[k] > allowed:
+            new.append(f)
+    stale = [k for k, n in baseline.items() if seen.get(k, 0) < n]
+    return new, stale

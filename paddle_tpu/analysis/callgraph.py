@@ -1,0 +1,616 @@
+"""Call graph + trace/thread reachability for tpu_lint.
+
+Trace entry points are DISCOVERED, not listed: any ``jax.jit`` /
+``jax.pjit`` / ``framework.jit`` wrap site (call form, decorator form, or
+``functools.partial(jax.jit, ...)``) names a wrapped function, possibly
+through ``compile_cache.instrument`` / ``functools.partial`` / a local or
+``self.<attr>`` assignment — that function is a *trace root*. This is what
+seeds the repo's real entries (``TrainStep._step``,
+``DistributedTrainStep._step``, the generation/serving prefill+decode
+bodies, ``fleet.metrics``' reduce, the flash-attention kernels) without a
+hand-maintained list that would rot.
+
+From the roots, reachable-under-trace propagates along resolved call
+edges. Resolution is deliberately approximate but sound for this
+codebase's idioms:
+
+- bare names -> module functions / imported project symbols / nested defs;
+- ``self.m(...)`` -> MRO method, else methods named ``m`` on project
+  subclasses (how ``Layer.__call__`` finds the concrete ``forward``);
+- ``self.attr(...)`` where ``__init__`` did ``self.attr = SomeLayer(...)``
+  -> that class's ``__call__``/``forward``;
+- ``functional_call(model, ...)`` -> every project ``forward`` (the
+  traced-model bridge);
+- higher-order jax wrappers (``vmap``/``lax.scan``/``jax.tree.map``/...)
+  -> their function-valued arguments.
+
+The same machinery records, per jit site, the *compiled-callable
+registry* — which ``self._compiled``-style attributes hold a compiled
+program, with their donated argument positions and static argnames — so
+rules can recognize dispatch sites (R1 lazy-value syncs, R3
+donation-after-use) and thread entry points (``threading.Thread(target=
+...)`` / ``Timer`` / ``Thread`` subclasses) for R5.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .model import ClassInfo, FunctionInfo, Project
+
+__all__ = ["CompiledInfo", "CallGraph", "build_callgraph", "dotted_path"]
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_INSTRUMENT_NAMES = {"instrument"}
+_HIGHER_ORDER = {"vmap", "pmap", "scan", "while_loop", "cond", "fori_loop",
+                 "map", "tree_map", "checkpoint", "remat", "custom_vjp",
+                 "custom_jvp", "grad", "value_and_grad", "shard_map"}
+
+
+def dotted_path(node) -> Optional[Tuple[str, ...]]:
+    """``a.b.c`` -> ("a", "b", "c"); None for non-name chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+@dataclass
+class CompiledInfo:
+    """One jit wrap site and where its compiled callable is stored."""
+
+    target: Optional[FunctionInfo]     # the traced python body, if resolved
+    donate: Set[int] = field(default_factory=set)
+    statics: Set[str] = field(default_factory=set)
+    site_file: str = ""
+    site_line: int = 0
+    decorator: bool = False    # @jit form: calling the NAME dispatches
+
+    @property
+    def site(self) -> str:
+        return f"jit @ {self.site_file}:{self.site_line}"
+
+
+@dataclass
+class DispatchCall:
+    node: ast.Call
+    compiled: CompiledInfo
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.edges: Dict[str, List[FunctionInfo]] = {}
+        # (caller, call node, callee) — rules use the arg lists to refine
+        # which callee params actually receive traced values
+        self.call_edges: List[Tuple[FunctionInfo, ast.Call, FunctionInfo]] = []
+        self.trace_roots: List[Tuple[FunctionInfo, CompiledInfo]] = []
+        self.thread_roots: List[FunctionInfo] = []
+        # compiled-callable registry
+        self.by_class_attr: Dict[Tuple[str, str], CompiledInfo] = {}
+        self.by_local: Dict[Tuple[str, str], CompiledInfo] = {}
+        self.accessor_methods: Dict[Tuple[str, str], CompiledInfo] = {}
+        # decorator-jitted function qualname -> its CompiledInfo (calling
+        # the bare name IS a dispatch of the compiled callable)
+        self.by_name_root: Dict[str, CompiledInfo] = {}
+        # per-file synthetic scope for module-level jit sites
+        self._module_fis: Dict[str, FunctionInfo] = {}
+        # per-function dispatch calls (calls of a known compiled callable)
+        self.dispatch_calls: Dict[str, List[DispatchCall]] = {}
+        # classes that start a thread somewhere in their methods
+        self.threaded_classes: Set[str] = set()
+
+    # --------------------------------------------------------- resolution
+    def _local_assign_map(self, fi: FunctionInfo) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                out[node.targets[0].id] = node.value
+        return out
+
+    def _class_attr_assign(self, ci: ClassInfo, attr: str) -> Optional[ast.AST]:
+        for m in ci.methods.values():
+            for node in ast.walk(m.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if (isinstance(t, ast.Attribute) and t.attr == attr
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        return node.value
+        return None
+
+    def _resolve_dotted(self, fi: FunctionInfo, path: Tuple[str, ...]) -> str:
+        """Map a source name chain to a best-effort dotted module path
+        (``jnp.dot`` -> ``jax.numpy.dot``) using the file's imports."""
+        alias = fi.file.aliases.get(path[0])
+        if alias is None:
+            return ".".join(path)
+        if alias[0] == "module":
+            return ".".join((alias[1],) + path[1:])
+        return ".".join((alias[1], alias[2]) + path[1:])
+
+    def is_jit_callee(self, fi: FunctionInfo, func: ast.AST) -> bool:
+        path = dotted_path(func)
+        if path is None:
+            return False
+        dotted = self._resolve_dotted(fi, path)
+        if dotted in _JIT_NAMES:
+            return True
+        # the framework's own jit() (paddle_tpu.framework.jit.jit)
+        if path[-1] == "jit" and dotted.endswith("framework.jit.jit"):
+            return True
+        if len(path) == 1 and path[0] == "jit":
+            target = self.project.resolve_symbol(fi.file, "jit")
+            return isinstance(target, FunctionInfo)
+        return False
+
+    def _unwrap_target(self, fi: FunctionInfo, expr: ast.AST,
+                       depth: int = 0) -> Optional[ast.AST]:
+        """Peel instrument()/partial()/local- and self-assignments down to
+        the expression naming the traced body."""
+        if depth > 8 or expr is None:
+            return None
+        if isinstance(expr, ast.Call):
+            path = dotted_path(expr.func)
+            if path and (path[-1] in _INSTRUMENT_NAMES
+                         or path[-1] == "partial"):
+                if expr.args:
+                    return self._unwrap_target(fi, expr.args[0], depth + 1)
+                return None
+            return None
+        if isinstance(expr, ast.Name):
+            # nested def or a local alias
+            scope: Optional[FunctionInfo] = fi
+            while scope is not None:
+                if expr.id in scope.nested:
+                    return expr
+                scope = scope.parent
+            local = self._local_assign_map(fi).get(expr.id)
+            if local is not None and not isinstance(local, ast.Name):
+                return self._unwrap_target(fi, local, depth + 1)
+            return expr
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and fi.cls is not None:
+                if self.project.mro_method(fi.cls, expr.attr) is not None:
+                    return expr
+                assigned = self._class_attr_assign(fi.cls, expr.attr)
+                if assigned is not None:
+                    return self._unwrap_target(fi, assigned, depth + 1)
+            return expr
+        if isinstance(expr, ast.Lambda):
+            return None
+        return None
+
+    def _target_function(self, fi: FunctionInfo,
+                         expr: Optional[ast.AST]) -> Optional[FunctionInfo]:
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Name):
+            scope: Optional[FunctionInfo] = fi
+            while scope is not None:
+                if expr.id in scope.nested:
+                    return scope.nested[expr.id]
+                scope = scope.parent
+            got = self.project.resolve_symbol(fi.file, expr.id)
+            return got if isinstance(got, FunctionInfo) else None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and fi.cls is not None:
+            return self.project.mro_method(fi.cls, expr.attr)
+        return None
+
+    # ------------------------------------------------------- jit scanning
+    def _int_positions(self, fi: FunctionInfo, expr: ast.AST) -> Set[int]:
+        """Every int constant inside tuple/constant literals reachable from
+        ``expr`` (resolving one level of local names) — the union over
+        conditional forms like ``(0, 1, 2, 3) if donate else ()``."""
+        if isinstance(expr, ast.Name):
+            expr = self._local_assign_map(fi).get(expr.id, expr)
+        out: Set[int] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                    and not isinstance(node.value, bool):
+                out.add(node.value)
+        return out
+
+    def _str_names(self, fi: FunctionInfo, expr: ast.AST) -> Set[str]:
+        if isinstance(expr, ast.Name):
+            expr = self._local_assign_map(fi).get(expr.id, expr)
+        out: Set[str] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                out.add(node.value)
+        return out
+
+    def _record_jit_call(self, fi: FunctionInfo, call: ast.Call,
+                         store: Optional[ast.AST]) -> None:
+        target_expr = self._unwrap_target(fi, call.args[0]) if call.args \
+            else None
+        target = self._target_function(fi, target_expr)
+        info = CompiledInfo(target, site_file=fi.file.rel,
+                            site_line=call.lineno)
+        bound = isinstance(target_expr, ast.Attribute)
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                info.donate = self._int_positions(fi, kw.value)
+            elif kw.arg == "static_argnames":
+                info.statics |= self._str_names(fi, kw.value)
+            elif kw.arg == "static_argnums" and target is not None:
+                params = target.params
+                if params[:1] in (["self"], ["cls"]) and bound:
+                    params = params[1:]
+                for i in self._int_positions(fi, kw.value):
+                    if 0 <= i < len(params):
+                        info.statics.add(params[i])
+        if target is not None:
+            target.trace_root = True
+            target.statics |= info.statics
+            self.trace_roots.append((target, info))
+        # where is the compiled callable stored?
+        if store is not None:
+            if isinstance(store, ast.Name):
+                self.by_local[(fi.qualname, store.id)] = info
+            elif isinstance(store, ast.Attribute) \
+                    and isinstance(store.value, ast.Name) \
+                    and store.value.id == "self" and fi.cls is not None:
+                self.by_class_attr[(fi.cls.qualname, store.attr)] = info
+
+    def _scan_jit_sites(self) -> None:
+        for fi in list(self.project.functions.values()):
+            node = fi.node
+            # decorator forms on the def itself
+            for dec in getattr(node, "decorator_list", ()):
+                d = dec
+                if isinstance(d, ast.Call) and self.is_jit_callee(fi, d.func):
+                    info = CompiledInfo(fi, site_file=fi.file.rel,
+                                        site_line=d.lineno)
+                    for kw in d.keywords:
+                        if kw.arg == "static_argnames":
+                            info.statics |= self._str_names(fi, kw.value)
+                        elif kw.arg == "static_argnums":
+                            for i in self._int_positions(fi, kw.value):
+                                if 0 <= i < len(fi.params):
+                                    info.statics.add(fi.params[i])
+                        elif kw.arg == "donate_argnums":
+                            info.donate = self._int_positions(fi, kw.value)
+                    fi.trace_root = True
+                    fi.statics |= info.statics
+                    info.decorator = True
+                    self.by_name_root.setdefault(fi.qualname, info)
+                    self.trace_roots.append((fi, info))
+                elif isinstance(d, ast.Call) and dotted_path(d.func) and \
+                        dotted_path(d.func)[-1] == "partial" and d.args and \
+                        self.is_jit_callee(fi, d.args[0]):
+                    info = CompiledInfo(fi, site_file=fi.file.rel,
+                                        site_line=d.lineno)
+                    for kw in d.keywords:
+                        if kw.arg == "static_argnames":
+                            info.statics |= self._str_names(fi, kw.value)
+                        elif kw.arg == "donate_argnums":
+                            info.donate = self._int_positions(fi, kw.value)
+                    fi.trace_root = True
+                    fi.statics |= info.statics
+                    info.decorator = True
+                    self.by_name_root.setdefault(fi.qualname, info)
+                    self.trace_roots.append((fi, info))
+                elif not isinstance(d, ast.Call) and \
+                        self.is_jit_callee(fi, d):
+                    info = CompiledInfo(fi, site_file=fi.file.rel,
+                                        site_line=d.lineno, decorator=True)
+                    fi.trace_root = True
+                    self.by_name_root.setdefault(fi.qualname, info)
+                    self.trace_roots.append((fi, info))
+            # call forms inside the body (own statements only — nested defs
+            # are their own FunctionInfo)
+            self._scan_jit_statements(fi, self._own_statements(fi))
+        # module-level wrap sites (`run = jax.jit(body)` at file scope):
+        # the body is a trace root exactly as if wrapped in a function
+        for sf in self.project.files:
+            mfi = self._module_fi(sf)
+            self._scan_jit_statements(mfi, self._own_statements(mfi))
+
+    def _module_fi(self, sf) -> FunctionInfo:
+        """Synthetic FunctionInfo standing for a file's module scope (so
+        alias/local-assign resolution works for module-level jit sites and
+        their dispatch calls)."""
+        fi = self._module_fis.get(sf.rel)
+        if fi is None:
+            fi = FunctionInfo("<module>", sf.tree, sf,
+                              f"{sf.rel}::<module>")
+            self._module_fis[sf.rel] = fi
+        return fi
+
+    def _scan_jit_statements(self, fi: FunctionInfo, stmts) -> None:
+        for stmt in stmts:
+            store = None
+            call = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.value, ast.Call):
+                store, call = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                           ast.Call):
+                call = stmt.value
+            if call is not None and self.is_jit_callee(fi, call.func):
+                self._record_jit_call(fi, call, store)
+            elif call is not None:
+                # jit nested one level down: x = jax.jit(instrument(f))
+                for sub in ast.walk(call):
+                    if isinstance(sub, ast.Call) and sub is not call \
+                            and self.is_jit_callee(fi, sub.func):
+                        self._record_jit_call(fi, sub, store)
+                        break
+
+    def _scan_accessors(self) -> None:
+        """Methods that just hand back a stored compiled callable
+        (``return self._compiled_checked``) — lets ``self.m()(args)``
+        dispatch sites resolve."""
+        for fi in self.project.functions.values():
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Attribute) \
+                        and isinstance(node.value.value, ast.Name) \
+                        and node.value.value.id == "self":
+                    info = self.by_class_attr.get(
+                        (fi.cls.qualname, node.value.attr))
+                    if info is not None:
+                        self.accessor_methods[
+                            (fi.cls.qualname, fi.name)] = info
+
+    # --------------------------------------------------------- call edges
+    def _own_statements(self, fi: FunctionInfo):
+        """Every statement of ``fi`` excluding nested function bodies."""
+        out = []
+        stack = list(fi.node.body)
+        while stack:
+            s = stack.pop(0)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.append(s)
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.stmt):
+                    stack.append(child)
+        return out
+
+    def own_calls(self, fi: FunctionInfo) -> List[ast.Call]:
+        out = []
+        for stmt in self._own_statements(fi):
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    out.append(node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    break
+        # dedupe (nested stmt flattening can visit a call twice)
+        seen: Set[int] = set()
+        uniq = []
+        for c in out:
+            if id(c) not in seen:
+                seen.add(id(c))
+                uniq.append(c)
+        return uniq
+
+    def resolve_call(self, fi: FunctionInfo,
+                     call: ast.Call) -> List[FunctionInfo]:
+        proj = self.project
+        func = call.func
+        out: List[FunctionInfo] = []
+        if isinstance(func, ast.Name):
+            scope: Optional[FunctionInfo] = fi
+            while scope is not None:
+                if func.id in scope.nested:
+                    return [scope.nested[func.id]]
+                scope = scope.parent
+            got = proj.resolve_symbol(fi.file, func.id)
+            if isinstance(got, FunctionInfo):
+                out.append(got)
+                if got.name == "functional_call":
+                    out.extend(self._all_forwards())
+            elif isinstance(got, ClassInfo):
+                init = proj.mro_method(got, "__init__")
+                if init is not None:
+                    out.append(init)
+        elif isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and fi.cls is not None:
+                m = proj.mro_method(fi.cls, func.attr)
+                if m is not None:
+                    out.append(m)
+                else:
+                    out.extend(proj.subclass_methods(fi.cls, func.attr))
+                    inst_cls = fi.cls.attr_types.get(func.attr)
+                    if inst_cls:
+                        out.extend(self._instance_call(inst_cls))
+            elif isinstance(base, ast.Name):
+                got = proj.resolve_module_attr(fi.file, base.id, func.attr)
+                if isinstance(got, FunctionInfo):
+                    out.append(got)
+                    if got.name == "functional_call":
+                        out.extend(self._all_forwards())
+                elif isinstance(got, ClassInfo):
+                    init = proj.mro_method(got, "__init__")
+                    if init is not None:
+                        out.append(init)
+        # higher-order jax wrappers: their function-valued args run traced
+        path = dotted_path(func)
+        if path and path[-1] in _HIGHER_ORDER:
+            for a in list(call.args)[:2]:
+                t = self._target_function(fi, a) if isinstance(
+                    a, (ast.Name, ast.Attribute)) else None
+                if t is not None:
+                    out.append(t)
+        return out
+
+    def _instance_call(self, class_name: str) -> List[FunctionInfo]:
+        out = []
+        for ci in self.project.classes_by_name.get(class_name, ()):
+            for name in ("__call__", "forward"):
+                if name in ci.methods:
+                    out.append(ci.methods[name])
+                    break
+        return out
+
+    _forwards_cache: Optional[List[FunctionInfo]] = None
+
+    def _all_forwards(self) -> List[FunctionInfo]:
+        if self._forwards_cache is None:
+            self._forwards_cache = [
+                f for f in self.project.functions.values()
+                if f.name == "forward" and f.cls is not None]
+        return self._forwards_cache
+
+    def _build_edges(self) -> None:
+        for fi in self.project.functions.values():
+            callees: List[FunctionInfo] = []
+            for call in self.own_calls(fi):
+                resolved = self.resolve_call(fi, call)
+                callees.extend(resolved)
+                for callee in resolved:
+                    self.call_edges.append((fi, call, callee))
+                self._check_dispatch(fi, call)
+                self._check_thread(fi, call)
+            self.edges[fi.qualname] = callees
+
+    # ------------------------------------------------- dispatch & threads
+    def _compiled_for_call(self, fi: FunctionInfo,
+                           call: ast.Call) -> Optional[CompiledInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            scope: Optional[FunctionInfo] = fi
+            while scope is not None:
+                info = self.by_local.get((scope.qualname, func.id))
+                if info is not None:
+                    return info
+                scope = scope.parent
+            # module-level `run = jax.jit(body)` called by global name
+            info = self.by_local.get((f"{fi.file.rel}::<module>", func.id))
+            if info is not None:
+                return info
+            # decorator-jitted function: the bare name IS the compiled
+            # callable
+            got = self.project.resolve_symbol(fi.file, func.id)
+            if isinstance(got, FunctionInfo):
+                return self.by_name_root.get(got.qualname)
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name) \
+                and func.value.id == "self" and fi.cls is not None:
+            ci: Optional[ClassInfo] = fi.cls
+            seen = set()
+            stack = [ci]
+            while stack:
+                c = stack.pop(0)
+                if c is None or c.qualname in seen:
+                    continue
+                seen.add(c.qualname)
+                info = self.by_class_attr.get((c.qualname, func.attr))
+                if info is not None:
+                    return info
+                for bname in c.bases:
+                    base = self.project.resolve_symbol(c.file, bname)
+                    if isinstance(base, ClassInfo):
+                        stack.append(base)
+            # decorator-jitted method called as self.m(...)
+            m = self.project.mro_method(fi.cls, func.attr)
+            if m is not None:
+                return self.by_name_root.get(m.qualname)
+            return None
+        if isinstance(func, ast.Call) and isinstance(func.func,
+                                                     ast.Attribute) \
+                and isinstance(func.func.value, ast.Name) \
+                and func.func.value.id == "self" and fi.cls is not None:
+            return self.accessor_methods.get((fi.cls.qualname,
+                                              func.func.attr))
+        return None
+
+    def _check_dispatch(self, fi: FunctionInfo, call: ast.Call) -> None:
+        info = self._compiled_for_call(fi, call)
+        if info is not None:
+            fi.dispatch = True
+            self.dispatch_calls.setdefault(fi.qualname, []).append(
+                DispatchCall(call, info))
+
+    def _check_thread(self, fi: FunctionInfo, call: ast.Call) -> None:
+        path = dotted_path(call.func)
+        if not path or path[-1] not in ("Thread", "Timer"):
+            return
+        target_expr = None
+        for kw in call.keywords:
+            if kw.arg in ("target", "function"):
+                target_expr = kw.value
+        if target_expr is None and path[-1] == "Timer" \
+                and len(call.args) >= 2:
+            target_expr = call.args[1]
+        target = self._target_function(fi, target_expr)
+        if target is not None and not target.thread_root:
+            target.thread_root = True
+            self.thread_roots.append(target)
+        if fi.cls is not None:
+            self.threaded_classes.add(fi.cls.qualname)
+
+    def _scan_thread_subclasses(self) -> None:
+        for ci in self.project.classes.values():
+            if any(b in ("Thread", "Timer") for b in ci.bases):
+                self.threaded_classes.add(ci.qualname)
+                run = ci.methods.get("run")
+                if run is not None and not run.thread_root:
+                    run.thread_root = True
+                    self.thread_roots.append(run)
+
+    # ------------------------------------------------------- reachability
+    def _bfs_trace(self) -> None:
+        from collections import deque
+
+        q = deque()
+        for root, info in self.trace_roots:
+            label = f"{root.short} [{info.site}]"
+            if not root.trace_reachable:
+                root.trace_reachable = True
+                root.trace_chain = (label,)
+                q.append(root)
+        while q:
+            cur = q.popleft()
+            for nxt in self.edges.get(cur.qualname, ()):
+                if not nxt.trace_reachable:
+                    nxt.trace_reachable = True
+                    chain = cur.trace_chain
+                    if len(chain) < 6:
+                        nxt.trace_chain = chain + (nxt.short,)
+                    else:
+                        nxt.trace_chain = chain[:5] + ("...", nxt.short)
+                    q.append(nxt)
+
+    def _bfs_threads(self) -> None:
+        from collections import deque
+
+        q = deque()
+        for root in self.thread_roots:
+            root.thread_reachable = True
+            q.append(root)
+        while q:
+            cur = q.popleft()
+            if cur.cls is not None:
+                self.threaded_classes.add(cur.cls.qualname)
+            for nxt in self.edges.get(cur.qualname, ()):
+                if not nxt.thread_reachable:
+                    nxt.thread_reachable = True
+                    q.append(nxt)
+
+
+def build_callgraph(project: Project) -> CallGraph:
+    cg = CallGraph(project)
+    cg._scan_jit_sites()
+    cg._scan_accessors()
+    cg._scan_thread_subclasses()
+    cg._build_edges()
+    cg._bfs_trace()
+    cg._bfs_threads()
+    return cg

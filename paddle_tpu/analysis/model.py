@@ -1,0 +1,465 @@
+"""Source model for tpu_lint: parsed files, symbols, imports, suppressions.
+
+The analyzer never imports the code under analysis — everything is pure
+``ast`` over the source tree, so it runs in milliseconds per file and can
+lint code whose imports would initialize a backend. This module builds the
+*project index* the call-graph layer (``callgraph.py``) and the rules
+(``rules.py``) consume:
+
+- :class:`SourceFile` — one parsed module: AST, dotted module name, the
+  per-file import alias table, and the ``# tpu-lint:`` suppression map;
+- :class:`FunctionInfo` / :class:`ClassInfo` — every def/class with a
+  stable qualified name (``relpath::Class.method``), parameter lists, and
+  the class attribute-type map (``self.embed = nn.Embedding(...)``) that
+  lets ``self.embed(...)`` resolve to a forward;
+- :class:`Project` — the whole tree plus lookup helpers.
+
+Suppression grammar (the reason is MANDATORY — an empty one is itself a
+finding, rule R0)::
+
+    x = flag.item()   # tpu-lint: disable=R1(one-time init readback)
+    # tpu-lint: disable=R2(bucketed by design), R4(keys derived per row)
+    # tpu-lint: disable-file=R5(single-threaded CLI tool)
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Finding", "SourceFile", "FunctionInfo", "ClassInfo", "Project",
+           "load_project", "RULE_IDS"]
+
+RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*(disable(?:-file)?)\s*=\s*(.*?)\s*$")
+_RULE_REASON_RE = re.compile(r"(R\d+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    """One analyzer result. ``key()`` is the baseline identity — it hangs
+    on rule + file + enclosing symbol + the offending source line, so
+    unrelated edits (line drift) don't churn the baseline."""
+
+    rule: str
+    path: str              # project-relative, '/'-separated
+    line: int
+    message: str
+    symbol: str = ""       # qualified enclosing function, "" at module level
+    snippet: str = ""      # stripped source line
+    chain: Tuple[str, ...] = ()   # trace-entry chain (outermost first)
+    hint: str = ""
+
+    def key(self) -> str:
+        snip = " ".join(self.snippet.split())
+        return f"{self.rule}|{self.path}|{self.symbol}|{snip}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "snippet": self.snippet, "chain": list(self.chain),
+                "hint": self.hint, "key": self.key()}
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        out = f"{self.rule} {self.path}:{self.line}{sym} {self.message}"
+        if self.chain:
+            out += "\n      trace chain: " + " -> ".join(self.chain)
+        if self.hint:
+            out += f"\n      hint: {self.hint}"
+        return out
+
+
+@dataclass
+class Suppression:
+    rule: str
+    reason: str
+    line: int
+    file_level: bool = False
+    used: bool = False
+
+
+class SourceFile:
+    def __init__(self, root: str, path: str):
+        self.path = path
+        self.rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        parts = self.rel[:-3].split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        self.module = ".".join(parts)
+        self.package = ".".join(parts[:-1]) if parts else ""
+        if self.rel.endswith("__init__.py"):
+            self.package = self.module
+        # alias -> ("module", dotted) | ("symbol", dotted_module, name)
+        self.aliases: Dict[str, tuple] = {}
+        self._collect_imports()
+        # line -> [Suppression]; plus file-level entries
+        self.suppressions: Dict[int, List[Suppression]] = {}
+        self.file_suppressions: List[Suppression] = []
+        self.bad_suppressions: List[Suppression] = []
+        self._collect_suppressions()
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    # ------------------------------------------------------------ imports
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    self.aliases[name] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = self.package.split(".")
+                    # level 1 = current package, 2 = parent, ...
+                    if node.level > 1:
+                        base = base[: len(base) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module] if node.module
+                                           else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    self.aliases[name] = ("symbol", mod, a.name)
+
+    # ------------------------------------------------------- suppressions
+    def _comment_tokens(self):
+        """(line, text) of REAL comments only — a suppression example
+        quoted in a docstring must not install an actual suppression."""
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            return [(t.start[0], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):
+            return []
+
+    def _collect_suppressions(self) -> None:
+        for i, raw in self._comment_tokens():
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            file_level = m.group(1) == "disable-file"
+            for rm in _RULE_REASON_RE.finditer(m.group(2)):
+                rule, reason = rm.group(1), (rm.group(2) or "").strip()
+                s = Suppression(rule, reason, i, file_level)
+                if not reason:
+                    self.bad_suppressions.append(s)
+                    continue
+                if file_level:
+                    self.file_suppressions.append(s)
+                else:
+                    self.suppressions.setdefault(i, []).append(s)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding at ``line`` is suppressed by a comment on the same
+        line, on the line directly above (a standalone comment), or by a
+        file-level disable."""
+        for s in self.file_suppressions:
+            if s.rule == rule:
+                s.used = True
+                return True
+        for cand in (line, line - 1):
+            for s in self.suppressions.get(cand, ()):
+                if s.rule == rule and (cand == line
+                                       or self._comment_only(cand)):
+                    s.used = True
+                    return True
+        return False
+
+    def _comment_only(self, line: int) -> bool:
+        return (1 <= line <= len(self.lines)
+                and self.lines[line - 1].lstrip().startswith("#"))
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    file: SourceFile
+    qualname: str
+    bases: List[str] = field(default_factory=list)   # source-level names
+    methods: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+    # self.X = SomeClass(...) assignments anywhere in the class's methods
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class name
+    # self.X = threading.Lock()/RLock()/Condition()
+    lock_attrs: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FunctionInfo:
+    name: str
+    node: ast.AST            # FunctionDef | AsyncFunctionDef
+    file: SourceFile
+    qualname: str
+    cls: Optional[ClassInfo] = None
+    params: List[str] = field(default_factory=list)
+    # param name -> default AST node (None when no default)
+    defaults: Dict[str, Optional[ast.AST]] = field(default_factory=dict)
+    # names of params declared static at a jit wrap site (callgraph fills)
+    statics: set = field(default_factory=set)
+    trace_root: bool = False
+    trace_reachable: bool = False
+    trace_chain: Tuple[str, ...] = ()
+    thread_root: bool = False
+    thread_reachable: bool = False
+    dispatch: bool = False   # calls a known compiled callable
+    nested: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+    parent: Optional["FunctionInfo"] = None
+
+    @property
+    def short(self) -> str:
+        return (f"{self.cls.name}.{self.name}" if self.cls else self.name)
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _param_names(node) -> Tuple[List[str], Dict[str, Optional[ast.AST]]]:
+    a = node.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    defaults: Dict[str, Optional[ast.AST]] = {p: None for p in params}
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        defaults[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        defaults[p.arg] = d
+    return params, defaults
+
+
+class Project:
+    """Every parsed file plus symbol lookup tables."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.files: List[SourceFile] = []
+        self.modules: Dict[str, SourceFile] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}          # qualname -> info
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.by_bare_name: Dict[str, List[FunctionInfo]] = {}
+        # module-level functions per file: name -> FunctionInfo
+        self.module_funcs: Dict[str, Dict[str, FunctionInfo]] = {}
+
+    # -------------------------------------------------------------- build
+    def add_file(self, sf: SourceFile) -> None:
+        self.files.append(sf)
+        self.modules[sf.module] = sf
+        self.module_funcs[sf.rel] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(sf, node, cls=None, prefix="")
+            elif isinstance(node, ast.ClassDef):
+                self._add_class(sf, node)
+
+    def _add_class(self, sf: SourceFile, node: ast.ClassDef) -> None:
+        qual = f"{sf.rel}::{node.name}"
+        ci = ClassInfo(node.name, node, sf, qual)
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                ci.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                ci.bases.append(b.attr)
+        self.classes[qual] = ci
+        self.classes_by_name.setdefault(node.name, []).append(ci)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(sf, item, cls=ci, prefix=node.name + ".")
+        self._scan_attr_types(ci)
+
+    def _scan_attr_types(self, ci: ClassInfo) -> None:
+        for fi in ci.methods.values():
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call):
+                    cname = None
+                    if isinstance(v.func, ast.Name):
+                        cname = v.func.id
+                    elif isinstance(v.func, ast.Attribute):
+                        cname = v.func.attr
+                    if cname in _LOCK_CTORS:
+                        if t.attr not in ci.lock_attrs:
+                            ci.lock_attrs.append(t.attr)
+                    elif cname and cname[:1].isupper():
+                        ci.attr_types.setdefault(t.attr, cname)
+
+    def _add_function(self, sf: SourceFile, node, cls, prefix,
+                      parent: Optional[FunctionInfo] = None) -> FunctionInfo:
+        qual = f"{sf.rel}::{prefix}{node.name}"
+        if parent is not None:
+            qual = f"{parent.qualname}.<locals>.{node.name}"
+        params, defaults = _param_names(node)
+        fi = FunctionInfo(node.name, node, sf, qual, cls=cls,
+                          params=params, defaults=defaults, parent=parent)
+        self.functions[qual] = fi
+        self.by_bare_name.setdefault(node.name, []).append(fi)
+        if cls is not None and parent is None:
+            cls.methods[node.name] = fi
+        if cls is None and parent is None:
+            self.module_funcs[sf.rel][node.name] = fi
+        # nested defs (closures passed to jit / Thread targets)
+        for item in node.body:
+            fi_child = None
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi_child = self._add_function(sf, item, cls=cls,
+                                              prefix=prefix, parent=fi)
+            if fi_child is not None:
+                fi.nested[fi_child.name] = fi_child
+        return fi
+
+    # ------------------------------------------------------------- lookup
+    def resolve_symbol(self, sf: SourceFile, name: str):
+        """Resolve a bare name used in ``sf`` to a FunctionInfo or
+        ClassInfo (module-level def, or an imported project symbol)."""
+        mf = self.module_funcs.get(sf.rel, {})
+        if name in mf:
+            return mf[name]
+        for ci in self.classes_by_name.get(name, ()):
+            if ci.file is sf:
+                return ci
+        alias = sf.aliases.get(name)
+        if alias is None:
+            return None
+        if alias[0] == "symbol":
+            mod, sym = alias[1], alias[2]
+            target = self.modules.get(mod)
+            if target is None:
+                # "from a import b" where a.b is a module
+                target = self.modules.get(f"{mod}.{sym}")
+                return None if target is None else target
+            got = self.module_funcs.get(target.rel, {}).get(sym)
+            if got is not None:
+                return got
+            for ci in self.classes_by_name.get(sym, ()):
+                if ci.file is target:
+                    return ci
+        return None
+
+    def resolve_module_attr(self, sf: SourceFile, base: str, attr: str):
+        alias = sf.aliases.get(base)
+        if alias is None or alias[0] != "module":
+            # "from x import y" where y is a submodule
+            if alias is not None and alias[0] == "symbol":
+                target = self.modules.get(f"{alias[1]}.{alias[2]}")
+                if target is not None:
+                    got = self.module_funcs.get(target.rel, {}).get(attr)
+                    if got is not None:
+                        return got
+                    for ci in self.classes_by_name.get(attr, ()):
+                        if ci.file is target:
+                            return ci
+            return None
+        target = self.modules.get(alias[1])
+        if target is None:
+            return None
+        got = self.module_funcs.get(target.rel, {}).get(attr)
+        if got is not None:
+            return got
+        for ci in self.classes_by_name.get(attr, ()):
+            if ci.file is target:
+                return ci
+        return None
+
+    def mro_method(self, ci: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        seen = set()
+        stack = [ci]
+        while stack:
+            c = stack.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if name in c.methods:
+                return c.methods[name]
+            for bname in c.bases:
+                base = self.resolve_symbol(c.file, bname)
+                if isinstance(base, ClassInfo):
+                    stack.append(base)
+                else:
+                    for cand in self.classes_by_name.get(bname, ()):
+                        stack.append(cand)
+        return None
+
+    def subclass_methods(self, ci: ClassInfo, name: str) -> List[FunctionInfo]:
+        """Methods named ``name`` on project classes that (transitively)
+        name ``ci`` (by class name) among their bases."""
+        out = []
+        for cand in self.classes.values():
+            if cand is ci:
+                continue
+            if self._derives_from(cand, ci.name, depth=0):
+                if name in cand.methods:
+                    out.append(cand.methods[name])
+        return out
+
+    def _derives_from(self, ci: ClassInfo, base_name: str, depth: int) -> bool:
+        if depth > 6:
+            return False
+        for b in ci.bases:
+            if b == base_name:
+                return True
+            for cand in self.classes_by_name.get(b, ()):
+                if self._derives_from(cand, base_name, depth + 1):
+                    return True
+        return False
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(dirpath, fn)))
+    return out
+
+
+def load_project(root: str, paths: List[str]) -> Tuple[Project, List[Finding]]:
+    """Parse every .py under ``paths``; returns the project plus parse/
+    suppression-policy findings (R0)."""
+    proj = Project(root)
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            sf = SourceFile(root, path)
+        except SyntaxError as e:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            findings.append(Finding(
+                "R0", rel, int(e.lineno or 1),
+                f"file does not parse: {e.msg}"))
+            continue
+        proj.add_file(sf)
+        for s in sf.bad_suppressions:
+            findings.append(Finding(
+                "R0", sf.rel, s.line,
+                f"suppression for {s.rule} carries no reason — "
+                f"write `# tpu-lint: disable={s.rule}(why this is safe)`; "
+                f"the bare disable is NOT honored",
+                snippet=sf.snippet(s.line)))
+    return proj, findings

@@ -53,6 +53,7 @@ def callbacks_supported() -> bool:
             # would raise, and False would be cached forever on a
             # callback-capable backend
             with jax.ensure_compile_time_eval():
+                # tpu-lint: disable=R2(one-time backend capability probe, memoized in a module global; ensure_compile_time_eval keeps it out of any enclosing trace)
                 out = jax.jit(lambda x: jax.pure_callback(
                     lambda y: y, jax.ShapeDtypeStruct((), jnp.float32), x))(
                         jnp.float32(3.0))
@@ -147,6 +148,7 @@ class SparseEmbedding(Layer):
         if not in_trace:
             # Eager path: plain host pull, no callback machinery (works on
             # backends without host-callback support).
+            # tpu-lint: disable=R1(eager branch — the in_trace check above proved ids is not a Tracer and no trace is active)
             rows = self.table.pull(np.asarray(ids).reshape(-1))
             return jnp.asarray(rows).reshape(ids.shape + (self.embed_dim,))
         if self.training and not anchor_traced:

@@ -188,6 +188,7 @@ class DistributedTrainStep(StepSeams):
 
         batch_spec = PartitionSpec(tuple(a for a in batch_axes if a in self.mesh.shape) or None)
         self._batch_sharding = NamedSharding(self.mesh, batch_spec)
+        # tpu-lint: disable=R1(one-time construction readback; see TrainStep.__init__ — lazy key inputs trip the tunnel slow path)
         self._base_key = jax.block_until_ready(framework_random.next_key())
         self._count = 0
         self._rng_streams = DEFAULT_RNG_STREAMS

@@ -170,6 +170,7 @@ class Tensor:
         return np.asarray(self._data)
 
     def item(self):
+        # tpu-lint: disable=R1(eager-mode API — .item() IS the documented sync point; never trace-reachable)
         return self._data.item()
 
     def detach(self) -> "Tensor":

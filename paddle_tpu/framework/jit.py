@@ -274,6 +274,7 @@ class TrainStep(StepSeams):
         self._rng_streams = tuple(rng_streams)
         # materialized once: a lazy key input would trip the tunnel
         # slow path documented in _step
+        # tpu-lint: disable=R1(one-time construction readback; keeps every later step dispatch on the tunnel fast path)
         self._base_key = jax.block_until_ready(framework_random.next_key())
         self._count = 0
         self.grad_accum_steps = int(grad_accum_steps)

@@ -158,6 +158,7 @@ class NumericsWatchdog:
         # ONE device_get for the whole window — per-flag bool() would cost
         # up to 2*check_interval serialized host round-trips per flush,
         # defeating the batched-sync design
+        # tpu-lint: disable=R1(THE batched watchdog sync point — one device_get per check_interval window, by design)
         fetched = jax.device_get([(loss, ok, found)
                                   for _, _, loss, ok, found in todo])
         out: List[Tuple[int, int, float]] = []

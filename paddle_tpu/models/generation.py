@@ -157,6 +157,14 @@ def sample_logits(logits, key=None, temperature=1.0, top_k: int = 0,
         kth = vals[..., -1:]
         l = jnp.where(l < kth, -jnp.inf, l)
     if use_top_p is None:  # eager convenience: decide from the value
+        if isinstance(top_p, jax.core.Tracer):
+            # under trace the value is unknowable: deciding here would
+            # concretize the tracer (ConcretizationTypeError deep in jax);
+            # traced callers must pick the sampling graph statically
+            raise ValueError(
+                "top_p is traced but use_top_p was not given; pass "
+                "use_top_p= explicitly (it selects the compiled sampling "
+                "graph and must be static)")
         use_top_p = float(top_p) < 1.0
     if use_top_p:
         top_p = jnp.asarray(top_p, jnp.float32)
@@ -390,7 +398,8 @@ class GenerationEngine:
                     top_k=int(top_k), greedy=greedy, use_top_p=use_top_p)
                 tokens.append(tok)
                 dones.append(done)
-                jax.block_until_ready(tok)  # honest TTFT: token IS ready
+                # tpu-lint: disable=R1(honest TTFT — the metric is "token READY", not "dispatch returned")
+                jax.block_until_ready(tok)
                 ttft = time.perf_counter() - t0
                 pos = prompt_len
                 # the early-stop host read serializes dispatch (one device
@@ -399,6 +408,7 @@ class GenerationEngine:
                 # ``interval``-th step; overshoot columns are trimmed below
                 check_done = eos_token_id is not None
                 for i in range(max_new_tokens - 1):
+                    # tpu-lint: disable=R1(interval-batched early-stop read — one sync per done_check_interval steps, overshoot trimmed below)
                     if check_done and i % interval == 0 and bool(all_done):
                         break
                     compile_cache.record_call(self._cc_decode)

@@ -264,8 +264,12 @@ class ContinuousBatchingEngine:
                 np.int32(slot), np.int32(L - 1), key, eos,
                 np.float32(request.temperature),
                 np.float32(request.top_p), np.bool_(request.greedy))
-        first = int(np.asarray(tok))
-        fin = bool(np.asarray(done0))
+        # ONE batched transfer for both scalars — two np.asarray reads
+        # here cost two serialized device round-trips per admission.
+        # tpu-lint: disable=R1(admission's single batched sync point — the first token must reach the client now)
+        first_h, fin_h = jax.device_get((tok, done0))
+        first = int(first_h)
+        fin = bool(fin_h)
         self.requests[slot] = request
         self._positions[slot] = L
         self._tokens[slot] = first
@@ -292,8 +296,13 @@ class ContinuousBatchingEngine:
                 self._tokens[:, None], self._positions, self._keys,
                 self._done, self._eos, self._temp, self._top_p,
                 self._greedy)
-        toks = np.array(tok)   # writable copies: admit() scribbles slots
-        dns = np.array(done)
+        # one batched transfer for the whole [B] step readback (token +
+        # done vectors) instead of two serialized np.array round-trips;
+        # np.array then makes writable copies: admit() scribbles slots
+        # tpu-lint: disable=R1(the per-step [B]-token readback IS the streaming output; one batched transfer per decode step)
+        tok_h, done_h = jax.device_get((tok, done))
+        toks = np.array(tok_h)
+        dns = np.array(done_h)
         events: List[SlotEvent] = []
         for i, req in enumerate(self.requests):
             if req is None:

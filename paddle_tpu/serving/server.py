@@ -229,7 +229,10 @@ class InferenceServer:
             self._stop = True
             self._drain = drain
             self._cv.notify_all()
-        t = self._thread
+            # read under the cv like every other _thread access — a
+            # concurrent start() could otherwise publish the thread
+            # between this read and the join (tpu_lint R5)
+            t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout)
             if t.is_alive():

@@ -13,6 +13,7 @@ composes it in front of a compiled program — the same single-artifact
 serve-raw-text contract, with the string stage pinned to host exactly
 where the reference pins its op (CPU-only kernel).
 """
+# tpu-lint: disable-file=R2(host-side string tokenizer by contract — forward consumes python strings/lists, never traced arrays; the analyzer reaches it only through the functional_call->every-forward over-approximation)
 from __future__ import annotations
 
 import unicodedata

@@ -1,0 +1,652 @@
+"""tpu_lint: trace-discipline static analysis.
+
+Per rule (R1–R5): >=2 true-positive fixtures modeled on real (pre-fix)
+defect shapes from this repo, plus >=1 false-positive guard proving the
+idioms the codebase relies on stay clean. Then the policy layer
+(mandatory suppression reasons, baseline accept/new/stale semantics), the
+CLI exit codes, and a whole-repo smoke run against the checked-in
+baseline asserting zero NEW findings.
+
+Everything here is pure-AST over tmp fixture trees — no jit, no device
+work — so the module stays far under the tier-1 time budget (the one
+whole-repo parse is ~5 s on the 2-core box).
+"""
+import importlib.util
+import json
+import os
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (analyze, diff_baseline, load_baseline,
+                                 save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(tmp_path, source, name="mod.py"):
+    """Write one fixture module and run every rule over it."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return analyze(str(tmp_path), ["."]).findings
+
+
+def rules_at(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ================================================================== R1
+def test_r1_item_in_trace_reachable(tmp_path):
+    # pre-fix GradScaler shape: a per-flag .item() readback inside code
+    # reachable from a jit entry point
+    fs = lint(tmp_path, """
+        import jax
+
+        def check(flag):
+            return flag.item()
+
+        @jax.jit
+        def step(x, flag):
+            if check(flag):
+                return x
+            return x * 2
+    """)
+    r1 = rules_at(fs, "R1")
+    assert any(".item()" in f.message and f.symbol == "check" for f in r1)
+    # the finding names the jit entry that makes the helper reachable
+    assert any("step" in " ".join(f.chain) for f in r1 if f.symbol == "check")
+
+
+def test_r1_np_asarray_on_traced_value(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def fwd(ids):
+            rows = np.asarray(ids)
+            return rows
+    """)
+    assert any("np.asarray" in f.message for f in rules_at(fs, "R1"))
+
+
+def test_r1_lazy_dispatch_readback(tmp_path):
+    # pre-fix ContinuousBatchingEngine.admit shape: two serialized scalar
+    # reads of compiled-call results instead of one batched device_get
+    fs = lint(tmp_path, """
+        import jax
+
+        def admit(x):
+            step = jax.jit(lambda v: (v, v > 0))
+            tok, done = step(x)
+            first = int(tok)
+            fin = bool(done)
+            return first, fin
+    """)
+    msgs = [f.message for f in rules_at(fs, "R1")]
+    assert any("`int()`" in m for m in msgs)
+    assert any("`bool()`" in m for m in msgs)
+
+
+def test_r1_method_form_block_until_ready(tmp_path):
+    # `arr.block_until_ready()` must be caught like the function form
+    fs = lint(tmp_path, """
+        def fence(out):
+            out.block_until_ready()
+            return out
+    """)
+    assert any(".block_until_ready()" in f.message
+               for f in rules_at(fs, "R1"))
+
+
+def test_r1_guard_region_is_clean(tmp_path):
+    # viterbi_decode shape: the host read happens only on the proven-
+    # concrete side of an isinstance(..., Tracer) guard
+    fs = lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def decode(paths, lengths):
+            if not isinstance(lengths, jax.core.Tracer):
+                paths = paths[:, :int(jnp.max(lengths))]
+            return paths
+    """)
+    assert rules_at(fs, "R1") == []
+
+
+def test_r1_batched_device_get_is_explicit_not_implicit(tmp_path):
+    # the repo's fixed shape — ONE batched device_get — still surfaces as
+    # an explicit-sync finding (it must carry a reason), but the int()/
+    # bool() on the fetched host values are clean
+    fs = lint(tmp_path, """
+        import jax
+
+        def step(x):
+            run = jax.jit(lambda v: (v, v > 0))
+            tok, done = run(x)
+            tok_h, done_h = jax.device_get((tok, done))
+            return int(tok_h), bool(done_h)
+    """)
+    r1 = rules_at(fs, "R1")
+    assert len(r1) == 1 and "device_get" in r1[0].message
+
+
+def test_r1_module_level_jit_wrap_site(tmp_path):
+    # `run = jax.jit(body)` at FILE scope must make body a trace root
+    fs = lint(tmp_path, """
+        import jax
+
+        def body(x):
+            v = float(x)
+            return x * v
+
+        run = jax.jit(body)
+    """)
+    assert any("`float()`" in f.message and f.symbol == "body"
+               for f in rules_at(fs, "R1"))
+
+
+# ================================================================== R2
+def test_r2_branch_on_traced_value(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x, n):
+            if n > 3:
+                return x
+            while n < 0:
+                n = n + 1
+            return x * 2
+    """)
+    r2 = rules_at(fs, "R2")
+    assert any("`if` branches" in f.message for f in r2)
+    assert any("`while` branches" in f.message for f in r2)
+
+
+def test_r2_fstring_of_tracer(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            label = f"loss={x}"
+            return x
+    """)
+    assert any("f-string" in f.message for f in rules_at(fs, "R2"))
+
+
+def test_r2_jit_inside_loop(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def train(batches):
+            for b in batches:
+                step = jax.jit(lambda v: v * 2)
+                step(b)
+    """)
+    assert any("inside a loop" in f.message for f in rules_at(fs, "R2"))
+
+
+def test_r2_shape_branch_is_static(tmp_path):
+    # bucketed-prefill idiom: branching on .shape is per-shape
+    # specialization (how the compile-budget design works), not a hazard
+    fs = lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            if x.shape[0] > 8:
+                return x[:8]
+            return x
+    """)
+    assert rules_at(fs, "R2") == []
+
+
+def test_r2_isinstance_guarded_branch_is_clean(tmp_path):
+    # generation.py prefill-vs-decode dispatch: isinstance(pos, int)
+    # proves the scalar is static on that path
+    fs = lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def fwd(x, pos):
+            if isinstance(pos, int) and pos == 0:
+                return x
+            return x + 1
+    """)
+    assert rules_at(fs, "R2") == []
+
+
+# ================================================================== R3
+def test_r3_donated_then_read(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def run(state, x):
+            step = jax.jit(lambda s, v: s + v, donate_argnums=(0,))
+            out = step(state, x)
+            return out + state
+    """)
+    r3 = rules_at(fs, "R3")
+    assert any("`state` was donated" in f.message for f in r3)
+
+
+def test_r3_donated_in_loop_without_rebind(tmp_path):
+    # the decode-loop bug shape the KV-cache engine is built to avoid:
+    # iteration 2 would dispatch an already-donated buffer
+    fs = lint(tmp_path, """
+        import jax
+
+        def decode(cache, xs):
+            step = jax.jit(lambda c, v: c, donate_argnums=(0,))
+            outs = []
+            for x in xs:
+                outs.append(step(cache, x))
+            return outs
+    """)
+    assert any("never reassigned in the loop" in f.message
+               for f in rules_at(fs, "R3"))
+
+
+def test_r3_decorator_jitted_callee(tmp_path):
+    # @partial(jax.jit, donate_argnums=...) — calling the bare name IS a
+    # dispatch of the compiled callable, so donation rules apply
+    fs = lint(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def run(state, x):
+            out = step(state, x)
+            return out + state
+    """)
+    assert any("`state` was donated" in f.message
+               for f in rules_at(fs, "R3"))
+
+
+def test_r3_rebound_from_results_is_clean(tmp_path):
+    # the repo's actual decode loop: the donated cache is rebound from
+    # the call's results every iteration
+    fs = lint(tmp_path, """
+        import jax
+
+        def decode(cache, xs):
+            step = jax.jit(lambda c, v: (c, v), donate_argnums=(0,))
+            for x in xs:
+                cache, tok = step(cache, x)
+            return cache
+    """)
+    assert rules_at(fs, "R3") == []
+
+
+# ================================================================== R4
+def test_r4_key_reused_across_two_ops(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def sample(key, logits):
+            a = jax.random.categorical(key, logits)
+            b = jax.random.categorical(key, logits)
+            return a, b
+    """)
+    r4 = rules_at(fs, "R4")
+    assert any("consumed again" in f.message for f in r4)
+
+
+def test_r4_key_reused_across_loop(tmp_path):
+    # the PR-4 historical bug: one key for every decode step (and every
+    # row) — identical prompts sampled identical continuations
+    fs = lint(tmp_path, """
+        import jax
+
+        def decode(key, steps, logits):
+            toks = []
+            for _ in range(steps):
+                toks.append(jax.random.categorical(key, logits))
+            return toks
+    """)
+    assert any("inside a loop" in f.message for f in rules_at(fs, "R4"))
+
+
+def test_r4_split_and_fold_in_are_clean(tmp_path):
+    # the fixed shapes: split per use, fold_in per iteration (the
+    # per-(step,row) key derivation), branch-exclusive single use
+    fs = lint(tmp_path, """
+        import jax
+
+        def sample(key, logits):
+            key, k1 = jax.random.split(key)
+            a = jax.random.categorical(k1, logits)
+            key, k2 = jax.random.split(key)
+            b = jax.random.categorical(k2, logits)
+            return a, b
+
+        def decode(key, steps, logits):
+            toks = []
+            for i in range(steps):
+                toks.append(jax.random.categorical(
+                    jax.random.fold_in(key, i), logits))
+            return toks
+
+        def either(key, logits, greedy):
+            if greedy:
+                return jax.random.categorical(key, logits)
+            return jax.random.categorical(key, logits)
+    """)
+    assert rules_at(fs, "R4") == []
+
+
+def test_r4_from_import_forms(tmp_path):
+    # `from jax import random` and `from jax.random import normal` must
+    # be recognized as key consumers, not just `jax.random.*` chains
+    fs = lint(tmp_path, """
+        from jax import random
+        from jax.random import normal
+
+        def a(key, logits):
+            x = random.categorical(key, logits)
+            y = random.categorical(key, logits)
+            return x, y
+
+        def b(key):
+            u = normal(key, (4,))
+            v = normal(key, (4,))
+            return u, v
+    """)
+    r4 = rules_at(fs, "R4")
+    assert {f.symbol for f in r4} == {"a", "b"}
+
+
+def test_r4_interprocedural_consumption(tmp_path):
+    # reuse through a helper: the callee's param is (transitively) key-
+    # consuming, so passing the same key twice correlates the draws
+    fs = lint(tmp_path, """
+        import jax
+
+        def draw(key, logits):
+            return jax.random.categorical(key, logits)
+
+        def sample(key, logits):
+            a = draw(key, logits)
+            b = draw(key, logits)
+            return a, b
+    """)
+    assert len(rules_at(fs, "R4")) >= 1
+
+
+# ================================================================== R5
+def test_r5_unguarded_read_in_threaded_class(tmp_path):
+    # pre-fix InferenceServer.shutdown shape: _thread read outside the
+    # condition variable that guards it at every other site
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = None
+                self._stop = False
+
+            def start(self):
+                with self._lock:
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+
+            def _run(self):
+                with self._lock:
+                    if self._thread is None:
+                        return
+
+            def shutdown(self):
+                with self._lock:
+                    self._stop = True
+                t = self._thread
+                return t
+    """)
+    r5 = rules_at(fs, "R5")
+    assert any(f.symbol == "Server.shutdown" and "_thread" in f.message
+               for f in r5)
+
+
+def test_r5_unguarded_write_from_worker(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                self._n += 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """)
+    assert any("_n" in f.message and f.symbol == "Counter._work"
+               for f in rules_at(fs, "R5"))
+
+
+def test_r5_lock_inherited_by_private_helper(tmp_path):
+    # helper only ever called with the lock held inherits its context —
+    # the scheduler/engine idiom; no finding
+    fs = lint(tmp_path, """
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self._t = threading.Thread(target=self.drain)
+
+            def drain(self):
+                with self._lock:
+                    self._flush()
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._flush()
+
+            def _flush(self):
+                while self._items:
+                    self._items.pop()
+    """)
+    assert rules_at(fs, "R5") == []
+
+
+def test_r5_single_threaded_class_ignored(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def read(self):
+                return self._n
+    """)
+    assert rules_at(fs, "R5") == []
+
+
+# ===================================================== suppression policy
+def test_suppression_with_reason_is_honored(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def flush(flags):
+            # tpu-lint: disable=R1(deliberate batched flush point)
+            return jax.device_get(flags)
+    """)
+    assert rules_at(fs, "R1") == []
+    assert rules_at(fs, "R0") == []
+
+
+def test_bare_suppression_is_r0_and_not_honored(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def flush(flags):
+            return jax.device_get(flags)  # tpu-lint: disable=R1
+    """)
+    assert any("no reason" in f.message for f in rules_at(fs, "R0"))
+    assert len(rules_at(fs, "R1")) == 1  # the bare disable did nothing
+
+
+def test_suppression_examples_in_docstrings_are_inert(tmp_path):
+    # a suppression QUOTED in a docstring must neither install a real
+    # suppression nor (bare form) raise R0 — only true comments count
+    fs = lint(tmp_path, '''
+        """Module doc.
+
+            x = y.item()  # tpu-lint: disable-file=R1(docstring example)
+            z = q.item()  # tpu-lint: disable=R1
+        """
+        import jax
+
+        def flush(flags):
+            return jax.device_get(flags)
+    ''')
+    assert rules_at(fs, "R0") == []          # bare example is inert
+    assert len(rules_at(fs, "R1")) == 1      # file-disable example too
+
+
+def test_file_level_suppression(tmp_path):
+    fs = lint(tmp_path, """
+        # tpu-lint: disable-file=R1(host-side tool by contract)
+        import jax
+
+        def a(x):
+            return jax.device_get(x)
+
+        def b(x):
+            return x.item()
+    """)
+    assert rules_at(fs, "R1") == []
+
+
+# ============================================================== baseline
+def test_baseline_accepts_then_fails_new(tmp_path):
+    src = """
+        import jax
+
+        def flush(flags):
+            return jax.device_get(flags)
+    """
+    findings = lint(tmp_path, src)
+    assert len(findings) == 1
+    bl_path = tmp_path / "bl.json"
+    save_baseline(str(bl_path), findings)
+    baseline = load_baseline(str(bl_path))
+
+    new, stale = diff_baseline(findings, baseline)
+    assert new == [] and stale == []
+
+    # a second, new occurrence (different function) fails
+    grown = lint(tmp_path, src + """
+        def flush2(flags):
+            return jax.device_get(flags)
+    """)
+    new, _ = diff_baseline(grown, baseline)
+    assert len(new) == 1 and new[0].symbol == "flush2"
+
+    # line drift does NOT churn the baseline (keys carry no line numbers)
+    drifted = lint(tmp_path, "\n\n\n" + textwrap.dedent(src))
+    new, stale = diff_baseline(drifted, baseline)
+    assert new == [] and stale == []
+
+
+def test_baseline_stale_keys_reported_not_failing(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def flush(flags):
+            return jax.device_get(flags)
+    """)
+    bl_path = tmp_path / "bl.json"
+    save_baseline(str(bl_path), findings)
+    new, stale = diff_baseline([], load_baseline(str(bl_path)))
+    assert new == [] and len(stale) == 1
+
+
+def test_r0_findings_are_never_baselinable(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def flush(flags):
+            return jax.device_get(flags)  # tpu-lint: disable=R1
+    """)
+    r0 = rules_at(findings, "R0")
+    bl_path = tmp_path / "bl.json"
+    save_baseline(str(bl_path), findings)   # counts include the R0 key
+    new, _ = diff_baseline(findings, load_baseline(str(bl_path)))
+    assert any(f.rule == "R0" for f in new)  # still fails
+
+
+# ==================================================== CLI + repo smoke
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_lint_cli", os.path.join(REPO, "tools", "tpu_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_nonzero_on_injected_violation(tmp_path, monkeypatch, capsys):
+    cli = _load_cli()
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x, n):
+            if n > 0:
+                return x
+            return x.item()
+    """))
+    monkeypatch.setattr(cli, "REPO", str(tmp_path))
+    assert cli.main([str(bad), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "R1" in out and "R2" in out
+
+    # --json carries the machine-readable findings + keys
+    assert cli.main([str(bad), "--no-baseline", "--json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in data["new_findings"]} == {"R1", "R2"}
+    assert all("key" in f for f in data["findings"])
+
+    assert cli.main(["nope_not_here"]) == 2
+
+    # --update-baseline over a subtree would erase the accepted entries
+    # outside it; the CLI must refuse
+    assert cli.main([str(bad), "--update-baseline"]) == 2
+
+
+def test_repo_is_clean_under_checked_in_baseline(capsys):
+    """THE gate: the shipped tree + .tpu_lint_baseline.json => zero new
+    findings. Any regression (new sync/retrace/donation/key/lock bug, or
+    a reason-less suppression) fails this test before the runtime soaks
+    ever see it."""
+    cli = _load_cli()
+    rc = cli.main([])   # defaults: paddle_tpu + tools, default baseline
+    out = capsys.readouterr().out
+    assert rc == 0, f"tpu_lint found NEW findings:\n{out}"
+    assert "no new findings" in out
+    # the analyzer really saw the tree (not an empty walk)
+    assert "trace roots" in out.split("\n")[0]

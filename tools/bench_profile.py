@@ -21,6 +21,7 @@ from bench import _chip_peak_flops
 def timeit(fn, *args, n=10, warmup=2):
     for _ in range(warmup):
         out = fn(*args)
+    # tpu-lint: disable=R1(benchmark warmup fence — the timed region must start with nothing in flight)
     jax.tree.map(lambda x: x.block_until_ready()
                  if hasattr(x, "block_until_ready") else x, out)
     # host-read sync (block_until_ready is unreliable through the tunnel)

@@ -2,6 +2,12 @@
 """Robustness gate: ONE command CI can block on for the fault-tolerance
 story. Runs, in order:
 
+0. ``tools/tpu_lint.py --baseline .tpu_lint_baseline.json`` — the static
+   trace-discipline analyzer (host syncs, retrace hazards, donation
+   misuse, PRNG reuse, lock bypasses). First because it is the cheapest
+   stage by two orders of magnitude (~5 s, no backend): a NEW unbaselined
+   finding fails the gate before any soak spends minutes proving the same
+   bug at runtime;
 1. ``tools/chaos_soak.py --quick`` — the self-healing train loop under
    NaN batches, a step stall, and a kill-and-restart (fails on any
    unrecovered fault, loss divergence beyond tolerance, or a steady-state
@@ -20,8 +26,9 @@ Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 nightly full matrix)::
 
     python tools/robustness_gate.py
-    python tools/robustness_gate.py --skip-sweep   # soak only
+    python tools/robustness_gate.py --skip-sweep   # lint + soak only
     python tools/robustness_gate.py --elastic      # + shrink/grow proof
+    python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
 
@@ -56,9 +63,16 @@ def main() -> int:
                     help="run the soak without --quick")
     ap.add_argument("--elastic", action="store_true",
                     help="also run the shrink/grow-on-preemption scenario")
+    ap.add_argument("--skip-lint", action="store_true",
+                    help="skip the tpu_lint static-analysis stage")
     args = ap.parse_args()
 
     results = {}
+    if not args.skip_lint:
+        results["tpu_lint"] = _run(
+            "tpu_lint", [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
+                         "--baseline",
+                         os.path.join(REPO, ".tpu_lint_baseline.json")])
     if not args.skip_soak:
         cmd = [sys.executable, os.path.join(TOOLS, "chaos_soak.py")]
         if not args.full_soak:

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""tpu_lint — trace-discipline static analyzer for the TPU-native stack.
+
+Catches, before runtime: host syncs in trace-reachable/hot code (R1),
+retrace hazards (R2), donation-after-use (R3), PRNG key reuse (R4), and
+unguarded shared state in threaded classes (R5). Pure-AST: no jax import,
+no backend, whole-repo runs in seconds.
+
+    python tools/tpu_lint.py                          # paddle_tpu + tools
+    python tools/tpu_lint.py paddle_tpu/serving       # a subtree
+    python tools/tpu_lint.py --baseline .tpu_lint_baseline.json
+    python tools/tpu_lint.py --baseline ... --update-baseline
+    python tools/tpu_lint.py --json                   # machine-readable
+    python tools/tpu_lint.py --list-rules
+
+Exit codes: 0 = clean (every finding suppressed or baselined);
+1 = NEW findings (beyond the baseline); 2 = usage error.
+
+Suppression (reason REQUIRED — a bare disable is rule R0 and fails)::
+
+    x = flag.item()   # tpu-lint: disable=R1(one-time init readback)
+    # tpu-lint: disable-file=R5(single-threaded CLI tool)
+
+Baseline workflow: triage every finding — fix it or suppress it with a
+reason; only then accept the residue with ``--update-baseline``. The
+checked-in ``.tpu_lint_baseline.json`` makes pre-existing accepted
+findings pass while any NEW finding fails the build (first stage of
+``tools/robustness_gate.py``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_PATHS = ("paddle_tpu", "tools")
+DEFAULT_BASELINE = os.path.join(REPO, ".tpu_lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: paddle_tpu tools)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON; accepted findings pass, new "
+                         "findings fail (default: .tpu_lint_baseline.json "
+                         "when it exists)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0 (R0 policy findings still fail)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore any baseline")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import (analyze, diff_baseline, load_baseline,
+                                     save_baseline, RULE_DOCS)
+
+    if args.list_rules:
+        for rule, doc in sorted(RULE_DOCS.items()):
+            print(f"{rule}  {doc}")
+        return 0
+
+    paths = list(args.paths) or list(DEFAULT_PATHS)
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(REPO, p)
+        if not os.path.exists(full):
+            print(f"tpu_lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline \
+            and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    t0 = time.monotonic()
+    result = analyze(REPO, paths)
+    elapsed = time.monotonic() - t0
+
+    if args.update_baseline:
+        if args.paths:
+            # a subtree run sees a subset of the findings — rewriting the
+            # whole-repo baseline from it would silently erase every
+            # accepted entry outside the subtree and fail the next gate
+            print("tpu_lint: --update-baseline only works on the default "
+                  "scope (paddle_tpu + tools); drop the explicit paths",
+                  file=sys.stderr)
+            return 2
+        target = baseline_path or DEFAULT_BASELINE
+        keep = [f for f in result.findings if f.rule != "R0"]
+        save_baseline(target, keep)
+        r0 = [f for f in result.findings if f.rule == "R0"]
+        print(f"tpu_lint: baseline updated: {target} "
+              f"({len(keep)} finding(s) accepted)")
+        for f in r0:
+            print(f.render())
+        return 1 if r0 else 0
+
+    baseline = {}
+    if baseline_path and not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+    new, stale = diff_baseline(result.findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "stats": result.stats(),
+            "elapsed_s": round(elapsed, 3),
+            "baseline": baseline_path if baseline else None,
+            "findings": [f.as_dict() for f in result.findings],
+            "new_findings": [f.as_dict() for f in new],
+            "stale_baseline_keys": stale,
+        }, indent=1))
+        return 1 if new else 0
+
+    stats = result.stats()
+    print(f"tpu_lint: {stats['files']} files, "
+          f"{stats['trace_roots']} trace roots, "
+          f"{stats['trace_reachable']} trace-reachable fns, "
+          f"{stats['thread_roots']} thread roots "
+          f"({elapsed:.2f}s)")
+    if baseline:
+        accepted = len(result.findings) - len(new)
+        print(f"tpu_lint: {len(result.findings)} finding(s); "
+              f"{accepted} baselined, {len(new)} NEW")
+    else:
+        print(f"tpu_lint: {len(result.findings)} finding(s)")
+    shown = new if baseline else result.findings
+    for f in shown:
+        print(f.render())
+    for k in stale:
+        print(f"stale baseline entry (consider --update-baseline): {k}")
+    if new:
+        print(f"\nFAIL: {len(new)} new finding(s) — fix them, or "
+              f"suppress with `# tpu-lint: disable=R<n>(reason)`, or "
+              f"(last resort) re-accept with --update-baseline",
+              file=sys.stderr)
+        return 1
+    print("OK: no new findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
