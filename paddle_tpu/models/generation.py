@@ -47,7 +47,8 @@ from ..io.batching import bucket_for
 
 __all__ = ["GenerationEngine", "generate", "init_cache", "sample_logits",
            "sample_logits_rows", "per_row_keys", "slice_cache_rows",
-           "scatter_cache_rows", "cache_sharding_spec",
+           "scatter_cache_rows", "gather_cache_blocks",
+           "scatter_cache_blocks", "cache_sharding_spec",
            "DEFAULT_PREFILL_BUCKETS"]
 
 # prompt lengths round up to the smallest of these (clipped to the
@@ -132,6 +133,52 @@ def scatter_cache_rows(cache, row_cache, index):
             live, row.astype(live.dtype), (idx, zero, zero, zero))
 
     return jax.tree.map(up, cache, row_cache)
+
+
+def gather_cache_blocks(pool, block_indices, length: int):
+    """Assemble a cache row from a paged block pool: gather ``pool``
+    leaves ``[N, bs, Hkv, D]`` at (possibly traced) ``block_indices``
+    ``[n]`` and lay the blocks out contiguously as ``[1, length, Hkv,
+    D]`` (zero-padded past ``n*bs``).
+
+    The prefix-cache read primitive: matched prompt blocks land in a
+    slot's cache rows in-program, so a cache hit never re-prefills the
+    shared prefix. Indices past the matched chain point at the pool's
+    reserved dump block (row 0) — those positions hold garbage, which is
+    safe under the same invariant as slot reuse: the position mask never
+    lets a query see beyond its request's frontier, and every position
+    is rewritten before it first becomes visible."""
+    idx = jnp.asarray(block_indices, jnp.int32)
+
+    def assemble(leaf):
+        n, bs = idx.shape[0], leaf.shape[1]
+        blocks = jnp.take(leaf, idx, axis=0)            # [n, bs, Hkv, D]
+        flat = blocks.reshape(1, n * bs, *leaf.shape[2:])
+        if n * bs < length:
+            pad = [(0, 0), (0, length - n * bs)] + [(0, 0)] * (flat.ndim - 2)
+            flat = jnp.pad(flat, pad)
+        return flat[:, :length]
+
+    return jax.tree.map(assemble, pool)
+
+
+def scatter_cache_blocks(pool, row_cache, block_indices):
+    """Write a cache row back into a paged block pool: split ``row_cache``
+    leaves ``[1, S, Hkv, D]`` into ``n`` blocks of the pool's block size
+    and scatter them at (possibly traced) ``block_indices`` ``[n]``.
+
+    The prefix-cache store primitive (inverse of
+    :func:`gather_cache_blocks`). Blocks the host chose not to cache
+    point their index at the reserved dump row 0 — duplicate writes to
+    the dump are harmless because its content is never read as valid."""
+    idx = jnp.asarray(block_indices, jnp.int32)
+
+    def store(leaf, row):
+        n, bs = idx.shape[0], leaf.shape[1]
+        blocks = row[0, :n * bs].reshape(n, bs, *leaf.shape[2:])
+        return leaf.at[idx].set(blocks.astype(leaf.dtype))
+
+    return jax.tree.map(store, pool, row_cache)
 
 
 # -------------------------------------------------------------- sampling

@@ -1,22 +1,30 @@
-"""paddle_tpu.serving — continuous-batching inference.
+"""paddle_tpu.serving — continuous-batching inference, fleet-scale.
 
 The layer between ``models.generation`` (two compiled programs, one
 closed batch) and an open request stream: a fixed ``B``-slot decode
 batch whose slots admit/free independently (``engine``), FIFO admission
 control with backpressure and deadlines (``scheduler``), a threaded
 front end with per-request streaming and crash recovery (``server``),
-and operator metrics (``metrics``). See README "Serving" for the
-architecture sketch and slot lifecycle.
+operator metrics (``metrics``), a paged prefix/KV block pool for
+cross-request prompt reuse (``prefix_cache``), and a load-aware router
+over N replicas (``router``). See README "Serving" and "Fleet serving"
+for the architecture sketches.
 
-    from paddle_tpu.serving import InferenceServer
+    from paddle_tpu.serving import InferenceServer, ReplicaRouter
 
-    with InferenceServer(lm, slots=8, max_length=1024) as srv:
-        h = srv.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
-        for tok in h.stream():
-            ...
+    fleet = ReplicaRouter([
+        InferenceServer(lm, slots=8, max_length=1024,
+                        prefix_cache=64 << 20)
+        for _ in range(4)])
+    h = fleet.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    for tok in h.stream():
+        ...
 """
 from .engine import ContinuousBatchingEngine, SlotEvent  # noqa: F401
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
+from .prefix_cache import BlockPool, PrefixHit, StorePlan  # noqa: F401
+from .router import (NoReplicasAvailable, ReplicaRouter,  # noqa: F401
+                     RouterHandle)
 from .scheduler import (Backpressure, FifoScheduler, QueueFull,  # noqa: F401
                         Request, SchedulerClosed)
 from .server import InferenceServer, RequestHandle  # noqa: F401
@@ -25,4 +33,6 @@ __all__ = [
     "ContinuousBatchingEngine", "SlotEvent", "InferenceServer",
     "RequestHandle", "FifoScheduler", "Request", "Backpressure",
     "QueueFull", "SchedulerClosed", "ServingMetrics", "LatencyHistogram",
+    "BlockPool", "PrefixHit", "StorePlan", "ReplicaRouter",
+    "RouterHandle", "NoReplicasAvailable",
 ]

@@ -45,9 +45,11 @@ from ..framework import compile_cache
 from ..framework.dtype import convert_dtype
 from ..io.batching import bucket_for
 from ..models.generation import (DEFAULT_PREFILL_BUCKETS, _constrain_cache,
-                                 init_cache, per_row_keys, sample_logits_rows,
-                                 scatter_cache_rows)
+                                 gather_cache_blocks, init_cache,
+                                 per_row_keys, sample_logits_rows,
+                                 scatter_cache_blocks, scatter_cache_rows)
 from ..nn.layer import buffer_state, functional_call, param_state
+from .prefix_cache import BlockPool
 
 __all__ = ["ContinuousBatchingEngine", "SlotEvent"]
 
@@ -69,12 +71,21 @@ class ContinuousBatchingEngine:
     compiled sampling graph); everything else — temperature, top_p value,
     greedy-vs-sample, eos id, seed — is per-request and traced, so a
     heterogeneous batch still runs the single decode program.
+
+    ``prefix_cache`` (None | BlockPool | True | byte budget | kwargs
+    dict) switches admission to the paged-pool program: matched prompt
+    blocks are copied out of the pool in-program and only the novel
+    suffix is prefilled, at the cost of the suffix forward running the
+    chunked-continuation attention path instead of the block-local
+    (flash-eligible) prefill. Default None keeps the PR 4 admit program
+    bit-for-bit.
     """
 
     def __init__(self, model, slots: int = 4,
                  max_length: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 top_k: int = 0, allow_top_p: bool = True):
+                 top_k: int = 0, allow_top_p: bool = True,
+                 prefix_cache=None):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
         self.model = model
@@ -92,19 +103,69 @@ class ContinuousBatchingEngine:
         self.prefill_buckets = buckets or (self.max_length,)
         self.top_k = int(top_k)
         self.allow_top_p = bool(allow_top_p)
+        self.pool = self._normalize_pool(prefix_cache)
         model_name = type(model).__name__
         self._cc_prefill = compile_cache.register_name(
             f"serve:prefill:{model_name}")
         self._cc_decode = compile_cache.register_name(
             f"serve:decode:{model_name}")
-        donate = (2,) if jax.default_backend() != "cpu" else ()
-        self._prefill_compiled = jax.jit(
-            compile_cache.instrument(self._prefill_fn, self._cc_prefill),
-            donate_argnums=donate)
+        on_device = jax.default_backend() != "cpu"
+        if self.pool is not None:
+            # cache hit or miss, every admission runs the SAME pooled
+            # program family (one per suffix bucket): n_matched is traced
+            # (0 on a miss), so the compile budget stays #buckets + 1
+            donate = (2, 3) if on_device else ()
+            self._prefill_compiled = jax.jit(
+                compile_cache.instrument(self._prefill_pool_fn,
+                                         self._cc_prefill),
+                donate_argnums=donate)
+        else:
+            donate = (2,) if on_device else ()
+            self._prefill_compiled = jax.jit(
+                compile_cache.instrument(self._prefill_fn, self._cc_prefill),
+                donate_argnums=donate)
         self._decode_compiled = jax.jit(
             compile_cache.instrument(self._decode_fn, self._cc_decode),
-            donate_argnums=donate)
+            donate_argnums=(2,) if on_device else ())
         self.reset()
+
+    def _normalize_pool(self, prefix_cache) -> Optional[BlockPool]:
+        """Accept the serving-layer spellings of "give me a prefix
+        cache": ``None``/``False``/``0`` (off — the PR 4 admit program,
+        bit-identical), a ready :class:`BlockPool`, ``True`` (defaults),
+        a positive int/float byte budget, or a kwargs dict for
+        :class:`BlockPool`. A zero budget means OFF, not a one-block
+        pool — configs spell "disabled" as 0."""
+        if prefix_cache is None or prefix_cache is False:
+            return None
+        if isinstance(prefix_cache, (int, float)) and not isinstance(
+                prefix_cache, bool) and prefix_cache <= 0:
+            return None
+        if isinstance(prefix_cache, BlockPool):
+            prefix_cache.compatible_with(self.spec, self.max_length)
+            owner = getattr(prefix_cache, "_owner", None)
+            if owner is not None and owner is not self:
+                # each admit program DONATES the pool tensors; a second
+                # engine dispatching against the same pool would read
+                # buffers the first one already consumed
+                raise ValueError(
+                    "this BlockPool is already attached to another "
+                    "engine; build one pool per replica")
+            prefix_cache._owner = self
+            return prefix_cache
+        kwargs = {}
+        if isinstance(prefix_cache, dict):
+            kwargs = dict(prefix_cache)
+        elif prefix_cache is not True:
+            kwargs = {"max_bytes": int(prefix_cache)}
+        kwargs.setdefault("max_length", self.max_length)
+        pool = BlockPool(self.model, **kwargs)
+        # same geometry gate as the ready-pool branch: an explicit
+        # kwargs max_length larger than the engine cache would otherwise
+        # only surface as a reshape error inside the admit program
+        pool.compatible_with(self.spec, self.max_length)
+        pool._owner = self
+        return pool
 
     # ------------------------------------------------------------- state
     def reset(self) -> None:
@@ -114,6 +175,8 @@ class ContinuousBatchingEngine:
         self._params = param_state(self.model)
         self._buffers = buffer_state(self.model)
         self.live_cache = init_cache(self.model, self.slots, self.max_length)
+        if self.pool is not None:
+            self.pool.reset()
         B = self.slots
         self._positions = np.zeros(B, np.int32)
         self._tokens = np.zeros(B, np.int32)
@@ -190,6 +253,40 @@ class ContinuousBatchingEngine:
         done = next_tok[0] == eos_id
         return next_tok[0], done, live_cache
 
+    def _prefill_pool_fn(self, params, buffers, live_cache, pool, ids, slot,
+                         last_index, n_matched, read_idx, write_idx, key,
+                         eos_id, temperature, top_p, greedy):
+        """The paged-pool admit program: ONE fused dispatch copies the
+        matched prefix blocks out of the pool, prefills only the novel
+        suffix at the (traced) matched offset, scatters the assembled
+        slot cache into the live batch, and writes the prompt's new full
+        blocks back into the pool.
+
+        Every per-request quantity — the matched length, the block
+        read/write rows (padded to ``max_length // block_tokens``, dump
+        row 0 where unused), the slot — is traced, so a hit and a miss
+        of any length run the SAME program per suffix bucket. The suffix
+        forward attends through ``cached_attention``'s chunked-
+        continuation path (multi-token queries against the full cache at
+        a traced offset), which is what makes the prefix K/V reusable
+        without re-running its FLOPs."""
+        slot_cache = gather_cache_blocks(pool, read_idx, self.max_length)
+        (logits, slot_cache), _ = functional_call(
+            self.model, params, buffers, ids, cache=slot_cache,
+            position_offset=n_matched, gather_last=last_index)
+        logits = logits[:, 0, :]
+        rows = per_row_keys(key, 1)
+        next_tok = sample_logits_rows(
+            logits, rows, temperature, self.top_k, top_p,
+            use_top_p=self.allow_top_p,
+            greedy_mask=jnp.asarray(greedy).reshape(1))
+        pool = scatter_cache_blocks(pool, slot_cache, write_idx)
+        live_cache = scatter_cache_rows(live_cache, slot_cache, slot)
+        live_cache = _constrain_cache(live_cache, self.slots,
+                                      self.spec["num_kv_heads"])
+        done = next_tok[0] == eos_id
+        return next_tok[0], done, live_cache, pool
+
     def _decode_fn(self, params, buffers, live_cache, tokens, positions,
                    keys, done, eos, temperature, top_p, greedy_mask):
         (logits, live_cache), _ = functional_call(
@@ -227,22 +324,7 @@ class ContinuousBatchingEngine:
                 f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
                 f"exceeds the engine's max_length {self.max_length}")
 
-    def admit(self, request, slot: int) -> Tuple[int, bool]:
-        """Prefill ``request`` into free ``slot``; returns the first
-        sampled token and whether the request finished at prefill (eos on
-        the first token). The live batch keeps decoding other slots'
-        requests before/after this call — only this call itself runs the
-        prefill program."""
-        from ..profiler import RecordEvent
-
-        if self.requests[slot] is not None:
-            raise RuntimeError(f"slot {slot} is occupied")
-        prompt = np.asarray(request.prompt, np.int32).ravel()
-        L = int(prompt.shape[0])
-        self.validate(L, int(request.max_new_tokens))
-        bucket = self.bucket_for_prompt(L)
-        ids_p = np.zeros((1, bucket), np.int32)
-        ids_p[0, :L] = prompt
+    def _request_key(self, request) -> np.ndarray:
         seed = getattr(request, "seed", None)
         if seed is None:
             # fresh randomness per request — matching solo
@@ -250,20 +332,80 @@ class ContinuousBatchingEngine:
             # with the same prompt must NOT sample identical streams
             from ..framework import random as framework_random
 
-            key = np.asarray(
+            return np.asarray(
                 jax.random.key_data(framework_random.next_key()),
                 np.uint32)
-        else:
-            key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+
+    def _plan_hit(self, prompt: np.ndarray, L: int):
+        """Pin the longest usable pool match for ``prompt`` and plan the
+        block writes. The match shrinks (block granularity) until
+        ``matched + suffix_bucket`` fits the cache — the suffix write
+        window must never clamp against the cache end."""
+        hit = self.pool.lookup(prompt)
+        matched = hit.tokens
+        while (matched > 0
+               and matched + self.bucket_for_prompt(L - matched)
+               > self.max_length):
+            matched -= self.pool.block_tokens
+        if matched != hit.tokens:
+            hit = self.pool.trim(hit, matched)
+        plan = self.pool.plan_store(prompt, matched, digests=hit.digests)
+        return hit, plan
+
+    def admit(self, request, slot: int) -> Tuple[int, bool, int]:
+        """Prefill ``request`` into free ``slot``; returns the first
+        sampled token, whether the request finished at prefill (eos on
+        the first token), and how many prompt tokens were served from
+        the prefix cache (0 without a pool). The live batch keeps
+        decoding other slots' requests before/after this call — only
+        this call itself runs the prefill program."""
+        from ..profiler import RecordEvent
+
+        if self.requests[slot] is not None:
+            raise RuntimeError(f"slot {slot} is occupied")
+        prompt = np.asarray(request.prompt, np.int32).ravel()
+        L = int(prompt.shape[0])
+        self.validate(L, int(request.max_new_tokens))
+        key = self._request_key(request)
         eos = np.int32(-1 if request.eos_token_id is None
                        else request.eos_token_id)
+        temp = np.float32(request.temperature)
+        top_p = np.float32(request.top_p)
+        greedy = np.bool_(request.greedy)
+        hit_tokens = 0
         with RecordEvent("serve:prefill"), self._eval_mode():
             compile_cache.record_call(self._cc_prefill)
-            tok, done0, self.live_cache = self._prefill_compiled(
-                self._params, self._buffers, self.live_cache, ids_p,
-                np.int32(slot), np.int32(L - 1), key, eos,
-                np.float32(request.temperature),
-                np.float32(request.top_p), np.bool_(request.greedy))
+            if self.pool is None:
+                bucket = self.bucket_for_prompt(L)
+                ids_p = np.zeros((1, bucket), np.int32)
+                ids_p[0, :L] = prompt
+                tok, done0, self.live_cache = self._prefill_compiled(
+                    self._params, self._buffers, self.live_cache, ids_p,
+                    np.int32(slot), np.int32(L - 1), key, eos, temp,
+                    top_p, greedy)
+            else:
+                hit, plan = self._plan_hit(prompt, L)
+                hit_tokens = hit.tokens
+                suffix = L - hit_tokens
+                bucket = self.bucket_for_prompt(suffix)
+                ids_p = np.zeros((1, bucket), np.int32)
+                ids_p[0, :suffix] = prompt[hit_tokens:]
+                try:
+                    tok, done0, self.live_cache, tensors = (
+                        self._prefill_compiled(
+                            self._params, self._buffers, self.live_cache,
+                            self.pool.tensors, ids_p, np.int32(slot),
+                            np.int32(suffix - 1), np.int32(hit_tokens),
+                            hit.read_idx, plan.write_idx, key, eos, temp,
+                            top_p, greedy))
+                except Exception:
+                    # dispatch never completed: unpin + free the plan's
+                    # rows (a post-dispatch device fault instead goes
+                    # through reset(), which rebuilds the pool tensors)
+                    self.pool.abort(hit, plan)
+                    raise
+                self.pool.commit(hit, plan, tensors)
         # ONE batched transfer for both scalars — two np.asarray reads
         # here cost two serialized device round-trips per admission.
         # tpu-lint: disable=R1(admission's single batched sync point — the first token must reach the client now)
@@ -279,7 +421,7 @@ class ContinuousBatchingEngine:
         self._temp[slot] = request.temperature
         self._top_p[slot] = request.top_p
         self._greedy[slot] = request.greedy
-        return first, fin
+        return first, fin, hit_tokens
 
     def step(self) -> List[SlotEvent]:
         """One decode iteration over the WHOLE live batch. Returns one

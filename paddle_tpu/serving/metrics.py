@@ -71,6 +71,21 @@ class LatencyHistogram:
                 "p99_ms": round(self.percentile(99) * 1e3, 3),
                 "max_ms": round(self.max * 1e3, 3)}
 
+    @classmethod
+    def merge(cls, hists: List["LatencyHistogram"]) -> "LatencyHistogram":
+        """Fleet roll-up: pool the replicas' reservoirs into one
+        histogram (exact count/sum/max; percentiles over the combined
+        sample — each replica's reservoir is a uniform sample of its
+        stream, so the pool approximates the fleet distribution weighted
+        by observed traffic)."""
+        out = cls(max_samples=max([h.max_samples for h in hists] or [1]))
+        for h in hists:
+            out.count += h.count
+            out.total += h.total
+            out.max = max(out.max, h.max)
+            out._samples.extend(h._samples)
+        return out
+
 
 class ServingMetrics:
     """Thread-safe counters/gauges/histograms for one serving loop."""
@@ -92,6 +107,10 @@ class ServingMetrics:
             self.tokens_emitted = 0
             self.prefills = 0
             self.decode_steps = 0
+            # prefix-cache reuse: prompt tokens served from the block
+            # pool vs prefilled from scratch (both 0 without a pool)
+            self.prefix_hit_tokens = 0
+            self.prefix_miss_tokens = 0
             self.queue_depth = 0
             self.active_slots = 0
             self._occ_integral = 0.0     # slot-seconds of occupancy
@@ -131,12 +150,16 @@ class ServingMetrics:
             self.queue_wait.observe(seconds)
 
     # ---------------------------------------------------------- snapshot
-    def snapshot(self, compile_stats: Optional[dict] = None) -> dict:
-        """One plain dict of everything — the serve_bench JSON shape."""
+    def snapshot(self, compile_stats: Optional[dict] = None,
+                 prefix_cache: Optional[dict] = None) -> dict:
+        """One plain dict of everything — the serve_bench JSON shape.
+        ``prefix_cache`` (a ``BlockPool.stats()`` dict) rides along under
+        its own key when the engine has a pool attached."""
         with self._lock:
             now = time.monotonic()
             self._advance_occupancy(now)
             elapsed = max(now - self._t0, 1e-9)
+            seen = self.prefix_hit_tokens + self.prefix_miss_tokens
             return {
                 "elapsed_s": round(elapsed, 3),
                 "slots": self.slots,
@@ -153,6 +176,10 @@ class ServingMetrics:
                 "tokens_emitted": self.tokens_emitted,
                 "prefills": self.prefills,
                 "decode_steps": self.decode_steps,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prefix_miss_tokens": self.prefix_miss_tokens,
+                "prefix_hit_rate": (round(self.prefix_hit_tokens / seen, 4)
+                                    if seen else 0.0),
                 "tokens_per_sec": round(self.tokens_emitted / elapsed, 2),
                 "requests_per_sec": round(
                     self.requests_completed / elapsed, 3),
@@ -161,4 +188,6 @@ class ServingMetrics:
                 "queue_wait": self.queue_wait.summary(),
                 **({"compile_stats": compile_stats}
                    if compile_stats is not None else {}),
+                **({"prefix_cache": prefix_cache}
+                   if prefix_cache is not None else {}),
             }

@@ -1,0 +1,419 @@
+"""Load-aware multi-replica router: one front door over N engines.
+
+A single :class:`~paddle_tpu.serving.server.InferenceServer` is one
+decode batch on one set of chips. Fleet traffic needs N of them plus a
+placement policy, and this module is that policy plus the membership
+bookkeeping around it:
+
+- **placement** scores every ACTIVE replica per request:
+  ``affinity_weight * prefix_affinity - load``, where load is slot
+  occupancy plus normalized queue depth (from the replica's live
+  engine/scheduler state — the same numbers ``ServingMetrics.snapshot``
+  reports) and prefix affinity is the fraction of the prompt the
+  replica's block pool could serve right now (``BlockPool.match``).
+  Shared-prefix traffic therefore lands where its blocks are warm
+  instead of re-prefilling on a cold replica, but a hot replica's queue
+  eventually outweighs its warm cache and traffic spills;
+- **backpressure** composes: a replica at queue depth raises
+  ``QueueFull`` and the router tries the next-best; only when EVERY
+  active replica rejects does the router re-raise ``QueueFull`` — still
+  a ``ConnectionError``, so callers wrap submits in the stack's
+  ``RetryPolicy`` exactly as for a single server. Zero live replicas
+  raises :class:`NoReplicasAvailable` (also retryable — a drain may be
+  about to finish or an add may be in flight);
+- **membership** follows the supervisor-style lifecycle the training
+  stack uses (PR 5/6): replicas are ACTIVE → DRAINING (placement stops,
+  accepted work finishes, then the server shuts down) → DEAD. A replica
+  that rejects with ``SchedulerClosed`` or whose handles fail is marked
+  DEAD in place — no health-check thread, the traffic itself is the
+  probe;
+- **crash recovery**: a :class:`RouterHandle` that sees its replica die
+  mid-stream resubmits the SAME request to a survivor, bounded by
+  ``max_reroutes``. The router assigns every sampled request a concrete
+  seed at the front door, so the rerouted run replays the identical
+  token stream (the per-request PRNG derivation is placement-invariant)
+  — delivery is at-least-once, content is exactly-once.
+
+The router is in-process and thread-safe: any number of client threads
+submit; each replica keeps its own single serving worker.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .prefix_cache import BlockPool  # noqa: F401  (re-export convenience)
+from .scheduler import Backpressure, QueueFull, SchedulerClosed
+from .server import InferenceServer, RequestHandle
+
+__all__ = ["ReplicaRouter", "RouterHandle", "NoReplicasAvailable",
+           "ACTIVE", "DRAINING", "DEAD"]
+
+ACTIVE = "active"
+DRAINING = "draining"
+DEAD = "dead"
+
+_name_serial = itertools.count()
+
+
+class NoReplicasAvailable(Backpressure):
+    """Every replica is draining or dead. Retryable (``ConnectionError``
+    via :class:`~paddle_tpu.serving.scheduler.Backpressure`): membership
+    changes — an add or a finished drain — are expected to clear it."""
+
+
+class _Replica:
+    __slots__ = ("name", "server", "state", "routed")
+
+    def __init__(self, name: str, server: InferenceServer):
+        self.name = name
+        self.server = server
+        self.state = ACTIVE
+        self.routed = 0
+
+
+class RouterHandle:
+    """Client-side handle that survives its replica.
+
+    Wraps the current :class:`RequestHandle`; when that handle fails
+    with a replica-death error (``SchedulerClosed`` — the replica shut
+    down under the request — or transport-style ``ConnectionError``),
+    the router resubmits to a survivor and the wait continues, up to
+    ``max_reroutes`` times. A reroute restarts the stream from the
+    first token (at-least-once delivery; the seeded replay makes the
+    tokens themselves identical)."""
+
+    _REROUTABLE = (SchedulerClosed, ConnectionError)
+
+    def __init__(self, router: "ReplicaRouter", submit_kwargs: dict):
+        self._router = router
+        self._kwargs = submit_kwargs
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._rerouting = False
+        self._inner: Optional[RequestHandle] = None
+        self.replica: Optional[str] = None
+        self.reroutes = 0
+        self._submit_t = time.monotonic()
+
+    # ---- router-side ----
+    def _attach(self, replica: str, inner: RequestHandle) -> None:
+        with self._lock:
+            self.replica = replica
+            self._inner = inner
+
+    def _current(self) -> RequestHandle:
+        with self._lock:
+            return self._inner
+
+    def _reroute(self, cause: BaseException,
+                 failed_inner: RequestHandle) -> RequestHandle:
+        """Resubmit after a replica death; raises ``cause`` when the
+        reroute budget is spent or no replica can take the request.
+        Single-flight per death: concurrent ``result()``/``stream()``
+        consumers who observe the same dead inner handle trigger ONE
+        resubmission — losers wait for the winner's placement and pick
+        up its handle (the in-flight flag is held across the placement,
+        not just the budget check)."""
+        with self._cv:
+            while self._rerouting and self._inner is failed_inner:
+                self._cv.wait(1.0)
+            if self._inner is not failed_inner:
+                return self._inner      # another consumer already rerouted
+            failed = self.replica
+            if self.reroutes >= self._router.max_reroutes:
+                raise cause
+            self.reroutes += 1
+            self._rerouting = True
+        try:
+            self._router._mark_dead(failed)
+            with self._router._lock:
+                self._router.requests_rerouted += 1
+            try:
+                self._router._place(self)
+            except Exception:
+                raise cause
+        finally:
+            with self._cv:
+                self._rerouting = False
+                self._cv.notify_all()
+        return self._current()
+
+    # ---- client-side (mirrors RequestHandle) ----
+    @property
+    def done(self) -> bool:
+        return self._current().done
+
+    @property
+    def cache_hit_tokens(self) -> int:
+        return self._current().cache_hit_tokens
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token measured from the ROUTER submit — a
+        rerouted request keeps paying for its time on the dead replica
+        (the per-attempt server handle restarts its own clock)."""
+        inner = self._current()
+        if inner.ttft_s is None:
+            return None
+        return inner.ttft_s + (inner._submit_t - self._submit_t)
+
+    @property
+    def request(self):
+        return self._current().request
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._current().error
+
+    def tokens(self) -> np.ndarray:
+        return self._current().tokens()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the full generated sequence, transparently
+        rerouting across replica deaths. ``timeout`` applies per
+        attempt (a reroute restarts the clock — the request restarts
+        too)."""
+        inner = self._current()
+        while True:
+            try:
+                return inner.result(timeout)
+            except self._REROUTABLE as e:
+                inner = self._reroute(e, inner)
+
+    def stream(self) -> Iterator[int]:
+        """Yield token ids as they are generated. After a reroute the
+        regenerated stream is re-emitted from its first token
+        (at-least-once), matching the single-server crash-recovery
+        restart semantics."""
+        inner = self._current()
+        while True:
+            try:
+                yield from inner.stream()
+                return
+            except self._REROUTABLE as e:
+                inner = self._reroute(e, inner)
+
+
+class ReplicaRouter:
+    """Front door over N :class:`InferenceServer` replicas."""
+
+    def __init__(self, replicas=(), *, affinity_weight: float = 0.75,
+                 max_reroutes: int = 2):
+        self.affinity_weight = float(affinity_weight)
+        self.max_reroutes = int(max_reroutes)
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self.requests_routed = 0
+        self.requests_rerouted = 0
+        self.replicas_failed = 0
+        for r in replicas:
+            self.add_replica(r)
+
+    # ------------------------------------------------------- membership
+    def add_replica(self, server: InferenceServer,
+                    name: Optional[str] = None) -> str:
+        """Register (and start) a replica; returns its name. New
+        replicas are immediately placeable — growing the fleet under
+        load is one call."""
+        name = name or f"replica-{next(_name_serial)}"
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = _Replica(name, server)
+        server.start()
+        return name
+
+    def drain(self, name: str, timeout: Optional[float] = None) -> None:
+        """Graceful removal: placement stops immediately, the replica
+        finishes every accepted request (its queue AND its live slots),
+        then shuts down and is marked DEAD. Raises ``TimeoutError`` if
+        the backlog outlives ``timeout`` (state stays DRAINING; call
+        again to keep waiting)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if rep.state == DEAD:
+                return
+            rep.state = DRAINING
+        rep.server.shutdown(drain=True, timeout=timeout)
+        with self._lock:
+            rep.state = DEAD
+
+    def _mark_dead(self, name: Optional[str]) -> None:
+        """Traffic-as-health-probe: a replica whose submit/handle died
+        with a closed-scheduler or transport error is DEAD until an
+        operator re-adds it."""
+        with self._lock:
+            rep = self._replicas.get(name) if name else None
+            if rep is not None and rep.state != DEAD:
+                rep.state = DEAD
+                self.replicas_failed += 1
+
+    def replicas(self) -> Dict[str, str]:
+        """``{name: state}`` — the membership table."""
+        with self._lock:
+            return {n: r.state for n, r in self._replicas.items()}
+
+    # -------------------------------------------------------- placement
+    def _score(self, rep: _Replica, prompt: np.ndarray,
+               digest_cache: dict) -> float:
+        srv = rep.server
+        occupancy = srv.engine.active_count / srv.engine.slots
+        queue = srv.scheduler.depth / srv.scheduler.max_queue_depth
+        affinity = 0.0
+        pool = srv.engine.pool
+        if pool is not None and prompt.shape[0] > 0:
+            # hash the prompt ONCE per block size, not once per replica
+            # — placement is the submit hot path
+            bs = pool.block_tokens
+            digests = digest_cache.get(bs)
+            if digests is None:
+                from .prefix_cache import chain_digests
+
+                digests = digest_cache[bs] = chain_digests(prompt, bs)
+            affinity = pool.match_digests(digests) / float(prompt.shape[0])
+        return self.affinity_weight * affinity - occupancy - queue
+
+    def _candidates(self, prompt: np.ndarray,
+                    prefer: Optional[str]) -> List[_Replica]:
+        with self._lock:
+            active = [r for r in self._replicas.values()
+                      if r.state == ACTIVE]
+        if not active:
+            raise NoReplicasAvailable(
+                "no ACTIVE replica (all draining or dead); add_replica() "
+                "or retry after a drain completes")
+        digest_cache: dict = {}
+        scored = sorted(
+            active,
+            key=lambda r: (r.name != prefer,
+                           -self._score(r, prompt, digest_cache),
+                           r.name))
+        return scored
+
+    def _place(self, handle: RouterHandle,
+               prefer: Optional[str] = None) -> None:
+        kwargs = handle._kwargs
+        prompt = kwargs["prompt"]
+        saw_full = False
+        for rep in self._candidates(prompt, prefer):
+            try:
+                inner = rep.server.submit(**kwargs)
+            except QueueFull:
+                saw_full = True      # alive, just at depth — capacity signal
+                continue
+            except SchedulerClosed:
+                # shut down behind our back — treat as dead, keep going
+                self._mark_dead(rep.name)
+                continue
+            handle._attach(rep.name, inner)
+            with self._lock:
+                rep.routed += 1
+                self.requests_routed += 1
+            return
+        if saw_full:
+            # at least one LIVE replica exists and rejected on depth:
+            # this is backpressure, not a fleet-down condition
+            raise QueueFull(
+                "every live replica is at queue depth; retry with "
+                "backoff (RetryPolicy treats this like any transport "
+                "failure)")
+        # every candidate was closed (marked DEAD above) or none existed:
+        # the retryable membership error, NOT the non-retryable
+        # SchedulerClosed — an add_replica()/finished drain may be a
+        # moment away and RetryPolicy callers must survive the race
+        raise NoReplicasAvailable(
+            "no ACTIVE replica accepted (all dead or draining); "
+            "add_replica() or retry after membership settles")
+
+    # ------------------------------------------------------------ client
+    def submit(self, prompt, max_new_tokens: int = 32,
+               do_sample: bool = False, temperature: float = 1.0,
+               top_p: float = 1.0, eos_token_id: Optional[int] = None,
+               seed: Optional[int] = None,
+               deadline: Optional[float] = None,
+               prefer: Optional[str] = None) -> RouterHandle:
+        """Place one request on the best replica; returns a
+        :class:`RouterHandle`. Same contract as
+        :meth:`InferenceServer.submit`, plus:
+
+        - unseeded sampled requests get a fresh concrete seed HERE, so a
+          mid-stream replica death replays the identical stream on the
+          survivor (still fresh randomness per request — the solo
+          semantics);
+        - ``prefer`` pins the first placement attempt to a named replica
+          (ops escape hatch; failover still applies)."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if do_sample and seed is None:
+            seed = int.from_bytes(os.urandom(7), "little")
+        handle = RouterHandle(self, dict(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            do_sample=bool(do_sample), temperature=float(temperature),
+            top_p=float(top_p), eos_token_id=eos_token_id, seed=seed,
+            deadline=deadline))
+        self._place(handle, prefer=prefer)
+        return handle
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop every replica (see ``InferenceServer.shutdown``)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        errs = []
+        for rep in reps:
+            try:
+                rep.server.shutdown(drain=drain, timeout=timeout)
+            except Exception as e:  # keep shutting the rest down
+                errs.append(e)
+            with self._lock:
+                rep.state = DEAD
+        if errs:
+            raise errs[0]
+
+    def __enter__(self) -> "ReplicaRouter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown(drain=exc == (None, None, None))
+        return False
+
+    # ------------------------------------------------------------- stats
+    def snapshot(self) -> dict:
+        """Fleet roll-up: per-replica server snapshots plus the router's
+        own placement counters and the fleet-wide prefix hit rate."""
+        with self._lock:
+            reps = list(self._replicas.items())
+            routed = self.requests_routed
+            rerouted = self.requests_rerouted
+            failed = self.replicas_failed
+        per_replica = {}
+        hit = miss = completed = tokens = 0
+        for name, rep in reps:
+            snap = (rep.server.snapshot() if rep.state != DEAD
+                    else {"state": DEAD})
+            snap["state"] = rep.state
+            snap["routed"] = rep.routed
+            per_replica[name] = snap
+            hit += snap.get("prefix_hit_tokens", 0)
+            miss += snap.get("prefix_miss_tokens", 0)
+            completed += snap.get("requests_completed", 0)
+            tokens += snap.get("tokens_emitted", 0)
+        seen = hit + miss
+        return {
+            "replicas": per_replica,
+            "requests_routed": routed,
+            "requests_rerouted": rerouted,
+            "replicas_failed": failed,
+            "requests_completed": completed,
+            "tokens_emitted": tokens,
+            "prefix_hit_tokens": hit,
+            "prefix_miss_tokens": miss,
+            "prefix_hit_rate": round(hit / seen, 4) if seen else 0.0,
+        }

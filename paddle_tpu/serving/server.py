@@ -62,6 +62,9 @@ class RequestHandle:
         self._done_evt = threading.Event()
         self.error: Optional[BaseException] = None
         self.ttft_s: Optional[float] = None
+        #: prompt tokens served from the prefix cache at admission (0
+        #: without a pool); clients read it off the handle to see reuse
+        self.cache_hit_tokens: int = 0
         self._submit_t = time.monotonic()
         self._last_token_t: Optional[float] = None
 
@@ -145,11 +148,12 @@ class InferenceServer:
                  max_queue_depth: int = 64,
                  max_prefills_per_step: int = 2,
                  top_k: int = 0, allow_top_p: bool = True,
-                 max_request_retries: int = 1):
+                 max_request_retries: int = 1,
+                 prefix_cache=None):
         self.engine = ContinuousBatchingEngine(
             network, slots=slots, max_length=max_length,
             prefill_buckets=prefill_buckets, top_k=top_k,
-            allow_top_p=allow_top_p)
+            allow_top_p=allow_top_p, prefix_cache=prefix_cache)
         self.scheduler = FifoScheduler(
             max_queue_depth=max_queue_depth,
             max_prefills_per_step=max_prefills_per_step)
@@ -250,8 +254,12 @@ class InferenceServer:
 
     def snapshot(self) -> dict:
         """Metrics + compile-counter snapshot (see
-        ``ServingMetrics.snapshot``)."""
-        return self.metrics.snapshot(self.engine.cache_stats())
+        ``ServingMetrics.snapshot``), plus the block-pool occupancy/
+        eviction numbers when a prefix cache is attached."""
+        pool = self.engine.pool
+        return self.metrics.snapshot(
+            self.engine.cache_stats(),
+            prefix_cache=None if pool is None else pool.stats())
 
     # ------------------------------------------------------------ worker
     def _loop(self) -> None:
@@ -268,11 +276,23 @@ class InferenceServer:
                 self._tick()
             except Exception as e:  # a fault must never kill the loop
                 self._recover(e)
-        # shutdown tail: fail whatever was not drained
+        self._fail_backlog()
+
+    def _fail_backlog(self) -> None:
+        """Shutdown tail: terminate whatever was not drained. Queued
+        requests whose DEADLINE already lapsed are expired (TimeoutError
+        + ``requests_expired``) exactly as a live tick would have done —
+        a shutdown racing the expiry sweep must not reclassify a
+        deadline miss as a generic failure (the client retry logic
+        treats the two very differently). Everything else fails with
+        ``SchedulerClosed``."""
         err = SchedulerClosed("server shut down before completion")
         for req in self.scheduler.close():
-            self.metrics.inc("requests_failed")
-            req.handle._fail(err)
+            if req.deadline is not None and req.deadline.expired():
+                self._expire(req)
+            else:
+                self.metrics.inc("requests_failed")
+                req.handle._fail(err)
         for slot, req in enumerate(list(self.engine.requests)):
             if req is not None:
                 self.engine.release(slot)
@@ -322,8 +342,13 @@ class InferenceServer:
         fault_point("serve.admit")  # spends retry budget, never loops
         now = time.monotonic()
         self.metrics.observe_queue_wait(now - req.handle._submit_t)
-        first, fin = self.engine.admit(req, slot)
+        first, fin, hit_tokens = self.engine.admit(req, slot)
         self.metrics.inc("prefills")
+        if self.engine.pool is not None:
+            req.handle.cache_hit_tokens = hit_tokens
+            self.metrics.inc("prefix_hit_tokens", hit_tokens)
+            self.metrics.inc("prefix_miss_tokens",
+                             len(req.prompt) - hit_tokens)
         h = req.handle
         h._push(first)
         self.metrics.inc("tokens_emitted")

@@ -42,6 +42,16 @@ def build_model(family: str, preset: str):
                             max_position_embeddings=1024,
                             hidden_dropout_prob=0.0,
                             attention_dropout_prob=0.0, dtype="bfloat16")
+        elif preset == "small":
+            # CPU-runnable but COMPUTE-bound (tiny is dispatch-bound, so
+            # prefill-vs-cache effects vanish in launch overhead) — the
+            # config serve_bench's prefix-cache acceptance runs use
+            cfg = GPTConfig(vocab_size=2048, hidden_size=256,
+                            num_layers=4, num_heads=8,
+                            max_position_embeddings=512,
+                            hidden_dropout_prob=0.0,
+                            attention_dropout_prob=0.0,
+                            use_flash_attention=False)
         else:
             cfg = gpt_tiny(hidden_dropout_prob=0.0,
                            attention_dropout_prob=0.0,
@@ -54,6 +64,11 @@ def build_model(family: str, preset: str):
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, num_layers=24,
                           num_heads=16, num_kv_heads=4,
                           max_position_embeddings=1024, dtype="bfloat16")
+    elif preset == "small":
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256, num_layers=4,
+                          num_heads=8, num_kv_heads=4,
+                          max_position_embeddings=512,
+                          use_flash_attention=False)
     else:
         cfg = llama_tiny(use_flash_attention=False)
     return LlamaForCausalLM(cfg), cfg
@@ -62,7 +77,7 @@ def build_model(family: str, preset: str):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", choices=("gpt", "llama"), default="gpt")
-    ap.add_argument("--preset", choices=("tiny", "serving"), default="tiny",
+    ap.add_argument("--preset", choices=("tiny", "small", "serving"), default="tiny",
                     help="tiny: CPU-safe smoke config; serving: 350M-class")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--prompt-len", type=int, default=24)

@@ -19,7 +19,15 @@ story. Runs, in order:
    HALF the devices via reshard-restore, kill again, regrow to the full
    topology, and demand final-loss parity with an uninterrupted run
    (fails on any unrecovered shrink, a resize that never resharded, or
-   loss divergence).
+   loss divergence);
+4. with ``--fleet``, ``tools/serve_bench.py --check --replicas 2
+   --prefix-cache-mb 4 --prefix-tokens 24 --crash-replica --verify 3`` —
+   the serving-fleet crash scenario: one replica is hard-killed
+   mid-window under a prefix-heavy trace; the router must requeue its
+   requests onto the survivor (zero lost), seeded-greedy probes must
+   stay token-identical to a solo ``generate`` (no divergence across the
+   reroute), and the survivor must hold its #buckets+1 compile budget
+   with zero steady-state recompiles.
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -28,6 +36,7 @@ nightly full matrix)::
     python tools/robustness_gate.py
     python tools/robustness_gate.py --skip-sweep   # lint + soak only
     python tools/robustness_gate.py --elastic      # + shrink/grow proof
+    python tools/robustness_gate.py --fleet        # + serving-fleet crash
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -63,6 +72,10 @@ def main() -> int:
                     help="run the soak without --quick")
     ap.add_argument("--elastic", action="store_true",
                     help="also run the shrink/grow-on-preemption scenario")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the serving-fleet replica-crash "
+                         "scenario (router reroute, token parity, "
+                         "compile budget)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     args = ap.parse_args()
@@ -84,6 +97,12 @@ def main() -> int:
         if not args.full_soak:
             cmd.append("--quick")
         results["elastic"] = _run("elastic", cmd)
+    if args.fleet:
+        results["fleet"] = _run(
+            "fleet", [sys.executable, os.path.join(TOOLS, "serve_bench.py"),
+                      "--check", "--replicas", "2", "--prefix-cache-mb",
+                      "4", "--prefix-tokens", "24", "--crash-replica",
+                      "--verify", "3"])
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,

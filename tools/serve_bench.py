@@ -1,25 +1,43 @@
-"""Latency-percentile load bench for the continuous-batching server.
+"""Latency-percentile load bench for the serving stack — solo or fleet.
 
 Open-loop Poisson load (arrivals don't wait for completions — the honest
 way to measure a server: closed-loop generators self-throttle and hide
-queueing collapse) against ``paddle_tpu.serving.InferenceServer``,
-reporting the serving numbers that matter and the compile discipline.
-Prints ONE JSON line:
+queueing collapse) against ``paddle_tpu.serving``, reporting the serving
+numbers that matter and the compile discipline. Prints ONE JSON line:
 
     {"metric": "gpt_serve_requests_per_sec", "value": N, "unit": "req/s",
      "extra": {"goodput": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ...,
                "inter_token_p50_ms": ..., "inter_token_p99_ms": ...,
                "tokens_per_sec": ..., "slot_occupancy": ...,
-               "prefill_compiles": ..., "decode_compiles": ...,
-               "steady_state_recompiles": ...}}
+               "cache_hit_rate": ..., "steady_state_recompiles": ...}}
 
-Warmup requests touch every prefill bucket first; the measured window
-must then hold at ``#buckets + 1`` programs — ANY steady-state recompile
-exits non-zero (the serving analogue of ``tools/retrace_report.py``).
+Defaults reproduce the PR 4 single-replica bench byte-for-byte (the
+``gpt_serve_requests_per_sec`` breadth metric ``bench.py`` probes).
+Fleet knobs:
+
+- ``--replicas N`` puts a load-aware ``ReplicaRouter`` in front of N
+  ``InferenceServer`` replicas (prefix-affinity + occupancy placement);
+- ``--prefix-cache-mb M`` attaches a paged prefix/KV block pool to every
+  replica (``--block-tokens`` sets the page size);
+- ``--prefix-tokens P`` switches the trace generator prefix-heavy: a
+  ``--prefix-frac`` share of requests open with the SAME P-token system
+  prefix (the millions-of-users shape), the rest stay uniform random;
+- ``--crash-replica`` hard-kills one replica mid-window (no drain) —
+  the router must requeue its requests onto survivors with no recompile
+  and, for the ``--verify K`` seeded-greedy probes, no token divergence
+  vs a solo ``generate`` (the fleet robustness gate).
+
+Warmup touches every prefill bucket on every replica first; the
+measured window must then hold at ``#buckets + 1`` programs per replica
+— ANY steady-state recompile exits non-zero (the serving analogue of
+``tools/retrace_report.py``), as does a verify mismatch or an
+unrecovered crash casualty.
 
     python tools/serve_bench.py                  # CPU-safe tiny config
     python tools/serve_bench.py --check          # quick CI/bench probe
     python tools/serve_bench.py --preset serving --slots 8 --rate 4
+    python tools/serve_bench.py --replicas 2 --prefix-cache-mb 8 \\
+        --prefix-tokens 24 --crash-replica --verify 3
 """
 from __future__ import annotations
 
@@ -35,10 +53,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
+def _pct(values, p):
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values, np.float64), p))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", choices=("gpt", "llama"), default="gpt")
-    ap.add_argument("--preset", choices=("tiny", "serving"), default="tiny")
+    ap.add_argument("--preset", choices=("tiny", "small", "serving"), default="tiny")
     ap.add_argument("--slots", type=int, default=3)
     ap.add_argument("--rate", type=float, default=2.0,
                     help="offered load, requests/s (Poisson arrivals)")
@@ -50,47 +74,142 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-request completion wait cap (s)")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request queue-wait SLO (s): requests that "
+                         "cannot start in time expire and count against "
+                         "goodput — the number queueing collapse "
+                         "actually destroys")
     ap.add_argument("--check", action="store_true",
                     help="small fixed workload for CI / bench.py probing")
+    # ---- fleet knobs ----
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="per-replica paged KV block pool budget (0=off)")
+    ap.add_argument("--block-tokens", type=int, default=8,
+                    help="prefix-cache page size in tokens")
+    ap.add_argument("--prefix-tokens", type=int, default=0,
+                    help="shared system-prefix length for the "
+                         "prefix-heavy trace (0=uniform random trace)")
+    ap.add_argument("--prefix-frac", type=float, default=0.9,
+                    help="share of requests carrying the shared prefix")
+    ap.add_argument("--affinity-weight", type=float, default=0.75)
+    ap.add_argument("--crash-replica", action="store_true",
+                    help="hard-kill one replica mid-window (router must "
+                         "reroute with no recompiles / no divergence)")
+    ap.add_argument("--verify", type=int, default=0,
+                    help="seeded-greedy probes checked token-exact "
+                         "against a solo generate after the window")
     args = ap.parse_args(argv)
     if args.check:
         args.requests = min(args.requests, 8)
         args.rate = min(args.rate, 4.0)
         args.new_tokens = min(args.new_tokens, 10)
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
+    if args.crash_replica and args.replicas < 2:
+        ap.error("--crash-replica needs --replicas >= 2 (someone must "
+                 "survive)")
 
     import jax
 
     from decode_bench import build_model
     from paddle_tpu.framework import compile_cache
-    from paddle_tpu.serving import InferenceServer, QueueFull
+    from paddle_tpu.serving import (InferenceServer, LatencyHistogram,
+                                    QueueFull, ReplicaRouter)
 
     model, cfg = build_model(args.model, args.preset)
+    prefix_pad = args.prefix_tokens + args.block_tokens
     max_length = min(cfg.max_position_embeddings,
-                     max(args.buckets) + args.new_tokens + 8)
-    srv = InferenceServer(model, slots=args.slots, max_length=max_length,
-                          prefill_buckets=args.buckets,
-                          max_queue_depth=args.max_queue_depth)
+                     max(args.buckets) + args.new_tokens + 8
+                     + (prefix_pad if args.prefix_tokens else 0))
+    if args.prefix_tokens and (args.prefix_tokens + args.block_tokens
+                               + args.new_tokens > max_length):
+        ap.error(
+            f"--prefix-tokens {args.prefix_tokens} + --block-tokens "
+            f"{args.block_tokens} + --new-tokens {args.new_tokens} "
+            f"exceeds the model's cache length {max_length} "
+            f"(max_position_embeddings={cfg.max_position_embeddings}); "
+            f"shrink the prefix or pick a larger preset")
+    if args.prefix_tokens and (args.prefix_tokens + args.block_tokens
+                               > max(args.buckets)):
+        # a cold shared-prefix prompt would overflow the top declared
+        # bucket into the ladder — a legitimate warmup compile the
+        # #buckets+1 budget check would then (correctly) reject
+        ap.error(
+            f"--prefix-tokens {args.prefix_tokens} + --block-tokens "
+            f"{args.block_tokens} overflows the largest prefill bucket "
+            f"{max(args.buckets)}; declare a bucket that fits the cold "
+            f"prefix prompt (e.g. --buckets {min(args.buckets)} "
+            f"{args.prefix_tokens + args.block_tokens})")
+    prefix_cache = (int(args.prefix_cache_mb * (1 << 20))
+                    if args.prefix_cache_mb > 0 else None)
+    servers = [
+        InferenceServer(
+            model, slots=args.slots, max_length=max_length,
+            prefill_buckets=args.buckets,
+            max_queue_depth=args.max_queue_depth,
+            prefix_cache=(dict(max_bytes=prefix_cache,
+                               block_tokens=args.block_tokens)
+                          if prefix_cache else None))
+        for _ in range(args.replicas)]
+    fleet = args.replicas > 1
+    router = None
+    if fleet:
+        router = ReplicaRouter(affinity_weight=args.affinity_weight)
+        names = [router.add_replica(s, f"r{i}")
+                 for i, s in enumerate(servers)]
+    srv = servers[0]
     rng = np.random.default_rng(args.seed)
     lens = sorted(b - 2 for b in srv.engine.prefill_buckets)
 
     def prompt(n):
         return rng.integers(0, cfg.vocab_size, (int(n),)).astype(np.int32)
 
-    # ---- warmup: touch every bucket (and the decode program) once ----
+    shared_prefix = (prompt(args.prefix_tokens)
+                     if args.prefix_tokens else None)
+
+    def trace_prompt(i):
+        """The measured trace: prefix-heavy when --prefix-tokens is
+        set, PR 4's uniform-random lengths otherwise."""
+        if shared_prefix is not None and rng.random() < args.prefix_frac:
+            sfx = prompt(int(rng.integers(2, args.block_tokens + 1)))
+            return np.concatenate([shared_prefix, sfx])
+        return prompt(int(rng.integers(4, max(lens) + 1)))
+
+    # ---- warmup: touch every bucket + the decode program, per replica ----
     t_warm = time.perf_counter()
-    for L in lens:
-        srv.submit(prompt(L), max_new_tokens=4).result(timeout=args.timeout)
-    srv.submit(prompt(lens[0]), max_new_tokens=4, do_sample=True,
-               temperature=0.9, top_p=0.9, seed=1).result(
-                   timeout=args.timeout)
+    for s in servers:
+        for L in lens:
+            s.submit(prompt(L), max_new_tokens=4).result(
+                timeout=args.timeout)
+        s.submit(prompt(lens[0]), max_new_tokens=4, do_sample=True,
+                 temperature=0.9, top_p=0.9, seed=1).result(
+                     timeout=args.timeout)
+        if shared_prefix is not None:
+            # the suffix bucket a prefix hit lands in must be warm too
+            s.submit(np.concatenate([shared_prefix, prompt(4)]),
+                     max_new_tokens=4).result(timeout=args.timeout)
     warmup_s = time.perf_counter() - t_warm
     compiles_before = compile_cache.cache_stats()["compiles"]
-    srv.metrics.reset()
+    for s in servers:
+        s.metrics.reset()
+
+    def submit(i, p, **kw):
+        if fleet:
+            return router.submit(p, **kw)
+        return srv.submit(p, **kw)
 
     # ---- measured open-loop window ----
     interarrival = rng.exponential(1.0 / max(args.rate, 1e-6),
                                    args.requests)
-    max_len = max(lens)
+    crash_at = args.requests // 2 if args.crash_replica else None
+    crashed_replica = None
+    # verify probes ride just below the crash point so the ones most
+    # likely to be in flight when the replica dies are token-checked
+    verify_idx = (set(range(max(0, crash_at - args.verify), crash_at))
+                  if crash_at is not None
+                  else set(range(args.verify)))
+    verify_solo = {}
     handles, rejected = [], 0
     t0 = time.perf_counter()
     for i in range(args.requests):
@@ -98,64 +217,160 @@ def main(argv=None) -> int:
         now = time.perf_counter()
         if target > now:
             time.sleep(target - now)
-        L = int(rng.integers(4, max_len + 1))
-        sampled = bool(i % 2)
+        if crash_at is not None and i == crash_at:
+            # hard kill, no drain: queued + in-flight requests must be
+            # rerouted by the router, not lost
+            crashed_replica = names[-1]
+            servers[-1].shutdown(drain=False, timeout=60.0)
+        p = trace_prompt(i)
+        verify = i in verify_idx
+        kw = dict(max_new_tokens=args.new_tokens, seed=args.seed + i,
+                  deadline=args.deadline)
+        if verify:
+            # correctness probes must not expire on the SLO — a queue-wait
+            # miss would masquerade as token divergence
+            kw["deadline"] = None
+            verify_solo[i] = p          # greedy + seeded: reproducible
+        else:
+            kw.update(do_sample=bool(i % 2), temperature=0.8, top_p=0.95)
         try:
-            handles.append(srv.submit(
-                prompt(L), max_new_tokens=args.new_tokens,
-                do_sample=sampled, temperature=0.8, top_p=0.95,
-                seed=args.seed + i))
+            handles.append((i, submit(i, p, **kw)))
         except QueueFull:
             rejected += 1  # open loop: a reject is goodput lost, not a wait
-    completed = 0
-    for h in handles:
+    completed, failed, expired = 0, 0, 0
+    results = {}
+    for i, h in handles:
         try:
-            h.result(timeout=args.timeout)
+            results[i] = h.result(timeout=args.timeout)
             completed += 1
+        except TimeoutError:
+            if args.deadline is not None:
+                expired += 1   # queue-wait SLO miss — goodput lost, not a bug
+            else:
+                failed += 1    # no SLO in play: a hung handle IS a lost
+                               # request (the --crash-replica gate must see it)
         except Exception:
-            pass
+            failed += 1
+    elapsed = time.perf_counter() - t0
     compiles_after = compile_cache.cache_stats()["compiles"]
     steady = compiles_after - compiles_before
-    snap = srv.snapshot()
-    srv.shutdown(drain=True, timeout=60.0)
 
-    cc = snap["compile_stats"]
+    # ---- verify: seeded-greedy fleet streams == solo generate ----
+    # divergence is judged only on probes that COMPLETED — a probe shed
+    # by backpressure or lost to the crash is a capacity/loss event
+    # (already visible in rejected/failed, and failed trips the crash
+    # gate), not nondeterminism
+    verify_failures = 0
+    verify_compared = 0
+    for i, p in verify_solo.items():
+        got = results.get(i)
+        if got is None:
+            continue
+        verify_compared += 1
+        solo = model.generate(
+            p[None], max_new_tokens=args.new_tokens,
+            max_length=max_length, prefill_buckets=tuple(args.buckets))[0]
+        if not np.array_equal(np.asarray(got), solo):
+            verify_failures += 1
+    # the solo engine above compiles its own programs; they are not
+    # serving-loop recompiles
+    live = [s for i, s in enumerate(servers)
+            if not (crashed_replica is not None and i == len(servers) - 1)]
+    snaps = [s.snapshot() for s in live]
+    for s in live:
+        s.shutdown(drain=True, timeout=60.0)
+
+    # ---- report ----
+    ttfts = [h.ttft_s for _, h in handles
+             if getattr(h, "ttft_s", None) is not None]
+    inter = LatencyHistogram.merge(
+        [s.metrics.inter_token for s in live]).summary()
+    queue_wait = LatencyHistogram.merge(
+        [s.metrics.queue_wait for s in live]).summary()
+    hit = sum(sn["prefix_hit_tokens"] for sn in snaps)
+    miss = sum(sn["prefix_miss_tokens"] for sn in snaps)
+    tokens_emitted = sum(sn["tokens_emitted"] for sn in snaps)
+    per_replica_compiles = [s.engine.cache_stats() for s in live]
+    budget = len(srv.engine.prefill_buckets) + 1
+    over_budget = [
+        i for i, cc in enumerate(per_replica_compiles)
+        if cc["prefill"]["compiles"] + cc["decode"]["compiles"] > budget]
+    occ = (sum(sn["slot_occupancy"] for sn in snaps) / len(snaps)
+           if snaps else 0.0)
+
     record = {
         "metric": f"{args.model}_serve_requests_per_sec",
-        "value": snap["requests_per_sec"],
+        "value": round(completed / max(elapsed, 1e-9), 3),
         "unit": "req/s",
         "extra": {
             "goodput": round(completed / max(args.requests, 1), 4),
             "offered_requests": args.requests,
             "completed": completed,
             "rejected": rejected,
+            "expired": expired,
+            "failed": failed,
+            "deadline_s": args.deadline,
             "offered_rate_per_sec": args.rate,
-            "tokens_per_sec": snap["tokens_per_sec"],
-            "ttft_p50_ms": snap["ttft"]["p50_ms"],
-            "ttft_p99_ms": snap["ttft"]["p99_ms"],
-            "inter_token_p50_ms": snap["inter_token"]["p50_ms"],
-            "inter_token_p99_ms": snap["inter_token"]["p99_ms"],
-            "queue_wait_p99_ms": snap["queue_wait"]["p99_ms"],
-            "slot_occupancy": snap["slot_occupancy"],
+            "elapsed_s": round(elapsed, 3),
+            "tokens_per_sec": round(tokens_emitted / max(elapsed, 1e-9), 2),
+            "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 3),
+            "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 3),
+            "inter_token_p50_ms": inter["p50_ms"],
+            "inter_token_p99_ms": inter["p99_ms"],
+            "queue_wait_p99_ms": queue_wait["p99_ms"],
+            "slot_occupancy": round(occ, 4),
             "slots": args.slots,
             "new_tokens": args.new_tokens,
-            "prefill_compiles": cc["prefill"]["compiles"],
-            "decode_compiles": cc["decode"]["compiles"],
+            "replicas": args.replicas,
+            "live_replicas": len(live),
+            "prefix_cache_mb": args.prefix_cache_mb,
+            "prefix_tokens": args.prefix_tokens,
+            "cache_hit_rate": round(hit / (hit + miss), 4)
+            if (hit + miss) else 0.0,
+            "prefix_hit_tokens": hit,
+            "prefix_miss_tokens": miss,
+            "prefill_compiles": sum(
+                cc["prefill"]["compiles"] for cc in per_replica_compiles),
+            "decode_compiles": sum(
+                cc["decode"]["compiles"] for cc in per_replica_compiles),
+            "compile_budget_per_replica": budget,
             "steady_state_recompiles": steady,
             "warmup_s": round(warmup_s, 2),
             "backend": jax.default_backend(),
             "device_kind": jax.devices()[0].device_kind,
             "preset": args.preset,
             "check": bool(args.check),
+            **({"crashed_replica": crashed_replica,
+                "rerouted": router.snapshot()["requests_rerouted"]}
+               if crashed_replica is not None else {}),
+            **({"verified": len(verify_solo),
+                "verify_compared": verify_compared,
+                "verify_failures": verify_failures}
+               if args.verify else {}),
         },
     }
     print(json.dumps(record))
+    rc = 0
     if steady:
         print(f"FAIL: {steady} recompile(s) during the measured window — "
               f"the serving loop is not shape-stable (see "
               f"compile_cache.cache_stats() signatures)", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if over_budget:
+        print(f"FAIL: replica(s) {over_budget} exceeded the "
+              f"#buckets+1={budget} compile budget", file=sys.stderr)
+        rc = 1
+    if verify_failures:
+        print(f"FAIL: {verify_failures}/{verify_compared} completed "
+              f"seeded-greedy probes diverged from solo generate "
+              f"(placement/reroute changed tokens)", file=sys.stderr)
+        rc = 1
+    if args.crash_replica and failed:
+        print(f"FAIL: {failed} request(s) lost to the replica crash — "
+              f"the router did not requeue them onto survivors",
+              file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
