@@ -157,7 +157,9 @@ def _write_file_durable(path: str, raw: bytes, atomic: bool) -> None:
 
 
 def save_state(state: Any, directory: str, *, async_=False,
-               io_threads: int = 8) -> Optional["_PendingSave"]:
+               io_threads: int = 8,
+               extra_meta: Optional[Dict[str, Any]] = None,
+               ) -> Optional["_PendingSave"]:
     """Save a pytree of arrays as a sharded checkpoint directory.
 
     Each addressable shard of each leaf becomes one ``.npy`` file (a unique
@@ -176,6 +178,12 @@ def save_state(state: Any, directory: str, *, async_=False,
     the shared directory with metadata last as the commit marker. A
     process killed mid-save never leaves a directory that
     :func:`latest_checkpoint`/:func:`load_state` would accept.
+
+    ``extra_meta`` merges additional records into ``metadata.json`` —
+    including overriding ``format`` (the LoRA adapter registry stamps
+    ``format: "lora_adapter"`` so :func:`load_state` can refuse to
+    restore an adapter as a full model). The structural keys
+    (``leaves``/``process_count``/``mesh``) cannot be overridden.
     """
     flat, _ = _flatten(state)
     proc = jax.process_index()
@@ -193,6 +201,14 @@ def save_state(state: Any, directory: str, *, async_=False,
     # (a peer killed pre-commit) instead of silently loading partial state
     meta: Dict[str, Any] = {"format": "paddle_tpu.ckpt.v1",
                             "process_count": nprocs, "leaves": {}}
+    if extra_meta:
+        reserved = {"leaves", "process_count", "mesh"}
+        bad = reserved & set(extra_meta)
+        if bad:
+            raise ValueError(
+                f"extra_meta may not override structural metadata keys "
+                f"{sorted(bad)}")
+        meta.update(extra_meta)
     # the mesh this checkpoint was written on (axes + device count): enough
     # for a restore onto a DIFFERENT topology to plan/report the re-slice
     # (elastic shrink/grow). Absent for host-only state; old checkpoints
@@ -495,6 +511,23 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
     except json.JSONDecodeError as e:
         raise CheckpointCorruptError(
             f"{directory}/{_METADATA}: undecodable metadata: {e}") from e
+    # a LoRA adapter checkpoint holds ONLY lora_A/lora_B leaves: fed to a
+    # full-model restore (a template expecting base weights) it would
+    # otherwise die on a confusing missing-leaves error deep below —
+    # name the real problem instead
+    if meta.get("format") == "lora_adapter" and template is not None:
+        flat_t, _ = _flatten(template)
+        non_lora = [k for k in flat_t
+                    if k.rsplit("/", 1)[-1].rsplit(".", 1)[-1]
+                    not in ("lora_A", "lora_B")]
+        if non_lora:
+            raise ValueError(
+                f"{directory} is a LoRA ADAPTER checkpoint (format="
+                f"'lora_adapter'): it carries only adapter leaves and "
+                f"cannot restore a full model (template expects e.g. "
+                f"{non_lora[0]!r}). Load the base model first, then "
+                f"attach the adapter via paddle_tpu.lora.load_adapter / "
+                f"AdapterStore.load")
     # merge shard lists from other processes' metadata (multi-host save);
     # files at or beyond process_count are STALE leftovers from an earlier
     # larger-world save into the same path — merging them would mix shards

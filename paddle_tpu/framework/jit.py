@@ -256,20 +256,33 @@ class TrainStep(StepSeams):
                  inputs_fn: Optional[Callable] = None,
                  grad_transform: Optional[Callable] = None, donate: bool = True,
                  rng_streams=DEFAULT_RNG_STREAMS, grad_accum_steps: int = 1,
-                 grad_accum_avg: bool = True, scaler=None):
+                 grad_accum_avg: bool = True, scaler=None,
+                 trainable: Optional[Callable[[str], bool]] = None):
         """``grad_accum_steps`` (k>1) enables gradient merge (reference
         ``fleet/meta_optimizers/gradient_merge_optimizer.py``): each call
         accumulates grads; every k-th call applies one optimizer update with
         the sum (mean when ``grad_accum_avg``). k calls on batch B equal one
-        k=1 call on batch k*B."""
+        k=1 call on batch k*B.
+
+        ``trainable`` (a predicate on parameter paths) freezes everything
+        it rejects: frozen params ride the BUFFERS pytree — still explicit
+        jit inputs (a base-weight reload never serves stale compile-time
+        constants), still donated, still in ``state_dict()`` for
+        crash-resume — but excluded from grad and from ``optimizer.init``,
+        so optimizer state scales with the trainable subset (the
+        ``Model.fit(lora=...)`` adapter path: rank-sized, not
+        model-sized)."""
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.inputs_fn = resolve_inputs_fn(inputs_fn, loss_fn)
         self.grad_transform = grad_transform
+        self._trainable = trainable
         # copy: the step donates its buffers; the Layer must keep valid arrays
-        self.params = jax.tree.map(lambda x: jnp.array(x, copy=True), param_state(model))
+        all_params = jax.tree.map(lambda x: jnp.array(x, copy=True), param_state(model))
+        self.params, frozen = self._split_trainable(all_params)
         self.buffers = jax.tree.map(lambda x: jnp.array(x, copy=True), buffer_state(model))
+        self.buffers.update(frozen)
         self.opt_state = optimizer.init(self.params)
         self._rng_streams = tuple(rng_streams)
         # materialized once: a lazy key input would trip the tunnel
@@ -299,6 +312,21 @@ class TrainStep(StepSeams):
         # finiteness in-graph (framework/debugging.py) — compiled on first use
         self._compiled_checked = None
         self._donate_argnums = donate_argnums
+
+    def _split_trainable(self, all_params):
+        """``(trainable, frozen)`` split of a flat param dict per the
+        ``trainable`` predicate (everything/nothing when None)."""
+        if self._trainable is None:
+            return all_params, {}
+        params = {k: v for k, v in all_params.items() if self._trainable(k)}
+        frozen = {k: v for k, v in all_params.items()
+                  if not self._trainable(k)}
+        if not params:
+            raise ValueError(
+                "the trainable= predicate selected no parameters — "
+                "nothing to optimize (for LoRA: apply_lora(model, config) "
+                "before building the step)")
+        return params, frozen
 
     def _step(self, params, buffers, opt_state, accum, scaler_state, batch,
               key, count, poison, with_check=False, do_update=True):
@@ -447,8 +475,9 @@ class TrainStep(StepSeams):
         return self.model
 
     def load_from_model(self):
-        self.params = param_state(self.model)
+        self.params, frozen = self._split_trainable(param_state(self.model))
         self.buffers = buffer_state(self.model)
+        self.buffers.update(frozen)
         return self
 
     def state_dict(self):

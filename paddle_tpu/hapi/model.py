@@ -212,7 +212,8 @@ class Model:
             self._train_step = _HapiTrainStep(
                 self.network, self._optimizer,
                 loss_fn=self._loss_on_batch if self._loss else None,
-                inputs_fn=inputs_fn, scaler=scaler)
+                inputs_fn=inputs_fn, scaler=scaler,
+                trainable=getattr(self, "_lora_trainable", None))
         return self._train_step
 
     # ------------------------------------------------------- batch methods
@@ -269,7 +270,10 @@ class Model:
         fit loop are synced in first. Returns a started
         ``paddle_tpu.serving.InferenceServer`` — ``submit()`` requests,
         ``shutdown(drain=True)`` when done (or use as a context
-        manager). See the README "Serving" section."""
+        manager). Extra kwargs ride through to ``InferenceServer`` —
+        including ``adapter_store=`` for multi-tenant LoRA serving
+        (submit with ``adapter_id=``). See the README "Serving" and
+        "Multi-tenant LoRA serving" sections."""
         if not hasattr(self.network, "cache_spec"):
             raise TypeError(
                 f"{type(self.network).__name__} has no cache_spec(); only "
@@ -306,7 +310,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             pad_batches=False, length_buckets=None, prefetch_depth=0,
-            recovery=None):
+            recovery=None, lora=None):
         """``pad_batches``/``length_buckets`` stabilize batch shapes so the
         compiled step is traced O(#buckets) times instead of once per novel
         shape (see ``paddle_tpu.io.batching``); ``prefetch_depth`` > 0
@@ -320,7 +324,37 @@ class Model:
         resume via AutoCheckpoint + data cursor, an optional hang watchdog,
         and SIGTERM checkpoint-and-exit (raises ``TrainingPreempted`` after
         the state is durably saved). See the README "Self-healing training"
-        section."""
+        section.
+
+        ``lora`` (a :class:`paddle_tpu.lora.LoraConfig` or its kwargs as a
+        dict) switches to adapter fine-tuning: the network is injected via
+        ``apply_lora`` (idempotent under the same config) and ONLY the
+        ``lora_A``/``lora_B`` leaves train — the base model is frozen and
+        optimizer state scales with the rank, not the model. Composes
+        with ``recovery=`` unchanged (the supervisor checkpoints the full
+        step state, so a crash-resumed adapter fit is bit-identical).
+        See the README "Multi-tenant LoRA serving" section."""
+        if lora is not None:
+            from ..lora import LoraConfig, apply_lora, is_lora_param
+
+            lcfg = (lora if isinstance(lora, LoraConfig)
+                    else LoraConfig(**lora))
+            apply_lora(self.network, lcfg)
+            self._lora_trainable = is_lora_param
+        else:
+            # each fit call decides: a plain fit() after an adapter fit
+            # is a FULL fine-tune again — a silently sticky frozen base
+            # would plateau with no error
+            self._lora_trainable = None
+        if (self._train_step is not None
+                and self._train_step._trainable
+                is not getattr(self, "_lora_trainable", None)):
+            # the existing step's trainable split doesn't match this
+            # call: push its live weights back into the network FIRST
+            # (a plain fit's progress lives only in the step), then
+            # rebuild with fresh optimizer state over the right set
+            self._train_step.sync_to_model()
+            self._train_step = None
         loader = _as_loader(train_data, batch_size, shuffle, num_workers,
                             drop_last, pad_batches, length_buckets)
         eval_loader = _as_loader(eval_data, batch_size, False, num_workers,

@@ -1,0 +1,342 @@
+"""LoRA injection: frozen-base low-rank adapters on existing layers.
+
+Low-Rank Adaptation (arXiv:2106.09685) fine-tunes a frozen base model by
+learning a rank-``r`` update per target projection: the layer computes
+``W x + (alpha/r) * B (A x)`` with ``A [in, r]``, ``B [r, out]`` and only
+``A``/``B`` trainable. At production scale this is the per-tenant story —
+hundreds of tenants share ONE base model and each owns a pytree a few
+thousand floats big.
+
+The injection here deliberately does NOT restructure the model:
+:func:`apply_lora` registers ``lora_A``/``lora_B`` as ordinary parameters
+ON each target layer and hangs the delta off a forward-post hook, so
+
+- base parameter *paths are unchanged* — base checkpoints load before or
+  after injection, and the base-model fingerprint an adapter checkpoint
+  pins is computed over exactly the paths a non-LoRA model has;
+- every existing execution path (eager, ``functional_call`` under
+  jit/grad, the compiled generate/serve programs) picks the delta up for
+  free: the hook runs inside the layer's ``__call__``;
+- ``B`` initializes to zeros, so an injected model is bit-identical to
+  the base until training moves the adapter.
+
+Two application modes, selected at trace time:
+
+- **solo** (default): the hook reads the layer's own ``lora_A``/``lora_B``
+  — the single-adapter path used by training and solo ``generate``;
+- **batched rows** (:func:`adapter_rows`): the serving engine activates a
+  per-batch-row adapter context — each target layer receives gathered
+  ``(A, B)`` pages of shape ``[B, in, r]`` / ``[B, r, out]`` and applies a
+  per-row contraction, so ONE compiled decode program serves a batch
+  mixing arbitrary tenants (row 0 of the page stack is the zero adapter =
+  the base model). Both modes share one einsum formulation, so a tenant's
+  served stream is token-identical to its solo generate.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F
+from ..nn.initializer import Constant, Normal
+from ..nn.layer import Layer
+
+__all__ = ["LoraConfig", "apply_lora", "applied_config", "lora_paths",
+           "lora_state", "set_adapter", "clear_adapter", "is_lora_param",
+           "base_fingerprint", "adapter_rows"]
+
+_LORA_LEAVES = ("lora_A", "lora_B")
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    """Adapter geometry shared by training, the registry and serving.
+
+    - ``rank``: the low-rank bottleneck ``r`` (optimizer state and
+      adapter checkpoints scale with it, not with the model);
+    - ``alpha``: the delta is scaled by ``alpha / rank`` (the LoRA-paper
+      convention, so sweeping ``rank`` keeps the update magnitude);
+    - ``target_modules``: leaf-layer names to inject (e.g.
+      ``("qkv_proj", "fc_in")``); ``None`` asks the model via its
+      ``lora_spec()`` (GPT/Llama families provide attention + MLP
+      projections);
+    - ``dropout``: input dropout on the adapter branch, training only.
+    """
+
+    rank: int = 8
+    alpha: float = 16.0
+    target_modules: Optional[Tuple[str, ...]] = None
+    dropout: float = 0.0
+
+    def __post_init__(self):
+        if int(self.rank) < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if not 0.0 <= float(self.dropout) < 1.0:
+            raise ValueError(
+                f"dropout must be in [0, 1), got {self.dropout}")
+        if self.target_modules is not None:
+            object.__setattr__(self, "target_modules",
+                               tuple(self.target_modules))
+
+    @property
+    def scaling(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+    def to_dict(self) -> dict:
+        return {"rank": int(self.rank), "alpha": float(self.alpha),
+                "target_modules": (None if self.target_modules is None
+                                   else list(self.target_modules)),
+                "dropout": float(self.dropout)}
+
+
+# ------------------------------------------------- batched adapter context
+# Trace-time state: the serving engine pushes a {layer_path: (A_rows,
+# B_rows)} dict around its functional_call so every hook reached under the
+# trace applies the per-row pages instead of the layer's own adapter.
+# thread-local because each engine worker traces on its own thread.
+_CTX = threading.local()
+
+
+def _ctx_stack() -> list:
+    stack = getattr(_CTX, "stack", None)
+    if stack is None:
+        stack = _CTX.stack = []
+    return stack
+
+
+def _current_rows() -> Optional[dict]:
+    stack = _ctx_stack()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def adapter_rows(pages, rows):
+    """Activate per-row adapter pages for every LoRA hook reached under
+    this context (trace-time, thread-local).
+
+    ``pages`` maps layer path -> ``(A_stack [S, in, r], B_stack [S, r,
+    out])`` — the registry's device-resident stacked buffer; ``rows`` is
+    the (possibly traced) ``[B]`` vector of stack rows, one per batch
+    row (0 = the zero adapter = base model). The gather happens here, in
+    program, so which tenants share the batch is pure DATA — admitting or
+    evicting a tenant never retraces."""
+    idx = jnp.asarray(rows, jnp.int32)
+    if idx.ndim == 0:
+        idx = idx[None]
+    ctx = {path: (jnp.take(a, idx, axis=0), jnp.take(b, idx, axis=0))
+           for path, (a, b) in pages.items()}
+    _ctx_stack().append(ctx)
+    try:
+        yield
+    finally:
+        _ctx_stack().pop()
+
+
+def _delta_rows(x, a_rows, b_rows, scaling):
+    """The one adapter contraction both modes share: ``x [B, ..., in]``
+    against per-row ``a_rows [B, in, r]`` / ``b_rows [B, r, out]``. A
+    single formulation (same dot_generals, same reduction order) is what
+    makes a tenant's batched served stream bit-identical to its solo
+    generate."""
+    a_rows = a_rows.astype(x.dtype)
+    b_rows = b_rows.astype(x.dtype)
+    t = jnp.einsum("b...i,bir->b...r", x, a_rows)
+    return jnp.einsum("b...r,bro->b...o", t, b_rows) * jnp.asarray(
+        scaling, x.dtype)
+
+
+class _LoraHook:
+    """Forward-post hook carrying one target layer's adapter math."""
+
+    __slots__ = ("path", "config")
+
+    def __init__(self, path: str, config: LoraConfig):
+        self.path = path
+        self.config = config
+
+    def __call__(self, layer, inputs, output):
+        x = inputs[0]
+        if self.config.dropout and layer.training:
+            x = F.dropout(x, p=self.config.dropout, training=True)
+        ctx = _current_rows()
+        if ctx is not None:
+            try:
+                a_rows, b_rows = ctx[self.path]
+            except KeyError:
+                raise KeyError(
+                    f"adapter_rows context active but holds no pages for "
+                    f"layer {self.path!r} — the AdapterStore was built "
+                    f"for a different injection (target_modules "
+                    f"mismatch?)") from None
+        else:
+            a, b = layer.lora_A, layer.lora_B
+            batch = x.shape[0]
+            a_rows = jnp.broadcast_to(a[None], (batch,) + a.shape)
+            b_rows = jnp.broadcast_to(b[None], (batch,) + b.shape)
+        return output + _delta_rows(x, a_rows, b_rows, self.config.scaling)
+
+
+@dataclass
+class _LoraApplied:
+    """Bookkeeping :func:`apply_lora` leaves on the model instance."""
+
+    config: LoraConfig
+    paths: List[str]
+    shapes: Dict[str, Tuple[Tuple[int, ...], Tuple[int, ...]]]
+    hooks: dict
+
+
+def _resolve_targets(model: Layer, config: LoraConfig) -> Tuple[str, ...]:
+    if config.target_modules is not None:
+        return tuple(config.target_modules)
+    spec = getattr(model, "lora_spec", None)
+    if spec is None:
+        raise ValueError(
+            f"{type(model).__name__} has no lora_spec() and the "
+            f"LoraConfig names no target_modules; pass target_modules= "
+            f"explicitly (leaf layer names, e.g. ('qkv_proj', 'fc_in'))")
+    return tuple(spec()["target_modules"])
+
+
+def applied_config(model: Layer) -> Optional[LoraConfig]:
+    """The :class:`LoraConfig` a model was injected with (None = base)."""
+    st = model.__dict__.get("_lora_applied")
+    return st.config if st is not None else None
+
+
+def lora_paths(model: Layer) -> List[str]:
+    """Paths of the injected target layers, in traversal order."""
+    st = model.__dict__.get("_lora_applied")
+    if st is None:
+        raise ValueError(f"{type(model).__name__} has no LoRA injection; "
+                         f"call apply_lora(model, config) first")
+    return list(st.paths)
+
+
+def apply_lora(model: Layer, config: LoraConfig) -> Layer:
+    """Inject LoRA branches into ``model``'s target projections, in place.
+
+    Each matched leaf layer (by name, among layers exposing
+    ``in_features``/``out_features``) gains parameters ``lora_A``
+    ``[in, rank]`` (Normal(0, 0.02)) and ``lora_B`` ``[rank, out]``
+    (zeros — injection is a numeric no-op until training) plus the delta
+    hook. GSPMD shardings follow the base weight: a column-parallel
+    target shards ``lora_B`` over "mp", a row-parallel target shards
+    ``lora_A``, so tensor-parallel serving needs no adapter gathers.
+
+    Idempotent under the SAME config; a second call with a different
+    config raises (un-inject by rebuilding the model)."""
+    existing = model.__dict__.get("_lora_applied")
+    if existing is not None:
+        if existing.config == config:
+            return model
+        raise ValueError(
+            f"model already carries a LoRA injection with "
+            f"{existing.config}; refusing to stack {config} on top — "
+            f"rebuild the model to change adapter geometry")
+    targets = _resolve_targets(model, config)
+    paths: List[str] = []
+    shapes: Dict[str, Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
+    hooks = {}
+    for path, layer in model.named_sublayers():
+        name = path.rsplit(".", 1)[-1]
+        if name not in targets:
+            continue
+        in_f = getattr(layer, "in_features", None)
+        out_f = getattr(layer, "out_features", None)
+        if in_f is None or out_f is None:
+            raise ValueError(
+                f"LoRA target {path!r} has no in_features/out_features — "
+                f"only linear-style projections can carry an adapter "
+                f"(got {type(layer).__name__})")
+        layer.add_parameter("lora_A", layer.create_parameter(
+            (in_f, config.rank),
+            default_initializer=Normal(0.0, 0.02)))
+        layer.add_parameter("lora_B", layer.create_parameter(
+            (config.rank, out_f), default_initializer=Constant(0.0)))
+        base_spec = layer._param_shardings.get("weight")
+        if base_spec == (None, "mp"):
+            layer.set_param_sharding("lora_B", (None, "mp"))
+        elif base_spec == ("mp", None):
+            layer.set_param_sharding("lora_A", ("mp", None))
+        hook = _LoraHook(path, config)
+        hooks[path] = layer.register_forward_post_hook(hook)
+        paths.append(path)
+        shapes[path] = ((in_f, config.rank), (config.rank, out_f))
+    if not paths:
+        raise ValueError(
+            f"no layer of {type(model).__name__} matched LoRA "
+            f"target_modules {targets!r}")
+    model.__dict__["_lora_applied"] = _LoraApplied(
+        config=config, paths=paths, shapes=shapes, hooks=hooks)
+    return model
+
+
+# --------------------------------------------------------- adapter pytree
+def is_lora_param(path: str) -> bool:
+    """True for adapter leaves (``...lora_A`` / ``...lora_B``) — the
+    trainable-set predicate ``Model.fit(lora=...)`` hands the train
+    step."""
+    return path.rsplit(".", 1)[-1] in _LORA_LEAVES
+
+
+def lora_state(model: Layer) -> Dict[str, jnp.ndarray]:
+    """The adapter pytree: flat ``{param_path: array}`` over the injected
+    ``lora_A``/``lora_B`` leaves only — the thing :func:`AdapterStore
+    <paddle_tpu.lora.store.AdapterStore>` saves, loads and stacks."""
+    lora_paths(model)  # raises when not injected
+    return {k: v for k, v in model.named_parameters() if is_lora_param(k)}
+
+
+def set_adapter(model: Layer, state: Dict) -> Layer:
+    """Write an adapter pytree (from :func:`lora_state` or an adapter
+    checkpoint) into the model's injected leaves. Missing or unexpected
+    keys are an error — a truncated adapter silently serving the base
+    model is exactly the bug this refuses to allow."""
+    want = set(lora_state(model))
+    got = set(state)
+    if want != got:
+        missing = sorted(want - got)[:3]
+        extra = sorted(got - want)[:3]
+        raise ValueError(
+            f"adapter state does not match this model's injection: "
+            f"{len(want - got)} missing (e.g. {missing}), "
+            f"{len(got - want)} unexpected (e.g. {extra})")
+    for k, v in state.items():
+        cur = model._get_by_path(k)
+        arr = jnp.asarray(v)
+        if tuple(cur.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"adapter leaf {k!r} has shape {tuple(arr.shape)}, model "
+                f"expects {tuple(cur.shape)} (rank mismatch?)")
+        model._set_by_path(k, arr.astype(cur.dtype))
+    return model
+
+
+def clear_adapter(model: Layer) -> Layer:
+    """Zero the injected leaves — back to exact base-model behaviour."""
+    for k, v in lora_state(model).items():
+        model._set_by_path(k, jnp.zeros_like(v))
+    return model
+
+
+def base_fingerprint(model: Layer) -> str:
+    """Structural fingerprint of the BASE model an adapter belongs to:
+    a digest over the model class plus every non-LoRA parameter's
+    ``(path, shape, dtype)``. Cheap (no device readback) and stable
+    across injection — an adapter checkpoint records it and the registry
+    refuses to load an adapter onto a different architecture. It
+    identifies the architecture, not the weight VALUES: pair it with
+    base-checkpoint provenance when several same-shaped bases coexist."""
+    rows = sorted(
+        (k, tuple(int(d) for d in v.shape), str(v.dtype))
+        for k, v in model.named_parameters() if not is_lora_param(k))
+    raw = json.dumps([type(model).__name__, rows]).encode()
+    return hashlib.blake2b(raw, digest_size=16).hexdigest()

@@ -1,0 +1,427 @@
+"""Adapter registry: small checkpoints on disk, stacked pages on device.
+
+The multi-tenant serving contract is "adding a tenant changes data,
+never programs". This module is the data side:
+
+- **disk**: :func:`save_adapter` writes the adapter pytree through the
+  crash-safe checkpoint stack (staging dir + fsync + crc32 + atomic
+  publish — PR 1 machinery unchanged) with a ``format: "lora_adapter"``
+  metadata record carrying rank/alpha/targets/dropout and the BASE-model
+  fingerprint. :func:`load_adapter` verifies both: a full checkpoint
+  refused as an adapter, an adapter refused onto the wrong base — each a
+  hard, named error;
+- **device**: :class:`AdapterStore` keeps up to ``max_loaded`` adapters
+  resident in ONE preallocated pytree per target layer —
+  ``(A_stack [S, in, r], B_stack [S, r, out])`` with ``S = max_loaded +
+  1`` and row 0 the reserved zero adapter (= base model). Loading a
+  tenant is a row write into the stack (``.at[slot].set``), evicting is
+  forgetting a row — buffer updates, never recompiles. The serving
+  programs take the whole stack as a plain jit input and gather per-slot
+  rows in-program (:func:`~paddle_tpu.lora.layers.adapter_rows`);
+- **residency**: LRU over unpinned rows. The engine pins a row for the
+  lifetime of every request decoding against it, so eviction can never
+  swap an adapter out from under a live stream.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .layers import (LoraConfig, applied_config, base_fingerprint,
+                     is_lora_param, lora_paths, lora_state)
+
+__all__ = ["ADAPTER_FORMAT", "AdapterError", "AdapterFormatError",
+           "AdapterStore", "save_adapter", "load_adapter",
+           "adapter_metadata", "normalize_adapter_id"]
+
+ADAPTER_FORMAT = "lora_adapter"
+
+BASE_ADAPTER = "base"   # reserved name for stack row 0 (the zero adapter)
+
+
+def normalize_adapter_id(adapter_id):
+    """Collapse the reserved ``"base"`` alias onto ``None`` (the zero
+    adapter). Every boundary that accepts an adapter id (server/router
+    submit, engine admit) normalizes through THIS helper, so one tenant
+    key can never split into two cache namespaces or metrics rows."""
+    return None if adapter_id == BASE_ADAPTER else adapter_id
+
+
+class AdapterError(RuntimeError):
+    """A registry operation failed host-side BEFORE any device dispatch
+    (unknown adapter, every slot pinned) — the serving loop fails just
+    the offending request, never the engine."""
+
+
+class AdapterFormatError(ValueError):
+    """A checkpoint is not what the caller pointed at: a full model
+    checkpoint fed to the adapter loader, an adapter checkpoint fed to a
+    full restore, or an adapter whose base fingerprint / LoRA geometry
+    does not match the serving model."""
+
+
+# -------------------------------------------------------------- disk side
+def save_adapter(directory: str, model, *, async_: bool = False):
+    """Save ``model``'s adapter pytree as a (tiny) crash-safe checkpoint.
+
+    The metadata records ``format: "lora_adapter"``, the LoRA geometry
+    and the base-model fingerprint, so :func:`load_adapter` /
+    :class:`AdapterStore` can hard-reject mismatched loads. Returns the
+    async save handle when ``async_`` (see ``checkpoint.save_state``)."""
+    from ..distributed.checkpoint import save_state
+
+    config = applied_config(model)
+    if config is None:
+        raise ValueError(
+            f"{type(model).__name__} has no LoRA injection to save; "
+            f"apply_lora(model, config) / Model.fit(lora=...) first")
+    extra = {"format": ADAPTER_FORMAT,
+             "lora": {**config.to_dict(),
+                      "base_fingerprint": base_fingerprint(model),
+                      "base_model": type(model).__name__}}
+    return save_state(lora_state(model), directory, async_=async_,
+                      extra_meta=extra)
+
+
+def adapter_metadata(directory: str) -> dict:
+    """The ``lora`` metadata record of an adapter checkpoint (raises
+    :class:`AdapterFormatError` for non-adapter directories)."""
+    try:
+        with open(os.path.join(directory, "metadata.json")) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise AdapterFormatError(
+            f"{directory}: not a readable checkpoint directory: {e}"
+        ) from e
+    if meta.get("format") != ADAPTER_FORMAT:
+        raise AdapterFormatError(
+            f"{directory} is not a LoRA adapter checkpoint (format="
+            f"{meta.get('format')!r}); full model checkpoints load via "
+            f"checkpoint.load_state / Model.load, not the adapter "
+            f"registry")
+    return dict(meta.get("lora") or {})
+
+
+def load_adapter(directory: str, model=None) -> Tuple[Dict, dict]:
+    """Load an adapter checkpoint: ``(adapter_state, lora_meta)``.
+
+    With ``model`` (a LoRA-applied network), the checkpoint's recorded
+    base fingerprint and LoRA geometry are verified against it —
+    mismatch is a hard :class:`AdapterFormatError`, because an adapter
+    trained against a different base would load cleanly and serve
+    garbage."""
+    from ..distributed.checkpoint import load_state
+
+    meta = adapter_metadata(directory)
+    if model is not None:
+        _check_compatible(directory, meta, model)
+    state = load_state(directory)
+    bad = sorted(k for k in state if not is_lora_param(k))
+    if bad:
+        raise AdapterFormatError(
+            f"{directory}: adapter checkpoint contains non-adapter "
+            f"leaves (e.g. {bad[:3]}) — corrupt metadata?")
+    return state, meta
+
+
+def _check_compatible(directory: str, meta: dict, model) -> None:
+    config = applied_config(model)
+    if config is None:
+        raise AdapterFormatError(
+            f"cannot load adapter {directory} into a model without a "
+            f"LoRA injection; apply_lora(model, config) first")
+    want_fp = base_fingerprint(model)
+    got_fp = meta.get("base_fingerprint")
+    if got_fp is not None and got_fp != want_fp:
+        raise AdapterFormatError(
+            f"{directory}: adapter was trained against base model "
+            f"{meta.get('base_model')!r} (fingerprint {got_fp}); this "
+            f"model's fingerprint is {want_fp} — refusing to serve an "
+            f"adapter on the wrong base")
+    for field in ("rank", "alpha", "dropout"):
+        got = meta.get(field)
+        want = getattr(config, field)
+        if got is not None and float(got) != float(want):
+            raise AdapterFormatError(
+                f"{directory}: adapter {field}={got} does not match the "
+                f"model's injection {field}={want}; adapters in one "
+                f"registry must share the stacked-page geometry")
+
+
+# ------------------------------------------------------------ device side
+class AdapterStore:
+    """Device-resident multi-adapter registry for ONE injected model.
+
+    ``register``/``load`` put adapters in the host registry; the first
+    request for a tenant stages its pages into a stack row
+    (:meth:`acquire`), evicting the least-recently-used unpinned row when
+    full. All registry mutation is host-side metadata plus shape-stable
+    row writes — the compiled serving programs never change.
+
+    Thread-safe: the serving worker acquires/releases; router threads
+    read :meth:`resident`/:meth:`known` for placement affinity.
+    """
+
+    def __init__(self, model, config: Optional[LoraConfig] = None,
+                 max_loaded: int = 8):
+        from .layers import apply_lora
+
+        applied = applied_config(model)
+        if applied is None:
+            if config is None:
+                raise ValueError(
+                    "AdapterStore needs a LoRA-applied model or a "
+                    "LoraConfig to apply (pass config=)")
+            apply_lora(model, config)
+            applied = config
+        elif config is not None and config != applied:
+            raise ValueError(
+                f"model is injected with {applied}, store asked for "
+                f"{config}; one geometry per model")
+        if int(max_loaded) < 1:
+            raise ValueError(f"max_loaded must be >= 1, got {max_loaded}")
+        self.model = model
+        self.config = applied
+        self.fingerprint = base_fingerprint(model)
+        self.paths = lora_paths(model)
+        self.max_loaded = int(max_loaded)
+        self.slots = self.max_loaded + 1      # +1: reserved zero row 0
+        st = model.__dict__["_lora_applied"]
+        self._lock = threading.Lock()
+        self._tick = 0
+        # row bookkeeping: _names[s] is the adapter resident in row s
+        self._names: List[Optional[str]] = [BASE_ADAPTER] + \
+            [None] * self.max_loaded
+        self._by_name: Dict[str, int] = {}
+        self._pins = [0] * self.slots
+        self._last_use = [0] * self.slots
+        self._host: Dict[str, Dict[str, np.ndarray]] = {}
+        # bumped on every register() of a name: the prefix-cache digest
+        # salt embeds it, so pushing a new adapter VERSION orphans the
+        # K/V blocks the old weights computed (they age out via LRU)
+        self._versions: Dict[str, int] = {}
+        self.loads = 0
+        self.evictions = 0
+        self.tensors = {}
+        for path in self.paths:
+            (a_shape, b_shape) = st.shapes[path]
+            a_ref = model._get_by_path(f"{path}.lora_A")
+            self.tensors[path] = (
+                jnp.zeros((self.slots,) + tuple(a_shape), a_ref.dtype),
+                jnp.zeros((self.slots,) + tuple(b_shape), a_ref.dtype))
+        self.page_bytes = int(sum(
+            a.nbytes + b.nbytes for a, b in self.tensors.values()
+        ) // self.slots)
+
+    # ------------------------------------------------------------- intake
+    def _as_pages(self, state: Dict) -> Dict[str, Tuple[np.ndarray,
+                                                        np.ndarray]]:
+        """Validate a flat adapter pytree against this store's geometry
+        and regroup it per layer path."""
+        st = self.model.__dict__["_lora_applied"]
+        pages = {}
+        seen = set()
+        for path in self.paths:
+            a_key, b_key = f"{path}.lora_A", f"{path}.lora_B"
+            if a_key not in state or b_key not in state:
+                raise AdapterFormatError(
+                    f"adapter state lacks {a_key!r}/{b_key!r}; it was "
+                    f"saved from a different injection "
+                    f"(target_modules/model mismatch)")
+            a = np.asarray(state[a_key])
+            b = np.asarray(state[b_key])
+            want_a, want_b = st.shapes[path]
+            if a.shape != tuple(want_a) or b.shape != tuple(want_b):
+                raise AdapterFormatError(
+                    f"adapter leaf shapes {a.shape}/{b.shape} at "
+                    f"{path!r} do not match the store geometry "
+                    f"{want_a}/{want_b} (rank mismatch?)")
+            pages[path] = (a, b)
+            seen.update((a_key, b_key))
+        extra = sorted(set(state) - seen)
+        if extra:
+            raise AdapterFormatError(
+                f"adapter state carries unexpected leaves (e.g. "
+                f"{extra[:3]}) — saved from a wider injection?")
+        return pages
+
+    def register(self, name: str, state: Dict) -> None:
+        """Host-register an adapter pytree under ``name``. Re-registering
+        a name replaces it (and refreshes its device pages if resident —
+        the adapter-update path)."""
+        if not name or name == BASE_ADAPTER:
+            raise ValueError(
+                f"adapter name must be a non-empty string != "
+                f"{BASE_ADAPTER!r}, got {name!r}")
+        pages = self._as_pages(state)
+        with self._lock:
+            self._host[name] = pages
+            self._versions[name] = self._versions.get(name, 0) + 1
+            slot = self._by_name.get(name)
+            if slot is not None:
+                if self._pins[slot] > 0:
+                    # live streams are mid-decode against the OLD pages:
+                    # overwriting in place would hand them mixed-version
+                    # weights. Orphan the row instead — pinned streams
+                    # keep it (it frees once they finish), the name
+                    # unmaps so the next acquire() stages the NEW pages
+                    # into a fresh row.
+                    del self._by_name[name]
+                    self._names[slot] = None
+                else:
+                    self._write_pages_locked(slot, pages)
+
+    def load(self, name: str, directory: str) -> None:
+        """Load an adapter checkpoint from ``directory`` and register it
+        as ``name`` — fingerprint/geometry mismatches are hard errors."""
+        state, _ = load_adapter(directory, self.model)
+        self.register(name, state)
+
+    # ---------------------------------------------------------- residency
+    def _write_pages_locked(self, slot: int, pages: Dict) -> None:
+        # a row write per target layer: shape-stable device updates (the
+        # stacks stay jit inputs of unchanged aval — no recompile)
+        self.tensors = {
+            path: (a_stack.at[slot].set(pages[path][0]),
+                   b_stack.at[slot].set(pages[path][1]))
+            for path, (a_stack, b_stack) in self.tensors.items()}
+        self.loads += 1
+
+    def acquire(self, name: Optional[str], *, with_salt: bool = False):
+        """Resolve ``name`` to a resident stack row and pin it (one pin
+        per live request). ``None``/``"base"`` is row 0. Raises
+        :class:`AdapterError` (host-side, pre-dispatch) for unknown
+        adapters or when every row is pinned by live requests.
+
+        ``with_salt`` returns ``(row, digest_salt)`` captured under ONE
+        lock hold — the admission path needs the salt of exactly the
+        version whose pages it just pinned; reading :meth:`salt`
+        separately would race a concurrent :meth:`register` and stamp
+        old-weight K/V into the new version's cache namespace."""
+        if name is None or name == BASE_ADAPTER:
+            with self._lock:
+                self._pins[0] += 1
+            return (0, b"") if with_salt else 0
+        with self._lock:
+            self._tick += 1
+            slot = self._by_name.get(name)
+            if slot is None:
+                pages = self._host.get(name)
+                if pages is None:
+                    raise AdapterError(
+                        f"unknown adapter {name!r}; register() or load() "
+                        f"it into the store first")
+                slot = self._free_slot_locked()
+                if slot is None:
+                    raise AdapterError(
+                        f"all {self.max_loaded} adapter rows are pinned "
+                        f"by live requests; raise max_loaded (>= engine "
+                        f"slots is always safe) or shed load")
+                self._write_pages_locked(slot, pages)
+                self._names[slot] = name
+                self._by_name[name] = slot
+            self._pins[slot] += 1
+            self._touch_locked(slot)
+            if not with_salt:
+                return slot
+            return slot, self._salt_locked(name)
+
+    def _touch_locked(self, slot: int) -> None:
+        self._last_use[slot] = self._tick
+
+    def _free_slot_locked(self) -> Optional[int]:
+        for s in range(1, self.slots):
+            # a nameless row may still be PINNED (orphaned by a
+            # re-register while streams decode against it) — not free
+            if self._names[s] is None and self._pins[s] == 0:
+                return s
+        victim = None
+        for s in range(1, self.slots):
+            if self._pins[s] > 0:
+                continue
+            if victim is None or self._last_use[s] < self._last_use[victim]:
+                victim = s
+        if victim is None:
+            return None
+        old = self._names[victim]
+        if old is not None:
+            del self._by_name[old]
+            self.evictions += 1
+        self._names[victim] = None
+        return victim
+
+    def release(self, slot: int) -> None:
+        """Drop one pin on ``slot`` (the engine calls this when the
+        request leaves its engine slot)."""
+        with self._lock:
+            if 0 <= slot < self.slots and self._pins[slot] > 0:
+                self._pins[slot] -= 1
+
+    def release_all(self) -> None:
+        """Crash-recovery sweep: the engine reset requeues every live
+        request, so every pin it held is void."""
+        with self._lock:
+            self._pins = [0] * self.slots
+
+    # ------------------------------------------------------------- lookup
+    def salt(self, name: Optional[str]) -> bytes:
+        """The prefix-cache digest-chain namespace for ``name`` — THE
+        single source for both the engine's block identity and the
+        router's affinity probe (a byte drift between the two would
+        silently zero affinity). Embeds the registration version: a
+        re-registered (updated) adapter gets a fresh namespace, so K/V
+        blocks its OLD weights computed can never serve the new ones
+        (stale blocks age out of the pool via LRU)."""
+        if name is None or name == BASE_ADAPTER:
+            return b""
+        with self._lock:
+            return self._salt_locked(name)
+
+    def _salt_locked(self, name: str) -> bytes:
+        return b"lora:%s@%d" % (str(name).encode(),
+                                self._versions.get(name, 0))
+
+    def known(self, name: Optional[str]) -> bool:
+        """Registered (host side) — submit-time validation."""
+        if name is None or name == BASE_ADAPTER:
+            return True
+        with self._lock:
+            return name in self._host
+
+    def resident(self, name: Optional[str]) -> bool:
+        """Currently holding a device row — the router's adapter-affinity
+        signal (placing a tenant where its pages are warm skips a load)."""
+        if name is None or name == BASE_ADAPTER:
+            return True
+        with self._lock:
+            return name in self._by_name
+
+    def loaded(self) -> Dict[str, int]:
+        """``{adapter_name: stack_row}`` of resident adapters."""
+        with self._lock:
+            return dict(self._by_name)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "max_loaded": self.max_loaded,
+                "registered": len(self._host),
+                "resident": len(self._by_name),
+                "pinned_rows": sum(1 for s in range(1, self.slots)
+                                   if self._pins[s] > 0),
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "page_bytes": self.page_bytes,
+                "rank": self.config.rank,
+            }
+
+    def __repr__(self):
+        s = self.stats()
+        return (f"AdapterStore(resident={s['resident']}/{s['max_loaded']},"
+                f" registered={s['registered']}, rank={s['rank']})")

@@ -6,9 +6,11 @@ batch whose slots admit/free independently (``engine``), FIFO admission
 control with backpressure and deadlines (``scheduler``), a threaded
 front end with per-request streaming and crash recovery (``server``),
 operator metrics (``metrics``), a paged prefix/KV block pool for
-cross-request prompt reuse (``prefix_cache``), and a load-aware router
-over N replicas (``router``). See README "Serving" and "Fleet serving"
-for the architecture sketches.
+cross-request prompt reuse (``prefix_cache``), a load-aware router
+over N replicas (``router``), and batched multi-tenant LoRA decode
+(``adapter_store=`` on the engine + ``adapter_id=`` per request — see
+``paddle_tpu.lora``). See README "Serving", "Fleet serving" and
+"Multi-tenant LoRA serving" for the architecture sketches.
 
     from paddle_tpu.serving import InferenceServer, ReplicaRouter
 
@@ -16,10 +18,12 @@ for the architecture sketches.
         InferenceServer(lm, slots=8, max_length=1024,
                         prefix_cache=64 << 20)
         for _ in range(4)])
-    h = fleet.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    h = fleet.submit(prompt_ids, max_new_tokens=64, eos_token_id=2,
+                     adapter_id="tenant-a")
     for tok in h.stream():
         ...
 """
+from ..lora.store import (AdapterError, AdapterStore)  # noqa: F401
 from .engine import ContinuousBatchingEngine, SlotEvent  # noqa: F401
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
 from .prefix_cache import BlockPool, PrefixHit, StorePlan  # noqa: F401
@@ -34,5 +38,5 @@ __all__ = [
     "RequestHandle", "FifoScheduler", "Request", "Backpressure",
     "QueueFull", "SchedulerClosed", "ServingMetrics", "LatencyHistogram",
     "BlockPool", "PrefixHit", "StorePlan", "ReplicaRouter",
-    "RouterHandle", "NoReplicasAvailable",
+    "RouterHandle", "NoReplicasAvailable", "AdapterStore", "AdapterError",
 ]

@@ -48,6 +48,8 @@ from ..models.generation import (DEFAULT_PREFILL_BUCKETS, _constrain_cache,
                                  gather_cache_blocks, init_cache,
                                  per_row_keys, sample_logits_rows,
                                  scatter_cache_blocks, scatter_cache_rows)
+from ..lora import adapter_rows as _adapter_rows_ctx
+from ..lora.store import AdapterStore, normalize_adapter_id
 from ..nn.layer import buffer_state, functional_call, param_state
 from .prefix_cache import BlockPool
 
@@ -79,13 +81,24 @@ class ContinuousBatchingEngine:
     chunked-continuation attention path instead of the block-local
     (flash-eligible) prefill. Default None keeps the PR 4 admit program
     bit-for-bit.
+
+    ``adapter_store`` (a :class:`~paddle_tpu.lora.AdapterStore` built on
+    the SAME LoRA-applied model) turns on batched multi-tenant decode:
+    each slot carries a traced page-stack row, the prefill/decode
+    programs gather that row's ``(A, B)`` pages in-program and apply the
+    low-rank delta per slot (row 0 = the zero adapter = base model), so
+    one compiled program family serves every tenant. Loading/evicting a
+    tenant is a store buffer update — never a recompile — and with a
+    prefix cache attached, each tenant's K/V blocks live under its own
+    digest namespace (adapter-modified projections make cross-tenant
+    reuse numerically wrong).
     """
 
     def __init__(self, model, slots: int = 4,
                  max_length: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  top_k: int = 0, allow_top_p: bool = True,
-                 prefix_cache=None):
+                 prefix_cache=None, adapter_store=None):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
         self.model = model
@@ -104,28 +117,34 @@ class ContinuousBatchingEngine:
         self.top_k = int(top_k)
         self.allow_top_p = bool(allow_top_p)
         self.pool = self._normalize_pool(prefix_cache)
+        self.store = self._normalize_store(adapter_store)
         model_name = type(model).__name__
         self._cc_prefill = compile_cache.register_name(
             f"serve:prefill:{model_name}")
         self._cc_decode = compile_cache.register_name(
             f"serve:decode:{model_name}")
         on_device = jax.default_backend() != "cpu"
+        lora = self.store is not None
         if self.pool is not None:
             # cache hit or miss, every admission runs the SAME pooled
             # program family (one per suffix bucket): n_matched is traced
-            # (0 on a miss), so the compile budget stays #buckets + 1
+            # (0 on a miss), so the compile budget stays #buckets + 1.
+            # The adapter page stacks ride as extra NON-donated inputs
+            # (the store keeps serving every later dispatch) — still one
+            # program per bucket, adapters or not.
             donate = (2, 3) if on_device else ()
-            self._prefill_compiled = jax.jit(
-                compile_cache.instrument(self._prefill_pool_fn,
-                                         self._cc_prefill),
-                donate_argnums=donate)
+            prefill = self._prefill_pool_lora_fn if lora \
+                else self._prefill_pool_fn
         else:
             donate = (2,) if on_device else ()
-            self._prefill_compiled = jax.jit(
-                compile_cache.instrument(self._prefill_fn, self._cc_prefill),
-                donate_argnums=donate)
+            prefill = self._prefill_lora_fn if lora else self._prefill_fn
+        self._prefill_compiled = jax.jit(
+            compile_cache.instrument(prefill, self._cc_prefill),
+            donate_argnums=donate)
         self._decode_compiled = jax.jit(
-            compile_cache.instrument(self._decode_fn, self._cc_decode),
+            compile_cache.instrument(
+                self._decode_lora_fn if lora else self._decode_fn,
+                self._cc_decode),
             donate_argnums=(2,) if on_device else ())
         self.reset()
 
@@ -167,6 +186,34 @@ class ContinuousBatchingEngine:
         pool._owner = self
         return pool
 
+    def _normalize_store(self, adapter_store) -> Optional[AdapterStore]:
+        """An :class:`AdapterStore` must wrap THIS engine's model
+        instance: the compiled programs reach the adapter hooks through
+        the model's injected layers, and the store's page geometry is
+        derived from exactly those layers."""
+        if adapter_store is None:
+            return None
+        if not isinstance(adapter_store, AdapterStore):
+            raise TypeError(
+                f"adapter_store must be a paddle_tpu.lora.AdapterStore, "
+                f"got {type(adapter_store).__name__}")
+        if adapter_store.model is not self.model:
+            raise ValueError(
+                "this AdapterStore was built for a different model "
+                "instance; build the store on the engine's model "
+                "(AdapterStore(model, ...))")
+        owner = getattr(adapter_store, "_owner", None)
+        if owner is not None and owner is not self:
+            # pins are engine-lifecycle state: a shared store would let
+            # one replica's crash-recovery release_all() void ANOTHER
+            # replica's live pins, making its rows evictable mid-stream
+            # (same sharing hazard BlockPool guards with _owner)
+            raise ValueError(
+                "this AdapterStore is already attached to another "
+                "engine; build one store per replica")
+        adapter_store._owner = self
+        return adapter_store
+
     # ------------------------------------------------------------- state
     def reset(self) -> None:
         """(Re)build the live batch: fresh cache, all slots free, weights
@@ -177,7 +224,13 @@ class ContinuousBatchingEngine:
         self.live_cache = init_cache(self.model, self.slots, self.max_length)
         if self.pool is not None:
             self.pool.reset()
+        if self.store is not None:
+            # every live request is about to be requeued: the pins this
+            # engine held on their page rows are void (the pages
+            # themselves survive — the store is never donated)
+            self.store.release_all()
         B = self.slots
+        self._adapter_slots = np.zeros(B, np.int32)
         self._positions = np.zeros(B, np.int32)
         self._tokens = np.zeros(B, np.int32)
         self._done = np.ones(B, bool)          # free slots sit "done"
@@ -287,6 +340,26 @@ class ContinuousBatchingEngine:
         done = next_tok[0] == eos_id
         return next_tok[0], done, live_cache, pool
 
+    # Adapter variants: same bodies, traced under an adapter-rows context
+    # — the per-row (A, B) gather happens in-program, so WHICH tenants
+    # occupy the batch is data. One extra program input (the page
+    # stacks), zero extra programs.
+    def _prefill_lora_fn(self, params, buffers, live_cache, pages, row,
+                         *rest):
+        with _adapter_rows_ctx(pages, row):
+            return self._prefill_fn(params, buffers, live_cache, *rest)
+
+    def _prefill_pool_lora_fn(self, params, buffers, live_cache, pool,
+                              pages, row, *rest):
+        with _adapter_rows_ctx(pages, row):
+            return self._prefill_pool_fn(params, buffers, live_cache,
+                                         pool, *rest)
+
+    def _decode_lora_fn(self, params, buffers, live_cache, pages, rows,
+                        *rest):
+        with _adapter_rows_ctx(pages, rows):
+            return self._decode_fn(params, buffers, live_cache, *rest)
+
     def _decode_fn(self, params, buffers, live_cache, tokens, positions,
                    keys, done, eos, temperature, top_p, greedy_mask):
         (logits, live_cache), _ = functional_call(
@@ -337,12 +410,14 @@ class ContinuousBatchingEngine:
                 np.uint32)
         return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
 
-    def _plan_hit(self, prompt: np.ndarray, L: int):
+    def _plan_hit(self, prompt: np.ndarray, L: int, salt: bytes = b""):
         """Pin the longest usable pool match for ``prompt`` and plan the
         block writes. The match shrinks (block granularity) until
         ``matched + suffix_bucket`` fits the cache — the suffix write
-        window must never clamp against the cache end."""
-        hit = self.pool.lookup(prompt)
+        window must never clamp against the cache end. ``salt``
+        namespaces the digest chain per adapter: a tenant only ever hits
+        K/V its own adapter computed."""
+        hit = self.pool.lookup(prompt, salt=salt)
         matched = hit.tokens
         while (matched > 0
                and matched + self.bucket_for_prompt(L - matched)
@@ -350,8 +425,10 @@ class ContinuousBatchingEngine:
             matched -= self.pool.block_tokens
         if matched != hit.tokens:
             hit = self.pool.trim(hit, matched)
-        plan = self.pool.plan_store(prompt, matched, digests=hit.digests)
+        plan = self.pool.plan_store(prompt, matched, digests=hit.digests,
+                                    salt=salt)
         return hit, plan
+
 
     def admit(self, request, slot: int) -> Tuple[int, bool, int]:
         """Prefill ``request`` into free ``slot``; returns the first
@@ -367,45 +444,74 @@ class ContinuousBatchingEngine:
         prompt = np.asarray(request.prompt, np.int32).ravel()
         L = int(prompt.shape[0])
         self.validate(L, int(request.max_new_tokens))
+        adapter_id = normalize_adapter_id(
+            getattr(request, "adapter_id", None))
+        if adapter_id is not None and self.store is None:
+            raise ValueError(
+                f"request names adapter {adapter_id!r} but this engine "
+                f"has no adapter_store")
         key = self._request_key(request)
         eos = np.int32(-1 if request.eos_token_id is None
                        else request.eos_token_id)
         temp = np.float32(request.temperature)
         top_p = np.float32(request.top_p)
         greedy = np.bool_(request.greedy)
+        a_row, a_salt = 0, b""
+        if self.store is not None:
+            # host-side resolve BEFORE any dispatch: an unknown adapter
+            # or a pinned-out store fails only this request (AdapterError
+            # — the server catches it without an engine reset). On a
+            # cold tenant this stages its pages into a stack row — a
+            # buffer update, never a recompile. Acquired LAST so every
+            # raise after the pin is owned by the try below; the digest
+            # salt rides along ATOMICALLY so a concurrent adapter update
+            # can't stamp these pages' K/V into the new version's
+            # namespace.
+            a_row, a_salt = self.store.acquire(adapter_id, with_salt=True)
         hit_tokens = 0
-        with RecordEvent("serve:prefill"), self._eval_mode():
-            compile_cache.record_call(self._cc_prefill)
-            if self.pool is None:
-                bucket = self.bucket_for_prompt(L)
-                ids_p = np.zeros((1, bucket), np.int32)
-                ids_p[0, :L] = prompt
-                tok, done0, self.live_cache = self._prefill_compiled(
-                    self._params, self._buffers, self.live_cache, ids_p,
-                    np.int32(slot), np.int32(L - 1), key, eos, temp,
-                    top_p, greedy)
-            else:
-                hit, plan = self._plan_hit(prompt, L)
-                hit_tokens = hit.tokens
-                suffix = L - hit_tokens
-                bucket = self.bucket_for_prompt(suffix)
-                ids_p = np.zeros((1, bucket), np.int32)
-                ids_p[0, :suffix] = prompt[hit_tokens:]
-                try:
-                    tok, done0, self.live_cache, tensors = (
-                        self._prefill_compiled(
-                            self._params, self._buffers, self.live_cache,
-                            self.pool.tensors, ids_p, np.int32(slot),
-                            np.int32(suffix - 1), np.int32(hit_tokens),
-                            hit.read_idx, plan.write_idx, key, eos, temp,
-                            top_p, greedy))
-                except Exception:
-                    # dispatch never completed: unpin + free the plan's
-                    # rows (a post-dispatch device fault instead goes
-                    # through reset(), which rebuilds the pool tensors)
-                    self.pool.abort(hit, plan)
-                    raise
-                self.pool.commit(hit, plan, tensors)
+        try:
+            lora_args = () if self.store is None else (
+                self.store.tensors, np.asarray([a_row], np.int32))
+            with RecordEvent("serve:prefill"), self._eval_mode():
+                compile_cache.record_call(self._cc_prefill)
+                if self.pool is None:
+                    bucket = self.bucket_for_prompt(L)
+                    ids_p = np.zeros((1, bucket), np.int32)
+                    ids_p[0, :L] = prompt
+                    tok, done0, self.live_cache = self._prefill_compiled(
+                        self._params, self._buffers, self.live_cache,
+                        *lora_args, ids_p,
+                        np.int32(slot), np.int32(L - 1), key, eos, temp,
+                        top_p, greedy)
+                else:
+                    hit, plan = self._plan_hit(prompt, L, salt=a_salt)
+                    hit_tokens = hit.tokens
+                    suffix = L - hit_tokens
+                    bucket = self.bucket_for_prompt(suffix)
+                    ids_p = np.zeros((1, bucket), np.int32)
+                    ids_p[0, :suffix] = prompt[hit_tokens:]
+                    try:
+                        tok, done0, self.live_cache, tensors = (
+                            self._prefill_compiled(
+                                self._params, self._buffers,
+                                self.live_cache, self.pool.tensors,
+                                *lora_args, ids_p, np.int32(slot),
+                                np.int32(suffix - 1), np.int32(hit_tokens),
+                                hit.read_idx, plan.write_idx, key, eos,
+                                temp, top_p, greedy))
+                    except Exception:
+                        # dispatch never completed: unpin + free the
+                        # plan's rows (a post-dispatch device fault
+                        # instead goes through reset(), which rebuilds
+                        # the pool tensors)
+                        self.pool.abort(hit, plan)
+                        raise
+                    self.pool.commit(hit, plan, tensors)
+        except Exception:
+            if self.store is not None:
+                # the request never reached a slot: its page pin is void
+                self.store.release(a_row)
+            raise
         # ONE batched transfer for both scalars — two np.asarray reads
         # here cost two serialized device round-trips per admission.
         # tpu-lint: disable=R1(admission's single batched sync point — the first token must reach the client now)
@@ -413,6 +519,7 @@ class ContinuousBatchingEngine:
         first = int(first_h)
         fin = bool(fin_h)
         self.requests[slot] = request
+        self._adapter_slots[slot] = a_row
         self._positions[slot] = L
         self._tokens[slot] = first
         self._done[slot] = fin
@@ -431,10 +538,12 @@ class ContinuousBatchingEngine:
         batching's equivalent of the generate() loop's done-check."""
         from ..profiler import RecordEvent
 
+        lora_args = () if self.store is None else (
+            self.store.tensors, self._adapter_slots)
         with RecordEvent("serve:decode"), self._eval_mode():
             compile_cache.record_call(self._cc_decode)
             tok, done, self.live_cache = self._decode_compiled(
-                self._params, self._buffers, self.live_cache,
+                self._params, self._buffers, self.live_cache, *lora_args,
                 self._tokens[:, None], self._positions, self._keys,
                 self._done, self._eos, self._temp, self._top_p,
                 self._greedy)
@@ -463,11 +572,15 @@ class ContinuousBatchingEngine:
     def release(self, slot: int) -> None:
         """Free ``slot`` immediately — no batch drain. The stale cache
         rows stay; the position mask keeps them invisible to whoever is
-        admitted next."""
+        admitted next. The slot's adapter-page pin drops with it (the
+        freed slot decodes as the zero adapter)."""
         self.requests[slot] = None
         self._done[slot] = True
         self._positions[slot] = 0
         self._tokens[slot] = 0
+        if self.store is not None:
+            self.store.release(int(self._adapter_slots[slot]))
+            self._adapter_slots[slot] = 0
 
     def cache_stats(self) -> dict:
         """Compile/call counters of the two serving programs — steady

@@ -118,6 +118,10 @@ class ServingMetrics:
             self.ttft = LatencyHistogram()
             self.inter_token = LatencyHistogram()
             self.queue_wait = LatencyHistogram()
+            # per-tenant traffic (adapter id -> counters/ttft), recorded
+            # only when the engine serves through an AdapterStore; the
+            # base model's share books under "base"
+            self._per_adapter: Dict[str, dict] = {}
 
     # ------------------------------------------------------------ events
     def _advance_occupancy(self, now: float) -> None:
@@ -149,12 +153,41 @@ class ServingMetrics:
         with self._lock:
             self.queue_wait.observe(seconds)
 
+    # ------------------------------------------------------- per adapter
+    def _adapter_locked(self, adapter_id) -> dict:
+        name = "base" if adapter_id is None else str(adapter_id)
+        e = self._per_adapter.get(name)
+        if e is None:
+            # smaller reservoir than the global histograms: one exists
+            # per TENANT, and p50 stabilizes long before 4096 samples
+            e = self._per_adapter[name] = {
+                "requests": 0, "tokens": 0,
+                "ttft": LatencyHistogram(max_samples=512)}
+        return e
+
+    def adapter_request(self, adapter_id) -> None:
+        with self._lock:
+            self._adapter_locked(adapter_id)["requests"] += 1
+
+    def adapter_tokens(self, adapter_id, n: int = 1) -> None:
+        with self._lock:
+            self._adapter_locked(adapter_id)["tokens"] += int(n)
+
+    def observe_adapter_ttft(self, adapter_id, seconds: float) -> None:
+        with self._lock:
+            self._adapter_locked(adapter_id)["ttft"].observe(seconds)
+
     # ---------------------------------------------------------- snapshot
     def snapshot(self, compile_stats: Optional[dict] = None,
-                 prefix_cache: Optional[dict] = None) -> dict:
+                 prefix_cache: Optional[dict] = None,
+                 adapter_store: Optional[dict] = None) -> dict:
         """One plain dict of everything — the serve_bench JSON shape.
-        ``prefix_cache`` (a ``BlockPool.stats()`` dict) rides along under
-        its own key when the engine has a pool attached."""
+        ``prefix_cache`` (a ``BlockPool.stats()`` dict) and
+        ``adapter_store`` (an ``AdapterStore.stats()`` dict) ride along
+        under their own keys when the engine has them attached; the
+        ``per_adapter`` block (requests / tokens / TTFT p50 per tenant)
+        appears whenever adapter traffic was recorded — the observable
+        inputs behind the router's adapter-affinity placement."""
         with self._lock:
             now = time.monotonic()
             self._advance_occupancy(now)
@@ -190,4 +223,13 @@ class ServingMetrics:
                    if compile_stats is not None else {}),
                 **({"prefix_cache": prefix_cache}
                    if prefix_cache is not None else {}),
+                **({"adapter_store": adapter_store}
+                   if adapter_store is not None else {}),
+                **({"per_adapter": {
+                    name: {"requests": e["requests"],
+                           "tokens": e["tokens"],
+                           "ttft_p50_ms": round(
+                               e["ttft"].percentile(50) * 1e3, 3)}
+                    for name, e in sorted(self._per_adapter.items())}}
+                   if self._per_adapter else {}),
             }

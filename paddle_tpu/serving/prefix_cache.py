@@ -49,21 +49,28 @@ __all__ = ["BlockPool", "PrefixHit", "StorePlan", "chain_digests"]
 _EMPTY = b"paddle_tpu.prefix_cache.root"
 
 
-def chain_digests(tokens, block_tokens: int) -> List[bytes]:
+def chain_digests(tokens, block_tokens: int,
+                  salt: bytes = b"") -> List[bytes]:
     """Digest chain over a prompt's MATCHABLE full blocks (never the
     whole prompt — the last token always stays for the suffix forward).
     Public so the router can hash a prompt ONCE per block size and probe
-    every replica's pool with :meth:`BlockPool.match_digests`."""
+    every replica's pool with :meth:`BlockPool.match_digests`.
+
+    ``salt`` namespaces the chain: identical prompts under different
+    salts share NOTHING. The multi-adapter engine salts with the tenant's
+    adapter id — its K/V was computed under adapter-modified projections,
+    so cross-tenant prefix reuse would serve the wrong numbers."""
     toks = np.asarray(tokens, np.int32).ravel()
     n = max(int(toks.shape[0]) - 1, 0) // int(block_tokens)
-    return _chain_digests(toks, int(block_tokens), n)
+    return _chain_digests(toks, int(block_tokens), n, salt)
 
 
 def _chain_digests(tokens: np.ndarray, block_tokens: int,
-                   n_blocks: int) -> List[bytes]:
+                   n_blocks: int, salt: bytes = b"") -> List[bytes]:
     """Digest of each of the first ``n_blocks`` full blocks, chained so
-    a digest commits to the block's entire left context."""
-    parent = _EMPTY
+    a digest commits to the block's entire left context (and the
+    namespace ``salt``, via the chain root)."""
+    parent = _EMPTY + salt if salt else _EMPTY
     out = []
     toks = np.ascontiguousarray(tokens[:n_blocks * block_tokens], np.int32)
     for i in range(n_blocks):
@@ -203,10 +210,11 @@ class BlockPool:
         return min((max(n_tokens - 1, 0)) // self.block_tokens,
                    self.blocks_per_prompt)
 
-    def match(self, tokens) -> int:
+    def match(self, tokens, salt: bytes = b"") -> int:
         """Peek: how many prompt tokens the pool could serve right now
         (no pinning, no LRU effect). The router's affinity signal."""
-        return self.match_digests(chain_digests(tokens, self.block_tokens))
+        return self.match_digests(
+            chain_digests(tokens, self.block_tokens, salt))
 
     def match_digests(self, digests: List[bytes]) -> int:
         """Peek by precomputed :func:`chain_digests` — the router hashes
@@ -220,13 +228,14 @@ class BlockPool:
                 m += 1
         return m * self.block_tokens
 
-    def lookup(self, tokens) -> PrefixHit:
+    def lookup(self, tokens, salt: bytes = b"") -> PrefixHit:
         """Walk the prompt's hash chain, pin every matched entry
         (refs+1 until :meth:`commit`/:meth:`abort`) and return the
-        padded read plan for the admit program."""
+        padded read plan for the admit program. ``salt`` namespaces the
+        chain (per-adapter K/V isolation — see :func:`chain_digests`)."""
         toks = np.asarray(tokens, np.int32).ravel()
         n = self._matchable_blocks(toks.shape[0])
-        digests = _chain_digests(toks, self.block_tokens, n)
+        digests = _chain_digests(toks, self.block_tokens, n, salt)
         read_idx = np.zeros(self.blocks_per_prompt, np.int32)
         hit = PrefixHit(tokens=0, read_idx=read_idx, digests=digests)
         with self._lock:
@@ -288,7 +297,8 @@ class BlockPool:
         return victim.index
 
     def plan_store(self, tokens, matched_tokens: int,
-                   digests: Optional[List[bytes]] = None) -> StorePlan:
+                   digests: Optional[List[bytes]] = None,
+                   salt: bytes = b"") -> StorePlan:
         """Allocate pool rows for the prompt's not-yet-cached full
         blocks past ``matched_tokens``. Rows come from the free list,
         then from LRU eviction of unpinned leaves; when neither yields a
@@ -301,7 +311,7 @@ class BlockPool:
         n = self._matchable_blocks(toks.shape[0])
         start = int(matched_tokens) // self.block_tokens
         if digests is None or len(digests) < n:
-            digests = _chain_digests(toks, self.block_tokens, n)
+            digests = _chain_digests(toks, self.block_tokens, n, salt)
         write_idx = np.zeros(self.blocks_per_prompt, np.int32)
         plan = StorePlan(write_idx=write_idx)
         with self._lock:

@@ -154,6 +154,12 @@ class RouterHandle:
         return self._current().cache_hit_tokens
 
     @property
+    def adapter_id(self):
+        """The tenant adapter this request decodes under (None = base);
+        a reroute carries it to the survivor unchanged."""
+        return self._kwargs.get("adapter_id")
+
+    @property
     def ttft_s(self) -> Optional[float]:
         """Time to first token measured from the ROUTER submit — a
         rerouted request keeps paying for its time on the dead replica
@@ -204,8 +210,13 @@ class ReplicaRouter:
     """Front door over N :class:`InferenceServer` replicas."""
 
     def __init__(self, replicas=(), *, affinity_weight: float = 0.75,
+                 adapter_affinity_weight: float = 0.5,
                  max_reroutes: int = 2):
         self.affinity_weight = float(affinity_weight)
+        # a tenant placed where its adapter pages are already resident
+        # skips a host->device page load (and an LRU eviction somewhere
+        # else); like prefix affinity, load eventually outweighs warmth
+        self.adapter_affinity_weight = float(adapter_affinity_weight)
         self.max_reroutes = int(max_reroutes)
         self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {}
@@ -263,26 +274,39 @@ class ReplicaRouter:
 
     # -------------------------------------------------------- placement
     def _score(self, rep: _Replica, prompt: np.ndarray,
-               digest_cache: dict) -> float:
+               digest_cache: dict, adapter_id: Optional[str]) -> float:
         srv = rep.server
         occupancy = srv.engine.active_count / srv.engine.slots
         queue = srv.scheduler.depth / srv.scheduler.max_queue_depth
         affinity = 0.0
         pool = srv.engine.pool
+        store = getattr(srv.engine, "store", None)
         if pool is not None and prompt.shape[0] > 0:
-            # hash the prompt ONCE per block size, not once per replica
-            # — placement is the submit hot path
+            # hash the prompt ONCE per (block size, adapter namespace),
+            # not once per replica — placement is the submit hot path.
+            # The salt comes from the replica's own AdapterStore (the
+            # SAME source its engine stamps blocks with, version
+            # included), so affinity reflects blocks this TENANT could
+            # actually hit on this replica
             bs = pool.block_tokens
-            digests = digest_cache.get(bs)
+            salt = (store.salt(adapter_id)
+                    if adapter_id is not None and store is not None
+                    else b"")
+            digests = digest_cache.get((bs, salt))
             if digests is None:
                 from .prefix_cache import chain_digests
 
-                digests = digest_cache[bs] = chain_digests(prompt, bs)
-            affinity = pool.match_digests(digests) / float(prompt.shape[0])
-        return self.affinity_weight * affinity - occupancy - queue
+                digests = digest_cache[(bs, salt)] = chain_digests(
+                    prompt, bs, salt)
+            affinity = (self.affinity_weight * pool.match_digests(digests)
+                        / float(prompt.shape[0]))
+        if adapter_id is not None and store is not None \
+                and store.resident(adapter_id):
+            affinity += self.adapter_affinity_weight
+        return affinity - occupancy - queue
 
-    def _candidates(self, prompt: np.ndarray,
-                    prefer: Optional[str]) -> List[_Replica]:
+    def _candidates(self, prompt: np.ndarray, prefer: Optional[str],
+                    adapter_id: Optional[str] = None) -> List[_Replica]:
         with self._lock:
             active = [r for r in self._replicas.values()
                       if r.state == ACTIVE]
@@ -290,11 +314,26 @@ class ReplicaRouter:
             raise NoReplicasAvailable(
                 "no ACTIVE replica (all draining or dead); add_replica() "
                 "or retry after a drain completes")
+        if adapter_id is not None:
+            # only replicas whose registry KNOWS the tenant can serve it
+            # — an unfiltered pick would abort placement on the replica's
+            # submit-time ValueError instead of failing over (e.g. a
+            # freshly added replica whose adapters haven't synced yet)
+            able = [r for r in active
+                    if (st := getattr(r.server.engine, "store", None))
+                    is not None and st.known(adapter_id)]
+            if not able:
+                raise ValueError(
+                    f"no ACTIVE replica knows adapter {adapter_id!r}; "
+                    f"AdapterStore.register()/load() it on at least one "
+                    f"replica")
+            active = able
         digest_cache: dict = {}
         scored = sorted(
             active,
             key=lambda r: (r.name != prefer,
-                           -self._score(r, prompt, digest_cache),
+                           -self._score(r, prompt, digest_cache,
+                                        adapter_id),
                            r.name))
         return scored
 
@@ -303,7 +342,8 @@ class ReplicaRouter:
         kwargs = handle._kwargs
         prompt = kwargs["prompt"]
         saw_full = False
-        for rep in self._candidates(prompt, prefer):
+        for rep in self._candidates(prompt, prefer,
+                                    kwargs.get("adapter_id")):
             try:
                 inner = rep.server.submit(**kwargs)
             except QueueFull:
@@ -339,7 +379,8 @@ class ReplicaRouter:
                top_p: float = 1.0, eos_token_id: Optional[int] = None,
                seed: Optional[int] = None,
                deadline: Optional[float] = None,
-               prefer: Optional[str] = None) -> RouterHandle:
+               prefer: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> RouterHandle:
         """Place one request on the best replica; returns a
         :class:`RouterHandle`. Same contract as
         :meth:`InferenceServer.submit`, plus:
@@ -349,15 +390,21 @@ class ReplicaRouter:
           survivor (still fresh randomness per request — the solo
           semantics);
         - ``prefer`` pins the first placement attempt to a named replica
-          (ops escape hatch; failover still applies)."""
+          (ops escape hatch; failover still applies);
+        - ``adapter_id`` adds adapter-affinity to placement: the tenant
+          lands where its pages are already device-resident when load
+          allows, and a reroute carries the adapter to the survivor."""
+        from ..lora.store import normalize_adapter_id
+
         prompt = np.asarray(prompt, np.int32).ravel()
+        adapter_id = normalize_adapter_id(adapter_id)
         if do_sample and seed is None:
             seed = int.from_bytes(os.urandom(7), "little")
         handle = RouterHandle(self, dict(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             do_sample=bool(do_sample), temperature=float(temperature),
             top_p=float(top_p), eos_token_id=eos_token_id, seed=seed,
-            deadline=deadline))
+            deadline=deadline, adapter_id=adapter_id))
         self._place(handle, prefer=prefer)
         return handle
 
@@ -395,6 +442,7 @@ class ReplicaRouter:
             failed = self.replicas_failed
         per_replica = {}
         hit = miss = completed = tokens = 0
+        per_adapter: Dict[str, dict] = {}
         for name, rep in reps:
             snap = (rep.server.snapshot() if rep.state != DEAD
                     else {"state": DEAD})
@@ -405,6 +453,11 @@ class ReplicaRouter:
             miss += snap.get("prefix_miss_tokens", 0)
             completed += snap.get("requests_completed", 0)
             tokens += snap.get("tokens_emitted", 0)
+            for a_name, e in snap.get("per_adapter", {}).items():
+                agg = per_adapter.setdefault(
+                    a_name, {"requests": 0, "tokens": 0})
+                agg["requests"] += e.get("requests", 0)
+                agg["tokens"] += e.get("tokens", 0)
         seen = hit + miss
         return {
             "replicas": per_replica,
@@ -416,4 +469,5 @@ class ReplicaRouter:
             "prefix_hit_tokens": hit,
             "prefix_miss_tokens": miss,
             "prefix_hit_rate": round(hit / seen, 4) if seen else 0.0,
+            **({"per_adapter": per_adapter} if per_adapter else {}),
         }

@@ -57,8 +57,11 @@ class Request:
     ``greedy``/``temperature``/``top_p``/``eos_token_id``/``seed`` map
     onto the engine's per-slot traced inputs; ``top_k`` (and whether
     top-p filtering exists at all) are engine statics chosen at server
-    construction. ``attempts`` counts admissions — the crash-recovery
-    requeue budget.
+    construction. ``adapter_id`` names the tenant's LoRA adapter in the
+    engine's :class:`~paddle_tpu.lora.AdapterStore` (``None`` = the base
+    model) — it resolves to a traced page-stack row at admission, so
+    which tenants share the batch is data, not program. ``attempts``
+    counts admissions — the crash-recovery requeue budget.
     """
 
     prompt: object
@@ -69,6 +72,7 @@ class Request:
     eos_token_id: Optional[int] = None
     seed: Optional[int] = None
     deadline: Optional[Deadline] = None
+    adapter_id: Optional[str] = None
     id: int = field(default_factory=lambda: next(_req_serial))
     attempts: int = 0
     handle: object = None  # back-pointer set by the server

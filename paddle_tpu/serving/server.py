@@ -40,6 +40,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from ..distributed.resilience import Deadline, fault_point
+from ..lora.store import AdapterError
 from .engine import ContinuousBatchingEngine
 from .metrics import ServingMetrics
 from .scheduler import FifoScheduler, QueueFull, Request, SchedulerClosed
@@ -98,6 +99,11 @@ class RequestHandle:
     def done(self) -> bool:
         return self._done_evt.is_set()
 
+    @property
+    def adapter_id(self):
+        """The tenant adapter this request decodes under (None = base)."""
+        return self.request.adapter_id
+
     def tokens(self) -> np.ndarray:
         """Tokens generated SO FAR (snapshot; may grow)."""
         with self._lock:
@@ -149,11 +155,12 @@ class InferenceServer:
                  max_prefills_per_step: int = 2,
                  top_k: int = 0, allow_top_p: bool = True,
                  max_request_retries: int = 1,
-                 prefix_cache=None):
+                 prefix_cache=None, adapter_store=None):
         self.engine = ContinuousBatchingEngine(
             network, slots=slots, max_length=max_length,
             prefill_buckets=prefill_buckets, top_k=top_k,
-            allow_top_p=allow_top_p, prefix_cache=prefix_cache)
+            allow_top_p=allow_top_p, prefix_cache=prefix_cache,
+            adapter_store=adapter_store)
         self.scheduler = FifoScheduler(
             max_queue_depth=max_queue_depth,
             max_prefills_per_step=max_prefills_per_step)
@@ -177,7 +184,8 @@ class InferenceServer:
                do_sample: bool = False, temperature: float = 1.0,
                top_p: float = 1.0, eos_token_id: Optional[int] = None,
                seed: Optional[int] = None,
-               deadline: Optional[float] = None) -> RequestHandle:
+               deadline: Optional[float] = None,
+               adapter_id: Optional[str] = None) -> RequestHandle:
         """Queue one generation request; returns immediately with a
         :class:`RequestHandle`. Raises ``ValueError`` on an impossible
         request (too long for the cache), :class:`QueueFull` when the
@@ -189,7 +197,13 @@ class InferenceServer:
         draws fresh randomness per request (also the solo semantics).
         ``deadline`` (seconds) bounds QUEUE WAIT: requests that can't
         start in time expire with ``TimeoutError`` instead of occupying
-        a slot nobody is waiting on."""
+        a slot nobody is waiting on.
+
+        ``adapter_id`` decodes the request under that tenant's LoRA
+        adapter (requires the server's engine to carry an
+        ``adapter_store`` that knows the name; ``None`` = base model).
+        Mixing adapters across the live batch is free — every slot
+        gathers its own pages inside the one compiled decode program."""
         from ..profiler import RecordEvent
 
         prompt = np.asarray(prompt, np.int32).ravel()
@@ -200,12 +214,28 @@ class InferenceServer:
                 "nucleus filter is not compiled into its sampling "
                 "graph); top_p requests would be silently ignored — "
                 "construct the server with allow_top_p=True")
+        from ..lora.store import normalize_adapter_id
+
+        adapter_id = normalize_adapter_id(adapter_id)
+        if adapter_id is not None:
+            store = self.engine.store
+            if store is None:
+                raise ValueError(
+                    f"request names adapter {adapter_id!r} but this "
+                    f"server has no adapter_store; construct it with "
+                    f"InferenceServer(..., adapter_store=AdapterStore("
+                    f"model, ...))")
+            if not store.known(adapter_id):
+                raise ValueError(
+                    f"unknown adapter {adapter_id!r}; AdapterStore."
+                    f"register()/load() it before submitting")
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             greedy=not do_sample, temperature=float(temperature),
             top_p=float(top_p), eos_token_id=eos_token_id,
             seed=None if seed is None else int(seed),
-            deadline=Deadline(deadline) if deadline is not None else None)
+            deadline=Deadline(deadline) if deadline is not None else None,
+            adapter_id=adapter_id)
         handle = RequestHandle(req)
         req.handle = handle
         self.start()
@@ -255,11 +285,14 @@ class InferenceServer:
     def snapshot(self) -> dict:
         """Metrics + compile-counter snapshot (see
         ``ServingMetrics.snapshot``), plus the block-pool occupancy/
-        eviction numbers when a prefix cache is attached."""
+        eviction numbers when a prefix cache is attached and the adapter
+        registry residency/eviction numbers when an adapter store is."""
         pool = self.engine.pool
+        store = self.engine.store
         return self.metrics.snapshot(
             self.engine.cache_stats(),
-            prefix_cache=None if pool is None else pool.stats())
+            prefix_cache=None if pool is None else pool.stats(),
+            adapter_store=None if store is None else store.stats())
 
     # ------------------------------------------------------------ worker
     def _loop(self) -> None:
@@ -312,6 +345,13 @@ class InferenceServer:
             for i, req in enumerate(admits):
                 try:
                     self._admit(req, self.engine.free_slots()[0])
+                except AdapterError as e:
+                    # raised host-side BEFORE any device dispatch: the
+                    # engine state is untouched, so only THIS request
+                    # fails (unknown adapter / registry at pin capacity)
+                    # — no reset, no requeue of innocents
+                    self.metrics.inc("requests_failed")
+                    req.handle._fail(e)
                 except Exception as e:
                     # the failing request AND the rest of this admission
                     # batch (popped but not yet admitted) must all reach
@@ -325,12 +365,15 @@ class InferenceServer:
         fault_point("serve.step")
         events = self.engine.step()
         self.metrics.inc("decode_steps")
+        per_adapter = self.engine.store is not None
         now = time.monotonic()
         for ev in events:
             req = self.engine.requests[ev.slot]
             h = req.handle
             h._push(ev.token)
             self.metrics.inc("tokens_emitted")
+            if per_adapter:
+                self.metrics.adapter_tokens(req.adapter_id)
             if h._last_token_t is not None:
                 self.metrics.observe_inter_token(now - h._last_token_t)
             h._last_token_t = now
@@ -353,9 +396,18 @@ class InferenceServer:
         h._push(first)
         self.metrics.inc("tokens_emitted")
         t1 = time.monotonic()
+        if self.engine.store is not None:
+            self.metrics.adapter_tokens(req.adapter_id)
         if h.ttft_s is None:  # a requeued request keeps its FIRST ttft
             h.ttft_s = t1 - h._submit_t
             self.metrics.observe_ttft(h.ttft_s)
+            if self.engine.store is not None:
+                # under the first-admission guard, like TTFT: a crash-
+                # requeued request is ONE request, not one per attempt
+                # (requests_submitted counts it once; per_adapter must
+                # agree or per-tenant goodput skews)
+                self.metrics.adapter_request(req.adapter_id)
+                self.metrics.observe_adapter_ttft(req.adapter_id, h.ttft_s)
         h._last_token_t = t1
         if fin or req.max_new_tokens == 1:
             # eos straight out of prefill: zero decode iterations

@@ -1,0 +1,566 @@
+"""Multi-tenant LoRA (paddle_tpu/lora + serving integration).
+
+The acceptance contract:
+
+1. **Per-tenant exactness** — a live batch mixing three adapters plus
+   the base model produces, for EVERY stream, exactly the tokens a solo
+   single-adapter ``generate()`` with the same seed produces (greedy and
+   seeded sampling);
+2. **Compile discipline** — with adapters enabled the serving loop still
+   holds at ``#prefill_buckets + 1`` programs, and adapter load/evict
+   churn (an ``AdapterStore`` buffer update) triggers ZERO compiles;
+3. **Registry safety** — LRU eviction is deterministic and reload is
+   bit-exact; pinned rows (live requests) never evict; a full-model
+   checkpoint is refused as an adapter and vice versa; an adapter
+   refuses to load onto a mismatched base (fingerprint);
+4. **Frozen-base training** — ``Model.fit(lora=...)`` moves only the
+   adapter pytree; base params stay bitwise identical and optimizer
+   state scales with the rank.
+
+Tier-1 budget discipline: ONE module-scoped injected model + store +
+server (ONE prefill bucket => two serving programs) shared by all
+integration tests; registry/metrics/router tests are device-free or
+device-light.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.lora import (AdapterError, AdapterFormatError, AdapterStore,
+                             LoraConfig, apply_lora, applied_config,
+                             base_fingerprint, clear_adapter, is_lora_param,
+                             load_adapter, lora_state, save_adapter,
+                             set_adapter)
+from paddle_tpu.serving import InferenceServer
+from paddle_tpu.serving.metrics import ServingMetrics
+
+GEO = dict(max_length=48, prefill_buckets=(12,))
+LCFG = LoraConfig(rank=4, alpha=8.0)
+
+
+def _tiny_cfg(**over):
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    base = dict(hidden_size=64, num_layers=2, num_heads=2, vocab_size=256,
+                max_position_embeddings=64, hidden_dropout_prob=0.0,
+                attention_dropout_prob=0.0, use_flash_attention=False)
+    base.update(over)
+    return gpt_tiny(**base)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from paddle_tpu.models.gpt import GPTForCausalLM
+
+    pt.seed(7)
+    model = GPTForCausalLM(_tiny_cfg())
+    model.eval()
+    base_out_params = {k: np.asarray(v) for k, v in model.named_parameters()}
+    apply_lora(model, LCFG)
+    return model, base_out_params
+
+
+@pytest.fixture(scope="module")
+def tenants(lm):
+    model, _ = lm
+    rng = np.random.default_rng(42)
+    zero = lora_state(model)
+    return {t: {k: rng.normal(0, 0.04, v.shape).astype(np.float32)
+                for k, v in zero.items()}
+            for t in ("t0", "t1", "t2")}
+
+
+@pytest.fixture(scope="module")
+def store(lm, tenants):
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=4)
+    for name, tree in tenants.items():
+        st.register(name, tree)
+    return st
+
+
+@pytest.fixture(scope="module")
+def server(lm, store):
+    model, _ = lm
+    srv = InferenceServer(model, slots=3, adapter_store=store,
+                          max_queue_depth=16, **GEO)
+    yield srv
+    try:
+        srv.shutdown(drain=False, timeout=30)
+    except Exception:
+        pass
+
+
+def _prompt(n, seed):
+    return np.random.default_rng(seed).integers(0, 256, (n,)).astype(
+        np.int32)
+
+
+# ------------------------------------------------------------ unit: config
+def test_lora_config_validation():
+    with pytest.raises(ValueError):
+        LoraConfig(rank=0)
+    with pytest.raises(ValueError):
+        LoraConfig(dropout=1.0)
+    cfg = LoraConfig(rank=8, alpha=16.0, target_modules=["q_proj"])
+    assert cfg.scaling == 2.0
+    assert cfg.target_modules == ("q_proj",)
+    assert is_lora_param("gpt.h.0.attn.qkv_proj.lora_A")
+    assert not is_lora_param("gpt.h.0.attn.qkv_proj.weight")
+
+
+def test_apply_lora_idempotent_and_conflicts(lm):
+    model, _ = lm
+    assert applied_config(model) == LCFG
+    apply_lora(model, LCFG)  # same config: no-op
+    with pytest.raises(ValueError, match="refusing to stack"):
+        apply_lora(model, LoraConfig(rank=2))
+    # a model with neither lora_spec nor explicit targets is rejected
+    from paddle_tpu.nn.layer import Layer
+
+    class Bare(Layer):
+        pass
+
+    with pytest.raises(ValueError, match="target_modules"):
+        apply_lora(Bare(), LoraConfig())
+    with pytest.raises(ValueError, match="matched"):
+        apply_lora(Bare(), LoraConfig(target_modules=("nope",)))
+
+
+def test_injection_is_base_identical_until_trained(lm):
+    """B = 0 at injection: bitwise no-op; set_adapter changes outputs;
+    clear_adapter restores base bitwise."""
+    from paddle_tpu.models.gpt import GPTForCausalLM
+
+    pt.seed(7)
+    fresh = GPTForCausalLM(_tiny_cfg())
+    fresh.eval()
+    x = _prompt(8, 0)[None]
+    base = np.asarray(fresh(x))
+    apply_lora(fresh, LCFG)
+    assert np.array_equal(base, np.asarray(fresh(x)))
+    rng = np.random.default_rng(5)
+    set_adapter(fresh, {k: rng.normal(0, 0.05, v.shape).astype(np.float32)
+                        for k, v in lora_state(fresh).items()})
+    assert not np.array_equal(base, np.asarray(fresh(x)))
+    clear_adapter(fresh)
+    assert np.array_equal(base, np.asarray(fresh(x)))
+
+
+def test_set_adapter_rejects_mismatch(lm, tenants):
+    model, _ = lm
+    good = tenants["t0"]
+    with pytest.raises(ValueError, match="missing"):
+        set_adapter(model, dict(list(good.items())[:-1]))
+    k0 = next(iter(good))
+    with pytest.raises(ValueError, match="shape"):
+        set_adapter(model, {**good, k0: np.zeros((3, 3), np.float32)})
+    clear_adapter(model)
+
+
+# -------------------------------------------------------- training (fit)
+def test_fit_trains_only_adapter_pytree():
+    from paddle_tpu import hapi
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.optimizer import Adam
+
+    pt.seed(3)
+    net = GPTForCausalLM(_tiny_cfg())
+    base_before = {k: np.asarray(v) for k, v in net.named_parameters()}
+    m = hapi.Model(net)
+    m.prepare(optimizer=Adam(learning_rate=1e-2, parameters=[]),
+              loss=lambda out, labels: net.loss(out, labels))
+    data = [(_prompt(10, i).reshape(2, 5),) * 2 for i in range(3)]
+    m.fit(data, epochs=2, verbose=0, lora=LoraConfig(rank=2, alpha=4.0))
+    step = m._train_step
+    # only adapter leaves are optimized...
+    assert all(is_lora_param(k) for k in step.params)
+    # ...the frozen base rides the buffers bitwise unchanged...
+    for k, v in base_before.items():
+        assert np.array_equal(v, np.asarray(step.buffers[k])), k
+    # ...the adapter actually moved...
+    assert any(not np.allclose(np.asarray(v), 0.0)
+               for k, v in step.params.items() if k.endswith("lora_B"))
+    # ...and optimizer state is rank-sized, not model-sized
+    import jax
+
+    opt_floats = sum(int(np.prod(l.shape)) for l in
+                     jax.tree_util.tree_leaves(step.opt_state)
+                     if hasattr(l, "shape"))
+    model_floats = sum(int(np.prod(v.shape)) for v in base_before.values())
+    assert opt_floats < model_floats / 10
+    # a later PLAIN fit must not silently keep the base frozen
+    m.fit(data, epochs=1, verbose=0)
+    step2 = m._train_step
+    assert step2 is not step and step2._trainable is None
+    assert any(not np.array_equal(base_before[k], np.asarray(v))
+               for k, v in step2.params.items() if k in base_before)
+
+
+# -------------------------------------------------- registry: disk format
+def test_adapter_save_load_roundtrip(lm, tenants, tmp_path):
+    model, _ = lm
+    set_adapter(model, tenants["t0"])
+    d = str(tmp_path / "t0")
+    save_adapter(d, model)
+    clear_adapter(model)
+    state, meta = load_adapter(d, model)
+    assert meta["rank"] == LCFG.rank
+    assert meta["base_fingerprint"] == base_fingerprint(model)
+    for k, v in state.items():
+        assert np.allclose(np.asarray(v), tenants["t0"][k]), k
+
+
+def test_format_guards_both_directions(lm, tmp_path):
+    """An adapter checkpoint refuses to restore a full model; a full
+    checkpoint refuses to load as an adapter."""
+    from paddle_tpu.distributed.checkpoint import load_state, save_state
+
+    model, _ = lm
+    adir = str(tmp_path / "adapter")
+    save_adapter(adir, model)
+    # adapter -> full-model restore: named ValueError, not missing-leaves
+    with pytest.raises(ValueError, match="LoRA ADAPTER checkpoint"):
+        load_state(adir, template=dict(model.state_dict()))
+    # full -> adapter loader: AdapterFormatError
+    fdir = str(tmp_path / "full")
+    save_state(dict(model.state_dict()), fdir)
+    with pytest.raises(AdapterFormatError, match="not a LoRA adapter"):
+        load_adapter(fdir, model)
+    with pytest.raises(AdapterFormatError):
+        AdapterStore(model, max_loaded=2).load("x", fdir)
+
+
+def test_fingerprint_mismatch_rejected(lm, tmp_path):
+    """An adapter saved against one base hard-fails onto another
+    architecture."""
+    from paddle_tpu.models.gpt import GPTForCausalLM
+
+    model, _ = lm
+    adir = str(tmp_path / "t")
+    save_adapter(adir, model)
+    pt.seed(9)
+    other = GPTForCausalLM(_tiny_cfg(hidden_size=32, num_heads=2))
+    apply_lora(other, LCFG)
+    with pytest.raises(AdapterFormatError, match="fingerprint"):
+        load_adapter(adir, other)
+    # geometry mismatch is equally fatal even on the right base
+    pt.seed(7)
+    same_arch = GPTForCausalLM(_tiny_cfg())
+    apply_lora(same_arch, LoraConfig(rank=2, alpha=8.0))
+    with pytest.raises(AdapterFormatError, match="rank"):
+        load_adapter(adir, same_arch)
+
+
+# --------------------------------------------------- registry: residency
+def test_store_lru_eviction_and_reload_determinism(lm, tenants):
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=2)
+    for name, tree in tenants.items():
+        st.register(name, tree)
+
+    def pages_of(name):
+        row = st.loaded()[name]
+        return {p: (np.asarray(a[row]), np.asarray(b[row]))
+                for p, (a, b) in st.tensors.items()}
+
+    s0 = st.acquire("t0"); st.release(s0)
+    first_pages = pages_of("t0")
+    s1 = st.acquire("t1"); st.release(s1)
+    assert set(st.loaded()) == {"t0", "t1"}
+    # t2 must evict the LRU resident (t0)
+    s2 = st.acquire("t2"); st.release(s2)
+    assert set(st.loaded()) == {"t1", "t2"}
+    assert st.stats()["evictions"] == 1
+    # reload of the evicted adapter is bit-exact and deterministic
+    s0b = st.acquire("t0"); st.release(s0b)
+    again = pages_of("t0")
+    for p in first_pages:
+        assert np.array_equal(first_pages[p][0], again[p][0])
+        assert np.array_equal(first_pages[p][1], again[p][1])
+    # unknown adapters fail host-side with the named error
+    with pytest.raises(AdapterError, match="unknown adapter"):
+        st.acquire("nope")
+
+
+def test_store_pinned_rows_never_evict(lm, tenants):
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=2)
+    for name, tree in tenants.items():
+        st.register(name, tree)
+    a = st.acquire("t0")
+    b = st.acquire("t1")
+    # both rows pinned: a third tenant cannot stage
+    with pytest.raises(AdapterError, match="pinned"):
+        st.acquire("t2")
+    st.release(b)
+    # now t2 evicts the UNPINNED t1, never the pinned t0
+    st.acquire("t2")
+    assert set(st.loaded()) == {"t0", "t2"}
+    st.release_all()
+    # base rows acquire/release without touching residency
+    assert st.acquire(None) == 0 and st.acquire("base") == 0
+    st.release_all()
+
+
+def test_reregister_bumps_cache_namespace(lm, tenants):
+    """Pushing a NEW version of an adapter must orphan prefix-cache
+    blocks its old weights computed: the digest salt embeds the
+    registration version."""
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=2)
+    st.register("t0", tenants["t0"])
+    s1 = st.salt("t0")
+    assert s1.startswith(b"lora:t0@")
+    st.register("t0", tenants["t1"])   # adapter update
+    s2 = st.salt("t0")
+    assert s1 != s2
+    assert st.salt(None) == st.salt("base") == b""
+
+
+def test_reregister_never_swaps_pages_under_a_pin(lm, tenants):
+    """Updating a RESIDENT adapter while streams decode against it must
+    not rewrite the pinned row: old streams keep the old pages (the row
+    is orphaned and frees when they finish); new acquires stage the new
+    pages into a fresh row."""
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=3)
+    st.register("t0", tenants["t0"])
+    row = st.acquire("t0")              # a live stream pins the row
+    before = np.asarray(st.tensors[st.paths[0]][0][row])
+    st.register("t0", tenants["t1"])    # push v2 mid-stream
+    after = np.asarray(st.tensors[st.paths[0]][0][row])
+    assert np.array_equal(before, after)        # pinned pages untouched
+    assert "t0" not in st.loaded()              # name unmapped
+    row2 = st.acquire("t0")                     # v2 stages into a FRESH row
+    assert row2 != row
+    # the orphaned-but-pinned row is not handed out as free
+    st.register("t2", tenants["t2"])
+    assert st.acquire("t2") not in (row, row2)
+    st.release_all()
+
+
+def test_store_register_validation(lm, tenants):
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=2)
+    with pytest.raises(ValueError):
+        st.register("base", tenants["t0"])
+    bad = dict(tenants["t0"])
+    bad.popitem()
+    with pytest.raises(AdapterFormatError, match="lacks"):
+        st.register("x", bad)
+
+
+# ------------------------------------------------- serving: THE acceptance
+@pytest.fixture(scope="module")
+def mixed_run(lm, tenants, server):
+    """Submit a staggered batch mixing 3 adapters + base (greedy and
+    seeded sampling) and capture solo references for every stream."""
+    model, _ = lm
+    reqs = [("t0", _prompt(7, 1), dict(max_new_tokens=6)),
+            (None, _prompt(9, 2), dict(max_new_tokens=5)),
+            ("t1", _prompt(5, 3), dict(max_new_tokens=7, do_sample=True,
+                                       temperature=0.8, seed=11)),
+            ("t2", _prompt(8, 4), dict(max_new_tokens=6, do_sample=True,
+                                       temperature=0.7, top_p=0.9,
+                                       seed=12)),
+            ("t0", _prompt(6, 5), dict(max_new_tokens=4, do_sample=True,
+                                       seed=13))]
+    solos = []
+    for tid, p, kw in reqs:
+        if tid is None:
+            clear_adapter(model)
+        else:
+            set_adapter(model, tenants[tid])
+        solos.append(model.generate(p[None], **kw, **GEO)[0])
+    clear_adapter(model)
+    handles = []
+    for tid, p, kw in reqs:
+        handles.append(server.submit(p, adapter_id=tid, **kw))
+        time.sleep(0.05)   # arrive while earlier requests are mid-decode
+    results = [h.result(timeout=300) for h in handles]
+    return reqs, solos, results
+
+
+def test_mixed_adapter_batch_matches_solo(mixed_run):
+    """THE acceptance: every stream of a batch mixing >=3 adapters plus
+    base is token-identical to the solo single-adapter generate with the
+    same seed — greedy and seeded sampling."""
+    reqs, solos, results = mixed_run
+    for (tid, _, _), solo, got in zip(reqs, solos, results):
+        np.testing.assert_array_equal(got, solo, err_msg=f"adapter={tid}")
+
+
+def test_compile_budget_holds_with_adapters(lm, store, server, tenants,
+                                            mixed_run):
+    """Steady state stays at #prefill_buckets + 1 programs with adapters
+    enabled, and LRU load/evict churn adds ZERO compiles."""
+    from paddle_tpu.framework import compile_cache
+
+    cc = server.engine.cache_stats()
+    assert cc["prefill"]["compiles"] == len(server.engine.prefill_buckets)
+    assert cc["decode"]["compiles"] == 1
+    with compile_cache.retrace_guard(max_compiles=0, label="lora-serving"):
+        hs = [server.submit(_prompt(4 + i, 20 + i),
+                            adapter_id=("t0", "t1", "t2", None)[i % 4],
+                            max_new_tokens=3, do_sample=bool(i % 2),
+                            seed=i) for i in range(6)]
+        for h in hs:
+            assert h.result(timeout=300).shape[0] == 3
+    cc2 = server.engine.cache_stats()
+    assert cc2["prefill"]["compiles"] == cc["prefill"]["compiles"]
+    assert cc2["decode"]["compiles"] == 1
+
+
+def test_adapter_submit_validation(lm, server):
+    model, _ = lm
+    with pytest.raises(ValueError, match="unknown adapter"):
+        server.submit(_prompt(5, 0), adapter_id="nobody")
+    bare = InferenceServer(model, slots=1, **GEO)
+    with pytest.raises(ValueError, match="no adapter_store"):
+        bare.submit(_prompt(5, 0), adapter_id="t0")
+
+
+def test_store_is_owned_by_one_engine(lm, store, server):
+    """Pins are engine-lifecycle state: attaching one store to a second
+    replica would let either engine's crash reset void the other's live
+    pins (same sharing hazard BlockPool guards)."""
+    model, _ = lm
+    with pytest.raises(ValueError, match="one store per replica"):
+        InferenceServer(model, slots=1, adapter_store=store, **GEO)
+
+
+def test_acquire_with_salt_is_atomic(lm, tenants):
+    """The admission path pins pages and captures the digest salt in one
+    lock hold, so a concurrent adapter update cannot stamp old-weight
+    K/V into the new version's namespace."""
+    model, _ = lm
+    st = AdapterStore(model, max_loaded=2)
+    st.register("t0", tenants["t0"])
+    row, salt = st.acquire("t0", with_salt=True)
+    assert salt == st.salt("t0")
+    st.register("t0", tenants["t1"])    # version bump mid-flight
+    assert st.salt("t0") != salt        # new namespace for new pages
+    assert st.acquire(None, with_salt=True) == (0, b"")
+    st.release_all()
+
+
+def test_base_alias_is_one_namespace(server, mixed_run):
+    """adapter_id="base" is the zero adapter: same stream, same metrics
+    key, no split cache namespace."""
+    p = _prompt(6, 77)
+    a = server.submit(p, max_new_tokens=4).result(timeout=300)
+    b = server.submit(p, adapter_id="base",
+                      max_new_tokens=4).result(timeout=300)
+    np.testing.assert_array_equal(a, b)
+    per = server.snapshot()["per_adapter"]
+    assert "base" in per and None not in per
+
+
+def test_snapshot_surfaces_per_adapter(server, mixed_run):
+    snap = server.snapshot()
+    per = snap["per_adapter"]
+    assert {"base", "t0", "t1", "t2"} <= set(per)
+    for e in per.values():
+        assert e["requests"] >= 1 and e["tokens"] >= 1
+        assert "ttft_p50_ms" in e
+    assert snap["adapter_store"]["resident"] >= 1
+    assert snap["adapter_store"]["rank"] == LCFG.rank
+
+
+# ------------------------------------------------- device-free satellites
+def test_metrics_per_adapter_block():
+    m = ServingMetrics(slots=2)
+    m.adapter_request("a")
+    m.adapter_tokens("a", 5)
+    m.observe_adapter_ttft("a", 0.1)
+    m.adapter_request(None)
+    m.adapter_tokens(None, 2)
+    snap = m.snapshot()
+    assert snap["per_adapter"]["a"] == {
+        "requests": 1, "tokens": 5, "ttft_p50_ms": 100.0}
+    assert snap["per_adapter"]["base"]["tokens"] == 2
+    m.reset()
+    assert "per_adapter" not in m.snapshot()
+
+
+def test_prefix_digest_salt_isolates_tenants():
+    from paddle_tpu.serving.prefix_cache import chain_digests
+
+    toks = np.arange(33, dtype=np.int32)
+    base = chain_digests(toks, 8)
+    t0 = chain_digests(toks, 8, salt=b"lora:t0")
+    t1 = chain_digests(toks, 8, salt=b"lora:t1")
+    assert len(base) == len(t0) == 4
+    assert all(a != b for a, b in zip(base, t0))
+    assert all(a != b for a, b in zip(t0, t1))
+    assert t0 == chain_digests(toks, 8, salt=b"lora:t0")
+
+
+class _StubStore:
+    def __init__(self, resident, known=None):
+        self._resident = set(resident)
+        self._known = set(known) if known is not None else set(resident)
+
+    def resident(self, name):
+        return name in self._resident
+
+    def known(self, name):
+        return name in (None, "base") or name in self._known
+
+    def salt(self, name):
+        return (b"" if name in (None, "base")
+                else b"lora:%s@1" % str(name).encode())
+
+
+def test_router_skips_replicas_without_the_adapter():
+    """A replica whose registry does not know the tenant is excluded
+    from placement (instead of aborting it with its submit-time
+    ValueError); a fleet with no knowing replica names the problem."""
+    from paddle_tpu.serving import ReplicaRouter
+    from tests.test_fleet_serving import _StubServer
+
+    knows = _StubServer(active=3, slots=4)     # busy but able
+    ignorant = _StubServer(active=0, slots=4)  # idle but unable
+    knows.engine.store = _StubStore({"tenant-a"})
+    ignorant.engine.store = _StubStore(())
+    r = ReplicaRouter()
+    r.add_replica(knows, "knows")
+    r.add_replica(ignorant, "ignorant")
+    r.submit(np.arange(8, dtype=np.int32), max_new_tokens=2,
+             adapter_id="tenant-a").result(timeout=30)
+    assert knows.submitted and not ignorant.submitted
+    with pytest.raises(ValueError, match="knows adapter"):
+        r.submit(np.arange(8, dtype=np.int32), adapter_id="tenant-b")
+
+
+def test_router_adapter_affinity_prefers_warm_replica():
+    """Device-free: the router places a tenant where its pages are
+    resident, but load still outweighs warmth."""
+    from paddle_tpu.serving import ReplicaRouter
+    from tests.test_fleet_serving import _StubServer
+
+    warm = _StubServer(active=1, slots=4)
+    cold = _StubServer(active=0, slots=4)
+    warm.engine.store = _StubStore({"tenant-a"})
+    cold.engine.store = _StubStore((), known={"tenant-a"})
+    r = ReplicaRouter(adapter_affinity_weight=0.5)
+    r.add_replica(warm, "warm")
+    r.add_replica(cold, "cold")
+    h = r.submit(np.arange(8, dtype=np.int32), max_new_tokens=2,
+                 adapter_id="tenant-a")
+    h.result(timeout=30)
+    assert warm.submitted and not cold.submitted
+    # without the adapter the same skew places on the idle replica
+    h2 = r.submit(np.arange(8, dtype=np.int32), max_new_tokens=2)
+    h2.result(timeout=30)
+    assert cold.submitted
+    # a heavily loaded warm replica loses to the idle cold one
+    warm.engine.active_count = 4
+    warm.scheduler.depth = 6
+    h3 = r.submit(np.arange(8, dtype=np.int32), max_new_tokens=2,
+                  adapter_id="tenant-a")
+    h3.result(timeout=30)
+    assert len(cold.submitted) == 2

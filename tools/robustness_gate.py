@@ -27,7 +27,16 @@ story. Runs, in order:
    requests onto the survivor (zero lost), seeded-greedy probes must
    stay token-identical to a solo ``generate`` (no divergence across the
    reroute), and the survivor must hold its #buckets+1 compile budget
-   with zero steady-state recompiles.
+   with zero steady-state recompiles;
+5. with ``--lora``, ``tools/lora_soak.py`` — the multi-tenant adapter
+   lifecycle: fine-tune a tiny adapter 20 steps under the supervisor,
+   hard-kill the process mid-checkpoint-save, resume from the newest
+   complete checkpoint, finish, publish the adapter, then serve it
+   mixed with base traffic — zero lost requests, zero steady-state
+   recompiles, token parity vs solo generate. A scoped
+   ``tpu_lint paddle_tpu/lora`` run (0 findings, reasoned suppressions
+   only) rides in the same stage so the new subsystem cannot regress
+   trace discipline even when the full-repo lint stage is skipped.
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -37,6 +46,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --skip-sweep   # lint + soak only
     python tools/robustness_gate.py --elastic      # + shrink/grow proof
     python tools/robustness_gate.py --fleet        # + serving-fleet crash
+    python tools/robustness_gate.py --lora         # + adapter lifecycle
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -76,6 +86,10 @@ def main() -> int:
                     help="also run the serving-fleet replica-crash "
                          "scenario (router reroute, token parity, "
                          "compile budget)")
+    ap.add_argument("--lora", action="store_true",
+                    help="also run the multi-tenant LoRA lifecycle "
+                         "(train, SIGKILL mid-save, resume, serve mixed "
+                         "+ scoped tpu_lint of paddle_tpu/lora)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     args = ap.parse_args()
@@ -103,6 +117,14 @@ def main() -> int:
                       "--check", "--replicas", "2", "--prefix-cache-mb",
                       "4", "--prefix-tokens", "24", "--crash-replica",
                       "--verify", "3"])
+    if args.lora:
+        results["lora"] = _run(
+            "lora", [sys.executable, os.path.join(TOOLS, "lora_soak.py")])
+        results["lora_lint"] = _run(
+            "lora_lint", [sys.executable,
+                          os.path.join(TOOLS, "tpu_lint.py"),
+                          os.path.join("paddle_tpu", "lora"),
+                          "--no-baseline"])
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,

@@ -27,6 +27,21 @@ Fleet knobs:
   and, for the ``--verify K`` seeded-greedy probes, no token divergence
   vs a solo ``generate`` (the fleet robustness gate).
 
+Multi-tenant LoRA knobs:
+
+- ``--adapters N`` registers N synthetic tenants (rank ``--adapter-rank``
+  LoRA adapters on the attention+MLP projections) in a per-replica
+  ``AdapterStore``; an ``--adapter-frac`` share of requests carries a
+  tenant id drawn Zipf-style (skewed popularity — the realistic shape);
+- ``--max-loaded`` caps device-resident adapters per replica (default:
+  all N), so a smaller value exercises LRU load/evict churn under load —
+  which must stay recompile-free;
+- ``--verify`` probes with a tenant id are checked token-exact against a
+  solo ``generate`` with that adapter's weights loaded.
+
+The JSON gains a ``per_adapter`` block (offered/completed/tokens/TTFT
+p50 per tenant) plus registry load/evict totals.
+
 Warmup touches every prefill bucket on every replica first; the
 measured window must then hold at ``#buckets + 1`` programs per replica
 — ANY steady-state recompile exits non-zero (the serving analogue of
@@ -99,6 +114,17 @@ def main(argv=None) -> int:
     ap.add_argument("--verify", type=int, default=0,
                     help="seeded-greedy probes checked token-exact "
                          "against a solo generate after the window")
+    # ---- multi-tenant LoRA knobs ----
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="register N synthetic LoRA tenants per replica "
+                         "(0 = base-only trace)")
+    ap.add_argument("--adapter-frac", type=float, default=0.7,
+                    help="share of requests carrying a tenant id "
+                         "(Zipf-skewed popularity over --adapters)")
+    ap.add_argument("--adapter-rank", type=int, default=4)
+    ap.add_argument("--max-loaded", type=int, default=0,
+                    help="device-resident adapters per replica (0 = all "
+                         "of --adapters; smaller exercises LRU churn)")
     args = ap.parse_args(argv)
     if args.check:
         args.requests = min(args.requests, 8)
@@ -143,6 +169,32 @@ def main(argv=None) -> int:
             f"{args.prefix_tokens + args.block_tokens})")
     prefix_cache = (int(args.prefix_cache_mb * (1 << 20))
                     if args.prefix_cache_mb > 0 else None)
+
+    # ---- multi-tenant LoRA: N synthetic adapters, one store per replica
+    tenant_names, tenant_trees, stores = [], {}, []
+    if args.adapters > 0:
+        from paddle_tpu.lora import (AdapterStore, LoraConfig, apply_lora,
+                                     lora_state)
+
+        lcfg = LoraConfig(rank=args.adapter_rank, alpha=2.0 * args.adapter_rank)
+        apply_lora(model, lcfg)
+        zero = lora_state(model)
+        arng = np.random.default_rng(args.seed + 777)
+        tenant_names = [f"tenant{k}" for k in range(args.adapters)]
+        for name in tenant_names:
+            tenant_trees[name] = {
+                k: arng.normal(0.0, 0.02, v.shape).astype(np.float32)
+                for k, v in zero.items()}
+        max_loaded = args.max_loaded or args.adapters
+        for _ in range(args.replicas):
+            store = AdapterStore(model, lcfg, max_loaded=max_loaded)
+            for name in tenant_names:
+                store.register(name, tenant_trees[name])
+            stores.append(store)
+        # Zipf-ish popularity: a few hot tenants, a long cool tail
+        zipf_w = np.array([1.0 / (k + 1) ** 1.1
+                           for k in range(args.adapters)])
+        zipf_w /= zipf_w.sum()
     servers = [
         InferenceServer(
             model, slots=args.slots, max_length=max_length,
@@ -150,8 +202,9 @@ def main(argv=None) -> int:
             max_queue_depth=args.max_queue_depth,
             prefix_cache=(dict(max_bytes=prefix_cache,
                                block_tokens=args.block_tokens)
-                          if prefix_cache else None))
-        for _ in range(args.replicas)]
+                          if prefix_cache else None),
+            adapter_store=stores[i] if stores else None)
+        for i in range(args.replicas)]
     fleet = args.replicas > 1
     router = None
     if fleet:
@@ -175,6 +228,13 @@ def main(argv=None) -> int:
             sfx = prompt(int(rng.integers(2, args.block_tokens + 1)))
             return np.concatenate([shared_prefix, sfx])
         return prompt(int(rng.integers(4, max(lens) + 1)))
+
+    def trace_tenant(i):
+        """Per-request tenant id: an --adapter-frac share of requests
+        carries one, drawn Zipf-style over the registered adapters."""
+        if not tenant_names or rng.random() >= args.adapter_frac:
+            return None
+        return tenant_names[int(rng.choice(args.adapters, p=zipf_w))]
 
     # ---- warmup: touch every bucket + the decode program, per replica ----
     t_warm = time.perf_counter()
@@ -210,6 +270,7 @@ def main(argv=None) -> int:
                   if crash_at is not None
                   else set(range(args.verify)))
     verify_solo = {}
+    tenant_of = {}
     handles, rejected = [], 0
     t0 = time.perf_counter()
     for i in range(args.requests):
@@ -223,14 +284,16 @@ def main(argv=None) -> int:
             crashed_replica = names[-1]
             servers[-1].shutdown(drain=False, timeout=60.0)
         p = trace_prompt(i)
+        tid = trace_tenant(i)
+        tenant_of[i] = tid
         verify = i in verify_idx
         kw = dict(max_new_tokens=args.new_tokens, seed=args.seed + i,
-                  deadline=args.deadline)
+                  deadline=args.deadline, adapter_id=tid)
         if verify:
             # correctness probes must not expire on the SLO — a queue-wait
             # miss would masquerade as token divergence
             kw["deadline"] = None
-            verify_solo[i] = p          # greedy + seeded: reproducible
+            verify_solo[i] = (p, tid)   # greedy + seeded: reproducible
         else:
             kw.update(do_sample=bool(i % 2), temperature=0.8, top_p=0.95)
         try:
@@ -262,16 +325,27 @@ def main(argv=None) -> int:
     # gate), not nondeterminism
     verify_failures = 0
     verify_compared = 0
-    for i, p in verify_solo.items():
+    if verify_solo and stores:
+        from paddle_tpu.lora import clear_adapter, set_adapter
+    for i, (p, tid) in verify_solo.items():
         got = results.get(i)
         if got is None:
             continue
         verify_compared += 1
+        if stores:
+            # the tenant's solo reference runs with ITS adapter loaded
+            # into the model's own leaves (engines hold their snapshot)
+            if tid is None:
+                clear_adapter(model)
+            else:
+                set_adapter(model, tenant_trees[tid])
         solo = model.generate(
             p[None], max_new_tokens=args.new_tokens,
             max_length=max_length, prefill_buckets=tuple(args.buckets))[0]
         if not np.array_equal(np.asarray(got), solo):
             verify_failures += 1
+    if verify_solo and stores:
+        clear_adapter(model)
     # the solo engine above compiles its own programs; they are not
     # serving-loop recompiles
     live = [s for i, s in enumerate(servers)
@@ -297,6 +371,28 @@ def main(argv=None) -> int:
         if cc["prefill"]["compiles"] + cc["decode"]["compiles"] > budget]
     occ = (sum(sn["slot_occupancy"] for sn in snaps) / len(snaps)
            if snaps else 0.0)
+
+    per_adapter = {}
+    if stores:
+        # offered/completed per tenant from the trace bookkeeping,
+        # merged with the servers' per_adapter metric blocks
+        for i, tid in tenant_of.items():
+            name = tid or "base"
+            e = per_adapter.setdefault(
+                name, {"offered": 0, "completed": 0, "tokens": 0,
+                       "ttft_p50_ms": 0.0})
+            e["offered"] += 1
+            if i in results:
+                e["completed"] += 1
+        for sn in snaps:
+            for name, m in sn.get("per_adapter", {}).items():
+                e = per_adapter.setdefault(
+                    name, {"offered": 0, "completed": 0, "tokens": 0,
+                           "ttft_p50_ms": 0.0})
+                e["tokens"] += m["tokens"]
+                e["ttft_p50_ms"] = max(e["ttft_p50_ms"], m["ttft_p50_ms"])
+        adapter_loads = sum(st.stats()["loads"] for st in stores)
+        adapter_evictions = sum(st.stats()["evictions"] for st in stores)
 
     record = {
         "metric": f"{args.model}_serve_requests_per_sec",
@@ -347,6 +443,14 @@ def main(argv=None) -> int:
                 "verify_compared": verify_compared,
                 "verify_failures": verify_failures}
                if args.verify else {}),
+            **({"adapters": args.adapters,
+                "adapter_frac": args.adapter_frac,
+                "adapter_rank": args.adapter_rank,
+                "max_loaded": args.max_loaded or args.adapters,
+                "adapter_loads": adapter_loads,
+                "adapter_evictions": adapter_evictions,
+                "per_adapter": per_adapter}
+               if stores else {}),
         },
     }
     print(json.dumps(record))
