@@ -878,6 +878,15 @@ def _run_benches(backend: str):
          # the serving-side secondary metrics, hoisted for trend tracking
          "gpt_decode_tokens_per_sec": decode.get("tokens_per_sec", 0.0),
          "gpt_serve_requests_per_sec": serve.get("requests_per_sec", 0.0)})
+    try:
+        # unified-registry scrape: the BENCH artifact carries the run's
+        # counters/occupancy/compile numbers next to its throughput (a
+        # telemetry failure must never sink the measured primary metric)
+        from paddle_tpu.observability import default_registry
+
+        primary["extra"]["metrics"] = default_registry().snapshot()
+    except Exception:
+        pass
     print(json.dumps(primary))
 
 

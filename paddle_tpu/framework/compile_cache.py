@@ -108,6 +108,19 @@ def record_trace(name: str, signature: str) -> None:
         e.last_trace_signature = signature
         _last_trace_signature = signature
         guards = list(_guards)
+    try:
+        # compiles are rare and exactly what a crash postmortem wants:
+        # land each one in the flight-recorder ring and the trace buffer
+        # (host-side bookkeeping only — the trace itself is already paying
+        # seconds; telemetry failures must never break it)
+        from ..observability import flight as _flight
+        from ..observability import tracing as _tracing
+
+        _flight.note("compile", corr=_tracing.current(), program=name,
+                     signature=signature[:200])
+        _tracing.record_event("compile", program=name)
+    except Exception:
+        pass
     for g in guards:
         g._on_trace(name, signature)
 

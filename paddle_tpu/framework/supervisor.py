@@ -50,6 +50,8 @@ from typing import Callable, List, Optional, Set, Tuple
 
 from ..distributed.resilience import (  # noqa: F401  (EXIT_* re-exported)
     Deadline, EXIT_HANG, EXIT_PREEMPTED, InjectedFault, fault_point)
+from ..observability import flight as _flight
+from ..observability import tracing as _tracing
 
 __all__ = [
     "RecoveryPolicy", "TrainingSupervisor", "NumericsWatchdog",
@@ -247,6 +249,13 @@ class HangWatchdog:
             self._fired = True
             self.hangs_detected += 1
             profiler.bump_counter("train.hang")
+            # flight-record the incident BEFORE any exit path: a hard
+            # os._exit leaves nothing else behind. The watcher thread has
+            # no step correlation id of its own — the dump's span tail
+            # carries the last step's.
+            _flight.dump("hang", extra={"elapsed_s": round(elapsed, 3),
+                                        "step_timeout_s": self.step_timeout,
+                                        "action": self.action})
             msg = (f"train step exceeded step_timeout={self.step_timeout}s "
                    f"(no heartbeat for {elapsed:.1f}s) — stuck H2D or hung "
                    f"collective?")
@@ -378,6 +387,10 @@ class TrainingSupervisor:
         if self.preempt is not None:
             self.preempt.uninstall()
         self.checkpoint.wait()
+        # the last step's correlation id (stamped by before_batch) must
+        # not leak past the supervised run: a later generate() on this
+        # thread would inherit the stale train-step lane
+        _tracing.set_current(None)
 
     def __enter__(self) -> "TrainingSupervisor":
         return self.start()
@@ -519,7 +532,14 @@ class TrainingSupervisor:
         """Fault sites ahead of the dispatch: a ``delay`` rule at
         ``train.step`` stalls (exercising the hang watchdog), a ``crash``
         kills the process, and a ``drop`` at ``train.data`` poisons the
-        upcoming batch through the step's NaN seam."""
+        upcoming batch through the step's NaN seam.
+
+        Also the training side's correlation-id mint: each step boundary
+        stamps the thread's tracing id, so spans and flight-recorder
+        dumps (anomaly, rollback, preemption) attribute to the step that
+        caused them."""
+        _tracing.set_current(
+            f"train-{os.getpid():x}-s{int(self.step._count)}")
         fault_point("train.step")
         try:
             fault_point("train.data")
@@ -561,6 +581,10 @@ class TrainingSupervisor:
                 f"numerics watchdog: non-finite step at epoch {epoch} batch "
                 f"{bi} (loss={loss}); update was skipped in-graph "
                 f"({self.watchdog.consecutive} consecutive)", RuntimeWarning)
+            _tracing.record_event("train:anomaly", epoch=epoch, batch=bi,
+                                  loss=loss)
+            _flight.note("train_anomaly", corr=_tracing.current(),
+                         epoch=epoch, batch=bi, loss=loss)
             if self.on_anomaly is not None:
                 self.on_anomaly({"epoch": epoch, "batch_index": bi,
                                  "loss": loss})
@@ -577,6 +601,14 @@ class TrainingSupervisor:
             self.hang.pause()
         self.rollbacks += 1
         profiler.bump_counter("train.rollback")
+        # crash artifact while the ring still holds the anomaly lead-up
+        # (the restore below rewinds state; the telemetry must not rewind)
+        _tracing.record_event("train:rollback", rollbacks=self.rollbacks)
+        _flight.dump("rollback", corr=_tracing.current(),
+                     extra={"rollbacks": self.rollbacks,
+                            "first_bad": list(self.watchdog.first_bad)
+                            if self.watchdog.first_bad else None,
+                            "anomalies": self.watchdog.anomalies})
         if self.rollbacks > self.policy.max_rollbacks:
             raise FloatingPointError(
                 f"numerics watchdog: {self.rollbacks} rollbacks exceeded "
@@ -607,6 +639,8 @@ class TrainingSupervisor:
         from ..profiler import RecordEvent
 
         profiler.bump_counter("train.preemption")
+        _flight.dump("preemption", corr=_tracing.current(),
+                     extra={"global_step": int(self.step._count)})
         if self.hang is not None:
             self.hang.pause()
         saved = False

@@ -44,6 +44,7 @@ from ..framework import random as framework_random
 from ..framework.dtype import convert_dtype
 from ..nn.layer import buffer_state, functional_call, param_state
 from ..io.batching import bucket_for
+from ..observability import tracing as _tracing
 
 __all__ = ["GenerationEngine", "generate", "init_cache", "sample_logits",
            "sample_logits_rows", "per_row_keys", "slice_cache_rows",
@@ -436,7 +437,16 @@ class GenerationEngine:
             tokens = []
             dones = []
             interval = max(1, int(done_check_interval))
+            # request-scoped tracing: host-side wall-clock spans at the
+            # existing dispatch points only (zero extra device syncs).
+            # The enabled flag is read ONCE — the per-token branch below
+            # is a plain bool check when tracing is off.
+            trace_on = _tracing.enabled()
+            corr = _tracing.current() if trace_on else None
+            if trace_on and corr is None:
+                corr = _tracing.new_correlation_id("gen")
             t0 = time.perf_counter()
+            t0_wall = time.time()
             with RecordEvent("decode"):
                 compile_cache.record_call(self._cc_prefill)
                 tok, done, all_done, cache = self._prefill_compiled(
@@ -448,6 +458,11 @@ class GenerationEngine:
                 # tpu-lint: disable=R1(honest TTFT — the metric is "token READY", not "dispatch returned")
                 jax.block_until_ready(tok)
                 ttft = time.perf_counter() - t0
+                if trace_on:
+                    t_wall = time.time()
+                    _tracing.record_span(
+                        "prefill", t0_wall, t_wall, corr=corr,
+                        tags={"bucket": bucket, "batch": B})
                 pos = prompt_len
                 # the early-stop host read serializes dispatch (one device
                 # round-trip per token) — only pay it when an eos id makes
@@ -466,6 +481,11 @@ class GenerationEngine:
                         use_top_p=use_top_p)
                     tokens.append(tok)
                     dones.append(done)
+                    if trace_on:
+                        now_wall = time.time()
+                        _tracing.record_span("decode_step", t_wall,
+                                             now_wall, corr=corr)
+                        t_wall = now_wall
                     pos += 1
             out = np.stack([np.asarray(t) for t in tokens], axis=1)
             if check_done and out.shape[1] > 1:

@@ -1,0 +1,41 @@
+"""paddle_tpu.observability — unified runtime telemetry for train + serve.
+
+Three pieces, one substrate (README "Observability"):
+
+- **metrics** (:mod:`.registry`): a thread-safe
+  :class:`~paddle_tpu.observability.registry.MetricsRegistry` of labeled
+  counters/gauges/histograms with a single :meth:`snapshot` and
+  Prometheus-text/JSON exporters. Existing telemetry —
+  ``ServingMetrics``, ``profiler.bump_counter`` totals,
+  ``compile_cache`` stats, ``BlockPool``/``AdapterStore`` occupancy,
+  scheduler queue depths — is absorbed via collectors behind its
+  existing APIs; nothing callers already consume changed shape.
+- **tracing** (:mod:`.tracing`): request-scoped correlation ids minted
+  at ``ReplicaRouter.submit`` / ``InferenceServer.submit`` / the
+  ``Model.fit`` step boundary and threaded through
+  scheduler→engine→stream (and supervisor→rollback), recording host-side
+  structured spans exportable as chrome://tracing JSON — one request =
+  one named lane. ``tools/trace_view.py`` merges fleet-replica dumps by
+  correlation id.
+- **flight recorder** (:mod:`.flight`): a bounded per-process ring of
+  recent events + span tail + metric snapshot, dumped as a crash
+  artifact on engine reset, supervisor rollback/hang/preemption.
+
+Import-light (stdlib only at module scope): every layer of the stack
+feeds this package, so it sits at the bottom of the import graph.
+"""
+from . import flight, tracing  # noqa: F401
+from .flight import FlightRecorder, flight_recorder  # noqa: F401
+from .registry import MetricsRegistry, default_registry  # noqa: F401
+from .tracing import (chrome_trace, correlate, current,  # noqa: F401
+                      enable, enabled, export_chrome_trace,
+                      new_correlation_id, record_event, record_span,
+                      set_current, span, spans)
+
+__all__ = [
+    "MetricsRegistry", "default_registry", "FlightRecorder",
+    "flight_recorder", "tracing", "flight", "new_correlation_id",
+    "correlate", "current", "set_current", "span", "spans",
+    "record_span", "record_event", "enable", "enabled", "chrome_trace",
+    "export_chrome_trace",
+]

@@ -14,6 +14,7 @@ from __future__ import annotations
 import contextlib
 import enum
 import os
+import threading as _threading
 import time
 from collections import defaultdict
 from typing import Callable, Iterable, Optional
@@ -50,17 +51,36 @@ _MAX_HOST_SPANS = 200_000
 
 
 class _HostEventRecorder:
-    """Lock-free-ish per-process span store (HostEventRecorder analogue,
-    ``host_event_recorder.h``)."""
+    """Lock-guarded per-process span store (HostEventRecorder analogue,
+    ``host_event_recorder.h``). Bounded: when the deque is full the
+    OLDEST span rolls off — silently losing data is a telemetry bug, so
+    every eviction is counted (``dropped`` here, plus the monotonic
+    ``profiler.spans_dropped`` counter) and surfaced by
+    :func:`host_event_summary`."""
 
-    def __init__(self):
+    def __init__(self, capacity: int = _MAX_HOST_SPANS):
         from collections import deque
 
-        self.spans = deque(maxlen=_MAX_HOST_SPANS)  # (name, t0, t1)
+        self.lock = _threading.Lock()
+        self.spans = deque(maxlen=capacity)  # (name, t0, t1)
         self.enabled = False
+        self.dropped = 0
+
+    def record(self, name, t0, t1):
+        with self.lock:
+            if len(self.spans) == self.spans.maxlen:
+                self.dropped += 1
+                evicted = True
+            else:
+                evicted = False
+            self.spans.append((name, t0, t1))
+        if evicted:
+            bump_counter("profiler.spans_dropped")
 
     def clear(self):
-        self.spans.clear()
+        with self.lock:
+            self.spans.clear()
+            self.dropped = 0
 
 
 _recorder = _HostEventRecorder()
@@ -90,7 +110,7 @@ class RecordEvent:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
         if self._t0 is not None and _recorder.enabled:
-            _recorder.spans.append((self.name, self._t0, time.perf_counter()))
+            _recorder.record(self.name, self._t0, time.perf_counter())
         self._t0 = None
 
     def __enter__(self):
@@ -118,8 +138,6 @@ class RecordEvent:
 # self-healing layer bumps these so operators can alert on them without
 # parsing logs. Unlike spans they are always on: a counter bump is a dict
 # update under a lock, cheap even in the train loop's rare branches.
-import threading as _threading
-
 _counters_lock = _threading.Lock()
 _counters: dict = defaultdict(int)
 
@@ -145,18 +163,36 @@ def reset_counters() -> None:
 __all__ += ["bump_counter", "counter_values", "reset_counters"]
 
 
-def host_event_summary(sort_by: str = "total"):
+def host_event_summary(sort_by: str = "total", percentiles=None):
     """Aggregate host spans: {name: (calls, total_s, avg_s, max_s)} —
-    the op-summary table of ``profiler_statistic.py`` for host phases."""
+    the op-summary table of ``profiler_statistic.py`` for host phases.
+
+    ``percentiles=(50, 99)`` appends one per-event percentile column per
+    requested value (nearest-rank over the recorded durations), so the
+    tuple becomes ``(calls, total_s, avg_s, max_s, p50_s, p99_s)``.
+    Spans evicted from the bounded recorder are surfaced as a
+    ``"(dropped spans)"`` row (count in the calls column) so a summary
+    over a long-lived server is never silently partial."""
+    from ..observability.registry import nearest_rank
+
+    with _recorder.lock:
+        items = list(_recorder.spans)
+        dropped = _recorder.dropped
+    pcts = tuple(float(p) for p in (percentiles or ()))
     agg = defaultdict(list)
-    for name, t0, t1 in _recorder.spans:
+    for name, t0, t1 in items:
         agg[name].append(t1 - t0)
-    rows = {
-        name: (len(ts), sum(ts), sum(ts) / len(ts), max(ts))
-        for name, ts in agg.items()
-    }
+    rows = {}
+    for name, ts in agg.items():
+        srt = sorted(ts)
+        rows[name] = (len(ts), sum(ts), sum(ts) / len(ts), srt[-1],
+                      *(nearest_rank(srt, p) for p in pcts))
     key = {"total": 1, "calls": 0, "avg": 2, "max": 3}[sort_by]
-    return dict(sorted(rows.items(), key=lambda kv: -kv[1][key]))
+    out = dict(sorted(rows.items(), key=lambda kv: -kv[1][key]))
+    if dropped:
+        out["(dropped spans)"] = (dropped, 0.0, 0.0, 0.0,
+                                  *(0.0 for _ in pcts))
+    return out
 
 
 # ------------------------------------------------------------- scheduler
@@ -282,13 +318,20 @@ class Profiler:
         return False
 
     # -- reporting
-    def summary(self, sort_by: str = "total") -> str:
-        rows = host_event_summary(sort_by)
-        lines = [f"{'event':<40}{'calls':>8}{'total(s)':>12}{'avg(ms)':>12}"
-                 f"{'max(ms)':>12}"]
-        for name, (calls, total, avg, mx) in rows.items():
-            lines.append(f"{name:<40}{calls:>8}{total:>12.4f}"
-                         f"{avg * 1e3:>12.3f}{mx * 1e3:>12.3f}")
+    def summary(self, sort_by: str = "total", percentiles=None) -> str:
+        pcts = tuple(percentiles or ())
+        rows = host_event_summary(sort_by, percentiles=pcts)
+        header = (f"{'event':<40}{'calls':>8}{'total(s)':>12}"
+                  f"{'avg(ms)':>12}{'max(ms)':>12}")
+        for p in pcts:
+            header += f"{f'p{p:g}(ms)':>12}"
+        lines = [header]
+        for name, (calls, total, avg, mx, *tail) in rows.items():
+            line = (f"{name:<40}{calls:>8}{total:>12.4f}"
+                    f"{avg * 1e3:>12.3f}{mx * 1e3:>12.3f}")
+            for v in tail:
+                line += f"{v * 1e3:>12.3f}"
+            lines.append(line)
         lines.append("")
         lines.append(self._timer.report())
         text = "\n".join(lines)
@@ -400,9 +443,11 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
         name = worker_name or f"{socket.gethostname()}_{os.getpid()}"
         path = os.path.join(dir_name,
                             f"{name}_{int(_time.time() * 1000)}.pb.json")
+        with _recorder.lock:
+            spans = list(_recorder.spans)
         with open(path, "w") as f:
             json.dump([{"name": n, "start": t0, "end": t1}
-                       for n, t0, t1 in _recorder.spans], f)
+                       for n, t0, t1 in spans], f)
         prof.last_protobuf_path = path
 
     return handler

@@ -34,6 +34,7 @@ the batch.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -42,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import compile_cache
+from ..observability import tracing as _tracing
 from ..framework.dtype import convert_dtype
 from ..io.batching import bucket_for
 from ..models.generation import (DEFAULT_PREFILL_BUCKETS, _constrain_cache,
@@ -469,6 +471,8 @@ class ContinuousBatchingEngine:
             # namespace.
             a_row, a_salt = self.store.acquire(adapter_id, with_salt=True)
         hit_tokens = 0
+        bucket = 0
+        t_span = time.time()
         try:
             lora_args = () if self.store is None else (
                 self.store.tensors, np.asarray([a_row], np.int32))
@@ -518,6 +522,17 @@ class ContinuousBatchingEngine:
         first_h, fin_h = jax.device_get((tok, done0))
         first = int(first_h)
         fin = bool(fin_h)
+        # host-side of the admission's existing sync point: the prefill
+        # span (bucket + prefix-hit + adapter tags) lands in the request's
+        # trace lane with zero extra device round-trips
+        tags = {"bucket": int(bucket), "prompt_len": L, "slot": int(slot)}
+        if self.pool is not None:
+            tags["prefix_hit_tokens"] = int(hit_tokens)
+        if adapter_id is not None:
+            tags["adapter"] = adapter_id
+        _tracing.record_span("prefill", t_span, time.time(),
+                             corr=getattr(request, "corr_id", None),
+                             tags=tags)
         self.requests[slot] = request
         self._adapter_slots[slot] = a_row
         self._positions[slot] = L
@@ -540,6 +555,7 @@ class ContinuousBatchingEngine:
 
         lora_args = () if self.store is None else (
             self.store.tensors, self._adapter_slots)
+        t_span = time.time()
         with RecordEvent("serve:decode"), self._eval_mode():
             compile_cache.record_call(self._cc_decode)
             tok, done, self.live_cache = self._decode_compiled(
@@ -554,6 +570,10 @@ class ContinuousBatchingEngine:
         tok_h, done_h = jax.device_get((tok, done))
         toks = np.array(tok_h)
         dns = np.array(done_h)
+        # batch-level decode-step span (uncorrelated lane): the compute
+        # timeline behind every live request's per-token spans
+        _tracing.record_span("decode_step", t_span, time.time(), corr=None,
+                             tags={"active": int(self.active_count)})
         events: List[SlotEvent] = []
         for i, req in enumerate(self.requests):
             if req is None:

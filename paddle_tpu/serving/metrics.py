@@ -19,12 +19,17 @@ numbers.
 """
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time
 from typing import Dict, List, Optional
 
+from ..observability import registry as _obs_registry
+
 __all__ = ["LatencyHistogram", "ServingMetrics"]
+
+_metrics_serial = itertools.count()
 
 
 class LatencyHistogram:
@@ -56,12 +61,7 @@ class LatencyHistogram:
                 self._samples[j] = s
 
     def percentile(self, p: float) -> float:
-        if not self._samples:
-            return 0.0
-        srt = sorted(self._samples)
-        idx = min(len(srt) - 1, max(0, int(round((p / 100.0)
-                                                 * (len(srt) - 1)))))
-        return srt[idx]
+        return _obs_registry.nearest_rank(sorted(self._samples), p)
 
     def summary(self) -> Dict[str, float]:
         mean = self.total / self.count if self.count else 0.0
@@ -94,6 +94,45 @@ class ServingMetrics:
         self.slots = int(slots)
         self._lock = threading.Lock()
         self.reset()
+        # absorbed into the unified observability registry behind this
+        # class's unchanged API: a weak (bound-method) collector feeds
+        # the counters/histograms into every snapshot()/prometheus_text
+        # scrape, labeled per instance so co-hosted replicas stay apart
+        self._obs_label = f"m{next(_metrics_serial)}"
+        _obs_registry.default_registry().register_collector(
+            self._obs_collect, labels={"metrics": self._obs_label},
+            name=f"serving_metrics.{self._obs_label}")
+
+    def _obs_collect(self) -> dict:
+        with self._lock:
+            counters = {
+                "serving.requests_submitted": self.requests_submitted,
+                "serving.requests_completed": self.requests_completed,
+                "serving.requests_rejected": self.requests_rejected,
+                "serving.requests_expired": self.requests_expired,
+                "serving.requests_failed": self.requests_failed,
+                "serving.requests_requeued": self.requests_requeued,
+                "serving.tokens_emitted": self.tokens_emitted,
+                "serving.prefills": self.prefills,
+                "serving.decode_steps": self.decode_steps,
+                "serving.prefix_hit_tokens": self.prefix_hit_tokens,
+                "serving.prefix_miss_tokens": self.prefix_miss_tokens,
+            }
+            hists = {}
+            for hname, h in (("serving.ttft_s", self.ttft),
+                             ("serving.inter_token_s", self.inter_token),
+                             ("serving.queue_wait_s", self.queue_wait)):
+                hists[hname] = {"count": h.count,
+                                "sum": round(h.total, 6),
+                                "p50": round(h.percentile(50), 6),
+                                "p99": round(h.percentile(99), 6),
+                                "max": round(h.max, 6)}
+            return {"counters": counters,
+                    "gauges": {"serving.metrics_queue_depth":
+                               self.queue_depth,
+                               "serving.metrics_active_slots":
+                               self.active_slots},
+                    "histograms": hists}
 
     def reset(self) -> None:
         with self._lock:

@@ -47,6 +47,7 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ..observability import tracing as _tracing
 from .prefix_cache import BlockPool  # noqa: F401  (re-export convenience)
 from .scheduler import Backpressure, QueueFull, SchedulerClosed
 from .server import InferenceServer, RequestHandle
@@ -134,6 +135,10 @@ class RouterHandle:
             self._router._mark_dead(failed)
             with self._router._lock:
                 self._router.requests_rerouted += 1
+            _tracing.record_event(
+                "reroute", corr=self.correlation_id,
+                failed_replica=failed, cause=type(cause).__name__,
+                reroutes=self.reroutes)
             try:
                 self._router._place(self)
             except Exception:
@@ -152,6 +157,13 @@ class RouterHandle:
     @property
     def cache_hit_tokens(self) -> int:
         return self._current().cache_hit_tokens
+
+    @property
+    def correlation_id(self) -> Optional[str]:
+        """The request's tracing correlation id — minted ONCE at the
+        router front door and carried across reroutes, so every
+        replica's spans for this request share one lane."""
+        return self._kwargs.get("correlation_id")
 
     @property
     def adapter_id(self):
@@ -393,19 +405,33 @@ class ReplicaRouter:
           (ops escape hatch; failover still applies);
         - ``adapter_id`` adds adapter-affinity to placement: the tenant
           lands where its pages are already device-resident when load
-          allows, and a reroute carries the adapter to the survivor."""
+          allows, and a reroute carries the adapter to the survivor;
+        - the router mints the request's tracing **correlation id** here
+          (``RouterHandle.correlation_id``): the placement span and every
+          downstream replica span — queue wait, prefill, per-token
+          decode, stream end, even across a crash reroute — share one
+          trace lane keyed by it."""
         from ..lora.store import normalize_adapter_id
 
         prompt = np.asarray(prompt, np.int32).ravel()
         adapter_id = normalize_adapter_id(adapter_id)
         if do_sample and seed is None:
             seed = int.from_bytes(os.urandom(7), "little")
+        corr = _tracing.new_correlation_id()
+        t0 = time.time()
         handle = RouterHandle(self, dict(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             do_sample=bool(do_sample), temperature=float(temperature),
             top_p=float(top_p), eos_token_id=eos_token_id, seed=seed,
-            deadline=deadline, adapter_id=adapter_id))
+            deadline=deadline, adapter_id=adapter_id,
+            correlation_id=corr))
         self._place(handle, prefer=prefer)
+        tags = {"replica": handle.replica,
+                "prompt_len": int(prompt.shape[0])}
+        if adapter_id is not None:
+            tags["adapter"] = adapter_id
+        _tracing.record_span("router:submit", t0, time.time(), corr=corr,
+                             tags=tags)
         return handle
 
     def shutdown(self, drain: bool = True,
@@ -432,6 +458,19 @@ class ReplicaRouter:
         return False
 
     # ------------------------------------------------------------- stats
+    def statusz(self) -> dict:
+        """Fleet ``/statusz``: membership table + the roll-up snapshot
+        (per-replica ``InferenceServer.statusz()`` is one hop away)."""
+        return {"time": round(time.time(), 3), "pid": os.getpid(),
+                "replicas": self.replicas(), "snapshot": self.snapshot()}
+
+    def metrics_text(self) -> str:
+        """Prometheus text for the whole process (all replicas share the
+        registry; per-server labels keep them apart)."""
+        from ..observability import default_registry
+
+        return default_registry().prometheus_text()
+
     def snapshot(self) -> dict:
         """Fleet roll-up: per-replica server snapshots plus the router's
         own placement counters and the fleet-wide prefix hit rate."""

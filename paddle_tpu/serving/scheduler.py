@@ -62,6 +62,10 @@ class Request:
     model) — it resolves to a traced page-stack row at admission, so
     which tenants share the batch is data, not program. ``attempts``
     counts admissions — the crash-recovery requeue budget.
+    ``corr_id`` is the request-scoped tracing correlation id minted at
+    the front door (router or server submit): every span the request
+    touches — queue wait, prefill, per-token decode, stream end — is
+    keyed by it, across replicas and crash-recovery requeues.
     """
 
     prompt: object
@@ -73,6 +77,7 @@ class Request:
     seed: Optional[int] = None
     deadline: Optional[Deadline] = None
     adapter_id: Optional[str] = None
+    corr_id: Optional[str] = None
     id: int = field(default_factory=lambda: next(_req_serial))
     attempts: int = 0
     handle: object = None  # back-pointer set by the server

@@ -31,6 +31,8 @@ Failure story (``distributed/resilience`` conventions):
 """
 from __future__ import annotations
 
+import itertools
+import os
 import queue
 import threading
 import time
@@ -41,11 +43,16 @@ import numpy as np
 
 from ..distributed.resilience import Deadline, fault_point
 from ..lora.store import AdapterError
+from ..observability import flight as _flight
+from ..observability import registry as _obs_registry
+from ..observability import tracing as _tracing
 from .engine import ContinuousBatchingEngine
 from .metrics import ServingMetrics
 from .scheduler import FifoScheduler, QueueFull, Request, SchedulerClosed
 
 __all__ = ["InferenceServer", "RequestHandle"]
+
+_server_serial = itertools.count()
 
 
 class RequestHandle:
@@ -67,7 +74,11 @@ class RequestHandle:
         #: without a pool); clients read it off the handle to see reuse
         self.cache_hit_tokens: int = 0
         self._submit_t = time.monotonic()
+        # wall-clock twin of _submit_t: trace spans use time.time() so
+        # fleet replicas merge onto one timeline (tools/trace_view.py)
+        self._submit_wall = time.time()
         self._last_token_t: Optional[float] = None
+        self._last_token_wall: Optional[float] = None
 
     # ---- worker-side (single writer: the serve loop) ----
     def _push(self, tok: int) -> None:
@@ -103,6 +114,12 @@ class RequestHandle:
     def adapter_id(self):
         """The tenant adapter this request decodes under (None = base)."""
         return self.request.adapter_id
+
+    @property
+    def correlation_id(self) -> Optional[str]:
+        """The request's tracing correlation id — the key into
+        ``observability.tracing.spans()`` / flight-recorder dumps."""
+        return self.request.corr_id
 
     def tokens(self) -> np.ndarray:
         """Tokens generated SO FAR (snapshot; may grow)."""
@@ -170,6 +187,15 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._drain = True
+        # absorb this server's live state into the process metrics
+        # registry: queue depth, slot occupancy, compile counters, and
+        # the pool/store occupancy blocks ride the scrape behind the
+        # existing APIs. Weak (bound-method) collector: a GC'd server
+        # drops out of the scrape instead of raising.
+        self._obs_label = f"srv{next(_server_serial)}"
+        _obs_registry.default_registry().register_collector(
+            self._obs_collect, labels={"server": self._obs_label},
+            name=f"serving.{self._obs_label}")
 
     # ------------------------------------------------------------ client
     def start(self) -> "InferenceServer":
@@ -185,7 +211,8 @@ class InferenceServer:
                top_p: float = 1.0, eos_token_id: Optional[int] = None,
                seed: Optional[int] = None,
                deadline: Optional[float] = None,
-               adapter_id: Optional[str] = None) -> RequestHandle:
+               adapter_id: Optional[str] = None,
+               correlation_id: Optional[str] = None) -> RequestHandle:
         """Queue one generation request; returns immediately with a
         :class:`RequestHandle`. Raises ``ValueError`` on an impossible
         request (too long for the cache), :class:`QueueFull` when the
@@ -203,7 +230,12 @@ class InferenceServer:
         adapter (requires the server's engine to carry an
         ``adapter_store`` that knows the name; ``None`` = base model).
         Mixing adapters across the live batch is free — every slot
-        gathers its own pages inside the one compiled decode program."""
+        gathers its own pages inside the one compiled decode program.
+
+        ``correlation_id`` keys the request's trace lane (queue wait →
+        prefill → per-token decode → stream end); ``None`` mints a fresh
+        one. The router passes its own id through here so a rerouted
+        request keeps ONE lane across replicas."""
         from ..profiler import RecordEvent
 
         prompt = np.asarray(prompt, np.int32).ravel()
@@ -229,13 +261,14 @@ class InferenceServer:
                 raise ValueError(
                     f"unknown adapter {adapter_id!r}; AdapterStore."
                     f"register()/load() it before submitting")
+        corr = correlation_id or _tracing.new_correlation_id()
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             greedy=not do_sample, temperature=float(temperature),
             top_p=float(top_p), eos_token_id=eos_token_id,
             seed=None if seed is None else int(seed),
             deadline=Deadline(deadline) if deadline is not None else None,
-            adapter_id=adapter_id)
+            adapter_id=adapter_id, corr_id=corr)
         handle = RequestHandle(req)
         req.handle = handle
         self.start()
@@ -244,8 +277,12 @@ class InferenceServer:
                 self.scheduler.submit(req)
             except QueueFull:
                 self.metrics.inc("requests_rejected")
+                _tracing.record_event("rejected", corr=corr,
+                                      queue_depth=self.scheduler.depth)
                 raise
         self.metrics.inc("requests_submitted")
+        _tracing.record_event("submit", corr=corr, request_id=req.id,
+                              prompt_len=int(prompt.shape[0]))
         self.metrics.set_queue_depth(self.scheduler.depth)
         with self._cv:
             self._cv.notify_all()
@@ -293,6 +330,49 @@ class InferenceServer:
             self.engine.cache_stats(),
             prefix_cache=None if pool is None else pool.stats(),
             adapter_store=None if store is None else store.stats())
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process metrics registry —
+        the ``/metrics`` handle (this server's gauges carry its
+        ``server=<label>`` labels; co-hosted replicas and the training
+        side share the same page)."""
+        return _obs_registry.default_registry().prometheus_text()
+
+    def statusz(self) -> dict:
+        """Introspection snapshot — the ``/statusz`` handle: live
+        engine/scheduler state, the full metrics snapshot, and the
+        flight-recorder/trace-buffer health."""
+        return {
+            "time": round(time.time(), 3),
+            "pid": os.getpid(),
+            "server": self._obs_label,
+            "active_slots": self.engine.active_count,
+            "slots": self.engine.slots,
+            "queue_depth": self.scheduler.depth,
+            "prefill_buckets": list(self.engine.prefill_buckets),
+            "snapshot": self.snapshot(),
+            "flight": _flight.flight_recorder().stats(),
+            "trace": _tracing.stats(),
+        }
+
+    def _obs_collect(self) -> dict:
+        """Registry collector: the occupancy/queue/compile numbers an
+        autoscaler polls, read from live state (no histogram math)."""
+        eng = self.engine
+        cc = eng.cache_stats()
+        gauges = {
+            "serving.queue_depth": self.scheduler.depth,
+            "serving.active_slots": eng.active_count,
+            "serving.slots": eng.slots,
+            "serving.prefill_compiles": cc["prefill"]["compiles"],
+            "serving.decode_compiles": cc["decode"]["compiles"],
+        }
+        out = {"gauges": gauges}
+        if eng.pool is not None:
+            gauges["serving.prefix_cache"] = eng.pool.stats()
+        if eng.store is not None:
+            gauges["serving.adapter_store"] = eng.store.stats()
+        return out
 
     # ------------------------------------------------------------ worker
     def _loop(self) -> None:
@@ -367,6 +447,7 @@ class InferenceServer:
         self.metrics.inc("decode_steps")
         per_adapter = self.engine.store is not None
         now = time.monotonic()
+        now_wall = time.time()
         for ev in events:
             req = self.engine.requests[ev.slot]
             h = req.handle
@@ -377,6 +458,13 @@ class InferenceServer:
             if h._last_token_t is not None:
                 self.metrics.observe_inter_token(now - h._last_token_t)
             h._last_token_t = now
+            # per-token decode span in the request's lane, bracketed by
+            # the existing step read-back (no extra sync): one "decode"
+            # slice per emitted token, spanning since its previous token
+            _tracing.record_span(
+                "decode", h._last_token_wall or now_wall, now_wall,
+                corr=req.corr_id, tags={"slot": ev.slot})
+            h._last_token_wall = now_wall
             if ev.done or h._count() >= req.max_new_tokens:
                 self._finish(req, ev.slot)
 
@@ -385,6 +473,12 @@ class InferenceServer:
         fault_point("serve.admit")  # spends retry budget, never loops
         now = time.monotonic()
         self.metrics.observe_queue_wait(now - req.handle._submit_t)
+        # the queue-wait lane slice: submit wall-time -> this admission
+        # (a requeued request's later admissions re-enter the lane as
+        # fresh queue_wait slices after the engine_reset marker)
+        _tracing.record_span("queue_wait", req.handle._submit_wall,
+                             time.time(), corr=req.corr_id,
+                             tags={"attempt": req.attempts})
         first, fin, hit_tokens = self.engine.admit(req, slot)
         self.metrics.inc("prefills")
         if self.engine.pool is not None:
@@ -409,6 +503,7 @@ class InferenceServer:
                 self.metrics.adapter_request(req.adapter_id)
                 self.metrics.observe_adapter_ttft(req.adapter_id, h.ttft_s)
         h._last_token_t = t1
+        h._last_token_wall = time.time()
         if fin or req.max_new_tokens == 1:
             # eos straight out of prefill: zero decode iterations
             self._finish(req, slot)
@@ -417,10 +512,13 @@ class InferenceServer:
         self.engine.release(slot)
         self.metrics.inc("requests_completed")
         self.metrics.set_active_slots(self.engine.active_count)
+        _tracing.record_event("stream_end", corr=req.corr_id,
+                              tokens=req.handle._count())
         req.handle._finish()
 
     def _expire(self, req: Request) -> None:
         self.metrics.inc("requests_expired")
+        _tracing.record_event("expired", corr=req.corr_id)
         req.handle._fail(TimeoutError(
             f"request {req.id} expired in queue after "
             f"{req.deadline.total:.3f}s deadline"))
@@ -436,6 +534,19 @@ class InferenceServer:
             f"serve loop fault ({type(exc).__name__}: {exc}); resetting "
             f"engine, requeueing {len(inflight)} in-flight request(s)",
             RuntimeWarning)
+        # crash artifact FIRST, while the ring still holds the lead-up:
+        # the flight dump carries the failing requests' correlation ids,
+        # their span tails, and the metric state at the moment of death
+        corrs = [r.corr_id for r in inflight]
+        for c in corrs:
+            _tracing.record_event("engine_reset", corr=c)
+        _flight.note("engine_reset", corr=corrs[0] if corrs else None,
+                     error=f"{type(exc).__name__}: {exc}",
+                     inflight=list(corrs))
+        _flight.dump("engine_reset", corr=corrs[0] if corrs else None,
+                     extra={"error": f"{type(exc).__name__}: {exc}",
+                            "inflight": list(corrs),
+                            "server": self._obs_label})
         try:
             self.engine.reset()
         except Exception as reset_exc:  # pragma: no cover

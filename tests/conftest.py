@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# fault-injection tests trigger flight-recorder crash dumps (engine
+# resets, rollbacks, hangs); keep their artifacts out of the repo tree
+import tempfile  # noqa: E402
+
+os.environ.setdefault("PT_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="pt_flight_tests_"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

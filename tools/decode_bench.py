@@ -84,12 +84,21 @@ def main(argv=None) -> int:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--buckets", type=int, nargs="+", default=None,
                     help="prefill length buckets (default: engine default)")
+    ap.add_argument("--trace-overhead", type=int, nargs="?", const=3,
+                    default=0, metavar="REPS",
+                    help="measure tracing-on vs tracing-off decode "
+                         "throughput (best of REPS runs each, default 3); "
+                         "exits non-zero if the overhead exceeds "
+                         "--trace-overhead-pct")
+    ap.add_argument("--trace-overhead-pct", type=float, default=2.0,
+                    help="max acceptable tracing overhead, percent")
     args = ap.parse_args(argv)
 
     import jax
 
     from paddle_tpu.framework import compile_cache
     from paddle_tpu.models.generation import GenerationEngine
+    from paddle_tpu.observability import default_registry, tracing
 
     model, cfg = build_model(args.model, args.preset)
     model.eval()
@@ -107,6 +116,51 @@ def main(argv=None) -> int:
     engine.generate(ids, max_new_tokens=args.new_tokens)
     warmup_s = time.perf_counter() - t_warm
     compiles_before = compile_cache.cache_stats()["compiles"]
+
+    if args.trace_overhead:
+        # the observability gate: per-token span recording on the decode
+        # hot loop must cost <--trace-overhead-pct of throughput.
+        # Best-of-REPS per mode filters scheduler noise on shared boxes;
+        # modes alternate so drift hits both equally.
+        reps = max(1, int(args.trace_overhead))
+        best = {True: 0.0, False: 0.0}
+        was_enabled = tracing.enabled()
+        try:
+            for _ in range(reps):
+                for mode in (False, True):
+                    tracing.enable(mode)
+                    _, stats = engine.generate(
+                        ids, max_new_tokens=args.new_tokens,
+                        return_stats=True)
+                    best[mode] = max(best[mode],
+                                     stats["decode_tokens_per_sec"])
+        finally:
+            tracing.enable(was_enabled)
+        overhead_pct = 100.0 * (best[False] - best[True]) / max(
+            best[False], 1e-9)
+        record = {
+            "metric": "decode_trace_overhead_pct",
+            "value": round(overhead_pct, 3),
+            "unit": "%",
+            "extra": {
+                "tokens_per_sec_tracing_off": round(best[False], 1),
+                "tokens_per_sec_tracing_on": round(best[True], 1),
+                "reps": reps,
+                "threshold_pct": args.trace_overhead_pct,
+                "batch": args.batch,
+                "new_tokens": args.new_tokens,
+                "preset": args.preset,
+                "backend": jax.default_backend(),
+            },
+        }
+        print(json.dumps(record))
+        if overhead_pct > args.trace_overhead_pct:
+            print(f"FAIL: tracing costs {overhead_pct:.2f}% decode "
+                  f"throughput (> {args.trace_overhead_pct}% budget) — "
+                  f"the span recorder is on the wrong side of a "
+                  f"dispatch point", file=sys.stderr)
+            return 1
+        return 0
 
     out, stats = engine.generate(ids, max_new_tokens=args.new_tokens,
                                  return_stats=True)
@@ -131,6 +185,9 @@ def main(argv=None) -> int:
             "backend": jax.default_backend(),
             "device_kind": jax.devices()[0].device_kind,
             "preset": args.preset,
+            # unified-registry snapshot: compile counters (and whatever
+            # else this process absorbed) ride the bench artifact
+            "metrics": default_registry().snapshot(),
         },
     }
     print(json.dumps(record))

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Flight-recorder crash drill: prove a crash leaves a usable artifact.
+
+Serves one seeded request through a tiny continuous-batching server with
+a seeded :class:`FaultPlan` injected at the ``serve.step`` site. The
+fault resets the engine mid-decode (the crash-recovery path), which must
+write a flight-recorder dump. The drill then asserts the postmortem is
+actually usable:
+
+- the dump exists, parses, and carries the ``flight_recorder`` format
+  marker + ``engine_reset`` reason;
+- the failing request's correlation id appears in the dump (both the
+  ``inflight`` list and its span tail), so an operator can walk from the
+  artifact to the exact request timeline;
+- the request itself still COMPLETED with the right number of tokens
+  (the crash drill must not cost availability);
+- the dump's span list round-trips through ``tools/trace_view.py``'s
+  merge (the artifact is consumable, not just well-formed JSON).
+
+Used standalone and as the ``robustness_gate.py --observability`` crash
+stage; ``tests/test_observability.py`` drives :func:`run_drill` in-proc.
+
+    python tools/flight_drill.py
+    python tools/flight_drill.py --dir /tmp/drill --new-tokens 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def run_drill(dump_dir: str, new_tokens: int = 6, model=None) -> dict:
+    """Run the crash drill, dumping into ``dump_dir``; returns a result
+    dict with ``ok`` plus per-check booleans (all must hold)."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.resilience import FaultPlan
+    from paddle_tpu.observability import flight
+    from paddle_tpu.serving import InferenceServer
+
+    flight.configure(dump_dir=dump_dir)
+    if model is None:
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+        pt.seed(7)
+        cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                       use_flash_attention=False)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+    vocab = model.cfg.vocab_size
+    srv = InferenceServer(model, slots=2, max_length=64,
+                          prefill_buckets=(16,), max_request_retries=1)
+    prompt = np.random.default_rng(0).integers(
+        0, vocab, (10,)).astype(np.int32)
+    plan = FaultPlan([{"site": "serve.step", "kind": "drop", "times": 1}],
+                     seed=3)
+    before = flight.flight_recorder().stats()["dumps_written"]
+    with plan, warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        handle = srv.submit(prompt, max_new_tokens=int(new_tokens),
+                            seed=11)
+        out = handle.result(timeout=300)
+    srv.shutdown(drain=True, timeout=60)
+    corr = handle.correlation_id
+
+    result = {"ok": False, "correlation_id": corr, "dump_path": None,
+              "fault_fired": bool(plan.fired and plan.fired[0] == 1),
+              "request_completed": int(out.shape[0]) == int(new_tokens)}
+    rec = flight.flight_recorder()
+    result["dump_written"] = (rec.stats()["dumps_written"] == before + 1)
+    path = rec.stats()["last_dump_path"]
+    result["dump_path"] = path
+    if not (result["fault_fired"] and result["dump_written"] and path):
+        return result
+    with open(path) as f:
+        dump = json.load(f)
+    result["well_formed"] = (
+        dump.get("format") == "flight_recorder"
+        and dump.get("reason") == "engine_reset"
+        and dump.get("pid") == os.getpid()
+        and isinstance(dump.get("events"), list)
+        and isinstance(dump.get("spans"), list))
+    result["corr_in_dump"] = (
+        dump.get("correlation_id") == corr
+        and corr in (dump.get("extra", {}).get("inflight") or []))
+    result["corr_in_spans"] = any(s.get("corr") == corr
+                                  for s in dump.get("spans", []))
+    # the artifact must be consumable by the merge tool, not just valid
+    from trace_view import load_spans, merge_chrome
+
+    spans, kind = load_spans(path)
+    merged = merge_chrome(spans, corr=corr)
+    lanes = {ev["tid"] for ev in merged["traceEvents"]
+             if ev["ph"] in ("X", "i")}
+    result["trace_view_merge"] = kind == "flight" and len(lanes) == 1
+    result["ok"] = all(v for k, v in result.items()
+                       if k != "ok" and isinstance(v, bool))
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="dump directory (default: fresh temp dir)")
+    ap.add_argument("--new-tokens", type=int, default=6)
+    args = ap.parse_args(argv)
+    dump_dir = args.dir or tempfile.mkdtemp(prefix="pt_flight_drill_")
+    result = run_drill(dump_dir, new_tokens=args.new_tokens)
+    print(json.dumps(result))
+    if not result["ok"]:
+        failed = [k for k, v in result.items()
+                  if isinstance(v, bool) and not v and k != "ok"]
+        print(f"FAIL: flight drill checks failed: {failed}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

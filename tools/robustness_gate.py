@@ -28,7 +28,16 @@ story. Runs, in order:
    stay token-identical to a solo ``generate`` (no divergence across the
    reroute), and the survivor must hold its #buckets+1 compile budget
    with zero steady-state recompiles;
-5. with ``--lora``, ``tools/lora_soak.py`` — the multi-tenant adapter
+5. with ``--observability``, the telemetry gate in three parts:
+   ``tools/flight_drill.py`` (an injected serve-loop crash must leave a
+   well-formed flight-recorder dump carrying the failing request's
+   correlation id, consumable by ``tools/trace_view.py``), a scoped
+   ``tpu_lint paddle_tpu/observability`` run (0 findings — the
+   telemetry layer itself must not regress trace discipline), and
+   ``tools/decode_bench.py --trace-overhead`` (per-token span recording
+   on the decode hot loop must cost <2% throughput, tracing-on vs
+   tracing-off);
+6. with ``--lora``, ``tools/lora_soak.py`` — the multi-tenant adapter
    lifecycle: fine-tune a tiny adapter 20 steps under the supervisor,
    hard-kill the process mid-checkpoint-save, resume from the newest
    complete checkpoint, finish, publish the adapter, then serve it
@@ -47,6 +56,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --elastic      # + shrink/grow proof
     python tools/robustness_gate.py --fleet        # + serving-fleet crash
     python tools/robustness_gate.py --lora         # + adapter lifecycle
+    python tools/robustness_gate.py --observability  # + telemetry gate
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -90,6 +100,11 @@ def main() -> int:
                     help="also run the multi-tenant LoRA lifecycle "
                          "(train, SIGKILL mid-save, resume, serve mixed "
                          "+ scoped tpu_lint of paddle_tpu/lora)")
+    ap.add_argument("--observability", action="store_true",
+                    help="also run the telemetry gate (flight-recorder "
+                         "crash drill + scoped tpu_lint of "
+                         "paddle_tpu/observability + <2%% decode "
+                         "tracing overhead)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     args = ap.parse_args()
@@ -117,6 +132,18 @@ def main() -> int:
                       "--check", "--replicas", "2", "--prefix-cache-mb",
                       "4", "--prefix-tokens", "24", "--crash-replica",
                       "--verify", "3"])
+    if args.observability:
+        results["flight_drill"] = _run(
+            "flight_drill", [sys.executable,
+                             os.path.join(TOOLS, "flight_drill.py")])
+        results["obs_lint"] = _run(
+            "obs_lint", [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
+                         os.path.join("paddle_tpu", "observability"),
+                         "--no-baseline"])
+        results["trace_overhead"] = _run(
+            "trace_overhead", [sys.executable,
+                               os.path.join(TOOLS, "decode_bench.py"),
+                               "--trace-overhead", "3"])
     if args.lora:
         results["lora"] = _run(
             "lora", [sys.executable, os.path.join(TOOLS, "lora_soak.py")])

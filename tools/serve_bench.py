@@ -351,6 +351,12 @@ def main(argv=None) -> int:
     live = [s for i, s in enumerate(servers)
             if not (crashed_replica is not None and i == len(servers) - 1)]
     snaps = [s.snapshot() for s in live]
+    # unified-registry scrape while every live server's collectors are
+    # still registered: occupancy, hit-rate and compile counters land in
+    # the BENCH artifact alongside the throughput numbers
+    from paddle_tpu.observability import default_registry
+
+    metrics_snap = default_registry().snapshot()
     for s in live:
         s.shutdown(drain=True, timeout=60.0)
 
@@ -436,6 +442,7 @@ def main(argv=None) -> int:
             "device_kind": jax.devices()[0].device_kind,
             "preset": args.preset,
             "check": bool(args.check),
+            "metrics": metrics_snap,
             **({"crashed_replica": crashed_replica,
                 "rerouted": router.snapshot()["requests_rerouted"]}
                if crashed_replica is not None else {}),

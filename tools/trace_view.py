@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Merge flight-recorder / trace dumps into one chrome://tracing JSON,
+keyed by correlation id.
+
+A fleet request's telemetry is scattered: the router's span buffer in
+one process, each replica's spans (and crash-time flight dumps) in
+others. This CLI reads any mix of
+
+- flight-recorder dumps (``{"format": "flight_recorder", "spans": [...],
+  "events": [...]}`` — what ``observability.flight.dump()`` writes),
+- raw span lists (``[{"name", "corr", "t0", "t1", "tags"}, ...]`` — what
+  ``observability.tracing.spans()`` serializes to),
+- chrome traces (``{"traceEvents": [...]}`` — what
+  ``export_chrome_trace`` writes),
+
+and merges every span into ONE chrome trace where each correlation id is
+a single named lane, regardless of which process recorded which piece.
+Wall-clock timestamps make the cross-process merge line up.
+
+    python tools/trace_view.py flight_records/*.json -o merged.json
+    python tools/trace_view.py --list flight_records/*.json
+    python tools/trace_view.py --corr req-1f03ab-000004 dumps/*.json \\
+        -o one_request.json
+
+Exit codes: 0 ok; 2 no spans found / unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+
+def _spans_from_chrome(obj: dict, label: str) -> List[dict]:
+    out = []
+    for ev in obj.get("traceEvents", []):
+        if ev.get("ph") not in ("X", "i"):
+            continue
+        t0 = float(ev.get("ts", 0.0)) / 1e6
+        t1 = t0 + float(ev.get("dur", 0.0)) / 1e6
+        args = dict(ev.get("args") or {})
+        corr = args.pop("correlation_id", None)
+        out.append({"name": ev.get("name", "?"), "corr": corr,
+                    "t0": t0, "t1": t1, "tags": args, "src": label})
+    return out
+
+
+def _events_as_spans(events: List[dict], label: str) -> List[dict]:
+    """Flight-recorder ring events become instant spans so a dump's
+    engine_reset/compile markers land on the merged timeline too."""
+    out = []
+    for ev in events:
+        if not isinstance(ev, dict) or "t" not in ev:
+            continue
+        tags = {k: v for k, v in ev.items()
+                if k not in ("t", "kind", "corr")
+                and isinstance(v, (str, int, float, bool))}
+        out.append({"name": f"event:{ev.get('kind', '?')}",
+                    "corr": ev.get("corr"), "t0": float(ev["t"]),
+                    "t1": float(ev["t"]), "tags": tags, "src": label})
+    return out
+
+
+def load_spans(path: str) -> Tuple[List[dict], str]:
+    """(spans, kind) from one input file; raises on unreadable input."""
+    with open(path) as f:
+        obj = json.load(f)
+    label = os.path.basename(path)
+    if isinstance(obj, dict) and obj.get("format") == "flight_recorder":
+        label = f"{label}:pid{obj.get('pid', '?')}"
+        spans = []
+        for rec in obj.get("spans", []):
+            rec = dict(rec)
+            rec["src"] = label
+            spans.append(rec)
+        spans.extend(_events_as_spans(obj.get("events", []), label))
+        return spans, "flight"
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        return _spans_from_chrome(obj, label), "chrome"
+    if isinstance(obj, list):
+        out = []
+        for rec in obj:
+            if isinstance(rec, dict) and "t0" in rec and "t1" in rec:
+                rec = dict(rec)
+                rec["src"] = label
+                out.append(rec)
+        return out, "spans"
+    raise ValueError(f"{path}: not a flight dump, span list, or "
+                     f"chrome trace")
+
+
+def merge_chrome(spans: List[dict], corr: Optional[str] = None) -> dict:
+    """One merged chrome trace: pid 1 = the merged view, one tid lane
+    per correlation id (sorted by first-span time so lanes read in
+    arrival order), lane 0 for uncorrelated spans."""
+    spans = [s for s in spans
+             if corr is None or (s.get("corr") or "").find(corr) >= 0]
+    first_seen = {}
+    for s in sorted(spans, key=lambda s: s["t0"]):
+        c = s.get("corr")
+        if c is not None and c not in first_seen:
+            first_seen[c] = s["t0"]
+    lanes = {c: i + 1 for i, c in enumerate(
+        sorted(first_seen, key=first_seen.get))}
+    events = [{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+               "args": {"name": "merged fleet trace"}},
+              {"ph": "M", "name": "thread_name", "pid": 1, "tid": 0,
+               "args": {"name": "untraced"}}]
+    for c, tid in lanes.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": tid, "args": {"name": c}})
+        events.append({"ph": "M", "name": "thread_sort_index", "pid": 1,
+                       "tid": tid, "args": {"sort_index": tid}})
+    for s in spans:
+        tid = lanes.get(s.get("corr"), 0)
+        args = dict(s.get("tags") or {})
+        if s.get("corr") is not None:
+            args["correlation_id"] = s["corr"]
+        if s.get("src"):
+            args["source"] = s["src"]
+        t0, t1 = float(s["t0"]), float(s["t1"])
+        ev = {"name": s.get("name", "?"), "pid": 1, "tid": tid,
+              "ts": t0 * 1e6, "args": args}
+        if t1 > t0:
+            ev.update(ph="X", dur=(t1 - t0) * 1e6)
+        else:
+            ev.update(ph="i", s="t")
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def list_correlations(spans: List[dict]) -> List[dict]:
+    by_corr = {}
+    for s in spans:
+        c = s.get("corr")
+        if c is None:
+            continue
+        e = by_corr.setdefault(c, {"corr": c, "spans": 0,
+                                   "t0": s["t0"], "t1": s["t1"],
+                                   "names": [], "sources": set()})
+        e["spans"] += 1
+        e["t0"] = min(e["t0"], s["t0"])
+        e["t1"] = max(e["t1"], s["t1"])
+        if s.get("name") not in e["names"]:
+            e["names"].append(s.get("name"))
+        if s.get("src"):
+            e["sources"].add(s["src"])
+    out = []
+    for e in sorted(by_corr.values(), key=lambda e: e["t0"]):
+        e["duration_ms"] = round((e["t1"] - e["t0"]) * 1e3, 3)
+        e["sources"] = sorted(e["sources"])
+        out.append(e)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+",
+                    help="flight dumps / span lists / chrome traces")
+    ap.add_argument("-o", "--output", default=None,
+                    help="merged chrome-trace JSON path")
+    ap.add_argument("--corr", default=None,
+                    help="keep only correlation ids containing this "
+                         "substring")
+    ap.add_argument("--list", action="store_true",
+                    help="print one line per correlation id instead of "
+                         "writing a trace")
+    args = ap.parse_args(argv)
+
+    spans: List[dict] = []
+    for path in args.inputs:
+        try:
+            got, kind = load_spans(path)
+        except Exception as e:
+            print(f"trace_view: {path}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"[trace_view] {path}: {len(got)} span(s) ({kind})",
+              file=sys.stderr)
+        spans.extend(got)
+    if not spans:
+        print("trace_view: no spans in any input", file=sys.stderr)
+        return 2
+
+    if args.list:
+        for e in list_correlations(spans):
+            if args.corr and args.corr not in e["corr"]:
+                continue
+            print(json.dumps(e))
+        return 0
+
+    trace = merge_chrome(spans, corr=args.corr)
+    n = sum(1 for ev in trace["traceEvents"] if ev["ph"] in ("X", "i"))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(trace, f)
+        print(f"[trace_view] wrote {args.output}: {n} event(s), "
+              f"{len({e['tid'] for e in trace['traceEvents']}) - 1} "
+              f"lane(s) — open in chrome://tracing", file=sys.stderr)
+    else:
+        print(json.dumps(trace))
+    return 0 if n else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
