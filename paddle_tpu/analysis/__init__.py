@@ -11,6 +11,9 @@ R2   retrace hazards (branch on tracer, tracer formatting, jit-in-loop)
 R3   donation-after-use of a donated buffer
 R4   PRNG key reuse without split/fold_in
 R5   shared state bypassing its majority-use lock in threaded classes
+R6   lock-order cycles / non-reentrant re-entry (interprocedural)
+R7   blocking work (sync/dispatch/sleep/wait/IO/rpc) under a held lock
+R8   mesh-axis & sharding discipline (axes, frozen resize, shard_map)
 ==== =================================================================
 
 Entry point::
@@ -18,21 +21,25 @@ Entry point::
     from paddle_tpu.analysis import analyze
     result = analyze("/repo", ["paddle_tpu", "tools"])
     for f in result.findings: print(f.render())
+    result.lock_graph     # nodes + acquisition sites + order edges
+    result.timing         # per-file parse/lint ms, per-rule totals
 
-CLI: ``tools/tpu_lint.py`` (human + ``--json``, baseline gate). See the
+CLI: ``tools/tpu_lint.py`` (human + ``--json``, baseline gate, the
+``.tpu_lint_cache/`` incremental engine and ``--changed-only``). See the
 README's "Static analysis (tpu_lint)" section for the rule catalog and
 the suppression / baseline-update policy.
 """
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List
 
 from .baseline import diff_baseline, load_baseline, save_baseline
 from .callgraph import CallGraph, build_callgraph
 from .model import Finding, Project, load_project
-from .rules import RULE_DOCS, run_rules
+from .rules import FileTimer, RULE_DOCS, run_rules
 
 __all__ = ["analyze", "AnalysisResult", "Finding", "RULE_DOCS",
            "load_baseline", "save_baseline", "diff_baseline"]
@@ -43,6 +50,8 @@ class AnalysisResult:
     project: Project
     callgraph: CallGraph
     findings: List[Finding] = field(default_factory=list)
+    lock_graph: dict = field(default_factory=dict)
+    timing: dict = field(default_factory=dict)
 
     @property
     def by_rule(self) -> Dict[str, List[Finding]]:
@@ -60,25 +69,58 @@ class AnalysisResult:
             "trace_reachable": sum(f.trace_reachable for f in fns),
             "thread_roots": len(self.callgraph.thread_roots),
             "thread_reachable": sum(f.thread_reachable for f in fns),
+            "locks": len(self.lock_graph.get("locks", ())),
+            "lock_edges": len(self.lock_graph.get("edges", ())),
             "findings": {r: len(v) for r, v in sorted(
                 self.by_rule.items())},
         }
+
+    def project_imports(self) -> Dict[str, List[str]]:
+        """rel -> rels of project files it imports (the incremental
+        engine's one-hop closure input). Uses the same
+        ``alias_modules`` derivation as the cache's fresh-parse overlay
+        so the two sides of the ``--changed-only`` graph can't drift."""
+        from .model import alias_modules
+
+        out: Dict[str, List[str]] = {}
+        for sf in self.project.files:
+            deps = set()
+            for alias in sf.aliases.values():
+                for m in alias_modules(alias):
+                    target = self.project.modules.get(m)
+                    if target is not None and target is not sf:
+                        deps.add(target.rel)
+            out[sf.rel] = sorted(deps)
+        return out
 
 
 def analyze(root: str, paths: List[str]) -> AnalysisResult:
     """Run every rule over the .py files under ``paths`` (relative to
     ``root``). Suppressed findings are dropped here; baseline filtering is
     the caller's second stage (``diff_baseline``)."""
+    t_start = time.perf_counter()
     abs_paths = [p if os.path.isabs(p) else os.path.join(root, p)
                  for p in paths]
-    project, findings = load_project(root, abs_paths)
+    timer = FileTimer()
+    project, findings = load_project(root, abs_paths,
+                                     parse_times=timer.parse)
+    t_parsed = time.perf_counter()
     cg = build_callgraph(project)
-    raw = run_rules(project, cg)
+    out = run_rules(project, cg, timer=timer)
     kept = list(findings)   # R0 policy findings are never suppressible
-    for f in raw:
+    for f in out.findings:
         sf = next((s for s in project.files if s.rel == f.path), None)
         if sf is not None and sf.suppressed(f.rule, f.line):
             continue
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
-    return AnalysisResult(project, cg, kept)
+    total = time.perf_counter() - t_start
+    timing = {
+        "total_ms": round(total * 1e3, 3),
+        "parse_ms": round((t_parsed - t_start) * 1e3, 3),
+        "lint_ms": round((total - (t_parsed - t_start)) * 1e3, 3),
+        "rules": out.rule_ms,
+        "files": timer.files_ms(),
+    }
+    return AnalysisResult(project, cg, kept, lock_graph=out.lock_graph,
+                          timing=timing)

@@ -20,7 +20,10 @@ from .model import Finding
 
 __all__ = ["load_baseline", "save_baseline", "diff_baseline"]
 
-_VERSION = 1
+# v2 (the R6/R7/R8 + incremental-engine release): same key schema, but
+# every v1 entry was re-audited — fixed in-tree or converted to an
+# inline reasoned suppression — so stale v1 entries cannot ride along.
+_VERSION = 2
 
 
 def load_baseline(path: str) -> Dict[str, int]:
@@ -31,8 +34,9 @@ def load_baseline(path: str) -> Dict[str, int]:
     if data.get("version") != _VERSION:
         raise ValueError(
             f"baseline {path} has version {data.get('version')!r}; this "
-            f"tool writes version {_VERSION} — regenerate with "
-            f"--update-baseline")
+            f"tool writes version {_VERSION} — re-triage every entry "
+            f"(fix it or suppress it in-line with a reason), then "
+            f"regenerate with --update-baseline (see MIGRATION.md)")
     return {str(k): int(v) for k, v in data.get("findings", {}).items()}
 
 

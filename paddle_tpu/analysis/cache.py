@@ -1,0 +1,229 @@
+"""Incremental engine: content-hash result cache + git-aware scoping.
+
+The analyzer is interprocedural (trace roots in one file make a helper
+in another reachable), so a naive per-file finding cache would silently
+go stale when a *different* file changes. The cache therefore has two
+honest modes, both keyed on content hashes (never mtimes):
+
+- **warm whole-repo**: a full run persists, per (analyzer digest, path
+  set), the per-file content hashes and the complete post-suppression
+  finding list plus stats/lock-graph/import-graph. The next run hashes
+  the tree (milliseconds); when EVERY hash matches, the cached result is
+  the exact answer and is served without parsing a single file. Any
+  drift → full re-analysis, cache refreshed. Whole-repo lint time is
+  therefore bounded by hashing, not analysis, for the overwhelmingly
+  common "nothing changed since CI last ran" case.
+- **``--changed-only``** (the pre-commit path): git names the changed
+  files; the cached import graph expands them one hop each way (what
+  they import, what imports them) so cross-file trace roots and lock
+  edges still resolve; only that closure is parsed and linted, and only
+  findings IN the changed files gate. Sub-second on a one-file diff.
+  Without a prior full-run cache the import graph is unknown and the
+  tool falls back to a full run (and says so).
+
+The analyzer digest hashes ``paddle_tpu/analysis/*.py`` itself, so
+editing any rule invalidates every cached result automatically.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+from .model import Finding, iter_py_files
+
+__all__ = ["LintCache", "git_changed_files", "CACHE_SCHEMA"]
+
+CACHE_SCHEMA = 1
+
+
+def _sha1_file(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _analyzer_digest() -> str:
+    """Content hash of the analysis package itself — a rule edit must
+    invalidate every cached result."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1()
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            h.update(fn.encode())
+            h.update(_sha1_file(os.path.join(pkg, fn)).encode())
+    return h.hexdigest()
+
+
+class LintCache:
+    """One cache directory (default ``<repo>/.tpu_lint_cache/``), one
+    entry per (analyzer digest, lint path set)."""
+
+    def __init__(self, root: str, cache_dir: Optional[str] = None):
+        self.root = root
+        self.dir = cache_dir or os.path.join(root, ".tpu_lint_cache")
+        self.analyzer = _analyzer_digest()
+
+    # ------------------------------------------------------------ keys
+    def _entry_path(self, paths: List[str]) -> str:
+        key = hashlib.sha1(("\x00".join(sorted(paths))).encode()
+                           ).hexdigest()[:16]
+        return os.path.join(self.dir, f"run_{key}.json")
+
+    def tree_digests(self, paths: List[str]) -> Dict[str, str]:
+        abs_paths = [p if os.path.isabs(p) else os.path.join(self.root, p)
+                     for p in paths]
+        out: Dict[str, str] = {}
+        for path in iter_py_files(abs_paths):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            out[rel] = _sha1_file(path)
+        return out
+
+    # ---------------------------------------------------------- lookup
+    def load(self, paths: List[str],
+             digests: Dict[str, str]) -> Optional[dict]:
+        """The cached entry when it matches the live tree exactly."""
+        try:
+            with open(self._entry_path(paths), "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if data.get("schema") != CACHE_SCHEMA \
+                or data.get("analyzer") != self.analyzer \
+                or data.get("files") != digests:
+            return None
+        return data
+
+    def cached_entry(self, paths: List[str]) -> Optional[dict]:
+        """The LAST full-run entry for ``paths`` regardless of hash
+        freshness — ``--changed-only`` scopes its closure from its
+        import graph and file list. Stale hashes are fine for the
+        UNCHANGED side of the graph; the changed files' own imports are
+        re-derived fresh (:meth:`fresh_imports`), so dependency edges
+        the edit just added still pull their targets into scope."""
+        try:
+            with open(self._entry_path(paths), "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if data.get("schema") != CACHE_SCHEMA:
+            return None
+        if not data.get("imports"):
+            return None
+        return data
+
+    def fresh_imports(self, changed: List[str],
+                      all_rels: List[str]) -> Dict[str, List[str]]:
+        """Re-parse just the CHANGED files and map their imports onto
+        project files (``all_rels`` = cached file list ∪ changed), so an
+        import added by the very edit under review scopes its target
+        into the closure. Shares ``module_name_of``/``alias_modules``
+        with ``AnalysisResult.project_imports`` — one derivation, two
+        sides of the same graph."""
+        from .model import SourceFile, alias_modules, module_name_of
+
+        mod_to_rel = {module_name_of(r): r
+                      for r in set(all_rels) | set(changed)}
+        out: Dict[str, List[str]] = {}
+        for rel in changed:
+            try:
+                sf = SourceFile(self.root, os.path.join(self.root, rel))
+            except (OSError, SyntaxError):
+                continue    # the full parse in analyze() will report it
+            deps = set()
+            for alias in sf.aliases.values():
+                for m in alias_modules(alias):
+                    got = mod_to_rel.get(m)
+                    if got is not None and got != rel:
+                        deps.add(got)
+            out[rel] = sorted(deps)
+        return out
+
+    # ----------------------------------------------------------- store
+    def store(self, paths: List[str], digests: Dict[str, str],
+              findings: List[Finding], stats: dict, lock_graph: dict,
+              imports: Dict[str, List[str]], timing: dict) -> bool:
+        """Best-effort: a cache write failure (read-only checkout, full
+        disk) must never fail the lint that produced the result."""
+        try:
+            return self._store(paths, digests, findings, stats,
+                               lock_graph, imports, timing)
+        except OSError:
+            return False
+
+    def _store(self, paths: List[str], digests: Dict[str, str],
+               findings: List[Finding], stats: dict, lock_graph: dict,
+               imports: Dict[str, List[str]], timing: dict) -> bool:
+        os.makedirs(self.dir, exist_ok=True)
+        entry = {
+            "schema": CACHE_SCHEMA,
+            "analyzer": self.analyzer,
+            "paths": sorted(paths),
+            "files": digests,
+            "findings": [f.as_dict() for f in findings],
+            "stats": stats,
+            "lock_graph": lock_graph,
+            "imports": imports,
+            "timing": timing,
+        }
+        path = self._entry_path(paths)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(entry, f)
+        os.replace(tmp, path)
+        return True
+
+    @staticmethod
+    def findings_from(data: dict) -> List[Finding]:
+        return [Finding.from_dict(d) for d in data.get("findings", ())]
+
+    # --------------------------------------------------------- closure
+    @staticmethod
+    def closure(changed: List[str],
+                imports: Dict[str, List[str]]) -> List[str]:
+        """changed + direct imports + direct importers (one hop each
+        way): enough context for cross-file trace roots, taint
+        refinement, and lock edges touching the changed files."""
+        importers: Dict[str, List[str]] = {}
+        for src, deps in imports.items():
+            for d in deps:
+                importers.setdefault(d, []).append(src)
+        out = set(changed)
+        for rel in changed:
+            out.update(imports.get(rel, ()))
+            out.update(importers.get(rel, ()))
+        return sorted(out)
+
+
+def git_changed_files(root: str,
+                      lint_paths: List[str]) -> Optional[List[str]]:
+    """Project-relative changed .py files per git (diff vs HEAD plus
+    untracked), restricted to the lint paths; None when git is
+    unavailable (callers fall back to a full run)."""
+    def run(args: List[str]) -> Optional[List[str]]:
+        try:
+            p = subprocess.run(["git", *args], cwd=root, timeout=30,
+                               capture_output=True, text=True)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if p.returncode != 0:
+            return None
+        return [ln.strip() for ln in p.stdout.splitlines() if ln.strip()]
+
+    diff = run(["diff", "--name-only", "HEAD", "--"])
+    untracked = run(["ls-files", "--others", "--exclude-standard"])
+    if diff is None or untracked is None:
+        return None
+    prefixes = tuple(p.rstrip("/") + "/" for p in lint_paths)
+    out = []
+    for rel in diff + untracked:
+        if not rel.endswith(".py"):
+            continue
+        if rel in lint_paths or rel.startswith(prefixes):
+            if os.path.exists(os.path.join(root, rel)):
+                out.append(rel)
+    return sorted(set(out))

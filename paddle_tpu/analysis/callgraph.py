@@ -594,6 +594,7 @@ class CallGraph:
         q = deque()
         for root in self.thread_roots:
             root.thread_reachable = True
+            root.thread_chain = (f"{root.short} [thread root]",)
             q.append(root)
         while q:
             cur = q.popleft()
@@ -602,6 +603,11 @@ class CallGraph:
             for nxt in self.edges.get(cur.qualname, ()):
                 if not nxt.thread_reachable:
                     nxt.thread_reachable = True
+                    chain = cur.thread_chain
+                    if len(chain) < 6:
+                        nxt.thread_chain = chain + (nxt.short,)
+                    else:
+                        nxt.thread_chain = chain[:5] + ("...", nxt.short)
                     q.append(nxt)
 
 

@@ -1,0 +1,608 @@
+"""R6/R7: interprocedural lock-order + blocking-under-lock analysis.
+
+The serving/observability stack is a lock-heavy threaded system (the
+server's condition variable, the scheduler/metrics/router/adapter-store
+locks, the tracing/flight rings). Two whole classes of bug there are
+invisible to R1–R5:
+
+- **R6 lock-order / deadlock**: acquiring lock B while holding lock A
+  fixes an order A→B; if any other path fixes B→A, two threads
+  interleaving the paths deadlock. Same-lock *re-entry* through a
+  non-reentrant ``threading.Lock`` is the single-thread special case —
+  it deadlocks unconditionally. Both need the *interprocedural*
+  acquisition graph: the second acquire is usually buried in a helper
+  (or a property) called from inside the first ``with`` region.
+- **R7 blocking-under-lock**: a sync (``device_get`` /
+  ``block_until_ready`` / ``.item()``), a compiled-program dispatch, a
+  device buffer update (``stack.at[i].set``), ``time.sleep``, an
+  unbounded ``Condition.wait()``/``queue.get()``/``join()``, file I/O,
+  or an rpc round-trip *inside a held-lock region*. Each is legal code —
+  R1 has nothing to say — but every thread contending that lock stalls
+  behind the slow operation: the classic serving latency cliff
+  (placement probes blocked behind an adapter-page H2D, a metrics
+  scrape blocked behind a disk write).
+
+Lock identity is canonical: ``self._cv = threading.Condition(self._lock)``
+collapses onto ``_lock`` (one lock, two names), locks defined on a base
+class resolve through the MRO, and module-level locks (singleton guards)
+are first-class nodes. The full graph — nodes, per-method acquisition
+sites, and held→acquired order edges with call-chain evidence — is
+exported in ``--json`` as ``lock_graph``.
+
+Pure AST like every other rule: no jax import, no thread ever started.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, dotted_path
+from .model import ClassInfo, Finding, FunctionInfo, Project
+
+__all__ = ["LockAnalysis", "analyze_locks"]
+
+_NONREENTRANT = {"Lock"}          # RLock/Semaphore re-entry is legal-ish
+_SLEEP_PATHS = {("time", "sleep")}
+_IO_NAME_CALLS = {"open"}
+_IO_DOTTED = {"fsync", "replace", "rename", "makedirs", "remove",
+              "unlink", "rmtree", "copyfile"}
+_RPC_NAMES = {"rpc_sync", "rpc_async"}
+_SYNC_TERMINALS = {"device_get", "block_until_ready"}
+_BUFFER_UPDATES = {"set", "add", "multiply", "divide", "min", "max",
+                   "apply"}
+
+
+@dataclass
+class LockNode:
+    """One canonical lock: an instance attr (``file::Class.attr``) or a
+    module-level name (``file::NAME``)."""
+
+    id: str
+    kind: str                      # Lock | RLock | Condition | Semaphore...
+    file: str
+    line: int
+    aliases: List[str] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {"id": self.id, "kind": self.kind, "file": self.file,
+                "line": self.line, "aliases": list(self.aliases)}
+
+
+@dataclass
+class _Event:
+    """One lock-relevant site inside a function (flow tracked by the
+    region walker): an acquisition, or a call made while holding."""
+
+    kind: str                      # "acquire" | "call" | "pcall"
+    line: int
+    held: FrozenSet[str]           # locks held BEFORE this event (local)
+    lock: Optional[str] = None     # for acquire
+    node: Optional[ast.Call] = None            # for call
+    target: Optional[FunctionInfo] = None      # for pcall (property)
+
+
+class LockAnalysis:
+    """Builds the canonical lock set, the per-function region events, the
+    interprocedural held-context fixpoint, and the R6/R7 findings."""
+
+    def __init__(self, project: Project, cg: CallGraph):
+        self.project = project
+        self.cg = cg
+        self.locks: Dict[str, LockNode] = {}
+        # (file.rel, name) -> LockNode for module-level locks
+        self._module_locks: Dict[Tuple[str, str], LockNode] = {}
+        # (file.rel, name) -> ClassInfo for `X = SomeClass()` singletons
+        self._module_instances: Dict[Tuple[str, str], ClassInfo] = {}
+        self._events: Dict[str, List[_Event]] = {}
+        self._resolved: Dict[int, List[FunctionInfo]] = {}
+        # lock contexts a function may be ENTERED with, plus one sample
+        # call chain per (function, lock) as evidence
+        self.entry_held: Dict[str, Set[str]] = {}
+        self.entry_chain: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+        self.acquisitions: List[dict] = []
+        self.order_edges: List[dict] = []
+        self.findings: List[Finding] = []
+
+    # ------------------------------------------------------------ build
+    def run(self) -> "LockAnalysis":
+        self._collect_module_locks()
+        self._collect_class_locks()
+        for fi in self.project.functions.values():
+            self._events[fi.qualname] = self._scan_regions(fi)
+        self._fixpoint()
+        self._emit_graph_and_r6()
+        self._emit_r7()
+        return self
+
+    # ------------------------------------------------- lock collection
+    @staticmethod
+    def _ctor_kind(value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        path = dotted_path(value.func)
+        if path and path[-1] in ("Lock", "RLock", "Condition", "Semaphore",
+                                 "BoundedSemaphore"):
+            return path[-1]
+        return None
+
+    def _collect_module_locks(self) -> None:
+        for sf in self.project.files:
+            for stmt in sf.tree.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                name = stmt.targets[0].id
+                kind = self._ctor_kind(stmt.value)
+                if kind is not None:
+                    node = LockNode(f"{sf.rel}::{name}", kind, sf.rel,
+                                    stmt.lineno)
+                    self._module_locks[(sf.rel, name)] = node
+                    self.locks[node.id] = node
+                    continue
+                if isinstance(stmt.value, ast.Call):
+                    cname = None
+                    f = stmt.value.func
+                    if isinstance(f, ast.Name):
+                        cname = f.id
+                    elif isinstance(f, ast.Attribute):
+                        cname = f.attr
+                    if cname:
+                        for ci in self.project.classes_by_name.get(
+                                cname, ()):
+                            if ci.file is sf or ci.lock_attrs:
+                                self._module_instances[(sf.rel, name)] = ci
+                                break
+
+    def _collect_class_locks(self) -> None:
+        for ci in self.project.classes.values():
+            for attr in ci.lock_attrs:
+                canon = self._canonical_attr(ci, attr)
+                lid = f"{ci.qualname}.{canon}"
+                node = self.locks.get(lid)
+                if node is None:
+                    node = LockNode(
+                        lid, ci.lock_kinds.get(canon, "Lock"),
+                        ci.file.rel, ci.lock_lines.get(canon, 0))
+                    self.locks[lid] = node
+                alias = f"{ci.name}.{attr}"
+                if attr != canon and alias not in node.aliases:
+                    node.aliases.append(alias)
+
+    @staticmethod
+    def _canonical_attr(ci: ClassInfo, attr: str) -> str:
+        # `_cv = Condition(self._lock)` -> _lock (one hop is enough; a
+        # Condition of a Condition is not a thing)
+        target = ci.lock_aliases.get(attr)
+        if target is not None and target in ci.lock_attrs:
+            return target
+        return attr
+
+    def _class_lock_id(self, cls: Optional[ClassInfo],
+                       attr: str) -> Optional[str]:
+        """Resolve ``self.<attr>`` to a canonical lock id, walking the
+        MRO so a lock constructed in a base class resolves from a
+        subclass method."""
+        seen: Set[str] = set()
+        stack = [cls] if cls is not None else []
+        while stack:
+            c = stack.pop(0)
+            if c is None or c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if attr in c.lock_attrs:
+                return f"{c.qualname}.{self._canonical_attr(c, attr)}"
+            for bname in c.bases:
+                base = self.project.resolve_symbol(c.file, bname)
+                if isinstance(base, ClassInfo):
+                    stack.append(base)
+        return None
+
+    def _lock_for_expr(self, fi: FunctionInfo,
+                       expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self":
+                return self._class_lock_id(fi.cls, expr.attr)
+            # module singleton: `_buf.lock` where `_buf = _TraceBuffer()`
+            inst = self._module_instances.get(
+                (fi.file.rel, expr.value.id))
+            if inst is not None:
+                return self._class_lock_id(inst, expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            node = self._module_locks.get((fi.file.rel, expr.id))
+            return node.id if node is not None else None
+        return None
+
+    # -------------------------------------------------- region walking
+    def _scan_regions(self, fi: FunctionInfo) -> List[_Event]:
+        events: List[_Event] = []
+
+        def prop_target(node: ast.Attribute) -> Optional[FunctionInfo]:
+            """``self.X.Y`` / ``MOD_INST.Y`` where Y is an @property of
+            X's known class — an acquisition hidden behind an attribute
+            read (``self.scheduler.depth`` takes the scheduler lock)."""
+            base = node.value
+            ci: Optional[ClassInfo] = None
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and fi.cls is not None:
+                cname = fi.cls.attr_types.get(base.attr)
+                if cname:
+                    for cand in self.project.classes_by_name.get(
+                            cname, ()):
+                        ci = cand
+                        break
+            elif isinstance(base, ast.Name):
+                ci = self._module_instances.get((fi.file.rel, base.id))
+            if ci is None:
+                return None
+            m = self.project.mro_method(ci, node.attr)
+            if m is None:
+                return None
+            for dec in getattr(m.node, "decorator_list", ()):
+                if isinstance(dec, ast.Name) and dec.id == "property":
+                    return m
+            return None
+
+        def walk(node: ast.AST, held: FrozenSet[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fi.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner: Set[str] = set(held)
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            events.append(_Event("call", sub.lineno,
+                                                 frozenset(inner),
+                                                 node=sub))
+                    lid = self._lock_for_expr(fi, item.context_expr)
+                    if lid is not None:
+                        events.append(_Event("acquire",
+                                             item.context_expr.lineno,
+                                             frozenset(inner), lock=lid))
+                        inner.add(lid)
+                for st in node.body:
+                    walk(st, frozenset(inner))
+                return
+            if isinstance(node, ast.Call):
+                events.append(_Event("call", node.lineno, held, node=node))
+            elif isinstance(node, ast.Attribute) and held \
+                    and isinstance(node.ctx, ast.Load):
+                t = prop_target(node)
+                if t is not None:
+                    events.append(_Event("pcall", node.lineno, held,
+                                         target=t))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for st in (fi.node.body if not isinstance(fi.node, ast.Module)
+                   else []):
+            walk(st, frozenset())
+        return events
+
+    # --------------------------------------------- held-context fixpoint
+    def _callees(self, fi: FunctionInfo,
+                 call: ast.Call) -> List[FunctionInfo]:
+        got = self._resolved.get(id(call))
+        if got is None:
+            got = list(self.cg.resolve_call(fi, call))
+            # `self.X.Y()` / `MOD_INST.Y()` through the known attribute
+            # type — the cross-OBJECT edges (server holding its cv while
+            # poking the scheduler) are exactly what lock ordering is
+            # about, so the lock analysis resolves one hop deeper than
+            # the base callgraph
+            f = call.func
+            if not got and isinstance(f, ast.Attribute):
+                base = f.value
+                ci: Optional[ClassInfo] = None
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self" and fi.cls is not None:
+                    cname = fi.cls.attr_types.get(base.attr)
+                    if cname:
+                        for cand in self.project.classes_by_name.get(
+                                cname, ()):
+                            ci = cand
+                            break
+                elif isinstance(base, ast.Name):
+                    ci = self._module_instances.get(
+                        (fi.file.rel, base.id))
+                if ci is not None:
+                    m = self.project.mro_method(ci, f.attr)
+                    if m is not None:
+                        got.append(m)
+            self._resolved[id(call)] = got
+        return got
+
+    def _fixpoint(self) -> None:
+        funcs = self.project.functions
+        for _ in range(12):
+            changed = False
+            for qual, events in self._events.items():
+                fi = funcs.get(qual)
+                if fi is None:
+                    continue
+                inherited = self.entry_held.get(qual, set())
+                for ev in events:
+                    ctx = set(ev.held) | inherited
+                    if not ctx:
+                        continue
+                    targets: List[FunctionInfo] = []
+                    if ev.kind == "call" and ev.node is not None:
+                        targets = self._callees(fi, ev.node)
+                    elif ev.kind == "pcall" and ev.target is not None:
+                        targets = [ev.target]
+                    for t in targets:
+                        cur = self.entry_held.setdefault(t.qualname, set())
+                        new = ctx - cur
+                        if new:
+                            cur |= new
+                            changed = True
+                            for lid in new:
+                                base = self.entry_chain.get((qual, lid))
+                                if base is None:
+                                    base = (f"{fi.short} [holds "
+                                            f"{_short_lock(lid)} @ "
+                                            f"{fi.file.rel}:{ev.line}]",)
+                                chain = base + (t.short,) \
+                                    if len(base) < 6 else base
+                                self.entry_chain.setdefault(
+                                    (t.qualname, lid), chain)
+            if not changed:
+                break
+
+    # --------------------------------------------------- graph + R6
+    def _emit_graph_and_r6(self) -> None:
+        funcs = self.project.functions
+        edge_seen: Set[Tuple[str, str]] = set()
+        reentry_seen: Set[Tuple[str, str]] = set()
+        graph: Dict[str, Set[str]] = {}
+        edge_site: Dict[Tuple[str, str], dict] = {}
+        for qual, events in self._events.items():
+            fi = funcs.get(qual)
+            if fi is None:
+                continue
+            inherited = self.entry_held.get(qual, set())
+            for ev in events:
+                if ev.kind != "acquire" or ev.lock is None:
+                    continue
+                self.acquisitions.append({
+                    "lock": ev.lock, "function": fi.short,
+                    "file": fi.file.rel, "line": ev.line})
+                ctx = set(ev.held) | inherited
+                if ev.lock in ctx:
+                    kind = self.locks[ev.lock].kind \
+                        if ev.lock in self.locks else "Lock"
+                    if kind in _NONREENTRANT \
+                            and (qual, ev.lock) not in reentry_seen:
+                        reentry_seen.add((qual, ev.lock))
+                        chain = self.entry_chain.get((qual, ev.lock), ())
+                        self.findings.append(Finding(
+                            "R6", fi.file.rel, ev.line,
+                            f"re-enters non-reentrant {kind} "
+                            f"`{_short_lock(ev.lock)}` already held on "
+                            f"this path — unconditional self-deadlock",
+                            symbol=fi.short,
+                            snippet=fi.file.snippet(ev.line),
+                            chain=chain,
+                            hint="release before calling back in, make "
+                                 "the helper lock-free (_locked suffix "
+                                 "convention), or use an RLock "
+                                 "deliberately"))
+                    ctx = ctx - {ev.lock}
+                for held_lock in sorted(ctx):
+                    edge = {"held": held_lock, "acquired": ev.lock,
+                            "function": fi.short, "file": fi.file.rel,
+                            "line": ev.line,
+                            "chain": list(self.entry_chain.get(
+                                (qual, held_lock), ()))}
+                    if (held_lock, ev.lock) not in edge_seen:
+                        edge_seen.add((held_lock, ev.lock))
+                        self.order_edges.append(edge)
+                        edge_site[(held_lock, ev.lock)] = edge
+                    graph.setdefault(held_lock, set()).add(ev.lock)
+        # cycles over the order graph: every SCC of size >1 is a
+        # deadlock knot. Report ONE finding per SCC naming EVERY
+        # intra-SCC edge (each such edge provably lies on some cycle —
+        # its endpoints are mutually reachable), not a synthetic walk
+        # through the SCC in discovery order: overlapping cycles
+        # (a<->b and b<->c share one SCC) must all surface.
+        for scc in _sccs(graph):
+            nodes = set(scc)
+            edges_in = sorted((u, v) for (u, v) in edge_site
+                              if u in nodes and v in nodes)
+            if not edges_in:
+                continue
+            sites = [edge_site[p] for p in edges_in]
+            anchor = sites[0]
+            desc = "; ".join(
+                f"{_short_lock(u)} -> {_short_lock(v)} at "
+                f"{s['file']}:{s['line']} ({s['function']})"
+                for (u, v), s in zip(edges_in, sites))
+            names = ", ".join(sorted(_short_lock(n) for n in nodes))
+            self.findings.append(Finding(
+                "R6", anchor["file"], anchor["line"],
+                f"lock-order cycle among {names}: {desc} — threads "
+                f"interleaving these paths deadlock",
+                symbol=anchor["function"],
+                snippet="", hint="impose one global acquisition order "
+                                 "(or drop to a single lock); every "
+                                 "edge above sits on a cycle — break "
+                                 "the set"))
+
+    # ----------------------------------------------------------- R7
+    def _blocking(self, fi: FunctionInfo,
+                  call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(label, hint) when ``call`` can stall the holding thread."""
+        f = call.func
+        path = dotted_path(f)
+        dotted = None
+        if path:
+            alias = fi.file.aliases.get(path[0])
+            root = alias[1] if alias and alias[0] == "module" else path[0]
+            dotted = (root,) + path[1:]
+        # time.sleep
+        if dotted and (dotted[0], dotted[-1]) in _SLEEP_PATHS:
+            return ("`time.sleep` under a held lock",
+                    "sleep outside the region, or poll with the lock "
+                    "released")
+        # explicit syncs
+        if path and path[-1] in _SYNC_TERMINALS:
+            return (f"`{'.'.join(path)}` (host sync) under a held lock",
+                    "copy the refs out under the lock, sync outside")
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not call.args and not call.keywords:
+            return ("`.item()` (host sync) under a held lock",
+                    "copy the refs out under the lock, sync outside")
+        # compiled-program dispatch
+        for dc in self.cg.dispatch_calls.get(fi.qualname, ()):
+            if dc.node is call:
+                return ("compiled-program dispatch under a held lock",
+                        "dispatch outside; commit results under the "
+                        "lock afterwards")
+        # device buffer update: stack.at[i].set(...)
+        if isinstance(f, ast.Attribute) and f.attr in _BUFFER_UPDATES \
+                and isinstance(f.value, ast.Subscript) \
+                and isinstance(f.value.value, ast.Attribute) \
+                and f.value.value.attr == "at":
+            return ("device buffer update (`.at[...].%s`) under a held "
+                    "lock" % f.attr,
+                    "stage the device write outside the metadata lock "
+                    "(serialize writers with a dedicated staging lock), "
+                    "commit the handle under it")
+        # unbounded waits
+        if isinstance(f, ast.Attribute) and f.attr == "wait" \
+                and not call.args \
+                and not any(kw.arg == "timeout" for kw in call.keywords):
+            return ("unbounded `.wait()` under a held lock",
+                    "pass a timeout and re-check the predicate — an "
+                    "unbounded wait wedges shutdown/drain")
+        if isinstance(f, ast.Attribute) and f.attr == "get" \
+                and not call.args \
+                and not any(kw.arg in ("timeout", "block")
+                            for kw in call.keywords):
+            return ("unbounded `queue.get()` under a held lock",
+                    "use get(timeout=...) or get_nowait() + retry with "
+                    "the lock released")
+        if isinstance(f, ast.Attribute) and f.attr == "join" \
+                and not call.args and not call.keywords:
+            return ("unbounded `.join()` under a held lock",
+                    "join with a timeout outside the lock — the joined "
+                    "thread may need this very lock to finish")
+        # file I/O
+        if isinstance(f, ast.Name) and f.id in _IO_NAME_CALLS:
+            return ("file I/O (`open`) under a held lock",
+                    "snapshot under the lock, write outside (the flight "
+                    "recorder's dump discipline)")
+        if path and len(path) >= 2 and path[-1] in _IO_DOTTED \
+                and path[0] in ("os", "shutil"):
+            return (f"file I/O (`{'.'.join(path)}`) under a held lock",
+                    "snapshot under the lock, write outside")
+        # rpc round-trips
+        if path and path[-1] in _RPC_NAMES:
+            return ("rpc round-trip under a held lock",
+                    "resolve the target under the lock, call outside")
+        return None
+
+    def _emit_r7(self) -> None:
+        funcs = self.project.functions
+        seen: Set[Tuple[str, int, str]] = set()
+        for qual, events in self._events.items():
+            fi = funcs.get(qual)
+            if fi is None:
+                continue
+            inherited = self.entry_held.get(qual, set())
+            for ev in events:
+                if ev.kind != "call" or ev.node is None:
+                    continue
+                ctx = set(ev.held) | inherited
+                if not ctx:
+                    continue
+                got = self._blocking(fi, ev.node)
+                if got is None:
+                    continue
+                label, hint = got
+                key = (qual, ev.line, label)
+                if key in seen:
+                    continue
+                seen.add(key)
+                lock_names = ", ".join(sorted(_short_lock(l)
+                                              for l in ctx))
+                chain = fi.thread_chain if fi.thread_reachable else ()
+                if not chain:
+                    for lid in sorted(ctx):
+                        chain = self.entry_chain.get((qual, lid), ())
+                        if chain:
+                            break
+                self.findings.append(Finding(
+                    "R7", fi.file.rel, ev.line,
+                    f"{label} (`{lock_names}`) — every thread "
+                    f"contending the lock stalls behind it",
+                    symbol=fi.short, snippet=fi.file.snippet(ev.line),
+                    chain=chain, hint=hint))
+
+    # ------------------------------------------------------------ export
+    def lock_graph(self) -> dict:
+        return {
+            "locks": [n.as_dict() for n in
+                      sorted(self.locks.values(), key=lambda n: n.id)],
+            "acquisitions": sorted(
+                self.acquisitions,
+                key=lambda a: (a["file"], a["line"], a["lock"])),
+            "edges": sorted(
+                self.order_edges,
+                key=lambda e: (e["file"], e["line"], e["acquired"])),
+        }
+
+
+def _short_lock(lid: str) -> str:
+    # "paddle_tpu/serving/server.py::InferenceServer._cv" -> the tail
+    return lid.split("::", 1)[-1]
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components of size >1 (Tarjan). Every edge
+    between two nodes of one SCC lies on some cycle — the caller reports
+    the full intra-SCC edge set, never a reconstructed single cycle."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            if len(scc) > 1:
+                out.append(list(reversed(scc)))
+
+    for v in sorted(set(graph) | {w for ws in graph.values()
+                                  for w in ws}):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def analyze_locks(project: Project, cg: CallGraph) -> LockAnalysis:
+    return LockAnalysis(project, cg).run()

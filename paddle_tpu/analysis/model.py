@@ -32,9 +32,29 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Finding", "SourceFile", "FunctionInfo", "ClassInfo", "Project",
-           "load_project", "RULE_IDS"]
+           "load_project", "RULE_IDS", "module_name_of", "alias_modules"]
 
-RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5")
+
+def module_name_of(rel: str) -> str:
+    """Project-relative path -> dotted module name (the ONE place the
+    ``__init__``-stripping rule lives; SourceFile and the incremental
+    cache's import overlay must never disagree on it)."""
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def alias_modules(alias: tuple) -> List[str]:
+    """Candidate module names an import-alias entry may refer to —
+    ``("module", m)`` is just m; ``("symbol", m, s)`` may be the symbol
+    s in module m OR the submodule m.s."""
+    mods = [alias[1]]
+    if alias[0] == "symbol":
+        mods.append(f"{alias[1]}.{alias[2]}")
+    return mods
+
+RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*tpu-lint:\s*(disable(?:-file)?)\s*=\s*(.*?)\s*$")
@@ -66,6 +86,14 @@ class Finding:
                 "snippet": self.snippet, "chain": list(self.chain),
                 "hint": self.hint, "key": self.key()}
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(rule=d["rule"], path=d["path"], line=int(d["line"]),
+                   message=d["message"], symbol=d.get("symbol", ""),
+                   snippet=d.get("snippet", ""),
+                   chain=tuple(d.get("chain") or ()),
+                   hint=d.get("hint", ""))
+
     def render(self) -> str:
         sym = f" [{self.symbol}]" if self.symbol else ""
         out = f"{self.rule} {self.path}:{self.line}{sym} {self.message}"
@@ -93,10 +121,8 @@ class SourceFile:
             self.text = f.read()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=path)
-        parts = self.rel[:-3].split("/")
-        if parts[-1] == "__init__":
-            parts = parts[:-1]
-        self.module = ".".join(parts)
+        self.module = module_name_of(self.rel)
+        parts = self.module.split(".") if self.module else []
         self.package = ".".join(parts[:-1]) if parts else ""
         if self.rel.endswith("__init__.py"):
             self.package = self.module
@@ -199,6 +225,14 @@ class ClassInfo:
     attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class name
     # self.X = threading.Lock()/RLock()/Condition()
     lock_attrs: List[str] = field(default_factory=list)
+    # lock attr -> ctor kind ("Lock"/"RLock"/"Condition"/...)
+    lock_kinds: Dict[str, str] = field(default_factory=dict)
+    # lock attr -> ctor line (the lock graph's node anchor)
+    lock_lines: Dict[str, int] = field(default_factory=dict)
+    # `self._cv = threading.Condition(self._lock)` — _cv IS _lock: the
+    # two names must collapse onto one lock node or every cv use would
+    # look like a second lock (and a false ordering edge)
+    lock_aliases: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -218,6 +252,7 @@ class FunctionInfo:
     trace_chain: Tuple[str, ...] = ()
     thread_root: bool = False
     thread_reachable: bool = False
+    thread_chain: Tuple[str, ...] = ()
     dispatch: bool = False   # calls a known compiled callable
     nested: Dict[str, "FunctionInfo"] = field(default_factory=dict)
     parent: Optional["FunctionInfo"] = None
@@ -302,6 +337,13 @@ class Project:
                     if cname in _LOCK_CTORS:
                         if t.attr not in ci.lock_attrs:
                             ci.lock_attrs.append(t.attr)
+                        ci.lock_kinds.setdefault(t.attr, cname)
+                        ci.lock_lines.setdefault(t.attr, node.lineno)
+                        if cname == "Condition" and v.args \
+                                and isinstance(v.args[0], ast.Attribute) \
+                                and isinstance(v.args[0].value, ast.Name) \
+                                and v.args[0].value.id == "self":
+                            ci.lock_aliases[t.attr] = v.args[0].attr
                     elif cname and cname[:1].isupper():
                         ci.attr_types.setdefault(t.attr, cname)
 
@@ -440,12 +482,18 @@ def iter_py_files(paths: List[str]) -> List[str]:
     return out
 
 
-def load_project(root: str, paths: List[str]) -> Tuple[Project, List[Finding]]:
+def load_project(root: str, paths: List[str],
+                 parse_times: Optional[Dict[str, float]] = None
+                 ) -> Tuple[Project, List[Finding]]:
     """Parse every .py under ``paths``; returns the project plus parse/
-    suppression-policy findings (R0)."""
+    suppression-policy findings (R0). ``parse_times`` (rel -> seconds)
+    feeds the ``--json`` timing block when provided."""
+    import time as _time
+
     proj = Project(root)
     findings: List[Finding] = []
     for path in iter_py_files(paths):
+        t0 = _time.perf_counter()
         try:
             sf = SourceFile(root, path)
         except SyntaxError as e:
@@ -454,6 +502,8 @@ def load_project(root: str, paths: List[str]) -> Tuple[Project, List[Finding]]:
                 "R0", rel, int(e.lineno or 1),
                 f"file does not parse: {e.msg}"))
             continue
+        if parse_times is not None:
+            parse_times[sf.rel] = _time.perf_counter() - t0
         proj.add_file(sf)
         for s in sf.bad_suppressions:
             findings.append(Finding(

@@ -31,13 +31,14 @@ are device futures until something forces them).
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .callgraph import CallGraph, dotted_path
 from .model import ClassInfo, Finding, FunctionInfo, Project
 
-__all__ = ["run_rules", "RULE_DOCS"]
+__all__ = ["run_rules", "RulesOutput", "FileTimer", "RULE_DOCS"]
 
 RULE_DOCS = {
     "R0": "suppression policy / parse errors (reasons are mandatory)",
@@ -48,14 +49,56 @@ RULE_DOCS = {
     "R4": "PRNG key consumed by >=2 random ops without split/fold_in",
     "R5": "shared attribute bypassing its majority-use lock in a "
           "threaded class",
+    "R6": "lock-order cycle across the interprocedural acquisition "
+          "graph, or re-entry through a non-reentrant Lock",
+    "R7": "blocking operation (host sync, compiled dispatch, buffer "
+          "update, sleep, unbounded wait/get/join, file I/O, rpc) "
+          "inside a held-lock region",
+    "R8": "mesh-axis/sharding discipline (undeclared PartitionSpec "
+          "axis, frozen program-axis resize, shard_map arity, "
+          "donated-input reshard)",
 }
+
+
+class FileTimer:
+    """Per-file wall-clock accounting for the ``--json`` timing block.
+
+    ``parse`` is exact (one entry per file parse); ``lint`` accumulates
+    the per-function/per-class rule passes attributed to the defining
+    file (the dominant cost — whole-project passes like the callgraph
+    BFS are reported in the rule totals instead)."""
+
+    def __init__(self):
+        self.parse: Dict[str, float] = {}
+        self.lint: Dict[str, float] = {}
+
+    def add(self, rel: str, dt: float) -> None:
+        self.lint[rel] = self.lint.get(rel, 0.0) + dt
+
+    def timed(self, items, rel_of):
+        for x in items:
+            t0 = time.perf_counter()
+            yield x
+            self.add(rel_of(x), time.perf_counter() - t0)
+
+    def files_ms(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for rel, dt in self.parse.items():
+            out.setdefault(rel, {})["parse_ms"] = round(dt * 1e3, 3)
+        for rel, dt in self.lint.items():
+            out.setdefault(rel, {})["lint_ms"] = round(dt * 1e3, 3)
+        return out
 
 _SYNC_TERMINALS = {"device_get", "block_until_ready"}
 _HOST_CASTS = {"int", "float", "bool"}
 _STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "device",
                  "aval", "weak_type"}
 # params with these names are config plumbing, never traced arrays
-_UNTAINTED_PARAM_NAMES = {"dtype", "name", "data_format", "mode"}
+# (padding/stride/kernel geometry joined the set in the PR-7 baseline
+# re-audit: `_pool`'s ceil-mode branch was a taint FP on them)
+_UNTAINTED_PARAM_NAMES = {"dtype", "name", "data_format", "mode",
+                          "padding", "pad", "kernel_size", "stride",
+                          "dilation", "groups"}
 _HOST_RESULT_CALLS = {"asarray", "array", "device_get", "item", "int",
                       "float", "bool", "len", "isinstance", "hasattr",
                       "getattr", "repr", "str", "format"}
@@ -374,7 +417,7 @@ def _finding(rule: str, fi: FunctionInfo, line: int, msg: str,
 def run_r1(project: Project, cg: CallGraph,
            taints: Dict[str, Taint]) -> List[Finding]:
     out: List[Finding] = []
-    for fi in project.functions.values():
+    for fi in _timed_functions(project):
         chain = fi.trace_chain if fi.trace_reachable else ()
         ctx = ("inside trace-reachable code — this would sync (or fail) "
                "at trace time" if fi.trace_reachable
@@ -469,6 +512,7 @@ def _implicit_syncs(fi: FunctionInfo, t: Taint, chain, traced: bool):
 
 _OWN_CALLS_CACHE: Dict[str, List[ast.Call]] = {}
 _CG_REF: Optional[CallGraph] = None
+_TIMER: Optional[FileTimer] = None
 
 
 def cg_own_calls_cached(fi: FunctionInfo) -> List[ast.Call]:
@@ -478,11 +522,18 @@ def cg_own_calls_cached(fi: FunctionInfo) -> List[ast.Call]:
     return got
 
 
+def _timed_functions(project: Project):
+    items = project.functions.values()
+    if _TIMER is None:
+        return iter(items)
+    return _TIMER.timed(items, lambda fi: fi.file.rel)
+
+
 # ================================================================== R2
 def run_r2(project: Project, cg: CallGraph,
            taints: Dict[str, Taint]) -> List[Finding]:
     out: List[Finding] = []
-    for fi in project.functions.values():
+    for fi in _timed_functions(project):
         t = taints.get(fi.qualname)
         if fi.trace_reachable and t is not None:
             out.extend(_branch_hazards(fi, t))
@@ -940,7 +991,7 @@ class _R4Scanner:
 def run_r4(project: Project, cg: CallGraph) -> List[Finding]:
     consuming = _consuming_params(project, cg)
     out: List[Finding] = []
-    for fi in project.functions.values():
+    for fi in _timed_functions(project):
         out.extend(_R4Scanner(fi, project, cg, consuming).run())
     return out
 
@@ -1067,15 +1118,42 @@ def run_r5(project: Project, cg: CallGraph) -> List[Finding]:
 
 
 # ============================================================== driver
-def run_rules(project: Project, cg: CallGraph) -> List[Finding]:
-    global _CG_REF
+@dataclass
+class RulesOutput:
+    findings: List[Finding] = field(default_factory=list)
+    lock_graph: dict = field(default_factory=dict)
+    rule_ms: Dict[str, float] = field(default_factory=dict)
+
+
+def run_rules(project: Project, cg: CallGraph,
+              timer: Optional[FileTimer] = None) -> RulesOutput:
+    from .locks import analyze_locks
+    from .sharding import analyze_sharding
+
+    global _CG_REF, _TIMER
     _CG_REF = cg
+    _TIMER = timer
     _OWN_CALLS_CACHE.clear()
-    taints = build_taints(project, cg)
-    findings: List[Finding] = []
-    findings.extend(run_r1(project, cg, taints))
-    findings.extend(run_r2(project, cg, taints))
-    findings.extend(run_r3(project, cg))
-    findings.extend(run_r4(project, cg))
-    findings.extend(run_r5(project, cg))
-    return findings
+    out = RulesOutput()
+
+    def staged(rule: str, fn):
+        t0 = time.perf_counter()
+        got = fn()
+        out.rule_ms[rule] = round(
+            out.rule_ms.get(rule, 0.0)
+            + (time.perf_counter() - t0) * 1e3, 3)
+        return got
+
+    taints = staged("taint", lambda: build_taints(project, cg))
+    out.findings.extend(staged("R1", lambda: run_r1(project, cg, taints)))
+    out.findings.extend(staged("R2", lambda: run_r2(project, cg, taints)))
+    out.findings.extend(staged("R3", lambda: run_r3(project, cg)))
+    out.findings.extend(staged("R4", lambda: run_r4(project, cg)))
+    out.findings.extend(staged("R5", lambda: run_r5(project, cg)))
+    locks = staged("R6+R7", lambda: analyze_locks(project, cg))
+    out.findings.extend(locks.findings)
+    out.lock_graph = locks.lock_graph()
+    out.findings.extend(staged("R8",
+                               lambda: analyze_sharding(project, cg)))
+    _TIMER = None
+    return out

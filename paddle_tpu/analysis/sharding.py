@@ -1,0 +1,325 @@
+"""R8: mesh-axis & sharding discipline.
+
+GSPMD sharding is stringly-typed: a ``PartitionSpec("modle")`` typo, a
+``shard_map`` whose ``in_specs`` doesn't match the wrapped signature, or
+a resize path that quietly rewrites a frozen program axis all pass every
+unit test that doesn't run on the exact failing topology. This rule
+family checks the contracts statically:
+
+- **undeclared axis**: every string axis inside a
+  ``PartitionSpec``/``P(...)`` call must be an axis some mesh in the
+  project actually declares (``init_mesh({...})`` /
+  ``plan_mesh_shape({...})`` dict keys, ``Mesh(devs, ("a", ...))`` /
+  ``axis_names=`` tuples), or one of the framework's reserved axis
+  vocabulary (``dp``/``sdp``/``mp``/``sp``/``ep``/``pp`` — the
+  ``elastic_mesh`` contract). A spec naming an axis no mesh carries is
+  silently replicated — the worst kind of perf bug;
+- **frozen-axis resize**: ``mp``/``sp``/``ep``/``pp`` partition the
+  *program* — ``plan_mesh_shape`` freezes them across elastic resizes.
+  A function that builds a mesh AND assigns a non-constant size to a
+  frozen axis key (``axes["mp"] = n // 4``) is re-deriving a program
+  axis from capacity — exactly the invariant violation the elastic
+  shrink/grow path must never make;
+- **shard_map arity**: a tuple-literal ``in_specs`` must have one spec
+  per wrapped-function parameter, and a tuple-literal ``out_specs`` one
+  spec per returned element (checked when every ``return`` is a literal
+  tuple of consistent length). Mismatches raise at trace time — on the
+  8-device suite, not the laptop;
+- **donated-input resharding**: applying ``with_sharding_constraint`` /
+  ``device_put`` to a parameter that the jit wrap site donates forces a
+  copy of a buffer the caller just gave away — the donation saves
+  nothing and the "in-place" update silently doubles peak memory.
+
+Pure AST; axis declarations are collected project-wide in one pass.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, dotted_path
+from .model import Finding, FunctionInfo, Project
+
+__all__ = ["analyze_sharding", "RESERVED_AXES"]
+
+# the framework's reserved mesh-axis vocabulary (elastic_mesh.FROZEN_AXES
+# + the data axes it rescales) — always considered declared
+RESERVED_AXES = ("dp", "sdp", "mp", "sp", "ep", "pp")
+FROZEN_AXES = ("mp", "sp", "ep", "pp")
+
+_MESH_BUILDERS = {"init_mesh", "plan_mesh_shape", "reshaped_mesh", "Mesh"}
+_MESH_BUILDER_KWARGS_SKIP = {"devices", "shape", "frozen", "default_axes",
+                             "checkpoint_dir"}
+_SPEC_NAMES = {"PartitionSpec"}
+_RESHARD_CALLS = {"with_sharding_constraint", "device_put"}
+
+
+def _call_tail(node: ast.Call) -> Optional[str]:
+    path = dotted_path(node.func)
+    return path[-1] if path else None
+
+
+def _is_spec_call(fi: FunctionInfo, node: ast.Call) -> bool:
+    """``PartitionSpec(...)`` under any import form, including
+    ``from jax.sharding import PartitionSpec as P``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr in _SPEC_NAMES
+    if isinstance(f, ast.Name):
+        if f.id in _SPEC_NAMES:
+            return True
+        alias = fi.file.aliases.get(f.id)
+        return bool(alias and alias[0] == "symbol"
+                    and alias[2] in _SPEC_NAMES)
+    return False
+
+
+def _string_consts(node: ast.AST) -> List[Tuple[str, int]]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append((sub.value, getattr(sub, "lineno", 0)))
+    return out
+
+
+def _own_walk(fi: FunctionInfo):
+    """Every node of ``fi`` excluding nested function subtrees (those
+    are their own FunctionInfo — walking them twice would double every
+    finding)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fi.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclass
+class _Sites:
+    """One-pass collection of every R8-relevant node in a function."""
+
+    spec_calls: List[ast.Call] = None
+    mesh_calls: List[ast.Call] = None
+    shard_maps: List[ast.Call] = None
+    frozen_stores: List[ast.Assign] = None
+
+    def __post_init__(self):
+        self.spec_calls = []
+        self.mesh_calls = []
+        self.shard_maps = []
+        self.frozen_stores = []
+
+
+def _collect_sites(fi: FunctionInfo) -> _Sites:
+    s = _Sites()
+    for node in _own_walk(fi):
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            if tail in _MESH_BUILDERS:
+                s.mesh_calls.append(node)
+            elif tail == "shard_map" and node.args:
+                s.shard_maps.append(node)
+            if _is_spec_call(fi, node):
+                s.spec_calls.append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Subscript):
+            key = node.targets[0].slice
+            if isinstance(key, ast.Constant) \
+                    and isinstance(key.value, str) \
+                    and key.value in FROZEN_AXES \
+                    and not isinstance(node.value, ast.Constant):
+                s.frozen_stores.append(node)
+    return s
+
+
+def _declared_axes_from(sites: _Sites) -> Set[str]:
+    axes: Set[str] = set()
+    for node in sites.mesh_calls:
+        tail = _call_tail(node)
+        # dict-literal shapes: keys are axis names
+        cands: List[ast.AST] = list(node.args[:1])
+        for kw in node.keywords:
+            if kw.arg in ("shape", "default_axes", "saved_axes"):
+                cands.append(kw.value)
+            elif kw.arg == "axis_names":
+                axes.update(s for s, _ in _string_consts(kw.value))
+            elif kw.arg is not None \
+                    and kw.arg not in _MESH_BUILDER_KWARGS_SKIP:
+                # init_mesh(dp=2, mp=4) keyword form
+                axes.add(kw.arg)
+        for c in cands:
+            if isinstance(c, ast.Dict):
+                for k in c.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        axes.add(k.value)
+        # Mesh(devs, ("dp", "mp")) positional axis names
+        if tail == "Mesh" and len(node.args) >= 2:
+            axes.update(s for s, _ in _string_consts(node.args[1]))
+    return axes
+
+
+def _finding(fi: FunctionInfo, line: int, msg: str, hint: str) -> Finding:
+    return Finding("R8", fi.file.rel, line, msg, symbol=fi.short,
+                   snippet=fi.file.snippet(line), hint=hint,
+                   chain=fi.trace_chain if fi.trace_reachable else ())
+
+
+def _check_specs(fi: FunctionInfo, sites: _Sites, declared: Set[str],
+                 out: List[Finding]) -> None:
+    for node in sites.spec_calls:
+        exprs = list(node.args) + [kw.value for kw in node.keywords]
+        for e in exprs:
+            for s, line in _string_consts(e):
+                if s not in declared:
+                    out.append(_finding(
+                        fi, line or node.lineno,
+                        f"PartitionSpec names axis {s!r} that no "
+                        f"mesh in the project declares — the "
+                        f"dimension silently replicates (or the "
+                        f"spec raises on a real mesh)",
+                        hint=f"declare the axis in the mesh "
+                             f"shape, or use one of "
+                             f"{sorted(declared)[:8]}..."))
+
+
+def _check_frozen_mutation(fi: FunctionInfo, sites: _Sites,
+                           out: List[Finding]) -> None:
+    if not sites.mesh_calls:
+        return
+    for node in sites.frozen_stores:
+        key = node.targets[0].slice
+        out.append(_finding(
+            fi, node.lineno,
+            f"frozen program axis {key.value!r} resized from a "
+            f"computed value on a mesh-building path — "
+            f"`plan_mesh_shape` freezes {FROZEN_AXES} across elastic "
+            f"resizes (resizing them changes the partitioned "
+            f"program, not the data layout)",
+            hint="let plan_mesh_shape rescale the data axes "
+                 "(dp/sdp) instead; a frozen-axis change is a "
+                 "retrain-time decision, not a resize"))
+
+
+def _wrapped_arity(project: Project, cg: CallGraph, fi: FunctionInfo,
+                   expr: ast.AST) -> Optional[Tuple[int, int]]:
+    """(required, total) POSITIONAL arity of the wrapped function —
+    keyword-only params never receive an in_spec, and defaulted params
+    are optional, so a spec count anywhere in the range is legal."""
+    a = None
+    if isinstance(expr, ast.Lambda):
+        a = expr.args
+    else:
+        target = None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            target = cg._target_function(fi, expr)
+        if target is None:
+            return None
+        a = target.node.args
+    if a.vararg or a.kwarg:
+        return None
+    pos = [p.arg for p in a.posonlyargs + a.args
+           if p.arg not in ("self", "cls")]
+    total = len(pos)
+    required = total - len(a.defaults)
+    return max(0, required), total
+
+
+def _return_arities(target: FunctionInfo) -> Optional[int]:
+    """Consistent literal-tuple return length, else None. Nested
+    function subtrees are PRUNED (ast.walk + continue would skip only
+    the def node, not its returns — a closure's `return a, b` must not
+    masquerade as the wrapped function's)."""
+    lens: Set[int] = set()
+    for node in _own_walk(target):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Tuple):
+                lens.add(len(node.value.elts))
+            else:
+                return None
+    if len(lens) == 1:
+        return lens.pop()
+    return None
+
+
+def _check_shard_map(fi: FunctionInfo, sites: _Sites, project: Project,
+                     cg: CallGraph, out: List[Finding]) -> None:
+    for node in sites.shard_maps:
+        in_specs = next((kw.value for kw in node.keywords
+                         if kw.arg == "in_specs"), None)
+        out_specs = next((kw.value for kw in node.keywords
+                          if kw.arg == "out_specs"), None)
+        wrapped = node.args[0]
+        arity = _wrapped_arity(project, cg, fi, wrapped)
+        if arity is not None and isinstance(in_specs,
+                                            (ast.Tuple, ast.List)):
+            required, total = arity
+            n = len(in_specs.elts)
+            if not (required <= n <= total):
+                want = (str(total) if required == total
+                        else f"{required}..{total}")
+                out.append(_finding(
+                    fi, node.lineno,
+                    f"shard_map in_specs has {n} spec(s) but the "
+                    f"wrapped function takes {want} positional "
+                    f"argument(s) — this raises at trace time on a "
+                    f"real mesh",
+                    hint="one PartitionSpec per wrapped positional "
+                         "parameter, in order"))
+        target = None
+        if isinstance(wrapped, (ast.Name, ast.Attribute)):
+            target = cg._target_function(fi, wrapped)
+        if target is not None and isinstance(out_specs,
+                                             (ast.Tuple, ast.List)):
+            rets = _return_arities(target)
+            if rets is not None and rets != len(out_specs.elts):
+                out.append(_finding(
+                    fi, node.lineno,
+                    f"shard_map out_specs has {len(out_specs.elts)} "
+                    f"spec(s) but `{target.short}` returns {rets} "
+                    f"element(s)",
+                    hint="one PartitionSpec per returned element"))
+
+
+def _check_donated_reshard(project: Project, cg: CallGraph,
+                           out: List[Finding]) -> None:
+    for root, info in cg.trace_roots:
+        if not info.donate:
+            continue
+        params = [p for p in root.params if p not in ("self", "cls")]
+        donated = {params[i] for i in info.donate if 0 <= i < len(params)}
+        if not donated:
+            continue
+        for node in ast.walk(root.node):
+            if not isinstance(node, ast.Call):
+                continue
+            path = dotted_path(node.func)
+            if not path or path[-1] not in _RESHARD_CALLS:
+                continue
+            if node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in donated:
+                out.append(_finding(
+                    root, node.lineno,
+                    f"`{path[-1]}` resharding `{node.args[0].id}`, which "
+                    f"is DONATED at the wrap site ({info.site}) — the "
+                    f"reshard copies a buffer the caller gave away "
+                    f"(donation saves nothing, peak memory doubles)",
+                    hint="reshard at the call boundary before donating, "
+                         "or drop the argument from donate_argnums"))
+
+
+def analyze_sharding(project: Project, cg: CallGraph) -> List[Finding]:
+    out: List[Finding] = []
+    per_fi = [(fi, _collect_sites(fi))
+              for fi in project.functions.values()]
+    declared: Set[str] = set(RESERVED_AXES)
+    for _, sites in per_fi:
+        declared |= _declared_axes_from(sites)
+    for fi, sites in per_fi:
+        _check_specs(fi, sites, declared, out)
+        _check_frozen_mutation(fi, sites, out)
+        _check_shard_map(fi, sites, project, cg, out)
+    _check_donated_reshard(project, cg, out)
+    return out

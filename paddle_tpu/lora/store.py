@@ -193,7 +193,17 @@ class AdapterStore:
         self.max_loaded = int(max_loaded)
         self.slots = self.max_loaded + 1      # +1: reserved zero row 0
         st = model.__dict__["_lora_applied"]
+        # two-lock discipline (tpu_lint R7): `_lock` guards the host
+        # metadata maps and is held only for dict/int work — the router's
+        # placement probes (resident/known/salt), the metrics collectors
+        # (stats) and the engine's release path contend it every request
+        # and must never stall behind device work. `_write_lock`
+        # serializes page STAGING (the .at[slot].set H2D writes) and is
+        # taken only by writers (acquire's miss path, register's
+        # refresh); it is always acquired FIRST, `_lock` only inside it
+        # — one global order, so R6 stays cycle-free.
         self._lock = threading.Lock()
+        self._write_lock = threading.Lock()
         self._tick = 0
         # row bookkeeping: _names[s] is the adapter resident in row s
         self._names: List[Optional[str]] = [BASE_ADAPTER] + \
@@ -260,22 +270,37 @@ class AdapterStore:
                 f"adapter name must be a non-empty string != "
                 f"{BASE_ADAPTER!r}, got {name!r}")
         pages = self._as_pages(state)
-        with self._lock:
-            self._host[name] = pages
-            self._versions[name] = self._versions.get(name, 0) + 1
-            slot = self._by_name.get(name)
-            if slot is not None:
-                if self._pins[slot] > 0:
-                    # live streams are mid-decode against the OLD pages:
-                    # overwriting in place would hand them mixed-version
+        with self._write_lock:
+            with self._lock:
+                slot = self._by_name.get(name)
+                if slot is not None and self._pins[slot] > 0:
+                    slot = None     # live streams: never rewrite in place
+            staged = self._stage_pages(slot, pages) \
+                if slot is not None else None
+            with self._lock:
+                self._host[name] = pages
+                self._versions[name] = self._versions.get(name, 0) + 1
+                cur = self._by_name.get(name)
+                if cur is not None and self._pins[cur] > 0:
+                    # live streams are mid-decode against the OLD pages
+                    # (a pin may have landed while we staged):
+                    # publishing now would hand them mixed-version
                     # weights. Orphan the row instead — pinned streams
                     # keep it (it frees once they finish), the name
                     # unmaps so the next acquire() stages the NEW pages
-                    # into a fresh row.
+                    # into a fresh row. The staged write is discarded.
                     del self._by_name[name]
-                    self._names[slot] = None
-                else:
-                    self._write_pages_locked(slot, pages)
+                    self._names[cur] = None
+                elif staged is not None and cur == slot:
+                    # pages + version bump publish under ONE lock hold,
+                    # so a concurrent acquire(with_salt=True) can never
+                    # pair the new salt with the old pages (or vice
+                    # versa) — the PR-9 namespace invariant
+                    self.tensors = staged
+                    self.loads += 1
+                elif cur is not None:
+                    del self._by_name[name]
+                    self._names[cur] = None
 
     def load(self, name: str, directory: str) -> None:
         """Load an adapter checkpoint from ``directory`` and register it
@@ -284,14 +309,22 @@ class AdapterStore:
         self.register(name, state)
 
     # ---------------------------------------------------------- residency
-    def _write_pages_locked(self, slot: int, pages: Dict) -> None:
+    def _stage_pages(self, slot: int, pages: Dict) -> Dict:
         # a row write per target layer: shape-stable device updates (the
-        # stacks stay jit inputs of unchanged aval — no recompile)
-        self.tensors = {
-            path: (a_stack.at[slot].set(pages[path][0]),
-                   b_stack.at[slot].set(pages[path][1]))
-            for path, (a_stack, b_stack) in self.tensors.items()}
-        self.loads += 1
+        # stacks stay jit inputs of unchanged aval — no recompile).
+        # Builds the WHOLE new stack dict and returns it; the caller
+        # publishes `self.tensors = staged` under `_lock` (one atomic
+        # assignment, so dispatch-side readers see all-old or all-new).
+        # Only `_write_lock` is held here — the metadata lock the
+        # router/metrics threads contend is free during the H2D writes.
+        staged = {}
+        for path, (a_stack, b_stack) in self.tensors.items():
+            a = a_stack.at[slot].set(  # tpu-lint: disable=R7(writer-only staging lock; the contended metadata lock is free)
+                pages[path][0])
+            b = b_stack.at[slot].set(  # tpu-lint: disable=R7(writer-only staging lock; the contended metadata lock is free)
+                pages[path][1])
+            staged[path] = (a, b)
+        return staged
 
     def acquire(self, name: Optional[str], *, with_salt: bool = False):
         """Resolve ``name`` to a resident stack row and pin it (one pin
@@ -309,9 +342,28 @@ class AdapterStore:
                 self._pins[0] += 1
             return (0, b"") if with_salt else 0
         with self._lock:
+            # resident fast path: pin + touch + salt under one hold —
+            # no staging, so the write lock is never involved
             self._tick += 1
             slot = self._by_name.get(name)
-            if slot is None:
+            if slot is not None:
+                self._pins[slot] += 1
+                self._touch_locked(slot)
+                if not with_salt:
+                    return slot
+                return slot, self._salt_locked(name)
+        # miss: stage the pages with the metadata lock RELEASED (the
+        # pre-fix shape held it across the .at[slot].set H2D writes,
+        # stalling every placement probe — tpu_lint R7's poster child)
+        with self._write_lock:
+            with self._lock:
+                slot = self._by_name.get(name)
+                if slot is not None:        # a register() raced us in
+                    self._pins[slot] += 1
+                    self._touch_locked(slot)
+                    if not with_salt:
+                        return slot
+                    return slot, self._salt_locked(name)
                 pages = self._host.get(name)
                 if pages is None:
                     raise AdapterError(
@@ -323,14 +375,31 @@ class AdapterStore:
                         f"all {self.max_loaded} adapter rows are pinned "
                         f"by live requests; raise max_loaded (>= engine "
                         f"slots is always safe) or shed load")
-                self._write_pages_locked(slot, pages)
+                # reserve: a PINNED nameless row — _free_slot_locked
+                # skips it, so no concurrent writer can steal the slot
+                # while we stage outside the lock
+                self._pins[slot] += 1
+                self._touch_locked(slot)
+            try:
+                staged = self._stage_pages(slot, pages)
+            except BaseException:
+                with self._lock:
+                    # roll the reservation back — guarded like release():
+                    # a crash-recovery release_all() may have zeroed the
+                    # pins while we staged outside `_lock`, and an
+                    # unguarded decrement would underflow to -1 (making
+                    # a later-pinned live row look evictable)
+                    if self._pins[slot] > 0:
+                        self._pins[slot] -= 1
+                raise
+            with self._lock:
+                self.tensors = staged
+                self.loads += 1
                 self._names[slot] = name
                 self._by_name[name] = slot
-            self._pins[slot] += 1
-            self._touch_locked(slot)
-            if not with_salt:
-                return slot
-            return slot, self._salt_locked(name)
+                if not with_salt:
+                    return slot
+                return slot, self._salt_locked(name)
 
     def _touch_locked(self, slot: int) -> None:
         self._last_use[slot] = self._tick

@@ -42,6 +42,7 @@ def causal_attention(q, k, v, dropout_p=0.0, training=True, use_flash=True):
     """Causal self-attention on [B, L, H, D]; Pallas flash path when the
     gate allows, XLA-fused softmax otherwise."""
     p_drop = dropout_p if training else 0.0
+    # tpu-lint: disable=R2(flash gate reads only static shape/dtype/platform of q,k — per-shape program selection inside the bucketed compile budget, re-audited PR 12)
     if use_flash and fa.should_use_flash(q, k, None, p_drop):
         if p_drop > 0.0:
             from ..nn.layer import take_rng_key

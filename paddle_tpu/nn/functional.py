@@ -976,6 +976,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     from ..kernels import flash_attention as _fa
 
     p_drop = dropout_p if training else 0.0
+    # tpu-lint: disable=R2(flash gate reads only static shape/dtype/platform of q,k — per-shape program selection inside the bucketed compile budget, re-audited PR 12)
     if _fa.should_use_flash(q, k, attn_mask, p_drop):
         bias, bias_grad = None, True
         if attn_mask is not None:

@@ -1,19 +1,23 @@
 """tpu_lint: trace-discipline static analysis.
 
-Per rule (R1–R5): >=2 true-positive fixtures modeled on real (pre-fix)
+Per rule (R1–R8): >=2 true-positive fixtures modeled on real (pre-fix)
 defect shapes from this repo, plus >=1 false-positive guard proving the
 idioms the codebase relies on stay clean. Then the policy layer
 (mandatory suppression reasons, baseline accept/new/stale semantics), the
-CLI exit codes, and a whole-repo smoke run against the checked-in
-baseline asserting zero NEW findings.
+incremental engine (content-hash cache invalidation, ``--changed-only``),
+the CLI exit codes, and a whole-repo smoke run against the checked-in
+baseline asserting zero NEW findings (plus the real lock graph naming
+the serving/lora acquisition edges).
 
 Everything here is pure-AST over tmp fixture trees — no jit, no device
 work — so the module stays far under the tier-1 time budget (the one
-whole-repo parse is ~5 s on the 2-core box).
+whole-repo parse is ~6 s on the 2-core box; its result is cached, so the
+later whole-repo assertions are millisecond cache hits).
 """
 import importlib.util
 import json
 import os
+import subprocess
 import textwrap
 
 import pytest
@@ -599,6 +603,561 @@ def test_r0_findings_are_never_baselinable(tmp_path):
     assert any(f.rule == "R0" for f in new)  # still fails
 
 
+# ================================================================== R6
+def test_r6_interprocedural_reentry(tmp_path):
+    # acquiring a non-reentrant Lock inside a helper reached from a
+    # region already holding it — the single-thread self-deadlock
+    fs = lint(tmp_path, """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+                    self._evict()
+
+            def _evict(self):
+                with self._lock:
+                    self._items.clear()
+    """)
+    r6 = rules_at(fs, "R6")
+    assert any("re-enters non-reentrant" in f.message
+               and f.symbol == "Store._evict" for f in r6)
+    # the evidence chain names the path that arrives with the lock held
+    assert any("Store.put" in " ".join(f.chain) for f in r6)
+
+
+def test_r6_cross_class_lock_order_cycle(tmp_path):
+    # A->B on one path, B->A on another: two threads interleaving
+    # deadlock. The second acquire is behind a cross-object method call.
+    fs = lint(tmp_path, """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self.a = A()
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def poke(self):
+                with self._lock:
+                    self.a.fwd()
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def fwd(self):
+                with self._lock:
+                    self.b.bump()
+    """)
+    r6 = rules_at(fs, "R6")
+    assert any("lock-order cycle" in f.message for f in r6)
+
+
+def test_r6_overlapping_cycles_all_edges_named(tmp_path):
+    # a<->b and b<->c share one SCC: the finding must name EVERY edge
+    # of the knot (not a synthetic walk that hides the second deadlock)
+    fs = lint(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def down(self):
+                with self._lock:
+                    self.b.noop()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = A()
+                self.c = C()
+
+            def noop(self):
+                with self._lock:
+                    pass
+
+            def poke(self):
+                with self._lock:
+                    self.a.ping()
+
+            def up(self):
+                with self._lock:
+                    self.c.down()
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def ping(self):
+                with self._lock:
+                    pass
+
+            def fwd(self):
+                with self._lock:
+                    self.b.noop()
+    """)
+    cyc = [f for f in rules_at(fs, "R6")
+           if "lock-order cycle" in f.message]
+    text = " ".join(f.message for f in cyc)
+    # both deadlock pairs surface, with both directions of each
+    assert "A._lock -> B._lock" in text and "B._lock -> A._lock" in text
+    assert "B._lock -> C._lock" in text and "C._lock -> B._lock" in text
+
+
+def test_r6_consistent_order_is_clean(tmp_path):
+    # nested locks taken in ONE global order everywhere — legal
+    fs = lint(tmp_path, """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def fwd(self):
+                with self._lock:
+                    self.b.bump()
+
+            def bwd(self):
+                with self._lock:
+                    self.b.bump()
+    """)
+    assert rules_at(fs, "R6") == []
+
+
+def test_r6_rlock_reentry_and_cv_alias_are_clean(tmp_path):
+    # RLock re-entry is legal; Condition(self._lock) is the SAME lock
+    # (one node in the graph), not a second lock ordered against it
+    fs = lint(tmp_path, """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    self._n += 1
+
+        class Cv:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._q = []
+
+            def put(self, x):
+                with self._cv:
+                    self._q.append(x)
+                    self._cv.notify_all()
+
+            def flush(self):
+                with self._lock:
+                    self._q.clear()
+    """)
+    assert rules_at(fs, "R6") == []
+    # and the alias really collapsed: a cv re-entry IS caught
+    fs2 = lint(tmp_path, """
+        import threading
+
+        class Cv:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._q = []
+
+            def put(self, x):
+                with self._cv:
+                    self._drain()
+
+            def _drain(self):
+                with self._lock:
+                    self._q.clear()
+    """, name="mod2.py")
+    assert any("re-enters non-reentrant" in f.message
+               for f in rules_at(fs2, "R6"))
+
+
+# ================================================================== R7
+def test_r7_device_page_write_under_lock(tmp_path):
+    # the pre-fix AdapterStore shape: .at[slot].set H2D staging while
+    # holding the metadata lock every placement probe contends
+    fs = lint(tmp_path, """
+        import threading
+
+        class PageStore:
+            def __init__(self, stacks):
+                self._lock = threading.Lock()
+                self.tensors = stacks
+                self._names = {}
+
+            def acquire(self, name, slot, pages):
+                with self._lock:
+                    self.tensors = {
+                        k: (a.at[slot].set(pages[k][0]),
+                            b.at[slot].set(pages[k][1]))
+                        for k, (a, b) in self.tensors.items()}
+                    self._names[name] = slot
+
+            def resident(self, name):
+                with self._lock:
+                    return name in self._names
+    """)
+    r7 = rules_at(fs, "R7")
+    assert any("device buffer update" in f.message
+               and f.symbol == "PageStore.acquire" for f in r7)
+
+
+def test_r7_sleep_and_unbounded_wait_under_lock(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+        import time
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+                self._jobs = []
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.5)
+                    return list(self._jobs)
+
+            def wait_all(self):
+                with self._cv:
+                    self._cv.wait()
+    """)
+    r7 = rules_at(fs, "R7")
+    assert any("`time.sleep`" in f.message for f in r7)
+    assert any("unbounded `.wait()`" in f.message for f in r7)
+
+
+def test_r7_io_and_sync_under_lock_interprocedural(tmp_path):
+    # the blocking op hides in a helper only reached with the lock held
+    fs = lint(tmp_path, """
+        import threading
+        import jax
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._events = []
+
+            def dump(self, path, flags):
+                with self._lock:
+                    self._write(path)
+                    host = jax.device_get(flags)
+                return host
+
+            def _write(self, path):
+                with open(path, "w") as f:
+                    f.write(str(self._events))
+    """)
+    r7 = rules_at(fs, "R7")
+    assert any("file I/O" in f.message and f.symbol == "Recorder._write"
+               for f in r7)
+    assert any("host sync" in f.message and f.symbol == "Recorder.dump"
+               for f in r7)
+
+
+def test_r7_bounded_wait_and_io_outside_lock_are_clean(tmp_path):
+    # the repo's fixed shapes: timeout-bounded cv.wait in the serve
+    # loop, and the flight recorder's snapshot-under-lock/write-outside
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._stop = False
+                self._events = []
+
+            def loop(self):
+                with self._cv:
+                    while not self._stop:
+                        self._cv.wait(0.1)
+
+            def dump(self, path):
+                with self._cv:
+                    events = list(self._events)
+                with open(path, "w") as f:
+                    f.write(str(events))
+    """)
+    assert rules_at(fs, "R7") == []
+
+
+# ================================================================== R8
+def test_r8_undeclared_partition_spec_axis(tmp_path):
+    fs = lint(tmp_path, """
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(devs):
+            mesh = Mesh(devs, ("dp", "mp"))
+            spec = P("tp", None)
+            return mesh, spec
+    """)
+    r8 = rules_at(fs, "R8")
+    assert any("names axis 'tp'" in f.message for f in r8)
+
+
+def test_r8_frozen_axis_resize(tmp_path):
+    # a plan_mesh_shape-style resize path recomputing mp/ep from the
+    # device count — the elastic_mesh invariant violation
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed.mesh import init_mesh
+
+        def shrink(saved, n_devices):
+            axes = dict(saved)
+            axes["mp"] = n_devices // 2
+            axes["ep"] = n_devices // axes["mp"]
+            return init_mesh(axes)
+    """)
+    r8 = rules_at(fs, "R8")
+    assert any("frozen program axis 'mp'" in f.message for f in r8)
+    assert any("frozen program axis 'ep'" in f.message for f in r8)
+
+
+def test_r8_shard_map_arity_mismatch(tmp_path):
+    fs = lint(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(grads, scale):
+            return grads
+
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                             out_specs=P("dp"))
+    """)
+    r8 = rules_at(fs, "R8")
+    assert any("in_specs has 1 spec(s) but the wrapped function takes 2"
+               in f.message for f in r8)
+
+
+def test_r8_donated_input_resharded(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            state = jax.lax.with_sharding_constraint(state, None)
+            return state + x
+    """)
+    assert any("DONATED at the wrap site" in f.message
+               for f in rules_at(fs, "R8"))
+
+
+def test_r8_legal_shapes_are_clean(tmp_path):
+    # dp/sdp resize IS the elastic contract; declared axes (including a
+    # custom one) pass; matching shard_map arity passes
+    fs = lint(tmp_path, """
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.distributed.mesh import init_mesh
+
+        def resize(saved, n_devices):
+            axes = dict(saved)
+            axes["dp"] = n_devices // 2
+            axes["sdp"] = 2
+            return init_mesh(axes)
+
+        def metric_mesh(devs):
+            mesh = Mesh(devs, ("metric",))
+            return mesh, P("metric")
+
+        def body(grads):
+            return grads
+
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                             out_specs=P("dp"))
+
+        def outer(x):
+            def helper(v):
+                return v, v
+            helper(x)
+
+        def wrap2(mesh):
+            # a CLOSURE's tuple return must not masquerade as the
+            # wrapped function's arity (nested defs are pruned)
+            return shard_map(outer, mesh=mesh, in_specs=(P("dp"),),
+                             out_specs=(P("dp"),))
+    """)
+    assert rules_at(fs, "R8") == []
+
+
+# ======================================================= incremental
+def _git(cwd, *args):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *args], cwd=cwd, check=True, capture_output=True)
+
+
+def test_cache_hit_and_invalidation(tmp_path, monkeypatch, capsys):
+    cli = _load_cli()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        def clean(x):
+            return x + 1
+    """))
+    monkeypatch.setattr(cli, "REPO", str(tmp_path))
+
+    assert cli.main(["pkg", "--json", "--no-baseline"]) == 0
+    d1 = json.loads(capsys.readouterr().out)
+    assert d1["schema_version"] == 2
+    assert d1["cache"]["hit"] is False
+    # fresh runs carry the timing block: per-file parse/lint ms + rules
+    assert "pkg/mod.py" in d1["timing"]["files"]
+    assert "parse_ms" in d1["timing"]["files"]["pkg/mod.py"]
+    assert "R1" in d1["timing"]["rules"]
+
+    # untouched tree => cache hit (no re-analysis)
+    assert cli.main(["pkg", "--json", "--no-baseline"]) == 0
+    d2 = json.loads(capsys.readouterr().out)
+    assert d2["cache"]["hit"] is True
+    assert d2["findings"] == d1["findings"]
+
+    # edit => invalidated => re-linted, and the new finding surfaces
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        import jax
+
+        def dirty(x):
+            return jax.device_get(x)
+    """))
+    assert cli.main(["pkg", "--json", "--no-baseline"]) == 1
+    d3 = json.loads(capsys.readouterr().out)
+    assert d3["cache"]["hit"] is False
+    assert {f["rule"] for f in d3["new_findings"]} == {"R1"}
+
+
+def test_changed_only_lints_just_the_diff(tmp_path, monkeypatch, capsys):
+    cli = _load_cli()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        def helper(x):
+            return x * 2
+    """))
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        from pkg.a import helper
+
+        def use(x):
+            return helper(x)
+    """))
+    (pkg / "c.py").write_text(textwrap.dedent("""
+        def thing(x):
+            return x + 1
+    """))
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    monkeypatch.setattr(cli, "REPO", str(tmp_path))
+
+    # no cache yet: --changed-only falls back to a full run (and says so)
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        import jax
+        from pkg.a import helper
+
+        def use(x):
+            return jax.device_get(helper(x))
+    """))
+    assert cli.main(["pkg", "--json", "--no-baseline",
+                     "--changed-only"]) == 1
+    d0 = json.loads(capsys.readouterr().out)
+    assert "fallback" in d0["cache"]["mode"]
+
+    # the fallback full run populated the cache; now the real path —
+    # and the edit ADDS an import (pkg.c) the cached graph has never
+    # seen: the fresh-parse overlay must still scope it in
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        import jax
+        from pkg.a import helper
+        from pkg.c import thing
+
+        def use(x):
+            return jax.device_get(thing(helper(x)))
+    """))
+    assert cli.main(["pkg", "--json", "--no-baseline",
+                     "--changed-only"]) == 1
+    d1 = json.loads(capsys.readouterr().out)
+    assert d1["cache"]["mode"] == "changed-only"
+    assert d1["cache"]["changed"] == ["pkg/b.py"]
+    # the import closure pulled BOTH context files in (a.py from the
+    # cached graph, c.py from the freshly added import), but only the
+    # CHANGED file's findings gate
+    assert d1["cache"]["closure_files"] >= 3
+    assert {f["path"] for f in d1["new_findings"]} == {"pkg/b.py"}
+
+    # clean diff => clean exit (even over a stale cache: "nothing
+    # uncommitted" is a valid pre-commit answer)
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "wip")
+    assert cli.main(["pkg", "--json", "--no-baseline",
+                     "--changed-only"]) == 0
+    d2 = json.loads(capsys.readouterr().out)
+    assert d2["cache"]["changed"] == []
+    assert d2["new_findings"] == []
+
+    # but a NON-empty diff over a cache whose unchanged side drifted
+    # (e.g. a pull landed commits since the last full run) must fall
+    # back to a full run — the cached graph can't scope the closure
+    cli.main(["pkg", "--json", "--no-baseline"])        # refresh cache
+    capsys.readouterr()
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        def helper(x):
+            return x * 3
+    """))
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "landed-behind-your-back")
+    (pkg / "c.py").write_text(textwrap.dedent("""
+        def thing(x):
+            return x + 2
+    """))
+    # c.py is the uncommitted diff; a.py drifted vs the cache behind
+    # git's back => full-run fallback (which still sees b.py's R1)
+    assert cli.main(["pkg", "--json", "--no-baseline",
+                     "--changed-only"]) == 1
+    d3 = json.loads(capsys.readouterr().out)
+    assert "fallback" in d3["cache"]["mode"]
+    assert "stale" in d3["cache"]["mode"]
+
+
+def test_baseline_v1_is_rejected_with_migration_pointer(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text('{"version": 1, "findings": {"R2|x|y|z": 1}}')
+    with pytest.raises(ValueError, match="MIGRATION"):
+        load_baseline(str(p))
+
+
 # ==================================================== CLI + repo smoke
 def _load_cli():
     spec = importlib.util.spec_from_file_location(
@@ -650,3 +1209,34 @@ def test_repo_is_clean_under_checked_in_baseline(capsys):
     assert "no new findings" in out
     # the analyzer really saw the tree (not an empty walk)
     assert "trace roots" in out.split("\n")[0]
+
+
+def test_repo_lock_graph_names_serving_and_lora_edges(capsys):
+    """The R6 acceptance shape: the --json lock graph carries the REAL
+    lock nodes + acquisition edges of serving/server.py and
+    lora/store.py, including the interprocedural order edge the serve
+    loop fixes by reading the scheduler's depth under its condition
+    variable. (Runs off the whole-repo cache the smoke test above just
+    warmed — milliseconds.)"""
+    cli = _load_cli()
+    rc = cli.main(["--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    lg = data["lock_graph"]
+    ids = {l["id"] for l in lg["locks"]}
+    assert any(i.endswith("server.py::InferenceServer._cv") for i in ids)
+    assert any(i.endswith("store.py::AdapterStore._lock") for i in ids)
+    acq = lg["acquisitions"]
+    by_file = {a["file"] for a in acq}
+    assert "paddle_tpu/serving/server.py" in by_file
+    assert "paddle_tpu/lora/store.py" in by_file
+    # named functions, not just files: the graph is auditable
+    assert any(a["function"] == "AdapterStore.acquire" for a in acq)
+    assert any(a["function"] == "InferenceServer._loop" for a in acq)
+    # the interprocedural held->acquired edge (cv held across the
+    # scheduler-depth property read)
+    assert any(e["held"].endswith("InferenceServer._cv")
+               and e["acquired"].endswith("FifoScheduler._lock")
+               for e in lg["edges"])
+    # timing rides the same JSON (warm runs report the cached-run block)
+    assert "timing" in data and data["timing"]

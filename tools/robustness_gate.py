@@ -2,12 +2,18 @@
 """Robustness gate: ONE command CI can block on for the fault-tolerance
 story. Runs, in order:
 
-0. ``tools/tpu_lint.py --baseline .tpu_lint_baseline.json`` — the static
-   trace-discipline analyzer (host syncs, retrace hazards, donation
-   misuse, PRNG reuse, lock bypasses). First because it is the cheapest
-   stage by two orders of magnitude (~5 s, no backend): a NEW unbaselined
-   finding fails the gate before any soak spends minutes proving the same
-   bug at runtime;
+0. ``tools/tpu_lint.py --json --baseline .tpu_lint_baseline.json`` — the
+   static trace-discipline analyzer (host syncs, retrace hazards,
+   donation misuse, PRNG reuse, lock bypasses, lock-order/deadlock,
+   blocking-under-lock, sharding discipline — R1–R8). ONE whole-repo run
+   covers every package, replacing the per-subsystem scoped runs the
+   ``--lora``/``--observability`` stages used to carry; the stage prints
+   a per-package parse/lint timing roll-up from the ``--json`` timing
+   block so lint-perf regressions are visible in CI logs. First because
+   it is the cheapest stage by two orders of magnitude (seconds cold,
+   milliseconds on a warm ``.tpu_lint_cache/``): a NEW unbaselined
+   finding fails the gate before any soak spends minutes proving the
+   same bug at runtime;
 1. ``tools/chaos_soak.py --quick`` — the self-healing train loop under
    NaN batches, a step stall, and a kill-and-restart (fails on any
    unrecovered fault, loss divergence beyond tolerance, or a steady-state
@@ -28,24 +34,22 @@ story. Runs, in order:
    stay token-identical to a solo ``generate`` (no divergence across the
    reroute), and the survivor must hold its #buckets+1 compile budget
    with zero steady-state recompiles;
-5. with ``--observability``, the telemetry gate in three parts:
+5. with ``--observability``, the telemetry gate in two parts:
    ``tools/flight_drill.py`` (an injected serve-loop crash must leave a
    well-formed flight-recorder dump carrying the failing request's
-   correlation id, consumable by ``tools/trace_view.py``), a scoped
-   ``tpu_lint paddle_tpu/observability`` run (0 findings — the
-   telemetry layer itself must not regress trace discipline), and
+   correlation id, consumable by ``tools/trace_view.py``) and
    ``tools/decode_bench.py --trace-overhead`` (per-token span recording
    on the decode hot loop must cost <2% throughput, tracing-on vs
-   tracing-off);
+   tracing-off). The old scoped ``tpu_lint paddle_tpu/observability``
+   run folded into stage 0's whole-repo lint;
 6. with ``--lora``, ``tools/lora_soak.py`` — the multi-tenant adapter
    lifecycle: fine-tune a tiny adapter 20 steps under the supervisor,
    hard-kill the process mid-checkpoint-save, resume from the newest
    complete checkpoint, finish, publish the adapter, then serve it
    mixed with base traffic — zero lost requests, zero steady-state
-   recompiles, token parity vs solo generate. A scoped
-   ``tpu_lint paddle_tpu/lora`` run (0 findings, reasoned suppressions
-   only) rides in the same stage so the new subsystem cannot regress
-   trace discipline even when the full-repo lint stage is skipped.
+   recompiles, token parity vs solo generate. (Its old scoped
+   ``tpu_lint paddle_tpu/lora`` companion folded into stage 0's
+   whole-repo lint.)
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -62,6 +66,7 @@ nightly full matrix)::
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -78,6 +83,70 @@ def _run(name: str, cmd: list) -> bool:
     env.setdefault("JAX_PLATFORMS", "cpu")
     p = subprocess.run(cmd, env=env, timeout=2400)
     ok = p.returncode == 0
+    print(f"[robustness_gate] === {name}: "
+          f"{'PASS' if ok else f'FAIL (rc={p.returncode})'} "
+          f"in {time.monotonic() - t0:.0f}s", flush=True)
+    return ok
+
+
+def _package_of(rel: str) -> str:
+    """paddle_tpu/serving/server.py -> paddle_tpu/serving; tools/x.py ->
+    tools — the roll-up grain of the lint timing table."""
+    parts = rel.split("/")
+    return "/".join(parts[:2]) if len(parts) > 2 else parts[0]
+
+
+def _run_lint() -> bool:
+    """ONE whole-repo tpu_lint run (R1–R8, baseline-gated) with a
+    per-package parse/lint timing roll-up — the unified replacement for
+    the scoped per-subsystem runs the --lora/--observability stages used
+    to carry."""
+    name = "tpu_lint"
+    cmd = [sys.executable, os.path.join(TOOLS, "tpu_lint.py"), "--json",
+           "--baseline", os.path.join(REPO, ".tpu_lint_baseline.json")]
+    print(f"[robustness_gate] === {name}: {' '.join(cmd[1:])}", flush=True)
+    t0 = time.monotonic()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    p = subprocess.run(cmd, env=env, timeout=2400, capture_output=True,
+                       text=True)
+    ok = p.returncode == 0
+    try:
+        data = json.loads(p.stdout)
+    except json.JSONDecodeError:
+        data = {}
+    timing = data.get("timing") or {}
+    # a warm-cache run reports the cached analysis' timings under
+    # "cached_run" — the per-package table must survive the fast path
+    files_ms = (timing.get("files")
+                or (timing.get("cached_run") or {}).get("files") or {})
+    per_pkg: dict = {}
+    for rel, t in files_ms.items():
+        agg = per_pkg.setdefault(_package_of(rel),
+                                 {"files": 0, "parse_ms": 0.0,
+                                  "lint_ms": 0.0})
+        agg["files"] += 1
+        agg["parse_ms"] += t.get("parse_ms", 0.0)
+        agg["lint_ms"] += t.get("lint_ms", 0.0)
+    if per_pkg:
+        print(f"[robustness_gate] {'package':32s} {'files':>5s} "
+              f"{'parse_ms':>9s} {'lint_ms':>9s}")
+        for pkg in sorted(per_pkg, key=lambda k: -per_pkg[k]["lint_ms"]):
+            a = per_pkg[pkg]
+            print(f"[robustness_gate] {pkg:32s} {a['files']:5d} "
+                  f"{a['parse_ms']:9.1f} {a['lint_ms']:9.1f}")
+    cache = data.get("cache") or {}
+    stats = data.get("stats") or {}
+    print(f"[robustness_gate] lint: {stats.get('files', '?')} files, "
+          f"{len(data.get('new_findings', []))} NEW finding(s), "
+          f"cache={'hit' if cache.get('hit') else cache.get('mode', '?')}",
+          flush=True)
+    for f in data.get("new_findings", []):
+        print(f"[robustness_gate]   NEW {f['rule']} {f['path']}:"
+              f"{f['line']} {f['message']}")
+    if not ok and not data:
+        sys.stdout.write(p.stdout[-2000:])
+        sys.stderr.write(p.stderr[-2000:])
     print(f"[robustness_gate] === {name}: "
           f"{'PASS' if ok else f'FAIL (rc={p.returncode})'} "
           f"in {time.monotonic() - t0:.0f}s", flush=True)
@@ -111,10 +180,15 @@ def main() -> int:
 
     results = {}
     if not args.skip_lint:
-        results["tpu_lint"] = _run(
-            "tpu_lint", [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
-                         "--baseline",
-                         os.path.join(REPO, ".tpu_lint_baseline.json")])
+        results["tpu_lint"] = _run_lint()
+    elif args.lora or args.observability:
+        # the scoped per-subsystem lints folded into stage 0; skipping
+        # it now skips THEIR lint coverage too — say so loudly instead
+        # of silently weakening the subsystem gates (MIGRATION.md)
+        print("[robustness_gate] WARNING: --skip-lint also skips the "
+              "lora/observability lint coverage that used to ride "
+              "their stages (now part of the unified whole-repo lint)",
+              flush=True)
     if not args.skip_soak:
         cmd = [sys.executable, os.path.join(TOOLS, "chaos_soak.py")]
         if not args.full_soak:
@@ -136,10 +210,6 @@ def main() -> int:
         results["flight_drill"] = _run(
             "flight_drill", [sys.executable,
                              os.path.join(TOOLS, "flight_drill.py")])
-        results["obs_lint"] = _run(
-            "obs_lint", [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
-                         os.path.join("paddle_tpu", "observability"),
-                         "--no-baseline"])
         results["trace_overhead"] = _run(
             "trace_overhead", [sys.executable,
                                os.path.join(TOOLS, "decode_bench.py"),
@@ -147,11 +217,6 @@ def main() -> int:
     if args.lora:
         results["lora"] = _run(
             "lora", [sys.executable, os.path.join(TOOLS, "lora_soak.py")])
-        results["lora_lint"] = _run(
-            "lora_lint", [sys.executable,
-                          os.path.join(TOOLS, "tpu_lint.py"),
-                          os.path.join("paddle_tpu", "lora"),
-                          "--no-baseline"])
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,

@@ -2,16 +2,29 @@
 """tpu_lint — trace-discipline static analyzer for the TPU-native stack.
 
 Catches, before runtime: host syncs in trace-reachable/hot code (R1),
-retrace hazards (R2), donation-after-use (R3), PRNG key reuse (R4), and
-unguarded shared state in threaded classes (R5). Pure-AST: no jax import,
-no backend, whole-repo runs in seconds.
+retrace hazards (R2), donation-after-use (R3), PRNG key reuse (R4),
+unguarded shared state in threaded classes (R5), lock-order cycles and
+non-reentrant re-entry (R6), blocking work under held locks (R7), and
+mesh-axis/sharding discipline (R8). Pure-AST: no jax import, no backend.
 
     python tools/tpu_lint.py                          # paddle_tpu + tools
     python tools/tpu_lint.py paddle_tpu/serving       # a subtree
+    python tools/tpu_lint.py --changed-only           # pre-commit: git
     python tools/tpu_lint.py --baseline .tpu_lint_baseline.json
     python tools/tpu_lint.py --baseline ... --update-baseline
     python tools/tpu_lint.py --json                   # machine-readable
     python tools/tpu_lint.py --list-rules
+
+Incremental engine: full runs persist a content-hash result cache under
+``.tpu_lint_cache/`` — when nothing changed, the next whole-repo run is
+served from the cache in milliseconds; any edit re-analyzes (and
+refreshes). ``--changed-only`` asks git for the changed files and lints
+just their one-hop import closure — the sub-second pre-commit path (it
+falls back to a full run when no cache exists yet). ``--no-cache``
+disables both. ``--json`` carries ``schema_version``, a ``timing`` block
+(per-file parse/lint ms, per-rule totals), the R6 ``lock_graph`` (lock
+nodes, acquisition sites, held→acquired order edges), and a ``cache``
+block (hit/miss, mode, changed files).
 
 Exit codes: 0 = clean (every finding suppressed or baselined);
 1 = NEW findings (beyond the baseline); 2 = usage error.
@@ -40,6 +53,11 @@ sys.path.insert(0, REPO)
 
 DEFAULT_PATHS = ("paddle_tpu", "tools")
 DEFAULT_BASELINE = os.path.join(REPO, ".tpu_lint_baseline.json")
+SCHEMA_VERSION = 2
+
+
+def _emit_json(payload: dict) -> None:
+    print(json.dumps(payload, indent=1))
 
 
 def main(argv=None) -> int:
@@ -49,7 +67,8 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to lint (default: paddle_tpu tools)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+                    help="machine-readable output (schema_version, "
+                         "timing, lock_graph, cache blocks)")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON; accepted findings pass, new "
                          "findings fail (default: .tpu_lint_baseline.json "
@@ -60,10 +79,22 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignore any baseline")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only git-changed files (plus their one-"
+                         "hop import closure for context) — the "
+                         "pre-commit path; falls back to a full run "
+                         "when no cache exists")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the .tpu_lint_cache/ incremental "
+                         "engine (always analyze from scratch)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="cache directory (default: "
+                         "<repo>/.tpu_lint_cache)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.analysis import (analyze, diff_baseline, load_baseline,
                                      save_baseline, RULE_DOCS)
+    from paddle_tpu.analysis.cache import LintCache, git_changed_files
 
     if args.list_rules:
         for rule, doc in sorted(RULE_DOCS.items()):
@@ -76,29 +107,124 @@ def main(argv=None) -> int:
         if not os.path.exists(full):
             print(f"tpu_lint: no such path: {p}", file=sys.stderr)
             return 2
+    if args.update_baseline and args.paths:
+        # a subtree run sees a subset of the findings — rewriting the
+        # whole-repo baseline from it would silently erase every
+        # accepted entry outside the subtree and fail the next gate
+        print("tpu_lint: --update-baseline only works on the default "
+              "scope (paddle_tpu + tools); drop the explicit paths",
+              file=sys.stderr)
+        return 2
+    if args.update_baseline and args.changed_only:
+        print("tpu_lint: --update-baseline needs the full view; drop "
+              "--changed-only", file=sys.stderr)
+        return 2
 
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline \
             and os.path.exists(DEFAULT_BASELINE):
         baseline_path = DEFAULT_BASELINE
 
+    cache = None if args.no_cache else LintCache(REPO, args.cache_dir)
     t0 = time.monotonic()
-    result = analyze(REPO, paths)
+    cache_info = {"enabled": cache is not None, "hit": False,
+                  "mode": "full"}
+
+    result = None
+    findings = None
+    stats = None
+    lock_graph = {}
+    timing = {}
+    changed = None
+
+    if args.changed_only:
+        changed = git_changed_files(REPO, paths)
+        entry = cache.cached_entry(paths) if cache is not None else None
+        if entry is not None and changed:
+            # (an EMPTY diff short-circuits below without this check —
+            # "nothing uncommitted" is a clean pre-commit answer no
+            # matter how stale the cache is)
+            # the cached graph is only trustworthy for the UNCHANGED
+            # side of the tree: if files outside the git diff drifted
+            # since the last full run (a pull landed commits, a file
+            # appeared/vanished), their trace roots / lock edges are
+            # missing from the graph and the closure would silently
+            # lose context — fall back to a full run (which refreshes)
+            live = cache.tree_digests(paths)
+            skip = set(changed)
+            if {k: v for k, v in live.items() if k not in skip} != \
+                    {k: v for k, v in (entry.get("files") or {}).items()
+                     if k not in skip}:
+                entry = None
+        if changed is None or entry is None:
+            why = ("git unavailable" if changed is None
+                   else "cached import graph missing or stale vs the "
+                        "unchanged tree (full run refreshes it)")
+            cache_info["mode"] = f"full (changed-only fallback: {why})"
+            changed = None
+        elif not changed:
+            elapsed = time.monotonic() - t0
+            cache_info.update(mode="changed-only", changed=[])
+            if args.as_json:
+                _emit_json({"schema_version": SCHEMA_VERSION,
+                            "stats": {}, "elapsed_s": round(elapsed, 3),
+                            "baseline": baseline_path, "cache": cache_info,
+                            "timing": {"total_ms":
+                                       round(elapsed * 1e3, 3)},
+                            "lock_graph": {}, "findings": [],
+                            "new_findings": [],
+                            "stale_baseline_keys": []})
+            else:
+                print(f"tpu_lint: no changed files under "
+                      f"{' '.join(paths)} ({elapsed:.2f}s)")
+                print("OK: no new findings")
+            return 0
+        else:
+            # cached import graph for the unchanged side of the tree,
+            # OVERLAID with the changed files' freshly parsed imports —
+            # a dependency edge the edit itself just added must pull
+            # its target into the lint scope
+            imports = dict(entry.get("imports") or {})
+            imports.update(cache.fresh_imports(
+                changed, list(entry.get("files") or ())))
+            scope = LintCache.closure(changed, imports)
+            cache_info.update(mode="changed-only", changed=changed,
+                              closure_files=len(scope))
+            result = analyze(REPO, scope)
+            # only findings IN the changed files gate; context files were
+            # linted for cross-file resolution, not for reporting
+            keep = set(changed)
+            findings = [f for f in result.findings if f.path in keep]
+            stats = result.stats()
+            lock_graph = result.lock_graph
+            timing = result.timing
+
+    if findings is None:
+        digests = cache.tree_digests(paths) if cache is not None else {}
+        got = cache.load(paths, digests) if cache is not None else None
+        if got is not None:
+            cache_info["hit"] = True
+            findings = LintCache.findings_from(got)
+            stats = got.get("stats", {})
+            lock_graph = got.get("lock_graph", {})
+            timing = {"total_ms": round((time.monotonic() - t0) * 1e3, 3),
+                      "cached_run": got.get("timing", {})}
+        else:
+            result = analyze(REPO, paths)
+            findings = result.findings
+            stats = result.stats()
+            lock_graph = result.lock_graph
+            timing = result.timing
+            if cache is not None:
+                cache.store(paths, digests, findings, stats, lock_graph,
+                            result.project_imports(), timing)
     elapsed = time.monotonic() - t0
 
     if args.update_baseline:
-        if args.paths:
-            # a subtree run sees a subset of the findings — rewriting the
-            # whole-repo baseline from it would silently erase every
-            # accepted entry outside the subtree and fail the next gate
-            print("tpu_lint: --update-baseline only works on the default "
-                  "scope (paddle_tpu + tools); drop the explicit paths",
-                  file=sys.stderr)
-            return 2
         target = baseline_path or DEFAULT_BASELINE
-        keep = [f for f in result.findings if f.rule != "R0"]
+        keep = [f for f in findings if f.rule != "R0"]
         save_baseline(target, keep)
-        r0 = [f for f in result.findings if f.rule == "R0"]
+        r0 = [f for f in findings if f.rule == "R0"]
         print(f"tpu_lint: baseline updated: {target} "
               f"({len(keep)} finding(s) accepted)")
         for f in r0:
@@ -108,32 +234,45 @@ def main(argv=None) -> int:
     baseline = {}
     if baseline_path and not args.no_baseline:
         baseline = load_baseline(baseline_path)
-    new, stale = diff_baseline(result.findings, baseline)
+    new, stale = diff_baseline(findings, baseline)
+    if changed is not None:
+        stale = []      # a partial view cannot judge staleness
 
     if args.as_json:
-        print(json.dumps({
-            "stats": result.stats(),
+        _emit_json({
+            "schema_version": SCHEMA_VERSION,
+            "stats": stats,
             "elapsed_s": round(elapsed, 3),
             "baseline": baseline_path if baseline else None,
-            "findings": [f.as_dict() for f in result.findings],
+            "cache": cache_info,
+            "timing": timing,
+            "lock_graph": lock_graph,
+            "findings": [f.as_dict() for f in findings],
             "new_findings": [f.as_dict() for f in new],
             "stale_baseline_keys": stale,
-        }, indent=1))
+        })
         return 1 if new else 0
 
-    stats = result.stats()
-    print(f"tpu_lint: {stats['files']} files, "
-          f"{stats['trace_roots']} trace roots, "
-          f"{stats['trace_reachable']} trace-reachable fns, "
-          f"{stats['thread_roots']} thread roots "
-          f"({elapsed:.2f}s)")
+    if stats:
+        mode = ""
+        if cache_info["hit"]:
+            mode = " [cache hit]"
+        elif changed is not None:
+            mode = (f" [changed-only: {len(changed)} changed, "
+                    f"{cache_info.get('closure_files', 0)} in closure]")
+        print(f"tpu_lint: {stats.get('files', 0)} files, "
+              f"{stats.get('trace_roots', 0)} trace roots, "
+              f"{stats.get('trace_reachable', 0)} trace-reachable fns, "
+              f"{stats.get('thread_roots', 0)} thread roots, "
+              f"{stats.get('locks', 0)} locks "
+              f"({elapsed:.2f}s){mode}")
     if baseline:
-        accepted = len(result.findings) - len(new)
-        print(f"tpu_lint: {len(result.findings)} finding(s); "
+        accepted = len(findings) - len(new)
+        print(f"tpu_lint: {len(findings)} finding(s); "
               f"{accepted} baselined, {len(new)} NEW")
     else:
-        print(f"tpu_lint: {len(result.findings)} finding(s)")
-    shown = new if baseline else result.findings
+        print(f"tpu_lint: {len(findings)} finding(s)")
+    shown = new if baseline else findings
     for f in shown:
         print(f.render())
     for k in stale:
