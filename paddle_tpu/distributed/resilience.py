@@ -25,9 +25,13 @@ them so ``rpc``, ``ps.service``, ``launch.kv_server`` and
   instrumented call sites invoke :func:`fault_point` which consults the
   active plan. Kinds: ``drop`` (raise :class:`InjectedFault`, a
   ``ConnectionError`` — production retry paths treat it as a transport
-  failure), ``delay`` (sleep), ``crash`` (``os._exit(CRASH_EXIT)`` — the
-  process dies as hard as a SIGKILL, no atexit/finally), ``partition``
-  (a contiguous outage window of calls). All randomness is seeded per rule,
+  failure), ``delay`` (sleep a fixed duration), ``slow`` (sleep a
+  seeded-random duration in ``[0.5, 1.5) * delay`` — the gray-failure
+  model: a replica that stays alive but each matching call drags by a
+  different, replayable amount), ``crash`` (``os._exit(CRASH_EXIT)`` —
+  the process dies as hard as a SIGKILL, no atexit/finally),
+  ``partition`` (a contiguous outage window of calls). All randomness is
+  seeded per rule,
   so a plan replays identically. Activating a plan (``with plan:`` or
   ``plan.install(env=True)``) also exports it via the ``PT_FAULT_PLAN``
   env var, so subprocesses spawned under the plan inherit it.
@@ -237,6 +241,10 @@ class FaultRule:
 
     - ``drop``: raise :class:`InjectedFault`.
     - ``delay``: sleep ``delay`` seconds, then let the call proceed.
+    - ``slow``: sleep a seeded-random duration in ``[0.5, 1.5) * delay``,
+      then let the call proceed — latency injection for gray-failure
+      drills (the sequence of durations is a pure function of the rule's
+      seed, so a slow-replica soak replays identically).
     - ``crash``: ``os._exit(CRASH_EXIT)`` — no cleanup, like SIGKILL.
     - ``partition``: every matching call in ``[after, after+times)`` fails
       (contiguous outage window; ``times=None`` = never heals).
@@ -249,7 +257,7 @@ class FaultRule:
     delay: float = 0.05
     after: int = 0
 
-    _KINDS = ("drop", "delay", "crash", "partition")
+    _KINDS = ("drop", "delay", "slow", "crash", "partition")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -350,8 +358,13 @@ class FaultPlan:
                 if rule.prob < 1.0 and self._rngs[i].random() >= rule.prob:
                     continue
                 self.fired[i] += 1
-            if rule.kind == "delay":
-                time.sleep(rule.delay)
+                # the RNG lives under the lock (prob draws share it);
+                # the sleep itself happens after release
+                sleep_s = rule.delay
+                if rule.kind == "slow":
+                    sleep_s = rule.delay * (0.5 + self._rngs[i].random())
+            if rule.kind in ("delay", "slow"):
+                time.sleep(sleep_s)
             elif rule.kind == "crash":
                 os._exit(CRASH_EXIT)
             else:  # drop / partition

@@ -5,8 +5,10 @@ over a TCP master store, ``rpc_sync``/``rpc_async`` executing pickled
 python callables on named workers, ``WorkerInfo`` registry, barriered
 ``shutdown``).
 """
-from .rpc import (WorkerInfo, get_all_worker_infos, get_current_worker_info,
-                  get_worker_info, init_rpc, rpc_async, rpc_sync, shutdown)
+from .rpc import (RpcTransportError, WorkerInfo, get_all_worker_infos,
+                  get_current_worker_info, get_worker_info, init_rpc,
+                  rpc_async, rpc_sync, shutdown)
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
-           "get_all_worker_infos", "get_current_worker_info", "WorkerInfo"]
+           "get_all_worker_infos", "get_current_worker_info", "WorkerInfo",
+           "RpcTransportError"]

@@ -24,7 +24,23 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 from ..launch.kv_server import KVClient, KVServer
-from ..resilience import RetryPolicy, fault_point, with_timeout
+from ..resilience import Deadline, RetryPolicy, fault_point, with_timeout
+
+
+class RpcTransportError(ConnectionError):
+    """A transport-level failure talking to a named peer: connect retries
+    exhausted, a connection dropped mid-request, or a truncated/garbled
+    frame. Subclasses ``ConnectionError`` so every caller's
+    ``resilience.RetryPolicy`` classifies it as retryable, and carries
+    ``peer`` so failure detectors can attribute the miss WITHOUT parsing
+    the message. Application exceptions raised by the remote fn are
+    re-raised as themselves, never wrapped — only the transport is ours
+    to classify."""
+
+    def __init__(self, peer: str, message: str):
+        super().__init__(f"rpc peer {peer!r}: {message}")
+        self.peer = peer
+
 
 _DEFAULT_RPC_TIMEOUT = 120.0
 # transport-level retries for connection establishment to a peer service
@@ -206,7 +222,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
     service.start()  # accept only now that state is fully visible
 
 
-def _invoke(to: str, fn, args, kwargs, timeout):
+def _invoke(to: str, fn, args, kwargs, timeout, connect_deadline=None):
     workers = _state["workers"]
     if workers is None:
         raise RuntimeError("rpc not initialized; call init_rpc first")
@@ -215,26 +231,62 @@ def _invoke(to: str, fn, args, kwargs, timeout):
     info: WorkerInfo = workers[to]
     payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})))
 
+    # the per-ATTEMPT connect timeout must also respect connect_deadline:
+    # the retry loop only checks elapsed time AFTER an attempt returns,
+    # so a SYN-blackholed peer would otherwise hold each attempt for the
+    # full request timeout and blow the caller's classification budget
+    connect_timeout = timeout
+    if connect_deadline is not None:
+        connect_timeout = (min(timeout, connect_deadline) if timeout
+                           else connect_deadline)
+
     def connect():
         # retried: nothing has been sent yet, so a drop/refusal here is
         # always safe to re-attempt (incl. injected rpc.connect faults)
         fault_point(f"rpc.connect.{to}")
         return socket.create_connection((info.ip, info.port),
-                                        timeout=timeout or None)
+                                        timeout=connect_timeout or None)
 
-    with _CONNECT_RETRY.call(connect, what=f"rpc connect {to}") as conn:
-        conn.sendall(struct.pack("<Q", len(payload)) + payload)
-        (size,) = struct.unpack("<Q", _read_full(conn, 8))
-        ok, result = pickle.loads(_read_full(conn, size))
+    retry = _CONNECT_RETRY
+    if connect_deadline is not None:
+        # callers with their own failure budget (health probes, bounded
+        # drains) shrink the default 5s connect-retry window so a dead
+        # peer is classified at THEIR deadline, not ours
+        retry = RetryPolicy(deadline=max(0.05, float(connect_deadline)),
+                            base_delay=0.05, max_delay=0.5,
+                            retryable=(ConnectionError, OSError))
+    # every failure below is a transport failure: the request either never
+    # reached the peer (connect), died on the wire (send/recv), or came
+    # back torn (short/garbled frame). All of them re-raise as the
+    # retryable RpcTransportError carrying the peer's name; only the
+    # remote fn's own exception (the ``not ok`` path) stays unwrapped.
+    try:
+        with retry.call(connect, what=f"rpc connect {to}") as conn:
+            # connected: restore the full REQUEST timeout for the
+            # send/recv phase (create_connection left the tighter
+            # connect budget installed on the socket)
+            conn.settimeout(timeout or None)
+            conn.sendall(struct.pack("<Q", len(payload)) + payload)
+            (size,) = struct.unpack("<Q", _read_full(conn, 8))
+            ok, result = pickle.loads(_read_full(conn, size))
+    except (TimeoutError, ConnectionError, OSError, EOFError,
+            struct.error, pickle.UnpicklingError) as e:
+        raise RpcTransportError(to, f"{type(e).__name__}: {e}") from e
     if not ok:
         raise result
     return result
 
 
 def rpc_sync(to: str, fn, args=None, kwargs=None,
-             timeout=_DEFAULT_RPC_TIMEOUT):
-    """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``."""
-    return _invoke(to, fn, args, kwargs, timeout)
+             timeout=_DEFAULT_RPC_TIMEOUT, connect_deadline=None):
+    """Blocking call of ``fn(*args, **kwargs)`` on worker ``to``.
+
+    Transport failures raise :class:`RpcTransportError` (a retryable
+    ``ConnectionError`` naming the peer); exceptions raised by ``fn``
+    itself propagate unwrapped. ``connect_deadline`` bounds the
+    connection-establishment retry window (default: the module's 5s
+    policy) — failure detectors pass a sub-second budget here."""
+    return _invoke(to, fn, args, kwargs, timeout, connect_deadline)
 
 
 def rpc_async(to: str, fn, args=None, kwargs=None,
@@ -249,10 +301,17 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
     return fut
 
 
-def _wait_keys(kv, keys, timeout, what):
-    deadline = time.monotonic() + timeout
+def _wait_keys(kv, keys, timeout, what, deadline: Optional[Deadline] = None):
+    """Poll until every key exists. The wait is bounded by ``timeout``
+    AND, when given, the caller's own :class:`resilience.Deadline` —
+    whichever budget runs out first ends the poll, so a caller mid-way
+    through its shutdown window never re-grants a full ``timeout`` to
+    each successive wait."""
+    if deadline is not None:
+        timeout = min(float(timeout), max(0.01, deadline.remaining()))
+    local = time.monotonic() + timeout
     for key in keys:
-        remaining = max(0.01, deadline - time.monotonic())
+        remaining = max(0.01, local - time.monotonic())
         policy = RetryPolicy(deadline=remaining, base_delay=0.05,
                              multiplier=1.0, max_delay=0.05)
         try:
@@ -262,13 +321,14 @@ def _wait_keys(kv, keys, timeout, what):
                 f"rpc {what} timed out waiting {key}") from None
 
 
-def _barrier(timeout=_DEFAULT_RPC_TIMEOUT):
+def _barrier(timeout=_DEFAULT_RPC_TIMEOUT,
+             deadline: Optional[Deadline] = None):
     kv: KVClient = _state["kv"]
     me: WorkerInfo = _state["self"]
     ns = _namespace()
     kv.put(f"{ns}/barrier/{me.rank}", "1", ttl=_KEY_TTL)
     _wait_keys(kv, [f"{ns}/barrier/{r}" for r in range(_state["world"])],
-               timeout, "shutdown barrier")
+               timeout, "shutdown barrier", deadline=deadline)
 
 
 def shutdown(timeout: float = _DEFAULT_RPC_TIMEOUT) -> None:
@@ -287,10 +347,11 @@ def shutdown(timeout: float = _DEFAULT_RPC_TIMEOUT) -> None:
     """
     if _state["workers"] is None:
         return
-    deadline = time.monotonic() + timeout
+    budget = Deadline(timeout)   # ONE budget across every phase below
+    deadline = budget.expires_at
     peers_alive = True
     try:
-        _barrier(timeout=max(0.1, timeout / 2))
+        _barrier(timeout=max(0.1, timeout / 2), deadline=budget)
     except (TimeoutError, OSError) as e:
         # a crashed peer can't arrive; tear down locally instead of raising
         # (the caller is exiting — there is nothing better it could do)
@@ -320,7 +381,7 @@ def shutdown(timeout: float = _DEFAULT_RPC_TIMEOUT) -> None:
                 _wait_keys(kv, [f"{ns}/departed/{r}"
                                 for r in range(_state["world"])],
                            max(0.1, deadline - time.monotonic()),
-                           "departure")
+                           "departure", deadline=budget)
             except TimeoutError:
                 pass  # a crashed peer shouldn't wedge the host's exit
         _state["kv_server"].stop()

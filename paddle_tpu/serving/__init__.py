@@ -27,16 +27,21 @@ from ..lora.store import (AdapterError, AdapterStore)  # noqa: F401
 from .engine import ContinuousBatchingEngine, SlotEvent  # noqa: F401
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
 from .prefix_cache import BlockPool, PrefixHit, StorePlan  # noqa: F401
-from .router import (NoReplicasAvailable, ReplicaRouter,  # noqa: F401
-                     RouterHandle)
-from .scheduler import (Backpressure, FifoScheduler, QueueFull,  # noqa: F401
-                        Request, SchedulerClosed)
+from .remote import (RemoteHandle, RemoteReplica,  # noqa: F401
+                     ReplicaUnreachable)
+from .router import (ACTIVE, DEAD, DRAINING, SUSPECT,  # noqa: F401
+                     NoReplicasAvailable, ReplicaRouter, RouterHandle)
+from .scheduler import (Backpressure, FifoScheduler,  # noqa: F401
+                        Overloaded, QueueFull, Request, SchedulerClosed)
 from .server import InferenceServer, RequestHandle  # noqa: F401
 
 __all__ = [
     "ContinuousBatchingEngine", "SlotEvent", "InferenceServer",
     "RequestHandle", "FifoScheduler", "Request", "Backpressure",
-    "QueueFull", "SchedulerClosed", "ServingMetrics", "LatencyHistogram",
-    "BlockPool", "PrefixHit", "StorePlan", "ReplicaRouter",
-    "RouterHandle", "NoReplicasAvailable", "AdapterStore", "AdapterError",
+    "QueueFull", "Overloaded", "SchedulerClosed", "ServingMetrics",
+    "LatencyHistogram", "BlockPool", "PrefixHit", "StorePlan",
+    "ReplicaRouter", "RouterHandle", "NoReplicasAvailable",
+    "RemoteReplica", "RemoteHandle", "ReplicaUnreachable",
+    "AdapterStore", "AdapterError", "ACTIVE", "SUSPECT", "DRAINING",
+    "DEAD",
 ]

@@ -110,6 +110,7 @@ class ServingMetrics:
                 "serving.requests_completed": self.requests_completed,
                 "serving.requests_rejected": self.requests_rejected,
                 "serving.requests_expired": self.requests_expired,
+                "serving.requests_shed": self.requests_shed,
                 "serving.requests_failed": self.requests_failed,
                 "serving.requests_requeued": self.requests_requeued,
                 "serving.tokens_emitted": self.tokens_emitted,
@@ -141,6 +142,12 @@ class ServingMetrics:
             self.requests_completed = 0
             self.requests_rejected = 0
             self.requests_expired = 0
+            # deadline-aware overload sheds (Overloaded, retryable) —
+            # deliberately separate from requests_expired (deadline
+            # actually lapsed: TimeoutError) and requests_failed
+            # (non-retryable faults): a client backs off a shed, gives
+            # up on an expiry, and pages on a failure
+            self.requests_shed = 0
             self.requests_failed = 0
             self.requests_requeued = 0
             self.tokens_emitted = 0
@@ -243,6 +250,7 @@ class ServingMetrics:
                 "requests_completed": self.requests_completed,
                 "requests_rejected": self.requests_rejected,
                 "requests_expired": self.requests_expired,
+                "requests_shed": self.requests_shed,
                 "requests_failed": self.requests_failed,
                 "requests_requeued": self.requests_requeued,
                 "tokens_emitted": self.tokens_emitted,

@@ -1,0 +1,499 @@
+"""Remote replicas: drive an :class:`InferenceServer` in another process
+over ``distributed.rpc``, behind the same interface the router already
+speaks.
+
+PR 8's :class:`~paddle_tpu.serving.router.ReplicaRouter` holds direct
+python references to its replicas, so the only failures it can survive
+are in-process ones. This module splits that boundary across hosts:
+
+- **host side** — the process that owns the chips calls
+  :func:`host_server` on its started ``InferenceServer`` (after
+  ``rpc.init_rpc``); the module-level ``_host_*`` functions are the rpc
+  surface (submit / stream-poll / probe / snapshot / statusz / drain),
+  pickled by reference so any peer that imports this module can call
+  them;
+- **client side** — :class:`RemoteReplica` adapts that surface back into
+  the duck type ``ReplicaRouter`` scores and submits to: a ``.engine`` /
+  ``.scheduler`` load view refreshed from health probes, ``submit()``
+  returning a :class:`RemoteHandle` whose background poller mirrors the
+  remote token stream into a local :class:`RequestHandle` (same
+  ``result()``/``stream()`` contract, same at-least-once restart
+  semantics across the remote server's crash recovery).
+
+Failure classification is the resilience layer's: every call is bounded
+by a per-call :class:`~paddle_tpu.distributed.resilience.Deadline` and
+transport failures surface as :class:`ReplicaUnreachable` (a retryable
+``ConnectionError``), while application errors the host raises —
+``QueueFull``, ``Overloaded``, ``SchedulerClosed``, ``ValueError`` —
+cross the wire unwrapped, so the router's failover logic cannot tell a
+remote replica from a local one. Idempotent calls (poll / probe /
+snapshot / shutdown) retry transport blips through a ``RetryPolicy``;
+``submit`` is NEVER retried at this layer (a lost response would make a
+duplicate admission undecidable) — a transport-failed submit reports
+``ReplicaUnreachable`` and the router fails over to another replica,
+where the router-assigned seed keeps the replayed stream token-identical.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..distributed import rpc
+from ..distributed.resilience import Deadline, FaultPlan, RetryPolicy
+from ..distributed.rpc import RpcTransportError
+from .scheduler import Request
+from .server import RequestHandle
+
+__all__ = ["RemoteReplica", "RemoteHandle", "ReplicaUnreachable",
+           "host_server", "unhost_server", "hosted_names",
+           "wait_for_stop", "stop_requested"]
+
+
+class ReplicaUnreachable(ConnectionError):
+    """The remote replica's host cannot be reached (connect refused,
+    connection dropped mid-call, retry budget spent). Retryable by
+    classification, but the router treats it like ``SchedulerClosed``:
+    mark the replica DEAD and fail over — a peer that stopped answering
+    is indistinguishable from a crashed one until an operator re-adds
+    it."""
+
+
+# ---------------------------------------------------------------------------
+# host side: the rpc surface (module-level functions pickle by reference)
+# ---------------------------------------------------------------------------
+_host_lock = threading.Lock()
+_hosted: Dict[str, object] = {}            # name -> server
+_live: Dict[str, object] = {}              # rid  -> RequestHandle
+_retired_at: Dict[str, float] = {}         # rid  -> done wall-time
+_rid_serial = itertools.count()
+_RETIRE_TTL = 60.0                         # keep done handles pollable
+_stop_event = threading.Event()
+
+
+def host_server(server, name: str = "default") -> str:
+    """Expose ``server`` (started if it is not yet) to rpc peers under
+    ``name``. One process can host several servers; each is addressed by
+    ``(rpc worker, name)``."""
+    with _host_lock:
+        if name in _hosted:
+            raise ValueError(f"server {name!r} already hosted here")
+        _hosted[name] = server
+    server.start()
+    return name
+
+
+def unhost_server(name: str = "default") -> None:
+    with _host_lock:
+        _hosted.pop(name, None)
+
+
+def hosted_names():
+    with _host_lock:
+        return sorted(_hosted)
+
+
+def _get_server(name: str):
+    with _host_lock:
+        srv = _hosted.get(name)
+    if srv is None:
+        raise RuntimeError(f"no hosted serving replica {name!r} in this "
+                           f"process; call remote.host_server() first")
+    return srv
+
+
+def _sweep_retired_locked(now: float) -> None:
+    # stamp completions the client never saw (its poller died / it
+    # rerouted away mid-blip): without this, an unpolled-to-done handle
+    # would sit in _live forever and a long-running host would leak
+    for rid, handle in _live.items():
+        if rid not in _retired_at and handle.done:
+            _retired_at[rid] = now
+    for rid in [r for r, t in _retired_at.items()
+                if now - t > _RETIRE_TTL]:
+        _retired_at.pop(rid, None)
+        _live.pop(rid, None)
+
+
+def _host_submit(name: str, kwargs: dict) -> str:
+    """Admit one request on the hosted server; returns a request id the
+    client polls. Admission errors (``QueueFull``/``Overloaded``/
+    ``SchedulerClosed``/``ValueError``) propagate to the caller
+    unwrapped."""
+    srv = _get_server(name)
+    handle = srv.submit(**dict(kwargs))
+    rid = f"{name}-{next(_rid_serial)}"
+    now = time.monotonic()
+    with _host_lock:
+        _sweep_retired_locked(now)
+        _live[rid] = handle
+    return rid
+
+
+def _host_poll(rid: str, cursor: int) -> dict:
+    """Read-only stream poll: tokens beyond ``cursor``, completion state,
+    and the error (the exception object itself — it pickles back to the
+    client and re-raises with its real type). ``restarted`` flags a
+    crash-recovery requeue on the host (its token list shrank below the
+    client's cursor), telling the client to replay from the start — the
+    same at-least-once contract a local ``stream()`` has. Idempotent:
+    done handles stay pollable for a grace TTL so a lost response can be
+    re-asked."""
+    with _host_lock:
+        handle = _live.get(rid)
+    if handle is None:
+        raise KeyError(f"unknown or expired remote request {rid!r}")
+    # read DONE first, tokens second: the worker pushes the final token
+    # before setting the done event, so this order can never pair
+    # done=True with a token list missing the tail (the reverse order
+    # could, truncating the stream on the race)
+    done = handle.done
+    toks = handle.tokens()
+    restarted = len(toks) < cursor
+    out = {
+        "tokens": [int(t) for t in (toks if restarted else toks[cursor:])],
+        "count": int(len(toks)),
+        "restarted": restarted,
+        "done": done,
+        "error": handle.error if done else None,
+        "ttft_s": handle.ttft_s,
+        "cache_hit_tokens": int(handle.cache_hit_tokens),
+    }
+    if done:
+        with _host_lock:
+            _retired_at.setdefault(rid, time.monotonic())
+    return out
+
+
+def _host_probe(name: str) -> dict:
+    # probes are the host's periodic heartbeat: piggyback the retired-
+    # handle sweep so a submit-quiet host still reclaims its registry
+    with _host_lock:
+        _sweep_retired_locked(time.monotonic())
+    return _get_server(name).probe()
+
+
+def _host_snapshot(name: str) -> dict:
+    return _get_server(name).snapshot()
+
+
+def _host_statusz(name: str) -> dict:
+    return _get_server(name).statusz()
+
+
+def _host_shutdown(name: str, drain: bool = True,
+                   timeout: Optional[float] = None) -> bool:
+    srv = _get_server(name)
+    srv.shutdown(drain=drain, timeout=timeout)
+    return True
+
+
+# -- chaos-drill helpers (tools/fleet_chaos.py drives these over rpc) -------
+_chaos_plan: Optional[FaultPlan] = None
+_chaos_lock = threading.Lock()
+
+
+def _host_install_plan(plan_json: str) -> bool:
+    """Install a :class:`FaultPlan` in THIS process (replacing any prior
+    chaos plan) — how the fleet soak turns a healthy remote replica into
+    a slow/faulty one mid-run without restarting it."""
+    global _chaos_plan
+    plan = FaultPlan.from_json(plan_json)
+    with _chaos_lock:
+        if _chaos_plan is not None:
+            _chaos_plan.uninstall()
+        plan.install(env=False)
+        _chaos_plan = plan
+    return True
+
+
+def _host_clear_plan() -> bool:
+    global _chaos_plan
+    with _chaos_lock:
+        if _chaos_plan is not None:
+            _chaos_plan.uninstall()
+            _chaos_plan = None
+    return True
+
+
+def _host_request_stop() -> bool:
+    """Ask the hosting process to wind down (its main thread typically
+    sits in :func:`wait_for_stop`)."""
+    _stop_event.set()
+    return True
+
+
+def stop_requested() -> bool:
+    return _stop_event.is_set()
+
+
+def wait_for_stop(timeout: Optional[float] = None) -> bool:
+    """Block the host's main thread until a peer calls
+    ``_host_request_stop`` (or ``timeout`` elapses); returns whether the
+    stop was requested."""
+    return _stop_event.wait(timeout)
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+class _EngineView:
+    """Load numbers the router's placement scorer reads, refreshed from
+    probes. ``pool``/``store`` stay ``None``: prefix/adapter affinity is
+    a local-replica signal (the block pool lives across the wire)."""
+
+    __slots__ = ("active_count", "slots")
+    pool = None
+    store = None
+
+    def __init__(self):
+        self.active_count = 0
+        self.slots = 1
+
+
+class _SchedulerView:
+    __slots__ = ("depth", "max_queue_depth")
+
+    def __init__(self):
+        self.depth = 0
+        self.max_queue_depth = 1
+
+
+class RemoteHandle(RequestHandle):
+    """Client-side mirror of a request running on a remote replica.
+
+    A daemon poller thread stream-polls the host and replays what it
+    learns into the inherited :class:`RequestHandle` machinery, so
+    ``result()``/``stream()``/``tokens()``/``done`` behave exactly like
+    a local handle's. A host-side crash-recovery restart surfaces as the
+    usual at-least-once replay; a transport failure (retry budget spent)
+    fails the handle with :class:`ReplicaUnreachable`, which the
+    ``RouterHandle`` above it treats as a replica death and reroutes."""
+
+    def __init__(self, replica: "RemoteReplica", req: Request, rid: str):
+        super().__init__(req)
+        self._replica = replica
+        self._rid = rid
+        self._cursor = 0
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"pt-remote-poll-{rid}")
+        self._poller.start()
+
+    def _poll_loop(self) -> None:
+        interval = self._replica.poll_interval
+        while not self._done_evt.is_set():
+            try:
+                out = self._replica._call(
+                    _host_poll, self._rid, self._cursor,
+                    what="remote poll")
+            except ReplicaUnreachable as e:
+                self._fail(e)
+                return
+            except KeyError as e:
+                # the host forgot us (it restarted, or the grace TTL
+                # lapsed): the stream cannot resume — same remedy as a
+                # dead peer, reroute via the handle failure
+                self._fail(ReplicaUnreachable(
+                    f"replica {self._replica.peer!r} lost request "
+                    f"{self._rid!r}: {e}"))
+                return
+            except BaseException as e:   # unexpected: surface, never hang
+                self._fail(e)
+                return
+            if out["restarted"]:
+                self._cursor = 0
+                self._restart()
+            if out["tokens"]:
+                now = time.monotonic()
+                if self.ttft_s is None:
+                    # client-observed TTFT (includes the wire) — the
+                    # consistent basis for RouterHandle's reroute-aware
+                    # TTFT arithmetic, which offsets by _submit_t
+                    self.ttft_s = now - self._submit_t
+                for tok in out["tokens"]:
+                    self._push(tok)
+                self._last_token_t = now
+            self._cursor = out["count"]
+            self.cache_hit_tokens = out["cache_hit_tokens"]
+            if out["done"]:
+                if out["error"] is not None:
+                    self._fail(out["error"])
+                else:
+                    self._finish()
+                return
+            time.sleep(interval)
+
+
+class RemoteReplica:
+    """An ``InferenceServer`` in another process, addressed by its rpc
+    worker name, wearing the local-server duck type the router drives
+    (``engine``/``scheduler`` load views, ``submit``/``start``/
+    ``shutdown``/``snapshot``/``statusz``/``probe``).
+
+    Every rpc is bounded by a per-call :class:`Deadline` derived from
+    ``rpc_timeout`` (and a sub-window ``connect_deadline`` so a DEAD
+    peer is classified fast, not at the transport's leisurely default);
+    idempotent calls retry transport failures through ``retry``. The
+    router's heartbeat detector calls :meth:`probe`, which doubles as
+    the load-view refresh. :meth:`abandon` fails every live handle with
+    :class:`ReplicaUnreachable` — the detector invokes it when it
+    declares this replica dead, so in-flight streams reroute
+    immediately instead of waiting out their own poll retries."""
+
+    def __init__(self, peer: str, hosted_name: str = "default", *,
+                 rpc_timeout: float = 10.0,
+                 connect_deadline: float = 1.0,
+                 poll_interval: float = 0.02,
+                 retry: Optional[RetryPolicy] = None):
+        self.peer = peer
+        self.hosted_name = hosted_name
+        self.rpc_timeout = float(rpc_timeout)
+        self.connect_deadline = float(connect_deadline)
+        self.poll_interval = float(poll_interval)
+        # transport-only retry: RpcTransportError is ours to absorb;
+        # remote application exceptions pass through untouched
+        self._retry = retry or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=0.5,
+            retryable=(RpcTransportError,))
+        self._no_retry = RetryPolicy(
+            max_attempts=1, retryable=(RpcTransportError,))
+        self.engine = _EngineView()
+        self.scheduler = _SchedulerView()
+        self._handles: "weakref.WeakSet[RemoteHandle]" = weakref.WeakSet()
+
+    # ------------------------------------------------------------ plumbing
+    def _call(self, fn, *args, what: str = "remote call",
+              deadline: Optional[Deadline] = None, retry=None,
+              rpc_timeout: Optional[float] = None):
+        timeout = rpc_timeout if rpc_timeout is not None else self.rpc_timeout
+        if deadline is not None:
+            timeout = max(0.05, min(timeout, deadline.remaining()))
+
+        def once():
+            return rpc.rpc_sync(
+                self.peer, fn, args=args, timeout=timeout,
+                connect_deadline=min(self.connect_deadline, timeout))
+
+        try:
+            return (retry or self._retry).call(
+                once, what=f"{what} {self.peer}")
+        except RpcTransportError as e:
+            # transport only: the attempt-capped policies re-raise the
+            # original RpcTransportError on exhaustion, so application
+            # exceptions from the remote fn — including a drain
+            # TimeoutError from the hosted server — pass through
+            # UNWRAPPED, exactly like a local replica's would
+            raise ReplicaUnreachable(
+                f"replica {self.peer!r} unreachable ({what}): {e}") from e
+
+    # ----------------------------------------------------- server surface
+    def start(self) -> "RemoteReplica":
+        """Best-effort initial probe to seed the load view. Never raises
+        — an unreachable or still-booting peer (its ``host_server`` call
+        may be seconds away behind a model build) is membership's
+        problem: the router's detector or first placement attempt will
+        classify it."""
+        try:
+            self.probe()
+        except Exception:
+            pass
+        return self
+
+    def wait_ready(self, timeout: float = 120.0,
+                   interval: float = 0.25) -> bool:
+        """Poll until the peer actually hosts ``hosted_name`` (rpc up
+        AND ``host_server`` called); returns readiness. Operators call
+        this between spawning a replica process and handing it to a
+        router whose failure detector would otherwise count the boot
+        window as probe misses."""
+        deadline = Deadline(timeout)
+        while True:
+            try:
+                self.probe()
+                return True
+            except Exception:
+                if deadline.expired():
+                    return False
+                time.sleep(interval)
+
+    def submit(self, **kwargs) -> RemoteHandle:
+        kwargs = dict(kwargs)
+        prompt = np.asarray(kwargs["prompt"], np.int32).ravel()
+        kwargs["prompt"] = prompt
+        # no transport retry (see module docstring): a lost submit
+        # response must surface, not double-admit
+        rid = self._call(_host_submit, self.hosted_name, kwargs,
+                         what="remote submit", retry=self._no_retry,
+                         deadline=Deadline(self.rpc_timeout))
+        req = Request(
+            prompt=prompt,
+            max_new_tokens=int(kwargs.get("max_new_tokens", 32)),
+            greedy=not kwargs.get("do_sample", False),
+            temperature=float(kwargs.get("temperature", 1.0)),
+            top_p=float(kwargs.get("top_p", 1.0)),
+            eos_token_id=kwargs.get("eos_token_id"),
+            seed=kwargs.get("seed"),
+            adapter_id=kwargs.get("adapter_id"),
+            corr_id=kwargs.get("correlation_id"))
+        handle = RemoteHandle(self, req, rid)
+        req.handle = handle
+        self._handles.add(handle)
+        return handle
+
+    def probe(self) -> dict:
+        """One health probe (rpc ``InferenceServer.probe``), refreshing
+        the load view the router's placement scorer reads. Single rpc
+        attempt, no transport retry: the failure detector calling this
+        aggregates misses itself — stacking transport retries under
+        each probe would only multiply its time-to-detection."""
+        out = self._call(_host_probe, self.hosted_name,
+                         what="remote probe", retry=self._no_retry,
+                         deadline=Deadline(self.rpc_timeout))
+        self.engine.active_count = int(out.get("active", 0))
+        self.engine.slots = max(1, int(out.get("slots", 1)))
+        self.scheduler.depth = int(out.get("queue_depth", 0))
+        self.scheduler.max_queue_depth = max(
+            1, int(out.get("max_queue_depth", 1)))
+        return out
+
+    def snapshot(self) -> dict:
+        try:
+            return self._call(_host_snapshot, self.hosted_name,
+                              what="remote snapshot",
+                              deadline=Deadline(self.rpc_timeout))
+        except ReplicaUnreachable:
+            return {"state": "unreachable", "peer": self.peer}
+
+    def statusz(self) -> dict:
+        try:
+            return self._call(_host_statusz, self.hosted_name,
+                              what="remote statusz",
+                              deadline=Deadline(self.rpc_timeout))
+        except ReplicaUnreachable:
+            return {"state": "unreachable", "peer": self.peer}
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        self._call(_host_shutdown, self.hosted_name, drain, timeout,
+                   what="remote shutdown",
+                   rpc_timeout=(timeout or self.rpc_timeout) + 5.0,
+                   deadline=Deadline((timeout or self.rpc_timeout) + 5.0))
+
+    def abandon(self, reason: str) -> int:
+        """Fail every live handle with :class:`ReplicaUnreachable` —
+        called by the router's failure detector on declaring this
+        replica dead, so in-flight ``RouterHandle`` consumers reroute
+        NOW rather than after their own poll retries. Returns how many
+        handles were abandoned."""
+        n = 0
+        for h in list(self._handles):
+            if not h.done:
+                h._fail(ReplicaUnreachable(
+                    f"replica {self.peer!r} abandoned: {reason}"))
+                n += 1
+        return n

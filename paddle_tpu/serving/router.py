@@ -23,43 +23,87 @@ bookkeeping around it:
   about to finish or an add may be in flight);
 - **membership** follows the supervisor-style lifecycle the training
   stack uses (PR 5/6): replicas are ACTIVE → DRAINING (placement stops,
-  accepted work finishes, then the server shuts down) → DEAD. A replica
-  that rejects with ``SchedulerClosed`` or whose handles fail is marked
-  DEAD in place — no health-check thread, the traffic itself is the
-  probe;
+  accepted work finishes, then the server shuts down) → DEAD, plus a
+  SUSPECT state for gray failures. A replica that rejects with
+  ``SchedulerClosed``/``ReplicaUnreachable`` or whose handles fail is
+  marked DEAD in place — the traffic itself is a probe;
+- **failure detection** (``health_check_interval=``): a heartbeat
+  thread probes every live replica (``InferenceServer.probe`` locally,
+  the rpc probe for :class:`~paddle_tpu.serving.remote.RemoteReplica`)
+  with phi-accrual-style suspicion — consecutive-miss count plus a
+  probe-latency EWMA. One miss (or a probe slower than
+  ``suspect_latency_factor`` x its EWMA) moves an ACTIVE replica to
+  SUSPECT: new placements stop, in-flight work continues — a gray
+  replica is quarantined before it is condemned. ``dead_misses``
+  consecutive misses declare it DEAD: the flight recorder dumps an
+  artifact carrying every affected correlation id, and remote replicas
+  ``abandon()`` their live handles so streams reroute NOW instead of
+  waiting out their own poll retries. A healthy probe revives a SUSPECT
+  back to ACTIVE. Every transition is counted in the metrics registry
+  (the router registers a collector) and flight-recorded;
 - **crash recovery**: a :class:`RouterHandle` that sees its replica die
   mid-stream resubmits the SAME request to a survivor, bounded by
   ``max_reroutes``. The router assigns every sampled request a concrete
   seed at the front door, so the rerouted run replays the identical
   token stream (the per-request PRNG derivation is placement-invariant)
-  — delivery is at-least-once, content is exactly-once.
+  — delivery is at-least-once, content is exactly-once. ``Overloaded``
+  sheds are NOT deaths: they re-raise to the client untouched (retry is
+  the client's call, and the replica that shed is perfectly healthy);
+- **hedged retries** (``hedge_multiplier=``): when a live stream's
+  next-token gap blows past ``hedge_multiplier`` x the fleet's
+  inter-token EWMA (floored at ``hedge_min_s``), the handle re-submits
+  the SAME request — same router-assigned seed — to a second replica
+  and takes whichever finishes first. Token identity makes the hedge
+  winner indistinguishable from the original; the loser's slot frees
+  when its stream completes (bounded waste, never wrong answers). Each
+  fire is counted, traced, and flight-dumped with the affected
+  correlation id.
 
 The router is in-process and thread-safe: any number of client threads
-submit; each replica keeps its own single serving worker.
+submit; each replica keeps its own single serving worker (local
+replicas) or rpc poller threads (remote ones). Defaults keep PR 8
+behavior bit-identical: no detector thread unless
+``health_check_interval`` is set, no hedging unless
+``hedge_multiplier`` is set.
 """
 from __future__ import annotations
 
 import itertools
 import os
+import queue as _queue
 import threading
 import time
+import weakref
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ..observability import flight as _flight
+from ..observability import registry as _obs_registry
 from ..observability import tracing as _tracing
 from .prefix_cache import BlockPool  # noqa: F401  (re-export convenience)
+from .remote import ReplicaUnreachable
 from .scheduler import Backpressure, QueueFull, SchedulerClosed
 from .server import InferenceServer, RequestHandle
 
 __all__ = ["ReplicaRouter", "RouterHandle", "NoReplicasAvailable",
-           "ACTIVE", "DRAINING", "DEAD"]
+           "ACTIVE", "SUSPECT", "DRAINING", "DEAD"]
 
 ACTIVE = "active"
+#: alive but misbehaving (a missed probe, or probes far slower than the
+#: replica's own latency EWMA): new placements stop, in-flight work
+#: continues, a healthy probe revives it — the gray-failure quarantine
+SUSPECT = "suspect"
 DRAINING = "draining"
 DEAD = "dead"
 
 _name_serial = itertools.count()
+_router_serial = itertools.count()
+
+# RouterHandle._hedge sentinel: a hedge was attempted for the current
+# attachment and cannot/need not fire again (placement failed, or the
+# hedge itself died) — distinct from None ("not fired yet")
+_HEDGE_UNAVAILABLE = object()
 
 
 class NoReplicasAvailable(Backpressure):
@@ -69,13 +113,19 @@ class NoReplicasAvailable(Backpressure):
 
 
 class _Replica:
-    __slots__ = ("name", "server", "state", "routed")
+    __slots__ = ("name", "server", "state", "routed", "misses",
+                 "lat_ewma", "inflight")
 
     def __init__(self, name: str, server: InferenceServer):
         self.name = name
         self.server = server
         self.state = ACTIVE
         self.routed = 0
+        self.misses = 0                  # consecutive probe failures
+        self.lat_ewma: Optional[float] = None   # probe latency EWMA (s)
+        # live RouterHandles placed here — the corr ids a death dump
+        # carries; weak so finished handles vanish on their own
+        self.inflight: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class RouterHandle:
@@ -100,6 +150,9 @@ class RouterHandle:
         self._inner: Optional[RequestHandle] = None
         self.replica: Optional[str] = None
         self.reroutes = 0
+        # hedge state: None = not fired; a RouterHandle = the live
+        # hedge; _HEDGE_UNAVAILABLE = attempted, don't re-fire
+        self._hedge = None
         self._submit_t = time.monotonic()
 
     # ---- router-side ----
@@ -196,47 +249,418 @@ class RouterHandle:
         """Block for the full generated sequence, transparently
         rerouting across replica deaths. ``timeout`` applies per
         attempt (a reroute restarts the clock — the request restarts
-        too)."""
-        inner = self._current()
+        too). With hedging enabled on the router, a stalled wait fires
+        one hedge submission and this returns whichever copy finishes
+        first (token-identical by seeded replay). ``Overloaded`` sheds
+        re-raise untouched: a shed is backpressure from a HEALTHY
+        replica, not a death — retrying is the client's decision."""
         while True:
+            inner = self._current()
             try:
-                return inner.result(timeout)
+                return self._await(inner, timeout)
             except self._REROUTABLE as e:
-                inner = self._reroute(e, inner)
+                if isinstance(e, Backpressure):
+                    raise
+                # reroute keyed on the handle WE were waiting on — a
+                # concurrent consumer may already have moved _inner, and
+                # passing the current handle would defeat the
+                # single-flight guard (and kill the healthy survivor)
+                self._reroute(e, inner)
+
+    def _await(self, inner: RequestHandle,
+               timeout: Optional[float]) -> np.ndarray:
+        """``inner.result()`` with the hedge watchdog: poll the done
+        event in slices, measure progress via the token count, fire a
+        hedge when the stall crosses the router's EWMA-derived
+        threshold, and adopt whichever copy completes first."""
+        router = self._router
+        if router.hedge_multiplier is None:
+            return inner.result(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        last_n = inner._count()
+        last_t = time.monotonic()
+        chosen: Optional[RequestHandle] = None
+        while chosen is None:
+            if inner._done_evt.wait(router.hedge_poll_interval):
+                chosen = inner
+                break
+            now = time.monotonic()
+            n = inner._count()
+            if n > last_n:
+                if last_n > 0:
+                    # only genuine inter-token gaps feed the EWMA: the
+                    # first token's gap is queue wait + prefill and
+                    # would drag the hedge threshold up by seconds
+                    router._note_inter_token((now - last_t) / (n - last_n),
+                                             count=n - last_n)
+                last_n, last_t = n, now
+            # hedge only on a STALLED LIVE STREAM (a next-token gap):
+            # pre-first-token delay is queue wait + prefill — the
+            # detector's territory, and hedging on it would double
+            # offered load exactly when the fleet is congested
+            hedge = (self._maybe_hedge(now - last_t) if last_n > 0
+                     else None)
+            if hedge is not None:
+                hinner = hedge._current()
+                if hinner is not None and hinner._done_evt.is_set():
+                    if hinner.error is None:
+                        with router._lock:
+                            router.hedge_wins += 1
+                        self._attach(hedge.replica, hinner)
+                        router._track(self, hedge.replica)
+                        with self._cv:
+                            self._hedge = None
+                        chosen = hinner
+                        break
+                    with self._cv:   # hedge died; primary carries on
+                        self._hedge = _HEDGE_UNAVAILABLE
+            if deadline is not None and now >= deadline:
+                raise TimeoutError(
+                    f"request not finished within {timeout}s "
+                    f"({inner._count()} tokens so far)")
+        if chosen.error is not None:
+            raise chosen.error
+        return chosen.tokens()
 
     def stream(self) -> Iterator[int]:
         """Yield token ids as they are generated. After a reroute the
         regenerated stream is re-emitted from its first token
         (at-least-once), matching the single-server crash-recovery
-        restart semantics."""
-        inner = self._current()
+        restart semantics. With hedging enabled, a mid-stream stall
+        fires one hedge and the stream SWITCHES to the hedge copy,
+        re-emitting from its first token — same at-least-once contract,
+        and seeded replay keeps the tokens themselves identical."""
         while True:
+            inner = self._current()
+            # one-cell box: _hedged_stream records which handle it was
+            # actually consuming when an error escaped (primary or an
+            # adopted hedge), so the reroute is keyed on the real
+            # casualty, not on whatever _inner points at by then
+            consumed = [inner]
             try:
-                yield from inner.stream()
+                if self._router.hedge_multiplier is None:
+                    yield from inner.stream()
+                else:
+                    yield from self._hedged_stream(inner, consumed)
                 return
             except self._REROUTABLE as e:
-                inner = self._reroute(e, inner)
+                if isinstance(e, Backpressure):
+                    raise
+                self._reroute(e, consumed[0])
+
+    def _hedged_stream(self, inner: RequestHandle,
+                       consumed: list) -> Iterator[int]:
+        router = self._router
+        # EWMA/stall bookkeeping observes token ARRIVALS via the count
+        # (the _await discipline), never queue-consumption gaps: a
+        # consumer that thinks for a second between tokens must not
+        # inflate the fleet inter-token EWMA, and tokens that piled up
+        # during its pause must not read as a stall
+        last_n = inner._count()
+        last_t = time.monotonic()
+
+        def observe() -> None:
+            nonlocal last_n, last_t
+            now = time.monotonic()
+            n = inner._count()
+            if n > last_n:
+                if last_n > 0:   # first gap = queue+prefill, not ITL
+                    router._note_inter_token(
+                        (now - last_t) / (n - last_n), count=n - last_n)
+                last_n, last_t = n, now
+
+        while True:
+            observe()
+            try:
+                kind, val = inner._q.get(
+                    timeout=router.hedge_poll_interval)
+            except _queue.Empty:
+                if last_n == 0:
+                    # no stream to measure yet: pre-first-token delay is
+                    # queue wait + prefill, the detector's territory —
+                    # hedging on it would double offered load exactly
+                    # when the fleet is congested
+                    continue
+                hedge = self._maybe_hedge(time.monotonic() - last_t)
+                if hedge is None:
+                    continue
+                hinner = hedge._current()
+                if hinner is None or (hinner._count() == 0
+                                      and not hinner.done):
+                    continue   # hedge placed but not producing yet
+                if hinner.done and hinner.error is not None:
+                    # a FAILED hedge is never adopted — tokens or not:
+                    # switching to a corpse would abandon a live
+                    # primary and book the loss as a win
+                    with self._cv:
+                        self._hedge = _HEDGE_UNAVAILABLE
+                    continue
+                # the hedge is producing: adopt it (stream re-emits
+                # from its first token; tokens are seed-identical)
+                with router._lock:
+                    router.hedge_wins += 1
+                self._attach(hedge.replica, hinner)
+                router._track(self, hedge.replica)
+                with self._cv:
+                    self._hedge = None
+                consumed[0] = hinner
+                yield from hinner.stream()
+                return
+            if kind == "tok":
+                yield val
+            elif kind == "restart":
+                continue
+            elif kind == "end":
+                return
+            else:
+                raise val
+
+    def _maybe_hedge(self, stall: float) -> Optional["RouterHandle"]:
+        """The live hedge handle, firing one if ``stall`` crossed the
+        router's threshold; ``None`` when hedging is off/warming/spent."""
+        h = self._hedge
+        if h is _HEDGE_UNAVAILABLE:
+            return None
+        if isinstance(h, RouterHandle):
+            return h
+        thr = self._router._hedge_threshold()
+        if thr is None or stall <= thr:
+            return None
+        return self._fire_hedge(stall, thr)
+
+    def _fire_hedge(self, stall: float,
+                    threshold: float) -> Optional["RouterHandle"]:
+        """Submit the hedge copy to a second replica (single-flight per
+        attachment; the slow replica is excluded, NOT marked dead — it
+        may merely be gray). Placement and telemetry run outside the
+        handle lock: only the claim/publish of ``_hedge`` sits under
+        it."""
+        router = self._router
+        with self._cv:
+            if self._hedge is not None:
+                h = self._hedge
+                return h if isinstance(h, RouterHandle) else None
+            self._hedge = _HEDGE_UNAVAILABLE   # claim (pessimistic)
+            slow = self.replica
+        hedge = RouterHandle(router, dict(self._kwargs))
+        try:
+            router._place(hedge, exclude={slow} if slow else ())
+        except Exception:
+            return None    # stays unavailable for this attachment
+        with router._lock:
+            router.requests_hedged += 1
+        corr = self.correlation_id
+        detail = {"slow_replica": slow, "hedge_replica": hedge.replica,
+                  "stall_s": round(stall, 4),
+                  "threshold_s": round(threshold, 4)}
+        _tracing.record_event("hedge_fire", corr=corr, **detail)
+        _flight.note("hedge_fire", corr=corr, **detail)
+        _flight.dump("hedge_fire", corr=corr,
+                     extra=dict(detail, corrs=[corr]))
+        with self._cv:
+            self._hedge = hedge
+        return hedge
 
 
 class ReplicaRouter:
-    """Front door over N :class:`InferenceServer` replicas."""
+    """Front door over N replicas — local :class:`InferenceServer` and
+    :class:`~paddle_tpu.serving.remote.RemoteReplica` alike (one duck
+    type, one placement/reroute policy)."""
 
     def __init__(self, replicas=(), *, affinity_weight: float = 0.75,
                  adapter_affinity_weight: float = 0.5,
-                 max_reroutes: int = 2):
+                 max_reroutes: int = 2,
+                 health_check_interval: Optional[float] = None,
+                 suspect_misses: int = 1, dead_misses: int = 3,
+                 suspect_latency_factor: float = 4.0,
+                 min_suspect_latency: float = 0.05,
+                 hedge_multiplier: Optional[float] = None,
+                 hedge_min_s: float = 0.25,
+                 hedge_warmup_tokens: int = 16,
+                 hedge_poll_interval: float = 0.02):
         self.affinity_weight = float(affinity_weight)
         # a tenant placed where its adapter pages are already resident
         # skips a host->device page load (and an LRU eviction somewhere
         # else); like prefix affinity, load eventually outweighs warmth
         self.adapter_affinity_weight = float(adapter_affinity_weight)
         self.max_reroutes = int(max_reroutes)
+        # --- failure detector (None = off: PR 8 behavior unchanged) ---
+        self.health_check_interval = health_check_interval
+        self.suspect_misses = int(suspect_misses)
+        self.dead_misses = int(dead_misses)
+        self.suspect_latency_factor = float(suspect_latency_factor)
+        self.min_suspect_latency = float(min_suspect_latency)
+        # --- hedging (None = off) ---
+        self.hedge_multiplier = (None if hedge_multiplier is None
+                                 else float(hedge_multiplier))
+        self.hedge_min_s = float(hedge_min_s)
+        self.hedge_warmup_tokens = int(hedge_warmup_tokens)
+        self.hedge_poll_interval = float(hedge_poll_interval)
         self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {}
         self.requests_routed = 0
         self.requests_rerouted = 0
-        self.replicas_failed = 0
+        self.requests_hedged = 0
+        self.hedge_wins = 0
+        self.replicas_failed = 0          # all deaths (traffic + probe)
+        self.replicas_suspected = 0
+        self.replicas_revived = 0
+        self._itl_ewma: Optional[float] = None   # observed inter-token s
+        self._itl_samples = 0
+        self._health_stop: Optional[threading.Event] = None
+        self._health_thread: Optional[threading.Thread] = None
+        # detector/hedge counters + per-state membership gauges ride the
+        # process metrics registry (weak collector, like the servers')
+        self._obs_label = f"router{next(_router_serial)}"
+        _obs_registry.default_registry().register_collector(
+            self._obs_collect, labels={"router": self._obs_label},
+            name=f"router.{self._obs_label}")
         for r in replicas:
             self.add_replica(r)
+        if self.health_check_interval:
+            self._health_stop = threading.Event()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="pt-router-health",
+                daemon=True)
+            self._health_thread.start()
+
+    def _obs_collect(self) -> dict:
+        with self._lock:
+            states = {ACTIVE: 0, SUSPECT: 0, DRAINING: 0, DEAD: 0}
+            for r in self._replicas.values():
+                states[r.state] = states.get(r.state, 0) + 1
+            counters = {
+                "router.requests_routed": self.requests_routed,
+                "router.requests_rerouted": self.requests_rerouted,
+                "router.requests_hedged": self.requests_hedged,
+                "router.hedge_wins": self.hedge_wins,
+                "router.replicas_failed": self.replicas_failed,
+                "router.replicas_suspected": self.replicas_suspected,
+                "router.replicas_revived": self.replicas_revived,
+            }
+            gauges = {f"router.replicas_{s}": n
+                      for s, n in states.items()}
+        return {"counters": counters, "gauges": gauges}
+
+    # -------------------------------------------------- failure detector
+    def _health_loop(self) -> None:
+        while not self._health_stop.wait(self.health_check_interval):
+            try:
+                self.check_health()
+            except Exception:   # pragma: no cover - detector never dies
+                pass
+
+    @staticmethod
+    def _probe_replica(server) -> dict:
+        probe = getattr(server, "probe", None)
+        if probe is not None:
+            return probe()
+        # minimal duck-typed fallback: live load reads double as probe
+        return {"active": server.engine.active_count,
+                "queue_depth": server.scheduler.depth}
+
+    def check_health(self) -> None:
+        """One probe round over every ACTIVE/SUSPECT replica (the
+        heartbeat thread's body; public so tests and ops tooling can
+        drive the detector synchronously). Probes run OUTSIDE the
+        router lock — a hung remote peer stalls this round, never a
+        placement."""
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if r.state in (ACTIVE, SUSPECT)]
+        for rep in reps:
+            t0 = time.monotonic()
+            try:
+                self._probe_replica(rep.server)
+            except Exception as e:
+                self._probe_miss(rep, e)
+            else:
+                self._probe_ok(rep, time.monotonic() - t0)
+
+    def _note_transition(self, kind: str, rep_name: str,
+                         detail: str) -> None:
+        _tracing.record_event(f"replica_{kind}", corr=None,
+                              replica=rep_name, detail=detail)
+        _flight.note(f"replica_{kind}", replica=rep_name, detail=detail)
+
+    def _probe_ok(self, rep: _Replica, latency: float) -> None:
+        transition = None
+        with self._lock:
+            if rep.state not in (ACTIVE, SUSPECT):
+                return
+            rep.misses = 0
+            prev = rep.lat_ewma
+            # phi-accrual-style gray detection: compare this probe to
+            # the replica's OWN history before folding it in, so a
+            # sudden stall stands out instead of dragging the baseline
+            slow = (prev is not None
+                    and latency > max(self.min_suspect_latency,
+                                      prev * self.suspect_latency_factor))
+            rep.lat_ewma = (latency if prev is None
+                            else 0.8 * prev + 0.2 * latency)
+            if slow and rep.state == ACTIVE:
+                rep.state = SUSPECT
+                self.replicas_suspected += 1
+                transition = ("suspect",
+                              f"probe {latency * 1e3:.1f}ms vs ewma "
+                              f"{prev * 1e3:.1f}ms")
+            elif not slow and rep.state == SUSPECT:
+                rep.state = ACTIVE
+                self.replicas_revived += 1
+                transition = ("revive", f"probe {latency * 1e3:.1f}ms")
+        if transition is not None:
+            self._note_transition(transition[0], rep.name, transition[1])
+
+    def _probe_miss(self, rep: _Replica, exc: BaseException) -> None:
+        transition = None
+        dead = False
+        with self._lock:
+            if rep.state not in (ACTIVE, SUSPECT):
+                return
+            rep.misses += 1
+            misses = rep.misses
+            if misses >= self.dead_misses:
+                dead = True
+            elif misses >= self.suspect_misses and rep.state == ACTIVE:
+                rep.state = SUSPECT
+                self.replicas_suspected += 1
+                transition = ("suspect",
+                              f"{misses} probe miss(es): "
+                              f"{type(exc).__name__}: {exc}")
+        if dead:
+            self._mark_dead(rep.name,
+                            cause=f"{rep.misses} consecutive probe "
+                                  f"misses: {type(exc).__name__}: {exc}")
+        elif transition is not None:
+            self._note_transition(transition[0], rep.name, transition[1])
+
+    # ---------------------------------------------------------- hedging
+    def _note_inter_token(self, dt: float, count: int = 1) -> None:
+        """Feed an observed inter-token gap into the fleet EWMA the
+        hedge threshold derives from. ``count`` > 1 means the observer
+        saw ``count`` tokens land across a window averaging ``dt`` per
+        token (remote pollers deliver bursts between observations) —
+        one EWMA update, ``count`` warmup credits, so fast replicas
+        still clear ``hedge_warmup_tokens``."""
+        with self._lock:
+            self._itl_ewma = (dt if self._itl_ewma is None
+                              else 0.9 * self._itl_ewma + 0.1 * dt)
+            self._itl_samples += max(1, int(count))
+
+    def _hedge_threshold(self) -> Optional[float]:
+        """Stall threshold (seconds without a next token) that fires a
+        hedge: ``hedge_multiplier`` x the fleet inter-token EWMA,
+        floored at ``hedge_min_s``; ``None`` while hedging is off or the
+        EWMA hasn't seen ``hedge_warmup_tokens`` samples (no hedging on
+        zero evidence)."""
+        if self.hedge_multiplier is None:
+            return None
+        with self._lock:
+            if (self._itl_ewma is None
+                    or self._itl_samples < self.hedge_warmup_tokens):
+                return None
+            return max(self.hedge_min_s,
+                       self._itl_ewma * self.hedge_multiplier)
 
     # ------------------------------------------------------- membership
     def add_replica(self, server: InferenceServer,
@@ -269,20 +693,63 @@ class ReplicaRouter:
         with self._lock:
             rep.state = DEAD
 
-    def _mark_dead(self, name: Optional[str]) -> None:
-        """Traffic-as-health-probe: a replica whose submit/handle died
-        with a closed-scheduler or transport error is DEAD until an
-        operator re-adds it."""
+    def _mark_dead(self, name: Optional[str],
+                   cause: str = "traffic failure") -> None:
+        """Declare a replica DEAD — from traffic (a submit/handle died
+        with a closed-scheduler or transport error) or from the failure
+        detector (probe misses). The flight recorder dumps an artifact
+        carrying every affected in-flight correlation id (the thread
+        ``tools/trace_view.py`` pulls a reroute together by), and a
+        remote replica ``abandon()``\\ s its live handles so their
+        ``RouterHandle`` consumers reroute immediately. All telemetry
+        runs OUTSIDE the router lock."""
         with self._lock:
             rep = self._replicas.get(name) if name else None
-            if rep is not None and rep.state != DEAD:
-                rep.state = DEAD
-                self.replicas_failed += 1
+            if rep is None or rep.state == DEAD:
+                return
+            rep.state = DEAD
+            self.replicas_failed += 1
+            handles = list(rep.inflight)
+        affected = []
+        for h in handles:
+            inner = h._current()
+            finished = (inner is not None
+                        and getattr(inner, "error", None) is None
+                        and getattr(inner, "done", False))
+            if not finished and h.correlation_id is not None:
+                affected.append(h.correlation_id)
+        corr = affected[0] if affected else None
+        _tracing.record_event("replica_dead", corr=corr, replica=name,
+                              cause=cause, inflight=len(affected))
+        _flight.note("replica_dead", corr=corr, replica=name,
+                     cause=cause, inflight=list(affected))
+        _flight.dump("replica_dead", corr=corr,
+                     extra={"replica": name, "cause": str(cause),
+                            "inflight": list(affected)})
+        abandon = getattr(rep.server, "abandon", None)
+        if abandon is not None:
+            try:
+                abandon(f"router declared {name} dead: {cause}")
+            except Exception:   # abandoning must never mask the death
+                pass
 
     def replicas(self) -> Dict[str, str]:
         """``{name: state}`` — the membership table."""
         with self._lock:
             return {n: r.state for n, r in self._replicas.items()}
+
+    def _track(self, handle: "RouterHandle", replica: str) -> None:
+        """Move a handle's inflight membership to ``replica`` (and off
+        every other replica): a rerouted or hedge-adopted request must
+        appear in the death dump of the replica actually RUNNING it,
+        not of one it left — trace_view reconstructs reroutes from
+        those correlation-id sets."""
+        with self._lock:
+            for r in self._replicas.values():
+                r.inflight.discard(handle)
+            rep = self._replicas.get(replica)
+            if rep is not None:
+                rep.inflight.add(handle)
 
     # -------------------------------------------------------- placement
     def _score(self, rep: _Replica, prompt: np.ndarray,
@@ -322,6 +789,12 @@ class ReplicaRouter:
         with self._lock:
             active = [r for r in self._replicas.values()
                       if r.state == ACTIVE]
+            if not active:
+                # degraded fallback: when EVERY live replica is merely
+                # SUSPECT (slow but answering), serving slowly beats
+                # rejecting the fleet's whole offered load
+                active = [r for r in self._replicas.values()
+                          if r.state == SUSPECT]
         if not active:
             raise NoReplicasAvailable(
                 "no ACTIVE replica (all draining or dead); add_replica() "
@@ -350,33 +823,41 @@ class ReplicaRouter:
         return scored
 
     def _place(self, handle: RouterHandle,
-               prefer: Optional[str] = None) -> None:
+               prefer: Optional[str] = None, exclude=()) -> None:
         kwargs = handle._kwargs
         prompt = kwargs["prompt"]
         saw_full = False
         for rep in self._candidates(prompt, prefer,
                                     kwargs.get("adapter_id")):
+            if rep.name in exclude:
+                continue             # hedges skip the stalled replica
             try:
                 inner = rep.server.submit(**kwargs)
-            except QueueFull:
-                saw_full = True      # alive, just at depth — capacity signal
+            except Backpressure:
+                # QueueFull (at depth) or Overloaded (deadline-aware
+                # shed): the replica is alive, just over capacity —
+                # fail over to the next candidate before propagating
+                saw_full = True
                 continue
-            except SchedulerClosed:
-                # shut down behind our back — treat as dead, keep going
-                self._mark_dead(rep.name)
+            except (SchedulerClosed, ReplicaUnreachable):
+                # shut down / unreachable behind our back — dead, keep
+                # going (ReplicaUnreachable is how a RemoteReplica's
+                # transport classification surfaces a lost peer)
+                self._mark_dead(rep.name, cause="submit failed")
                 continue
             handle._attach(rep.name, inner)
+            self._track(handle, rep.name)
             with self._lock:
                 rep.routed += 1
                 self.requests_routed += 1
             return
         if saw_full:
-            # at least one LIVE replica exists and rejected on depth:
-            # this is backpressure, not a fleet-down condition
+            # at least one LIVE replica exists and rejected on
+            # depth/deadline: backpressure, not a fleet-down condition
             raise QueueFull(
-                "every live replica is at queue depth; retry with "
-                "backoff (RetryPolicy treats this like any transport "
-                "failure)")
+                "every live replica is over capacity (queue depth or "
+                "deadline-aware shed); retry with backoff (RetryPolicy "
+                "treats this like any transport failure)")
         # every candidate was closed (marked DEAD above) or none existed:
         # the retryable membership error, NOT the non-retryable
         # SchedulerClosed — an add_replica()/finished drain may be a
@@ -437,12 +918,22 @@ class ReplicaRouter:
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """Stop every replica (see ``InferenceServer.shutdown``)."""
+        if self._health_stop is not None:
+            self._health_stop.set()
+            if self._health_thread is not None:
+                self._health_thread.join(timeout=5.0)
         with self._lock:
             reps = list(self._replicas.values())
         errs = []
         for rep in reps:
+            if rep.state == DEAD:
+                continue   # already declared dead: nothing to stop
             try:
                 rep.server.shutdown(drain=drain, timeout=timeout)
+            except ReplicaUnreachable:
+                # the peer is gone — which is exactly the state
+                # shutdown wants; a corpse must not fail the fleet exit
+                pass
             except Exception as e:  # keep shutting the rest down
                 errs.append(e)
             with self._lock:
@@ -478,7 +969,11 @@ class ReplicaRouter:
             reps = list(self._replicas.items())
             routed = self.requests_routed
             rerouted = self.requests_rerouted
+            hedged = self.requests_hedged
+            hedge_wins = self.hedge_wins
             failed = self.replicas_failed
+            suspected = self.replicas_suspected
+            revived = self.replicas_revived
         per_replica = {}
         hit = miss = completed = tokens = 0
         per_adapter: Dict[str, dict] = {}
@@ -502,7 +997,11 @@ class ReplicaRouter:
             "replicas": per_replica,
             "requests_routed": routed,
             "requests_rerouted": rerouted,
+            "requests_hedged": hedged,
+            "hedge_wins": hedge_wins,
             "replicas_failed": failed,
+            "replicas_suspected": suspected,
+            "replicas_revived": revived,
             "requests_completed": completed,
             "tokens_emitted": tokens,
             "prefix_hit_tokens": hit,

@@ -15,6 +15,22 @@ Expired requests are handed back to the server to fail with
 ``TimeoutError`` instead of burning prefill FLOPs on an answer nobody is
 waiting for.
 
+Overload shedding (``shed_on_overload=True``) is the deadline-AWARE half
+of admission control: the scheduler keeps an EWMA of its observed
+admission cadence (seconds between pops while work was waiting), so it
+can PREDICT each queued request's wait from its position. A request
+whose predicted wait already exceeds its remaining deadline is shed with
+:class:`Overloaded` — at submit when the queue is already too long
+(fast-fail: the client learns in microseconds, not after burning its
+whole deadline), or swept out of the queue body when service degrades
+after admission. The head of the queue is NEVER shed: it is about to be
+served, and shedding it would sacrifice the request most likely to make
+its SLO instead of the one least likely — the point is that ACCEPTED
+requests keep their p99 while the overflow fails fast and retryably.
+Requests without deadlines are never shed (there is no SLO to miss).
+Default off: a scheduler built without the flag behaves bit-identically
+to the pre-shedding one.
+
 The prefill/decode interleaving policy also lives here:
 ``max_prefills_per_step`` bounds how many admissions (each one compiled
 prefill dispatch) may run between consecutive decode iterations, so a
@@ -24,14 +40,15 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..distributed.resilience import Deadline
 
-__all__ = ["Backpressure", "QueueFull", "SchedulerClosed", "Request",
-           "FifoScheduler"]
+__all__ = ["Backpressure", "QueueFull", "Overloaded", "SchedulerClosed",
+           "Request", "FifoScheduler"]
 
 _req_serial = itertools.count()
 
@@ -44,6 +61,16 @@ class Backpressure(ConnectionError):
 
 class QueueFull(Backpressure):
     """The admission queue is at its depth cap."""
+
+
+class Overloaded(Backpressure):
+    """Deadline-aware shed: the predicted queue wait already exceeds the
+    request's remaining deadline, so it was failed FAST instead of being
+    left to time out. Retryable (``ConnectionError`` via
+    :class:`Backpressure`): another replica — or this one, a moment
+    later — may have the headroom. Distinct from :class:`QueueFull`
+    (depth cap) and from the ``TimeoutError`` of a deadline that
+    actually lapsed in queue."""
 
 
 class SchedulerClosed(RuntimeError):
@@ -88,22 +115,47 @@ class FifoScheduler:
     cap. All methods are safe to call from any thread; the serving worker
     is the only consumer."""
 
+    #: EWMA smoothing for the admission-cadence estimate (seconds per
+    #: admitted request); small enough to follow a degrading replica
+    #: within a handful of pops, large enough not to chase one slow tick
+    EWMA_ALPHA = 0.25
+
     def __init__(self, max_queue_depth: int = 64,
-                 max_prefills_per_step: int = 2):
+                 max_prefills_per_step: int = 2,
+                 shed_on_overload: bool = False):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
         if max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
         self.max_queue_depth = int(max_queue_depth)
         self.max_prefills_per_step = int(max_prefills_per_step)
+        self.shed_on_overload = bool(shed_on_overload)
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._closed = False
+        # admission cadence: seconds per admitted request, measured only
+        # across intervals where work was actually waiting (an idle gap
+        # says nothing about service speed). None until the first sample
+        # — no shedding decision is made on zero evidence.
+        self._svc_ewma: Optional[float] = None
+        self._last_admit_t: Optional[float] = None
 
     @property
     def depth(self) -> int:
         with self._lock:
             return len(self._q)
+
+    def predicted_wait(self, position: int) -> Optional[float]:
+        """Predicted queue wait (seconds) for a request at ``position``
+        (0 = next to pop), from the admission-cadence EWMA; ``None``
+        before any cadence evidence exists."""
+        with self._lock:
+            return self._predicted_wait_locked(position)
+
+    def _predicted_wait_locked(self, position: int) -> Optional[float]:
+        if self._svc_ewma is None:
+            return None
+        return max(0, int(position)) * self._svc_ewma
 
     def submit(self, request: Request) -> None:
         with self._lock:
@@ -113,6 +165,20 @@ class FifoScheduler:
                 raise QueueFull(
                     f"admission queue full ({self.max_queue_depth} "
                     f"requests waiting); retry with backoff")
+            if self.shed_on_overload and request.deadline is not None:
+                wait = self._predicted_wait_locked(len(self._q))
+                if wait is not None and wait > request.deadline.remaining():
+                    raise Overloaded(
+                        f"request shed at admission: predicted queue wait "
+                        f"{wait:.3f}s exceeds its remaining "
+                        f"{max(0.0, request.deadline.remaining()):.3f}s "
+                        f"deadline (queue depth {len(self._q)}); retry "
+                        f"against another replica")
+            if not self._q:
+                # queue was idle: the admission clock starts with this
+                # arrival — an idle gap must never be mistaken for
+                # service time in the cadence EWMA
+                self._last_admit_t = time.monotonic()
             self._q.append(request)
 
     def requeue(self, request: Request) -> None:
@@ -130,13 +196,25 @@ class FifoScheduler:
         admit: List[Request] = []
         expired: List[Request] = []
         budget = min(int(free_slots), self.max_prefills_per_step)
+        now = time.monotonic()
         with self._lock:
+            if not self._q:
+                # idle: reset the cadence clock so the NEXT admission
+                # interval measures service, not the lull before it
+                self._last_admit_t = now
             while self._q and len(admit) < budget:
                 req = self._q.popleft()
                 if req.deadline is not None and req.deadline.expired():
                     expired.append(req)
                     continue
                 admit.append(req)
+            if admit and self._last_admit_t is not None:
+                per = max(0.0, now - self._last_admit_t) / len(admit)
+                self._svc_ewma = (per if self._svc_ewma is None else
+                                  (1.0 - self.EWMA_ALPHA) * self._svc_ewma
+                                  + self.EWMA_ALPHA * per)
+            if admit:
+                self._last_admit_t = now
         return admit, expired
 
     def pop_expired(self) -> List[Request]:
@@ -153,6 +231,33 @@ class FifoScheduler:
                     keep.append(req)
             self._q = keep
         return expired
+
+    def pop_predicted_misses(self) -> List[Request]:
+        """Sweep out queued requests whose PREDICTED wait (position x
+        admission-cadence EWMA) exceeds their remaining deadline — the
+        post-admission half of overload shedding, for when service
+        degrades after a request was accepted. The queue head is never
+        shed (position 0 predicts zero wait: it is next), so this only
+        ever trims the doomed tail; the caller fails the returned
+        requests with :class:`Overloaded`. No-op unless
+        ``shed_on_overload`` and a cadence estimate exists."""
+        if not self.shed_on_overload:
+            return []
+        shed: List[Request] = []
+        with self._lock:
+            if self._svc_ewma is None or not self._q:
+                return []
+            keep: deque = deque()
+            for req in self._q:
+                pos = len(keep)   # position among the requests kept ahead
+                if (pos > 0 and req.deadline is not None
+                        and pos * self._svc_ewma
+                        > req.deadline.remaining()):
+                    shed.append(req)
+                else:
+                    keep.append(req)
+            self._q = keep
+        return shed
 
     def seal(self) -> None:
         """Refuse new submits but KEEP the queue — the graceful-shutdown

@@ -48,7 +48,8 @@ from ..observability import registry as _obs_registry
 from ..observability import tracing as _tracing
 from .engine import ContinuousBatchingEngine
 from .metrics import ServingMetrics
-from .scheduler import FifoScheduler, QueueFull, Request, SchedulerClosed
+from .scheduler import (FifoScheduler, Overloaded, QueueFull, Request,
+                        SchedulerClosed)
 
 __all__ = ["InferenceServer", "RequestHandle"]
 
@@ -172,7 +173,8 @@ class InferenceServer:
                  max_prefills_per_step: int = 2,
                  top_k: int = 0, allow_top_p: bool = True,
                  max_request_retries: int = 1,
-                 prefix_cache=None, adapter_store=None):
+                 prefix_cache=None, adapter_store=None,
+                 shed_on_overload: bool = False):
         self.engine = ContinuousBatchingEngine(
             network, slots=slots, max_length=max_length,
             prefill_buckets=prefill_buckets, top_k=top_k,
@@ -180,7 +182,8 @@ class InferenceServer:
             adapter_store=adapter_store)
         self.scheduler = FifoScheduler(
             max_queue_depth=max_queue_depth,
-            max_prefills_per_step=max_prefills_per_step)
+            max_prefills_per_step=max_prefills_per_step,
+            shed_on_overload=shed_on_overload)
         self.metrics = ServingMetrics(slots)
         self.max_request_retries = int(max_request_retries)
         self._cv = threading.Condition()
@@ -275,6 +278,14 @@ class InferenceServer:
         with RecordEvent("serve:admit"):
             try:
                 self.scheduler.submit(req)
+            except Overloaded:
+                # deadline-aware shed at the door: the fast-fail half of
+                # overload control (the request learns NOW, within
+                # microseconds of submit, not after its whole deadline)
+                self.metrics.inc("requests_shed")
+                _tracing.record_event("shed", corr=corr,
+                                      queue_depth=self.scheduler.depth)
+                raise
             except QueueFull:
                 self.metrics.inc("requests_rejected")
                 _tracing.record_event("rejected", corr=corr,
@@ -355,6 +366,28 @@ class InferenceServer:
             "trace": _tracing.stats(),
         }
 
+    def probe(self) -> dict:
+        """Cheap liveness/load probe — the payload the router's heartbeat
+        failure detector polls. Host-side attribute reads only (no
+        device sync, no histogram math), so a probe's latency measures
+        the REPLICA's responsiveness, not this method's cost. The
+        ``serve.probe`` fault site lets chaos drills fail or slow the
+        probe path in isolation."""
+        fault_point("serve.probe")
+        depth = self.scheduler.depth
+        return {
+            "time": round(time.time(), 3),
+            "pid": os.getpid(),
+            "active": self.engine.active_count,
+            "slots": self.engine.slots,
+            "queue_depth": depth,
+            "max_queue_depth": self.scheduler.max_queue_depth,
+            # what a request arriving NOW should expect to wait (None
+            # until the scheduler has cadence evidence) — the number an
+            # admission-control-aware client sizes its deadline against
+            "predicted_queue_wait": self.scheduler.predicted_wait(depth),
+        }
+
     def _obs_collect(self) -> dict:
         """Registry collector: the occupancy/queue/compile numbers an
         autoscaler polls, read from live state (no histogram math)."""
@@ -417,6 +450,8 @@ class InferenceServer:
     def _tick(self) -> None:
         for req in self.scheduler.pop_expired():
             self._expire(req)
+        for req in self.scheduler.pop_predicted_misses():
+            self._shed(req)
         free = self.engine.free_slots()
         if free:
             admits, expired = self.scheduler.take(len(free))
@@ -522,6 +557,18 @@ class InferenceServer:
         req.handle._fail(TimeoutError(
             f"request {req.id} expired in queue after "
             f"{req.deadline.total:.3f}s deadline"))
+
+    def _shed(self, req: Request) -> None:
+        """Post-admission shed: service degraded after this request was
+        queued and its predicted wait now exceeds its deadline — fail it
+        retryably NOW (Overloaded, a ``ConnectionError``) instead of
+        letting it ride the queue into a guaranteed ``TimeoutError``."""
+        self.metrics.inc("requests_shed")
+        _tracing.record_event("shed", corr=req.corr_id)
+        req.handle._fail(Overloaded(
+            f"request {req.id} shed from queue: predicted wait exceeds "
+            f"its {req.deadline.total:.3f}s deadline; retry against "
+            f"another replica"))
 
     def _recover(self, exc: BaseException, extra=()) -> None:
         """Crash-safe worker: reset the engine (donated buffers may be

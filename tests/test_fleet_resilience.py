@@ -1,0 +1,643 @@
+"""Cross-host serving resilience: rpc remote replicas, heartbeat failure
+detection, hedged retries, and overload-shedding admission.
+
+The acceptance contract on top of PR 8's in-process fleet:
+
+1. **Remote replicas speak the router's duck type** — a
+   ``RemoteReplica`` over real rpc sockets submits/streams/probes like a
+   local ``InferenceServer``, remote application errors (``QueueFull``)
+   cross the wire unwrapped so failover logic is placement-invariant,
+   and transport failures classify as retryable ``ReplicaUnreachable``;
+2. **The heartbeat detector quarantines before it condemns** — a probe
+   miss (or a probe far slower than the replica's latency EWMA) moves
+   ACTIVE -> SUSPECT (placement stops, in-flight continues), repeated
+   misses declare DEAD with a flight-recorder dump carrying the affected
+   correlation ids, and remote replicas abandon their live handles so
+   streams reroute immediately;
+3. **Hedged retries win without diverging** — a stalled stream fires one
+   hedge to a second replica reusing the router-assigned seed, and the
+   winner's tokens are identical; the slow replica is NOT marked dead;
+4. **Overload sheds fast, never at the head** — predicted-SLO-miss
+   requests fail with retryable ``Overloaded`` (counted as
+   ``requests_shed``, never as expired/failed), at submit when the
+   cadence EWMA already says so, from the queue body when service
+   degrades later.
+
+Tier-1 budget discipline: everything here runs on device-free stubs or a
+world-of-1 rpc loopback (module fixture); the only model-backed tests
+patch ``free_slots`` to [] so nothing ever compiles. The two-process
+soak (``tools/fleet_chaos.py``) is marked slow.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.resilience import (Deadline, FaultPlan,
+                                               RetryPolicy)
+from paddle_tpu.observability import flight as _flight
+from paddle_tpu.serving import (FifoScheduler, InferenceServer,
+                                Overloaded, QueueFull, RemoteReplica,
+                                ReplicaRouter, ReplicaUnreachable,
+                                Request, SchedulerClosed)
+from paddle_tpu.serving import remote as remote_mod
+from paddle_tpu.serving.server import RequestHandle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GEO = dict(max_length=64, prefill_buckets=(32,))
+
+
+# --------------------------------------------------------- stub plumbing
+class _FakeEngine:
+    pool = None
+    store = None
+
+    def __init__(self, slots=2):
+        self.active_count = 0
+        self.slots = slots
+
+
+class _FakeSched:
+    def __init__(self, depth=0, cap=8):
+        self.depth = depth
+        self.max_queue_depth = cap
+
+
+class _FakeServer:
+    """Duck-typed InferenceServer built on REAL RequestHandles: a worker
+    thread pushes ``tokens`` (optionally stalling forever after
+    ``stall_after`` of them, or pausing ``pause`` seconds mid-stream),
+    so router hedging/reroute logic sees genuine handle mechanics."""
+
+    def __init__(self, tokens=(1, 2, 3), delay=0.005, stall_after=None,
+                 pause=None, submit_error=None, fail_with=None,
+                 probe_exc=None, probe_sleep=0.0):
+        self.engine = _FakeEngine()
+        self.scheduler = _FakeSched()
+        self.tokens = list(tokens)
+        self.delay = delay
+        self.stall_after = stall_after
+        self.pause = pause
+        self.submit_error = submit_error
+        self.fail_with = fail_with
+        self.probe_exc = probe_exc
+        self.probe_sleep = probe_sleep
+        self.submitted = []
+
+    def start(self):
+        return self
+
+    def submit(self, **kw):
+        if self.submit_error is not None:
+            raise self.submit_error
+        self.submitted.append(kw)
+        req = Request(prompt=kw["prompt"],
+                      corr_id=kw.get("correlation_id"))
+        h = RequestHandle(req)
+        req.handle = h
+
+        def run():
+            if self.fail_with is not None:
+                time.sleep(self.delay)
+                h._fail(self.fail_with)
+                return
+            for i, t in enumerate(self.tokens):
+                if self.stall_after is not None and i >= self.stall_after:
+                    return               # stalls forever, never finishes
+                if self.pause is not None and i == 1:
+                    time.sleep(self.pause)
+                time.sleep(self.delay)
+                h._push(t)
+            h.ttft_s = self.delay
+            h._finish()
+
+        threading.Thread(target=run, daemon=True).start()
+        return h
+
+    def probe(self):
+        if self.probe_exc is not None:
+            raise self.probe_exc
+        if self.probe_sleep:
+            time.sleep(self.probe_sleep)
+        return {"active": self.engine.active_count,
+                "slots": self.engine.slots,
+                "queue_depth": self.scheduler.depth,
+                "max_queue_depth": self.scheduler.max_queue_depth}
+
+    def snapshot(self):
+        return {"requests_completed": len(self.submitted)}
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+
+def _warm_hedge(router, n=None):
+    for _ in range(n or router.hedge_warmup_tokens):
+        router._note_inter_token(0.01)
+
+
+def _hedge_router(**kw):
+    kw.setdefault("hedge_multiplier", 2.0)
+    kw.setdefault("hedge_min_s", 0.05)
+    kw.setdefault("hedge_warmup_tokens", 4)
+    kw.setdefault("hedge_poll_interval", 0.01)
+    return ReplicaRouter(**kw)
+
+
+def _mkreq(deadline=None):
+    req = Request(prompt=np.arange(2),
+                  deadline=Deadline(deadline) if deadline is not None
+                  else None)
+    req.handle = RequestHandle(req)
+    return req
+
+
+# ------------------------------------------------------ scheduler sheds
+def test_scheduler_sheds_predicted_miss_at_submit():
+    s = FifoScheduler(shed_on_overload=True)
+    assert s.predicted_wait(5) is None       # zero evidence: no shedding
+    with s._lock:
+        s._svc_ewma = 1.0                    # 1s per admission
+    s.submit(_mkreq())                       # position 0
+    s.submit(_mkreq(deadline=10.0))          # predicted 1.0s < 10s: in
+    with pytest.raises(Overloaded):
+        s.submit(_mkreq(deadline=0.5))       # predicted 2.0s > 0.5s: shed
+    assert s.depth == 2                      # the shed never queued
+    # no-deadline requests are never shed (no SLO to miss)
+    s.submit(_mkreq())
+    assert s.depth == 3
+
+
+def test_scheduler_shed_default_off_is_inert():
+    s = FifoScheduler()                      # shed_on_overload=False
+    with s._lock:
+        s._svc_ewma = 100.0
+    s.submit(_mkreq())
+    s.submit(_mkreq(deadline=0.01))          # hopeless, but NOT shed
+    assert s.depth == 2
+    assert s.pop_predicted_misses() == []
+
+
+def test_scheduler_queue_shed_spares_head():
+    s = FifoScheduler(shed_on_overload=True)
+    head = _mkreq(deadline=0.2)
+    mid = _mkreq(deadline=0.3)
+    tail = _mkreq()                          # no deadline: untouchable
+    for r in (head, mid, tail):
+        s.submit(r)
+    with s._lock:
+        s._svc_ewma = 1.0                    # service collapsed
+    shed = s.pop_predicted_misses()
+    assert shed == [mid]                     # position 1: predicted 1.0s
+    assert s.depth == 2                      # head survives at position 0
+    admit, _ = s.take(4)
+    assert admit[0] is head
+
+
+def test_scheduler_cadence_ewma_ignores_idle_gaps():
+    s = FifoScheduler(shed_on_overload=True)
+    s.submit(_mkreq())
+    s.take(1)                                # first admit: clock starts
+    s.submit(_mkreq())
+    time.sleep(0.05)
+    s.take(1)                                # genuine ~50ms sample
+    w = s.predicted_wait(2)
+    assert w is not None and 0.02 <= w <= 1.0
+    s.take(1)                                # empty queue: clock reset
+    time.sleep(0.25)                         # idle gap
+    s.submit(_mkreq())                       # arrival restarts the clock
+    s.take(1)
+    assert s.predicted_wait(1) < 0.15        # the 0.25s lull never counted
+
+
+# ------------------------------------------------- server-side accounting
+@pytest.fixture(scope="module")
+def lm():
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    pt.seed(7)
+    cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+def test_server_shed_accounting_separate_and_retryable(lm):
+    """requests_shed counts separately from expired/failed; both shed
+    flavors (door + queue sweep) surface as the retryable Overloaded.
+    Device-free: free_slots is pinned empty so nothing ever admits."""
+    model, _ = lm
+    srv = InferenceServer(model, slots=1, shed_on_overload=True, **GEO)
+    srv.engine.free_slots = lambda: []
+    with srv.scheduler._lock:
+        srv.scheduler._svc_ewma = 5.0
+    h1 = srv.submit(np.arange(4), max_new_tokens=2)   # deadline-free
+    with pytest.raises(Overloaded):                   # door shed (pos 1)
+        srv.submit(np.arange(4), max_new_tokens=2, deadline=1.0)
+    assert srv.metrics.requests_shed == 1
+    h2 = srv.submit(np.arange(4), max_new_tokens=2, deadline=60.0)
+    with srv.scheduler._lock:                         # service collapses
+        srv.scheduler._svc_ewma = 1000.0
+    with pytest.raises(Overloaded) as ei:             # queue-sweep shed
+        h2.result(timeout=30)
+    assert isinstance(ei.value, ConnectionError)      # retryable class
+    assert srv.metrics.requests_shed == 2
+    assert srv.metrics.requests_expired == 0
+    assert srv.metrics.requests_failed == 0
+    assert not h1.done                                # head never shed
+    snap = srv.snapshot()
+    assert snap["requests_shed"] == 2
+    # ...and a RetryPolicy really does classify a shed as retryable
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise Overloaded("shed")
+        return "ok"
+
+    assert RetryPolicy(max_attempts=3, base_delay=0.01).call(flaky) == "ok"
+    srv.shutdown(drain=False, timeout=30)
+
+
+def test_server_probe_shape_and_fault_site(lm):
+    model, _ = lm
+    srv = InferenceServer(model, slots=2, **GEO)
+    p = srv.probe()
+    assert p["slots"] == 2 and p["queue_depth"] == 0
+    assert p["max_queue_depth"] == srv.scheduler.max_queue_depth
+    with FaultPlan([{"site": "serve.probe", "kind": "drop"}], seed=0):
+        with pytest.raises(ConnectionError):
+            srv.probe()
+    srv.shutdown(drain=False, timeout=30)
+
+
+# -------------------------------------------------------- router detector
+def test_detector_miss_suspects_then_kills_and_dumps():
+    bad = _FakeServer(stall_after=0)         # its handle never finishes
+    ok = _FakeServer(tokens=(1, 2, 3))
+    router = ReplicaRouter(suspect_misses=1, dead_misses=3)
+    router.add_replica(bad, "bad")
+    router.add_replica(ok, "ok")
+    h = router.submit(np.arange(4), max_new_tokens=3, prefer="bad")
+    corr = h.correlation_id
+    dumps_before = _flight.flight_recorder().stats()["dumps_written"]
+    bad.probe_exc = ConnectionError("probe refused")
+    router.check_health()
+    assert router.replicas()["bad"] == "suspect"      # quarantined
+    router.check_health()
+    assert router.replicas()["bad"] == "suspect"      # not yet condemned
+    router.check_health()                             # 3rd miss: dead
+    assert router.replicas()["bad"] == "dead"
+    snap = router.snapshot()
+    assert snap["replicas_suspected"] == 1
+    assert snap["replicas_failed"] == 1
+    rec = _flight.flight_recorder()
+    assert rec.stats()["dumps_written"] == dumps_before + 1
+    path = rec.last_dump_path
+    assert path is not None and "replica_dead" in path
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["extra"]["replica"] == "bad"
+    assert corr in dump["extra"]["inflight"]          # affected corr rides
+    # the in-flight request is NOT lost: reroute still drives it home
+    # (local stubs have no abandon(); the handle's own wait does it)
+    h._current()._fail(SchedulerClosed("server gone"))
+    assert list(h.result(timeout=10)) == [1, 2, 3]
+    assert h.replica == "ok"
+
+
+def test_detector_latency_ewma_suspects_gray_then_revives():
+    gray = _FakeServer()
+    router = ReplicaRouter(suspect_latency_factor=3.0,
+                           min_suspect_latency=0.01)
+    router.add_replica(gray, "gray")
+    for _ in range(5):                       # healthy baseline EWMA
+        router.check_health()
+    assert router.replicas()["gray"] == "active"
+    gray.probe_sleep = 0.08                  # alive but 10x slower
+    router.check_health()
+    assert router.replicas()["gray"] == "suspect"
+    gray.probe_sleep = 0.0
+    for _ in range(3):
+        router.check_health()                # healthy probes revive it
+    assert router.replicas()["gray"] == "active"
+    snap = router.snapshot()
+    assert snap["replicas_suspected"] >= 1
+    assert snap["replicas_revived"] >= 1
+    assert snap["replicas_failed"] == 0
+
+
+def test_suspect_excluded_from_placement_until_no_active_left():
+    a = _FakeServer(tokens=(1,))
+    b = _FakeServer(tokens=(2,))
+    router = ReplicaRouter()
+    router.add_replica(a, "a")
+    router.add_replica(b, "b")
+    a.probe_exc = ConnectionError("gray")
+    router.check_health()                    # a -> suspect
+    assert router.replicas()["a"] == "suspect"
+    for _ in range(3):                       # placement avoids the suspect
+        h = router.submit(np.arange(4), max_new_tokens=1)
+        assert h.replica == "b"
+    b.probe_exc = ConnectionError("gray too")
+    a.probe_exc = None
+    router.check_health()                    # b -> suspect, a revives
+    assert router.replicas() == {"a": "active", "b": "suspect"}
+    b.probe_exc = None
+    a.probe_exc = ConnectionError("down again")
+    router.check_health()                    # a suspect again, b revives
+    # all-suspect fallback: degraded beats NoReplicasAvailable
+    b.probe_exc = ConnectionError("down")
+    router.check_health()
+    assert set(router.replicas().values()) == {"suspect"}
+    h = router.submit(np.arange(4), max_new_tokens=1)
+    assert h.replica in ("a", "b")
+    # registry collector carries the membership gauges + counters
+    from paddle_tpu.observability import default_registry
+
+    snap = default_registry().snapshot()
+    assert any(k.startswith("router.replicas_suspected")
+               for k in snap["counters"])
+
+
+# ------------------------------------------------------------- hedging
+def test_hedge_fires_on_stall_and_winner_is_adopted():
+    slow = _FakeServer(tokens=(7, 8, 9), stall_after=1)
+    fast = _FakeServer(tokens=(7, 8, 9))
+    router = _hedge_router()
+    router.add_replica(slow, "slow")
+    router.add_replica(fast, "fast")
+    _warm_hedge(router)
+    dumps_before = _flight.flight_recorder().stats()["dumps_written"]
+    h = router.submit(np.arange(4), max_new_tokens=3, prefer="slow")
+    out = h.result(timeout=30)
+    assert list(out) == [7, 8, 9]            # token-identical winner
+    assert h.replica == "fast"
+    assert router.requests_hedged == 1 and router.hedge_wins == 1
+    assert router.replicas()["slow"] == "active"    # gray, NOT dead
+    rec = _flight.flight_recorder()
+    assert rec.stats()["dumps_written"] == dumps_before + 1
+    assert "hedge_fire" in rec.last_dump_path
+    with open(rec.last_dump_path) as f:
+        assert h.correlation_id in f.read()
+
+
+def test_hedge_stream_switches_and_reemits():
+    slow = _FakeServer(tokens=(4, 5, 6), stall_after=1)
+    router = _hedge_router()
+    router.add_replica(slow, "slow")
+    router.add_replica(_FakeServer(tokens=(4, 5, 6)), "fast")
+    _warm_hedge(router)
+    h = router.submit(np.arange(4), max_new_tokens=3, prefer="slow")
+    got = list(h.stream())
+    # at-least-once: the switch re-emits from the hedge's first token,
+    # and the re-emitted stream is the identical token sequence
+    assert got[-3:] == [4, 5, 6]
+    assert router.hedge_wins == 1
+
+
+def test_hedge_without_second_replica_degrades_gracefully():
+    only = _FakeServer(tokens=(1, 2, 3), pause=0.3)   # mid-stream stall
+    router = _hedge_router()
+    router.add_replica(only, "only")
+    _warm_hedge(router)
+    h = router.submit(np.arange(4), max_new_tokens=3)
+    assert list(h.result(timeout=30)) == [1, 2, 3]    # still completes
+    assert router.requests_hedged == 0                # no one to hedge to
+    assert router.replicas()["only"] == "active"
+
+
+def test_hedge_disabled_by_default_and_below_warmup():
+    slow = _FakeServer(tokens=(1,), pause=None, delay=0.05)
+    router = ReplicaRouter()                          # hedging off
+    router.add_replica(slow, "a")
+    assert router._hedge_threshold() is None
+    router2 = _hedge_router()                         # on, but cold EWMA
+    router2.add_replica(_FakeServer(), "a")
+    assert router2._hedge_threshold() is None         # warmup gate
+
+
+def test_overloaded_from_handle_is_not_a_death():
+    shedding = _FakeServer(fail_with=Overloaded("shed from queue"))
+    router = ReplicaRouter()
+    router.add_replica(shedding, "only")
+    h = router.submit(np.arange(4), max_new_tokens=2)
+    with pytest.raises(Overloaded):
+        h.result(timeout=10)
+    assert h.reroutes == 0                   # backpressure != death
+    assert router.replicas()["only"] == "active"
+
+
+def test_router_fails_over_on_submit_overload():
+    shedding = _FakeServer(submit_error=Overloaded("at capacity"))
+    healthy = _FakeServer(tokens=(9,))
+    router = ReplicaRouter()
+    router.add_replica(shedding, "shedding")
+    router.add_replica(healthy, "healthy")
+    h = router.submit(np.arange(4), max_new_tokens=1, prefer="shedding")
+    assert h.replica == "healthy"            # failover, not failure
+    assert router.replicas()["shedding"] == "active"
+    healthy.submit_error = Overloaded("also full")
+    with pytest.raises(QueueFull):           # fleet-wide: retryable
+        router.submit(np.arange(4), max_new_tokens=1)
+
+
+# ------------------------------------------------- remote replicas (rpc)
+@pytest.fixture(scope="module")
+def rpc_world():
+    from paddle_tpu.distributed import rpc
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{s.getsockname()[1]}"
+    rpc.init_rpc(name="solo", rank=0, world_size=1, master_endpoint=ep)
+    yield rpc
+    rpc.shutdown(timeout=10.0)
+
+
+def _remote(hosted, **kw):
+    kw.setdefault("rpc_timeout", 5.0)
+    kw.setdefault("connect_deadline", 0.4)
+    kw.setdefault("poll_interval", 0.01)
+    return RemoteReplica("solo", hosted_name=hosted, **kw)
+
+
+def test_rpc_transport_error_names_peer(rpc_world):
+    from paddle_tpu.distributed.rpc import RpcTransportError
+
+    plan = FaultPlan([{"site": "rpc.connect.solo", "kind": "partition",
+                       "times": None}], seed=0)
+    with plan:
+        with pytest.raises(RpcTransportError) as ei:
+            rpc_world.rpc_sync("solo", int, args=(1,),
+                               connect_deadline=0.2)
+    assert ei.value.peer == "solo"
+    assert isinstance(ei.value, ConnectionError)      # retryable class
+    assert rpc_world.rpc_sync("solo", int, args=(1,)) == 1  # healed
+
+
+def test_remote_replica_round_trip_and_probe_view(rpc_world):
+    srv = _FakeServer(tokens=(11, 12, 13))
+    srv.engine.active_count = 1
+    srv.scheduler.depth = 3
+    remote_mod.host_server(srv, "rt")
+    rep = _remote("rt")
+    router = ReplicaRouter()
+    router.add_replica(rep, "remote")
+    h = router.submit(np.arange(4), max_new_tokens=3)
+    assert list(h.result(timeout=30)) == [11, 12, 13]
+    assert h.correlation_id is not None
+    # the probe refreshed the load view the placement scorer reads
+    assert rep.engine.active_count == 1 and rep.scheduler.depth == 3
+    assert rep.snapshot()["requests_completed"] == 1
+    # remote submit kwargs crossed the wire intact (incl. corr id)
+    assert srv.submitted[0]["correlation_id"] == h.correlation_id
+
+
+def test_remote_queuefull_crosses_wire_and_fails_over(rpc_world):
+    remote_mod.host_server(_FakeServer(submit_error=QueueFull("depth")),
+                           "full")
+    local = _FakeServer(tokens=(5,))
+    router = ReplicaRouter()
+    router.add_replica(_remote("full"), "remote")
+    router.add_replica(local, "local")
+    h = router.submit(np.arange(4), max_new_tokens=1, prefer="remote")
+    assert h.replica == "local"              # backpressure failed over
+    assert router.replicas()["remote"] == "active"
+
+
+def test_remote_partition_death_abandons_and_reroutes(rpc_world):
+    """THE remote acceptance: a partitioned peer's in-flight stream is
+    abandoned by the detector-declared death and completes on a local
+    survivor; the flight dump carries its correlation id."""
+    remote_mod.host_server(_FakeServer(tokens=(1, 2, 3), delay=0.3),
+                           "part")
+    rep = _remote("part", rpc_timeout=1.5)
+    router = ReplicaRouter(suspect_misses=1, dead_misses=2)
+    router.add_replica(rep, "remote")
+    router.add_replica(_FakeServer(tokens=(1, 2, 3)), "local")
+    h = router.submit(np.arange(4), max_new_tokens=3, prefer="remote")
+    plan = FaultPlan([{"site": "rpc.connect.solo", "kind": "partition",
+                       "times": None}], seed=0)
+    with plan:
+        router.check_health()
+        assert router.replicas()["remote"] == "suspect"
+        router.check_health()                # second miss: dead + abandon
+        assert router.replicas()["remote"] == "dead"
+        out = h.result(timeout=30)           # rerouted by the abandon
+    assert list(out) == [1, 2, 3]
+    assert h.replica == "local" and h.reroutes >= 1
+    path = _flight.flight_recorder().last_dump_path
+    assert path is not None and "replica_dead" in path
+    with open(path) as f:
+        assert h.correlation_id in json.load(f)["extra"]["inflight"]
+
+
+def test_remote_submit_to_unreachable_marks_dead_not_fatal(rpc_world):
+    remote_mod.host_server(_FakeServer(tokens=(6,)), "alive")
+    router = ReplicaRouter()
+    router.add_replica(_remote("alive"), "good")
+    dead = RemoteReplica("solo", hosted_name="alive", rpc_timeout=1.0,
+                         connect_deadline=0.2, poll_interval=0.01)
+    router.add_replica(dead, "bad")
+    plan = FaultPlan([{"site": "rpc.connect.solo", "kind": "partition",
+                       "times": None}], seed=0)
+    # the partition cuts BOTH replicas' transport (same peer), so drive
+    # placement onto the unreachable one while the plan is scoped to it
+    with plan:
+        with pytest.raises(ReplicaUnreachable):
+            dead.submit(prompt=np.arange(4), max_new_tokens=1)
+    h = router.submit(np.arange(4), max_new_tokens=1)
+    assert list(h.result(timeout=30)) == [6]
+
+
+# ------------------------------------------------ trace_view remote merge
+def test_trace_view_merges_remote_reroute_into_one_lane(tmp_path):
+    """A rerouted remote request's telemetry is scattered across the
+    router process and two replica processes; trace_view must merge all
+    of it into ONE lane keyed by the correlation id."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_view
+
+    corr = "req-abc123-000042"
+    t0 = 1000.0
+    router_dump = {
+        "format": "flight_recorder", "version": 1, "pid": 111,
+        "reason": "replica_dead",
+        "events": [{"t": t0 + 0.30, "kind": "replica_dead",
+                    "corr": corr, "replica": "r2"}],
+        "spans": [{"name": "router:submit", "corr": corr,
+                   "t0": t0, "t1": t0 + 0.01, "tags": {"replica": "r2"}}],
+    }
+    replica_a = {
+        "format": "flight_recorder", "version": 1, "pid": 222,
+        "reason": "snapshot",
+        "events": [],
+        "spans": [{"name": "queue_wait", "corr": corr, "t0": t0 + 0.01,
+                   "t1": t0 + 0.05, "tags": {}},
+                  {"name": "decode", "corr": corr, "t0": t0 + 0.05,
+                   "t1": t0 + 0.20, "tags": {"slot": 0}}],
+    }
+    replica_b = {
+        "format": "flight_recorder", "version": 1, "pid": 333,
+        "reason": "snapshot",
+        "events": [],
+        "spans": [{"name": "queue_wait", "corr": corr, "t0": t0 + 0.31,
+                   "t1": t0 + 0.33, "tags": {}},
+                  {"name": "decode", "corr": corr, "t0": t0 + 0.33,
+                   "t1": t0 + 0.50, "tags": {"slot": 1}}],
+    }
+    paths = []
+    for i, dump in enumerate((router_dump, replica_a, replica_b)):
+        p = tmp_path / f"dump{i}.json"
+        p.write_text(json.dumps(dump))
+        paths.append(str(p))
+    spans = []
+    for p in paths:
+        got, kind = trace_view.load_spans(p)
+        assert kind == "flight"
+        spans.extend(got)
+    merged = trace_view.merge_chrome(spans, corr=corr)
+    data_events = [e for e in merged["traceEvents"]
+                   if e["ph"] in ("X", "i")]
+    assert len(data_events) == 6             # 5 spans + the death event
+    lanes = {e["tid"] for e in data_events}
+    assert lanes == {1}                      # ONE lane across 3 processes
+    sources = {e["args"].get("source") for e in data_events}
+    assert len(sources) == 3                 # ...fed by all three dumps
+    listing = trace_view.list_correlations(spans)
+    assert len(listing) == 1 and listing[0]["corr"] == corr
+    assert len(listing[0]["sources"]) == 3
+
+
+# ------------------------------------------------------------------- slow
+@pytest.mark.slow
+def test_fleet_chaos_cli():
+    """The robustness_gate --fleet-chaos command end-to-end: three rpc
+    replica processes under SIGKILL + partition + slow + overload; exit
+    0 means zero lost, zero divergence, sheds fast-failed, detector
+    reroutes happened, and survivors held their compile budget."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PT_FAULT_PLAN", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_chaos.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, (proc.stdout[-3000:]
+                                  + proc.stderr[-2000:])
+    rec = json.loads(
+        [l for l in proc.stdout.splitlines()
+         if l.startswith('{"fleet_chaos"')][-1])["fleet_chaos"]
+    assert rec["failures"] == []
+    assert rec["sheds"] > 0
+    assert rec["requests_hedged"] >= 1
+    assert rec["replicas_failed"] >= 2       # partition + SIGKILL

@@ -171,6 +171,63 @@ def test_fault_plan_partition_window():
     assert outcomes == ["ok", "ok", "cut", "cut", "cut", "ok", "ok", "ok"]
 
 
+def test_fault_plan_slow_kind_seeded_latency(monkeypatch):
+    """``slow`` draws its sleep from the rule's seeded RNG in
+    [0.5, 1.5) * delay: durations VARY call to call (gray failure, not
+    a fixed stall) but replay identically for the same seed. Sleeps are
+    RECORDED (time.sleep patched), not wall-clock timed — scheduler
+    noise stays out of the assertions."""
+    import threading
+
+    from paddle_tpu.distributed import resilience as rz
+
+    main = threading.main_thread()
+
+    def run(seed):
+        recorded = []
+
+        def fake_sleep(s):
+            # only this test's calls: a stray daemon thread sleeping
+            # through the patch window must not pollute the schedule
+            if threading.current_thread() is main:
+                recorded.append(round(float(s), 9))
+
+        monkeypatch.setattr(rz.time, "sleep", fake_sleep)
+        plan = FaultPlan([{"site": "net.x", "kind": "slow",
+                           "times": None, "delay": 0.04}], seed=seed)
+        with plan:
+            for _ in range(6):
+                fault_point("net.x")       # never raises, only drags
+        monkeypatch.undo()
+        assert plan.fired[0] == 6
+        return recorded
+
+    a, b, c = run(7), run(7), run(8)
+    assert len(a) == 6
+    for d in a:
+        assert 0.02 <= d < 0.06            # [0.5, 1.5) * delay
+    assert max(a) - min(a) > 0.001         # actually varies per call
+    assert a == b                          # same seed -> same schedule
+    assert a != c                          # different seed -> different
+
+
+def test_fault_plan_slow_kind_counts_and_site_matching():
+    plan = FaultPlan([{"site": "kv.*", "kind": "slow", "times": 2,
+                       "delay": 0.03}], seed=1)
+    with plan:
+        t0 = time.monotonic()
+        fault_point("kv.get")
+        fault_point("kv.put")
+        slowed = time.monotonic() - t0
+        t1 = time.monotonic()
+        fault_point("kv.get")              # budget spent: full speed
+        fault_point("rpc.connect.w0")      # non-matching site
+        fast = time.monotonic() - t1
+    assert plan.fired[0] == 2
+    assert slowed >= 0.03                  # two sleeps of >= 0.015 each
+    assert fast < 0.01
+
+
 def test_fault_plan_env_roundtrip_and_subprocess_inheritance(tmp_path):
     """A plan active in the parent is inherited by subprocesses through
     PT_FAULT_PLAN with identical deterministic behavior."""
@@ -374,6 +431,35 @@ def test_elastic_heartbeat_health_and_recovery():
             server.stop()
         except Exception:
             pass
+
+
+def test_elastic_heartbeat_partition_flips_health_then_heals():
+    """Satellite: a PARTITION window (contiguous outage, the network
+    failure mode a drop count can't model) must flip ``is_healthy()``
+    false with ``last_error`` surfaced, and the manager must heal on
+    its own the moment the window closes — no restart, no re-register."""
+    with KVServer(0, host="127.0.0.1") as server:
+        mgr = ElasticManager(f"127.0.0.1:{server.port}", "hjob3", "node-z",
+                             ttl=1.0)
+        # the window opens AFTER registration (after=1 skips the
+        # register put... register doesn't hit the heartbeat site) and
+        # outlasts several ticks' retry budgets (2 attempts per tick)
+        plan = FaultPlan([{"site": "elastic.heartbeat",
+                           "kind": "partition", "times": 8}], seed=4)
+        with plan:
+            mgr.register()
+            assert mgr.is_healthy()          # a beat landed at register
+            _poll_until(lambda: not mgr.is_healthy(), timeout=15.0,
+                        what="unhealthy inside the partition window")
+            assert mgr.last_error is not None
+            assert isinstance(mgr.last_error, ConnectionError)
+            assert mgr._thread.is_alive()    # surfaced, never fatal
+            # window closes after 8 matching calls: health returns
+            _poll_until(mgr.is_healthy, timeout=15.0,
+                        what="healthy after the partition heals")
+            assert mgr.last_error is None
+            assert plan.fired[0] == 8
+        mgr.leave()
 
 
 def test_elastic_heartbeat_survives_injected_faults():

@@ -11,8 +11,10 @@ Scenarios (each runs in a fresh subprocess so ``crash`` faults can kill it):
 
 Expected outcomes by kind:
 
-- ``drop``/``delay`` — the scenario retries/absorbs the fault and exits 0
-  (for ``ckpt``, a failed save is fine as long as restore stays valid);
+- ``drop``/``delay``/``slow`` — the scenario retries/absorbs the fault
+  and exits 0 (``slow`` is the gray-failure kind: seeded-random latency
+  at the site; for ``ckpt``, a failed save is fine as long as restore
+  stays valid);
 - ``crash`` — the process dies with ``CRASH_EXIT``, and a clean re-run
   against the same state recovers (resume-after-crash).
 
@@ -95,7 +97,7 @@ MATRIX = [
     ("ckpt", "ckpt.shard_write"),
     ("ckpt", "ckpt.publish"),
 ]
-KINDS = ("drop", "delay", "crash")
+KINDS = ("drop", "delay", "slow", "crash")
 
 
 def _make_plan(site: str, kind: str) -> FaultPlan:

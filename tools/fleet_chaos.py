@@ -1,0 +1,445 @@
+#!/usr/bin/env python
+"""Cross-host fleet chaos soak: rpc remote replicas under SIGKILL,
+network partition, gray slowness, and 2x overload.
+
+Topology: this process (rank 0, "router") drives a ``ReplicaRouter``
+whose replicas are :class:`~paddle_tpu.serving.remote.RemoteReplica`
+adapters over three CHILD PROCESSES (ranks 1..3, "r1".."r3"), each
+hosting a real ``InferenceServer`` on the same seeded gpt_tiny weights.
+The phases, in order:
+
+1. **warmup** — one seeded request per replica, token-verified against a
+   parent-side solo ``generate()`` (also compiles every host's programs
+   and warms the router's inter-token EWMA);
+2. **overload** — a burst at ~2x fleet capacity with per-request
+   deadlines: the deadline-aware scheduler must SHED the overflow fast
+   (every shed < 10%% of its deadline, raised as the retryable
+   ``Overloaded``) while every accepted request completes — no
+   expirations, no timeouts;
+3. **slow replica** — a seeded ``slow`` FaultPlan is rpc-installed into
+   r3's ``serve.step``: a request pinned there stalls mid-stream, the
+   router's hedge fires to a healthy replica, and the hedge winner's
+   tokens are identical to solo (router-assigned-seed replay);
+4. **partition** — the parent installs a local partition plan on its
+   ``rpc.connect.r2`` site mid-stream: the in-flight request reroutes to
+   a survivor with identical tokens, and the heartbeat detector walks r2
+   through SUSPECT to DEAD (flight-recorder dump carrying the affected
+   correlation ids);
+5. **SIGKILL** — r1 is hard-killed mid-stream: same contract, zero lost.
+
+Exit 0 iff every phase held: zero lost requests, zero token divergence,
+sheds fast-failed, detector-driven reroutes happened, and the surviving
+hosts finish at their #prefill_buckets+1 compile budget. Wired into CI
+as ``robustness_gate.py --fleet-chaos`` (which runs ``--quick``).
+
+    python tools/fleet_chaos.py --quick
+    python tools/fleet_chaos.py            # longer overload burst
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SLOTS = 2
+GEO = dict(max_length=64, prefill_buckets=(32,))
+N_REPLICAS = 3
+SEED = 7
+
+
+def log(msg: str) -> None:
+    print(f"[fleet_chaos] {msg}", flush=True)
+
+
+def build_model():
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    pt.seed(SEED)
+    cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+# ---------------------------------------------------------------- child
+def child_main(rank: int, endpoint: str) -> int:
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.serving import InferenceServer, remote
+
+    name = f"r{rank}"
+    rpc.init_rpc(name=name, rank=rank, world_size=N_REPLICAS + 1,
+                 master_endpoint=endpoint)
+    model, _ = build_model()
+    server = InferenceServer(model, slots=SLOTS, max_queue_depth=16,
+                             shed_on_overload=True, **GEO)
+    remote.host_server(server, name="default")
+    log(f"child {name} (pid {os.getpid()}) hosting")
+    remote.wait_for_stop(timeout=600.0)
+    cc = server.engine.cache_stats()
+    n_buckets = len(server.engine.prefill_buckets)
+    budget_ok = (cc["prefill"]["compiles"] == n_buckets
+                 and cc["decode"]["compiles"] == 1)
+    log(f"child {name} compile budget: prefill "
+        f"{cc['prefill']['compiles']}/{n_buckets}, decode "
+        f"{cc['decode']['compiles']}/1 -> {'OK' if budget_ok else 'OVER'}")
+    try:
+        server.shutdown(drain=False, timeout=20)
+    except Exception as e:
+        log(f"child {name} shutdown: {e}")
+    rpc.shutdown(timeout=6.0)
+    return 0 if budget_ok else 3
+
+
+# --------------------------------------------------------------- parent
+class Check:
+    def __init__(self):
+        self.failures = []
+
+    def expect(self, ok: bool, what: str) -> bool:
+        log(f"{'PASS' if ok else 'FAIL'}: {what}")
+        if not ok:
+            self.failures.append(what)
+        return ok
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait(cond, timeout: float, what: str) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    log(f"timeout waiting for {what}")
+    return False
+
+
+def parent_main(args) -> int:
+    import numpy as np
+
+    flight_dir = tempfile.mkdtemp(prefix="fleet_chaos_flight_")
+    os.environ["PT_FLIGHT_DIR"] = flight_dir
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.resilience import FaultPlan
+    from paddle_tpu.serving import (Overloaded, RemoteReplica,
+                                    ReplicaRouter)
+    from paddle_tpu.serving import remote as remote_mod
+
+    endpoint = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PT_FAULT_PLAN", None)
+    procs = {}
+    check = Check()
+    t_start = time.monotonic()
+    try:
+        for rank in range(1, N_REPLICAS + 1):
+            procs[f"r{rank}"] = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child",
+                 "--rank", str(rank), "--endpoint", endpoint],
+                env=env)
+        rpc.init_rpc(name="router", rank=0, world_size=N_REPLICAS + 1,
+                     master_endpoint=endpoint)
+        log(f"rpc world up in {time.monotonic() - t_start:.0f}s")
+        model, cfg = build_model()
+        rng = np.random.default_rng(1234)
+
+        def prompt(n):
+            return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+        def solo(p, n, seed=None):
+            return model.generate(
+                p[None], max_new_tokens=n,
+                do_sample=seed is not None,
+                temperature=0.8 if seed is not None else 1.0,
+                seed=seed, **GEO)[0]
+
+        replicas = {f"r{r}": RemoteReplica(
+            f"r{r}", rpc_timeout=8.0, connect_deadline=0.75,
+            poll_interval=0.01) for r in range(1, N_REPLICAS + 1)}
+        # children host their servers only after a multi-second model
+        # build: wait for readiness BEFORE the router's detector starts
+        # counting their boot window as probe misses
+        for name, rep in replicas.items():
+            if not rep.wait_ready(timeout=300.0):
+                raise RuntimeError(f"{name} never hosted its server")
+        log(f"replicas ready at {time.monotonic() - t_start:.0f}s")
+        router = ReplicaRouter(
+            health_check_interval=0.25, suspect_misses=1, dead_misses=3,
+            hedge_multiplier=4.0, hedge_min_s=0.4,
+            hedge_warmup_tokens=8, max_reroutes=3)
+        for name, rep in replicas.items():
+            router.add_replica(rep, name)
+
+        # ---- phase 1: warmup + token parity per replica --------------
+        warm_tokens = 10
+        for name in sorted(replicas):
+            p = prompt(12)
+            want = solo(p, warm_tokens, seed=100)
+            h = router.submit(p, max_new_tokens=warm_tokens,
+                              do_sample=True, temperature=0.8, seed=100,
+                              prefer=name)
+            got = h.result(timeout=300)
+            check.expect(np.array_equal(got, want),
+                         f"warmup tokens identical on {name}")
+        log(f"warmup done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 2: 2x overload -> shed fast, accepted keep SLO ----
+        # gpt_tiny decodes so fast on this box that honest queues never
+        # form; slow EVERY host's serve loop with the seeded `slow`
+        # fault so the fleet has a realistic service rate to overload
+        # (and the phase is box-speed independent)
+        load_plan = FaultPlan([{"site": "serve.step", "kind": "slow",
+                                "times": None, "delay": 0.08}], seed=5)
+        for name in sorted(replicas):
+            rpc.rpc_sync(name, remote_mod._host_install_plan,
+                         args=(load_plan.to_json(),))
+        # saturate first (no deadlines) so every host's admission-
+        # cadence EWMA is warm — and measured UNDER the load the burst
+        # will see — before the deadline'd burst arrives
+        pre = [router.submit(prompt(8), max_new_tokens=24)
+               for _ in range(6 * N_REPLICAS * SLOTS)]
+        time.sleep(2.5)   # let cadence samples accumulate under load
+        burst_n = 24 if args.quick else 48
+        # two SLO classes sized off the fleet's own admission-control
+        # telemetry (probe() exposes predicted_queue_wait): a GENEROUS
+        # wave whose deadline clears the deepest queue — accepted
+        # requests must keep their SLO — and a TIGHT wave below today's
+        # median wait, which deadline-aware admission must shed AT THE
+        # DOOR instead of letting it time out
+        waits = []
+        for rep in replicas.values():
+            try:
+                w = rep.probe().get("predicted_queue_wait")
+            except Exception:
+                w = None
+            if w:
+                waits.append(w)
+        waits.sort()
+        median_w = waits[len(waits) // 2] if waits else 0.5
+        generous = max(3.0, 3.0 * (waits[-1] if waits else 1.0))
+        tight = max(1.0, 0.5 * median_w)
+        log(f"overload: predicted waits {[round(w, 2) for w in waits]} "
+            f"-> deadlines generous {generous:.2f}s / tight {tight:.2f}s")
+        door_shed, late_shed, accepted, lost = [], [], [], []
+        burst = []
+        for i in range(burst_n):
+            p = prompt(8)
+            deadline = generous if i % 2 == 0 else tight
+            t0 = time.monotonic()
+            try:
+                h = router.submit(p, max_new_tokens=6, deadline=deadline)
+            except ConnectionError:
+                # Overloaded (deadline-aware shed) or, at the very
+                # bottom of the queue ladder, QueueFull — either way a
+                # retryable reject raised at the door, in milliseconds
+                door_shed.append((time.monotonic() - t0, deadline))
+                continue
+            burst.append((h, t0, deadline))
+
+        # harvest CONCURRENTLY: a serial result() loop would timestamp a
+        # shed when the loop reaches its handle, not when it happened
+        harvest_lock = threading.Lock()
+
+        def harvest(h, t0, deadline):
+            try:
+                out = h.result(timeout=120)
+                with harvest_lock:
+                    accepted.append(len(out))
+            except Overloaded:
+                # post-admission shed: service degraded after this
+                # request was queued; still far faster than timing out
+                with harvest_lock:
+                    late_shed.append((time.monotonic() - t0, deadline))
+            except Exception as e:
+                with harvest_lock:
+                    lost.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=harvest, args=b, daemon=True)
+                   for b in burst]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for h in pre:
+            try:
+                h.result(timeout=180)
+            except Exception as e:
+                lost.append(f"preload {type(e).__name__}: {e}")
+        n_shed = len(door_shed) + len(late_shed)
+        check.expect(n_shed > 0,
+                     f"overload shed part of the 2x burst "
+                     f"({len(door_shed)} at the door + {len(late_shed)} "
+                     f"from queue of {burst_n})")
+        check.expect(len(accepted) >= burst_n // 4,
+                     f"overload kept serving the generous SLO class "
+                     f"({len(accepted)}/{burst_n // 2} completed)")
+        frac = [lat / dl for lat, dl in door_shed]
+        check.expect(bool(door_shed) and max(frac) < 0.1,
+                     f"door sheds failed fast: worst at "
+                     f"{max(frac) * 100 if frac else 0:.1f}% of its "
+                     f"deadline ({len(door_shed)} sheds)")
+        # a sweep-shed legitimately fires when remaining time crosses
+        # below the predicted wait — i.e. NEAR the deadline — so the
+        # bound is deadline + one serve-loop tick of slack; the real
+        # "never timed out" proof is the expired==0 check below
+        late_frac = [lat / dl for lat, dl in late_shed]
+        late_over = [lat - dl for lat, dl in late_shed]
+        check.expect(not late_over or max(late_over) < 0.5,
+                     f"queue sheds landed by their deadline (worst "
+                     f"{max(late_frac) * 100 if late_frac else 0:.0f}% "
+                     f"of deadline)")
+        check.expect(not lost, f"overload lost nothing ({lost[:3]})")
+        snaps = {n: r.snapshot() for n, r in replicas.items()}
+        fleet_shed = sum(s.get("requests_shed", 0) for s in snaps.values())
+        fleet_expired = sum(s.get("requests_expired", 0)
+                            for s in snaps.values())
+        check.expect(fleet_expired == 0,
+                     f"no request waited out its deadline "
+                     f"(expired={fleet_expired}, host sheds={fleet_shed})")
+        for name in sorted(replicas):   # restore full speed everywhere
+            rpc.rpc_sync(name, remote_mod._host_clear_plan)
+        log(f"overload done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 3: slow replica -> hedge, token-identical ---------
+        # delay must dominate the hedge threshold, which is EWMA-derived
+        # and inflated by the overload phase's contention: 4.0 -> every
+        # slowed step sleeps 2-6s, far past any realistic threshold
+        slow_plan = FaultPlan([{"site": "serve.step", "kind": "slow",
+                                "times": None, "delay": 4.0}], seed=11)
+        rpc.rpc_sync("r3", remote_mod._host_install_plan,
+                     args=(slow_plan.to_json(),))
+        p = prompt(12)
+        want = solo(p, 8, seed=555)
+        hedged_before = router.requests_hedged
+        h = router.submit(p, max_new_tokens=8, do_sample=True,
+                          temperature=0.8, seed=555, prefer="r3")
+        got = h.result(timeout=120)
+        rpc.rpc_sync("r3", remote_mod._host_clear_plan)
+        check.expect(np.array_equal(got, want),
+                     "hedged stream token-identical to solo")
+        check.expect(router.requests_hedged > hedged_before,
+                     f"hedge fired on the gray replica "
+                     f"(hedged={router.requests_hedged}, "
+                     f"wins={router.hedge_wins})")
+        hedge_dumps = [f for f in os.listdir(flight_dir)
+                       if "hedge_fire" in f]
+        check.expect(len(hedge_dumps) > 0,
+                     f"hedge fire flight-dumped ({len(hedge_dumps)})")
+        log(f"hedge done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 4: partition r2 -> detector death + reroute -------
+        p = prompt(12)
+        want = solo(p, 16, seed=777)
+        h = router.submit(p, max_new_tokens=16, do_sample=True,
+                          temperature=0.8, seed=777, prefer="r2")
+        part_plan = FaultPlan([{"site": "rpc.connect.r2",
+                                "kind": "partition", "times": None}],
+                              seed=0)
+        part_plan.install(env=False)
+        got = h.result(timeout=180)
+        check.expect(np.array_equal(got, want),
+                     "partitioned stream rerouted token-identical")
+        check.expect(
+            _wait(lambda: router.replicas().get("r2") == "dead",
+                  timeout=60, what="detector declaring r2 dead"),
+            "heartbeat detector declared the partitioned replica dead")
+        dead_dumps = [f for f in os.listdir(flight_dir)
+                      if "replica_dead" in f]
+        check.expect(len(dead_dumps) > 0,
+                     f"replica death flight-dumped ({len(dead_dumps)})")
+        check.expect(router.snapshot()["replicas_suspected"] >= 1,
+                     "detector counted a SUSPECT transition")
+        log(f"partition done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 5: SIGKILL r1 mid-stream --------------------------
+        p = prompt(12)
+        want = solo(p, 16, seed=888)
+        h = router.submit(p, max_new_tokens=16, do_sample=True,
+                          temperature=0.8, seed=888, prefer="r1")
+        for i, _tok in enumerate(h.stream()):
+            if i >= 2:   # provably mid-stream
+                break
+        procs["r1"].kill()
+        got = h.result(timeout=180)
+        check.expect(np.array_equal(got, want),
+                     "SIGKILLed stream rerouted token-identical")
+        check.expect(
+            _wait(lambda: router.replicas().get("r1") == "dead",
+                  timeout=60, what="detector declaring r1 dead"),
+            "heartbeat detector declared the killed replica dead")
+        snap = router.snapshot()
+        check.expect(snap["requests_rerouted"] + snap["hedge_wins"] >= 2,
+                     f"the partition + kill were rerouted/hedged "
+                     f"(rerouted={snap['requests_rerouted']}, "
+                     f"hedge_wins={snap['hedge_wins']})")
+        log(f"kill done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- teardown: stop survivors, collect their budget verdicts -
+        part_plan.uninstall()   # r2 reachable again for its stop signal
+        for name in ("r2", "r3"):
+            try:
+                rpc.rpc_sync(name, remote_mod._host_request_stop,
+                             timeout=10.0, connect_deadline=2.0)
+            except Exception as e:
+                check.expect(False, f"stop signal to {name}: {e}")
+        rpc.shutdown(timeout=8.0)
+        rc1 = procs["r1"].wait(timeout=30)
+        check.expect(rc1 == -9, f"r1 died by SIGKILL (rc={rc1})")
+        for name in ("r2", "r3"):
+            rc = procs[name].wait(timeout=120)
+            check.expect(rc == 0,
+                         f"{name} exited clean with compile budget held "
+                         f"(rc={rc})")
+
+        summary = {
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+            "sheds": n_shed,
+            "worst_shed_frac": round(max(frac + late_frac or [0.0]), 4),
+            "accepted": len(accepted),
+            "requests_routed": snap["requests_routed"],
+            "requests_rerouted": snap["requests_rerouted"],
+            "requests_hedged": snap["requests_hedged"],
+            "hedge_wins": snap["hedge_wins"],
+            "replicas_failed": snap["replicas_failed"],
+            "replicas_suspected": snap["replicas_suspected"],
+            "failures": check.failures,
+        }
+        print(json.dumps({"fleet_chaos": summary}), flush=True)
+        return 0 if not check.failures else 1
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller overload burst (the CI gate shape)")
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--endpoint", default=None)
+    args = ap.parse_args()
+    if args.child:
+        return child_main(args.rank, args.endpoint)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

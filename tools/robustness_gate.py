@@ -34,6 +34,13 @@ story. Runs, in order:
    stay token-identical to a solo ``generate`` (no divergence across the
    reroute), and the survivor must hold its #buckets+1 compile budget
    with zero steady-state recompiles;
+4b. with ``--fleet-chaos``, ``tools/fleet_chaos.py --quick`` — the
+   CROSS-HOST fleet soak: rpc remote replicas in child processes under
+   SIGKILL + network partition + slow-replica (``slow`` fault) +
+   2x-overload faults. Zero lost requests, detector-driven reroutes
+   (heartbeat misses -> DEAD -> abandoned handles fail over), hedge
+   winners token-identical to solo generate, and overload sheds failing
+   fast (< 10%% of their deadline) instead of timing out;
 5. with ``--observability``, the telemetry gate in two parts:
    ``tools/flight_drill.py`` (an injected serve-loop crash must leave a
    well-formed flight-recorder dump carrying the failing request's
@@ -59,6 +66,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --skip-sweep   # lint + soak only
     python tools/robustness_gate.py --elastic      # + shrink/grow proof
     python tools/robustness_gate.py --fleet        # + serving-fleet crash
+    python tools/robustness_gate.py --fleet-chaos  # + cross-host rpc soak
     python tools/robustness_gate.py --lora         # + adapter lifecycle
     python tools/robustness_gate.py --observability  # + telemetry gate
     python tools/robustness_gate.py --skip-lint    # runtime stages only
@@ -165,6 +173,10 @@ def main() -> int:
                     help="also run the serving-fleet replica-crash "
                          "scenario (router reroute, token parity, "
                          "compile budget)")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="also run the cross-host rpc fleet soak "
+                         "(SIGKILL + partition + slow replica + "
+                         "overload shed, tools/fleet_chaos.py --quick)")
     ap.add_argument("--lora", action="store_true",
                     help="also run the multi-tenant LoRA lifecycle "
                          "(train, SIGKILL mid-save, resume, serve mixed "
@@ -206,6 +218,11 @@ def main() -> int:
                       "--check", "--replicas", "2", "--prefix-cache-mb",
                       "4", "--prefix-tokens", "24", "--crash-replica",
                       "--verify", "3"])
+    if args.fleet_chaos:
+        results["fleet_chaos"] = _run(
+            "fleet_chaos", [sys.executable,
+                            os.path.join(TOOLS, "fleet_chaos.py"),
+                            "--quick"])
     if args.observability:
         results["flight_drill"] = _run(
             "flight_drill", [sys.executable,
