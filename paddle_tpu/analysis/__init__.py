@@ -14,6 +14,12 @@ R5   shared state bypassing its majority-use lock in threaded classes
 R6   lock-order cycles / non-reentrant re-entry (interprocedural)
 R7   blocking work (sync/dispatch/sleep/wait/IO/rpc) under a held lock
 R8   mesh-axis & sharding discipline (axes, frozen resize, shard_map)
+R9   resource-lifecycle leaks on exception paths (pin/commit/abort,
+     adapter pins, staged .tmp publishes)
+R10  SPMD collective divergence (rank-tainted branches, asymmetric
+     collective sequences)
+R11  rpc deadline/idempotence discipline (unbounded calls, retried
+     submits, swallowed transport errors)
 ==== =================================================================
 
 Entry point::
@@ -51,6 +57,7 @@ class AnalysisResult:
     callgraph: CallGraph
     findings: List[Finding] = field(default_factory=list)
     lock_graph: dict = field(default_factory=dict)
+    lifecycle_graph: dict = field(default_factory=dict)
     timing: dict = field(default_factory=dict)
 
     @property
@@ -123,4 +130,5 @@ def analyze(root: str, paths: List[str]) -> AnalysisResult:
         "files": timer.files_ms(),
     }
     return AnalysisResult(project, cg, kept, lock_graph=out.lock_graph,
+                          lifecycle_graph=out.lifecycle_graph,
                           timing=timing)

@@ -36,7 +36,10 @@ from .model import Finding, iter_py_files
 
 __all__ = ["LintCache", "git_changed_files", "CACHE_SCHEMA"]
 
-CACHE_SCHEMA = 1
+# 2: entries carry the R9 lifecycle_graph next to lock_graph (the
+# analyzer digest already invalidates on any rule edit; the schema bump
+# keeps a downgraded checkout from mis-reading the richer entries)
+CACHE_SCHEMA = 2
 
 
 def _sha1_file(path: str) -> str:
@@ -146,18 +149,21 @@ class LintCache:
     # ----------------------------------------------------------- store
     def store(self, paths: List[str], digests: Dict[str, str],
               findings: List[Finding], stats: dict, lock_graph: dict,
-              imports: Dict[str, List[str]], timing: dict) -> bool:
+              imports: Dict[str, List[str]], timing: dict,
+              lifecycle_graph: Optional[dict] = None) -> bool:
         """Best-effort: a cache write failure (read-only checkout, full
         disk) must never fail the lint that produced the result."""
         try:
             return self._store(paths, digests, findings, stats,
-                               lock_graph, imports, timing)
+                               lock_graph, imports, timing,
+                               lifecycle_graph or {})
         except OSError:
             return False
 
     def _store(self, paths: List[str], digests: Dict[str, str],
                findings: List[Finding], stats: dict, lock_graph: dict,
-               imports: Dict[str, List[str]], timing: dict) -> bool:
+               imports: Dict[str, List[str]], timing: dict,
+               lifecycle_graph: dict) -> bool:
         os.makedirs(self.dir, exist_ok=True)
         entry = {
             "schema": CACHE_SCHEMA,
@@ -167,6 +173,7 @@ class LintCache:
             "findings": [f.as_dict() for f in findings],
             "stats": stats,
             "lock_graph": lock_graph,
+            "lifecycle_graph": lifecycle_graph,
             "imports": imports,
             "timing": timing,
         }

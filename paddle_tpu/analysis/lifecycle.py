@@ -1,0 +1,803 @@
+"""R9: exception-flow resource-lifecycle (acquire/release leak) analysis.
+
+The serving stack runs on ref-counted *protocols*: a ``BlockPool.lookup``
+pins prefix-cache blocks until ``commit``/``abort``, an
+``AdapterStore.acquire`` pins a device page row until ``release``, a
+checkpoint publish stages a ``.tmp`` sibling that must reach
+``os.replace``. Every one of them is an invariant the runtime can only
+see when it is already violated (a pinned block that never unpins makes
+the pool unevictable; a leaked adapter pin wedges tenant eviction
+forever). This rule family checks the pairing *statically*, on every
+path — including the raise paths ``try``/``except`` carve out:
+
+- an acquired resource must reach a paired release (or be returned to
+  the caller — ownership transfer — or stored/escaped into longer-lived
+  state) on every normal exit;
+- a call that can raise while the resource is held must sit inside a
+  ``try`` whose handler or ``finally`` releases it (``abort``-in-except
+  IS a release — the engine's admission discipline);
+- an acquire whose result is discarded leaks immediately.
+
+Acquirers are discovered one interprocedural hop deep (like R6): a
+helper that acquires and *returns* the resource transfers ownership, so
+its callers are treated as acquiring at the call site — this is exactly
+how ``engine._plan_hit`` hands its pinned :class:`PrefixHit` to
+``admit``.
+
+Receiver typing is deliberately conservative: a method name like
+``acquire`` only matches when the receiver resolves to a protocol class
+(constructor scan, ``__init__`` parameter annotations, or a helper's
+return annotation — ``self.pool = self._normalize_pool(...) ->
+Optional[BlockPool]``) or carries a protocol receiver-name hint
+(``self.pool`` / ``self.store``). ``threading.Lock.acquire`` never
+matches (lock attrs are excluded), and passing a resource to an
+unresolved call is an *escape*, not a leak — unknown callees may release
+on the caller's behalf.
+
+The full protocol graph — per-function acquire and release sites — is
+exported in ``--json`` as ``lifecycle_graph`` alongside ``lock_graph``.
+Pure AST like every other rule: no jax import, nothing is executed.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, dotted_path
+from .model import ClassInfo, Finding, FunctionInfo, Project
+
+__all__ = ["LifecycleAnalysis", "analyze_lifecycle", "PROTOCOLS"]
+
+
+@dataclass(frozen=True)
+class Protocol:
+    """One acquire/release pairing the analyzer enforces."""
+
+    name: str
+    acquire: frozenset            # method names that acquire
+    release: frozenset            # method names that release
+    neutral: frozenset = frozenset()   # protocol plumbing: keeps holding
+    classes: frozenset = frozenset()   # owning class names (receiver type)
+    hints: frozenset = frozenset()     # receiver attr/var name fallbacks
+    raise_paths: bool = True      # also check exception edges
+    what: str = "resource"
+    fix: str = "pair the acquire with its release on every path"
+
+
+PROTOCOLS: Tuple[Protocol, ...] = (
+    Protocol(
+        name="block-pin",
+        acquire=frozenset({"lookup"}),
+        release=frozenset({"commit", "abort"}),
+        neutral=frozenset({"trim", "plan_store", "match",
+                           "match_digests"}),
+        classes=frozenset({"BlockPool"}),
+        hints=frozenset({"pool", "prefix_cache", "block_pool"}),
+        what="pinned prefix-cache blocks",
+        fix="commit() on success, abort() on EVERY failure path (an "
+            "abort in the except handler counts) — a leaked pin makes "
+            "the block unevictable forever"),
+    Protocol(
+        name="adapter-pin",
+        acquire=frozenset({"acquire"}),
+        release=frozenset({"release", "release_all"}),
+        classes=frozenset({"AdapterStore"}),
+        hints=frozenset({"store", "adapter_store", "adapters"}),
+        what="pinned adapter page row",
+        fix="release() the row on every path that does not hand it to "
+            "a live slot — a leaked pin blocks tenant eviction"),
+    Protocol(
+        name="pin",
+        acquire=frozenset({"pin"}),
+        release=frozenset({"unpin"}),
+        what="pinned entry",
+        fix="unpin on every path, including the raise paths"),
+)
+
+# staged-file protocol: `tmp = f"{path}.tmp..."` must reach os.replace
+# (publish) or a cleanup on every NORMAL exit. Raise paths are exempt by
+# design: the checkpoint layer is crash-safe precisely because a SIGKILL
+# leaves only the staging file, which orphan sweeps reap.
+_STAGED_RELEASE = {"replace", "rename", "remove", "unlink", "rmtree"}
+_STAGED_PROTO = Protocol(
+    name="staged-file",
+    acquire=frozenset(), release=frozenset(_STAGED_RELEASE),
+    raise_paths=False,
+    what="staged .tmp file",
+    fix="publish with os.replace (tmp, final) or clean it up before "
+        "returning — a staged file that never publishes is a silent "
+        "lost write")
+
+
+@dataclass
+class _Resource:
+    proto: Protocol
+    names: Set[str]
+    receiver: str                 # dotted repr of the receiver ("" unknown)
+    line: int
+    chain: Tuple[str, ...] = ()
+    reported: bool = False        # one raise-path finding per resource
+    maybe: bool = False           # held on only some merged branches
+
+
+@dataclass
+class _TryGuard:
+    """Release capability of an enclosing try. ``exc_*`` = released on
+    the exception path (handlers OR finally); ``fin_*`` = released on
+    EVERY path out (finally only) — a `return` inside the try is
+    covered only by the latter."""
+
+    exc_protocols: Set[str]
+    exc_names: Set[str]
+    exc_receivers: Set[str]
+    fin_protocols: Set[str]
+    fin_names: Set[str]
+    fin_receivers: Set[str]
+
+
+class LifecycleAnalysis:
+    def __init__(self, project: Project, cg: CallGraph):
+        self.project = project
+        self.cg = cg
+        self.findings: List[Finding] = []
+        self.acquires: List[dict] = []
+        self.releases: List[dict] = []
+        # qualname -> Protocol for helpers that acquire-and-return
+        self._transfer_fns: Dict[str, Protocol] = {}
+        self._local_maps: Dict[str, Dict[str, ast.AST]] = {}
+
+    # ------------------------------------------------------------ build
+    def run(self) -> "LifecycleAnalysis":
+        self._scan_transfer_helpers()
+        for fi in self.project.functions.values():
+            _Scanner(self, fi).run()
+        return self
+
+    # -------------------------------------------------- receiver typing
+    def _local_map(self, fi: FunctionInfo) -> Dict[str, ast.AST]:
+        got = self._local_maps.get(fi.qualname)
+        if got is None:
+            got = self._local_maps[fi.qualname] = \
+                self.cg._local_assign_map(fi)
+        return got
+
+    @staticmethod
+    def _annot_classes(node: Optional[ast.AST]) -> Set[str]:
+        """Class names inside a return/param annotation —
+        ``Optional[BlockPool]`` / ``"BlockPool"`` / ``BlockPool``."""
+        out: Set[str] = set()
+        if node is None:
+            return out
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.add(sub.attr)
+            elif isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, str):
+                out.add(sub.value.split(".")[-1].split("[")[0])
+        return out
+
+    def _self_attr_class(self, cls: ClassInfo, attr: str) -> Optional[str]:
+        """Best-effort class name of ``self.<attr>``: the constructor
+        scan, then ``self.X = self._helper(...)`` return annotations,
+        then ``self.X = <param>`` with an annotated ``__init__`` param."""
+        got = cls.attr_types.get(attr)
+        if got is not None:
+            return got
+        for m in cls.methods.values():
+            for node in ast.walk(m.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute) and t.attr == attr
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call) \
+                        and isinstance(v.func, ast.Attribute) \
+                        and isinstance(v.func.value, ast.Name) \
+                        and v.func.value.id == "self":
+                    helper = cls.methods.get(v.func.attr)
+                    if helper is not None:
+                        for cname in self._annot_classes(
+                                getattr(helper.node, "returns", None)):
+                            if cname in self.project.classes_by_name:
+                                return cname
+                elif isinstance(v, ast.Name):
+                    for arg in (m.node.args.posonlyargs + m.node.args.args
+                                + m.node.args.kwonlyargs):
+                        if arg.arg == v.id:
+                            for cname in self._annot_classes(
+                                    arg.annotation):
+                                if cname in self.project.classes_by_name:
+                                    return cname
+        return None
+
+    def _receiver_info(self, fi: FunctionInfo,
+                       base: ast.AST) -> Tuple[Optional[str], str]:
+        """(class name or None, dotted receiver repr) for ``base`` in
+        ``base.method(...)``."""
+        path = dotted_path(base)
+        repr_ = ".".join(path) if path else ""
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and fi.cls is not None:
+            if base.attr in fi.cls.lock_attrs:
+                return ("__lock__", repr_)
+            return (self._self_attr_class(fi.cls, base.attr), repr_)
+        if isinstance(base, ast.Name):
+            val = self._local_map(fi).get(base.id)
+            if isinstance(val, ast.Call):
+                cname = None
+                if isinstance(val.func, ast.Name):
+                    cname = val.func.id
+                elif isinstance(val.func, ast.Attribute):
+                    cname = val.func.attr
+                if cname and cname in self.project.classes_by_name:
+                    return (cname, repr_)
+            # annotated parameter: def admit(pool: BlockPool)
+            for arg in (fi.node.args.posonlyargs + fi.node.args.args
+                        + fi.node.args.kwonlyargs):
+                if arg.arg == base.id:
+                    for cname in self._annot_classes(arg.annotation):
+                        if cname in self.project.classes_by_name:
+                            return (cname, repr_)
+        return (None, repr_)
+
+    def _match_protocol(self, fi: FunctionInfo, call: ast.Call,
+                        method_sets: str) -> Optional[Tuple[Protocol, str]]:
+        """(protocol, receiver repr) when ``call`` is a protocol method
+        of kind ``method_sets`` ("acquire" | "release" | "neutral")."""
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        for proto in PROTOCOLS:
+            if f.attr not in getattr(proto, method_sets):
+                continue
+            cname, repr_ = self._receiver_info(fi, f.value)
+            if cname == "__lock__":
+                continue
+            if cname is not None:
+                if proto.classes and cname in proto.classes:
+                    return (proto, repr_)
+                if not proto.classes:
+                    return (proto, repr_)
+                continue    # typed to a different class: not this proto
+            # untyped receiver: the name-hint fallback
+            tail = repr_.split(".")[-1] if repr_ else ""
+            if tail in proto.hints or (not proto.classes
+                                       and not proto.hints):
+                return (proto, repr_)
+        return None
+
+    # ------------------------------------------- one-hop acquire helpers
+    def _scan_transfer_helpers(self) -> None:
+        """A function that acquires a protocol resource and *returns* it
+        transfers ownership — its callers acquire at the call site."""
+        for fi in self.project.functions.values():
+            bound: Dict[str, Protocol] = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    got = self._match_protocol(fi, node.value, "acquire")
+                    if got is None:
+                        continue
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                bound[n.id] = got[0]
+            if not bound:
+                continue
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    for n in ast.walk(node.value):
+                        if isinstance(n, ast.Name) and n.id in bound:
+                            self._transfer_fns[fi.qualname] = bound[n.id]
+                            break
+
+    def transfer_protocol(self, fi: FunctionInfo,
+                          call: ast.Call) -> Optional[Protocol]:
+        for callee in self.cg.resolve_call(fi, call):
+            proto = self._transfer_fns.get(callee.qualname)
+            if proto is not None:
+                return proto
+        return None
+
+    # ------------------------------------------------------------ export
+    def lifecycle_graph(self) -> dict:
+        return {
+            "protocols": [{
+                "name": p.name, "classes": sorted(p.classes),
+                "acquire": sorted(p.acquire),
+                "release": sorted(p.release)}
+                for p in PROTOCOLS + (_STAGED_PROTO,)],
+            "acquires": sorted(self.acquires, key=lambda a: (
+                a["file"], a["line"], a["protocol"])),
+            "releases": sorted(self.releases, key=lambda a: (
+                a["file"], a["line"], a["protocol"])),
+        }
+
+
+# calls that can never meaningfully raise mid-protocol (builtins, numpy
+# constructors, clock reads) — risky-call analysis skips them so correct
+# code like `t0 = time.time()` between acquire and try stays clean
+_SAFE_TAILS = {
+    "len", "int", "float", "bool", "str", "repr", "min", "max", "abs",
+    "sum", "any", "all", "round", "sorted", "list", "dict", "tuple",
+    "set", "frozenset", "range", "enumerate", "zip", "isinstance",
+    "hasattr", "getattr", "format", "print", "id", "time", "monotonic",
+    "perf_counter", "asarray", "array", "zeros", "ones", "append",
+    "items", "keys", "values", "get", "setdefault", "pop", "update",
+    "copy", "join", "split", "strip", "encode", "decode", "ravel",
+    "device_get", "int32", "float32", "bool_", "uint32",
+}
+
+
+class _Scanner:
+    """Path-aware acquire/release scan of one function (modeled on the
+    R4 scanner: branch states fork and merge, loops run two symbolic
+    iterations, try handlers grant exception protection)."""
+
+    def __init__(self, an: LifecycleAnalysis, fi: FunctionInfo):
+        self.an = an
+        self.fi = fi
+        self._serial = 0
+        self._emitted: Set[Tuple[int, str]] = set()
+
+    def run(self) -> None:
+        state: Dict[int, _Resource] = {}
+        fell_through = self._scan(self.fi.node.body, state, guards=[])
+        if fell_through:
+            for res in state.values():
+                self._leak(res, getattr(self.fi.node, "end_lineno",
+                                        self.fi.node.lineno),
+                           "function exits")
+
+    # ------------------------------------------------------------ emit
+    def _emit(self, line: int, msg: str, res: _Resource) -> None:
+        key = (line, res.proto.name)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        chain = res.chain or (
+            f"{self.fi.short} [acquires {res.proto.what} @ "
+            f"{self.fi.file.rel}:{res.line}]",)
+        self.an.findings.append(Finding(
+            "R9", self.fi.file.rel, line, msg, symbol=self.fi.short,
+            snippet=self.fi.file.snippet(line), chain=chain,
+            hint=res.proto.fix))
+
+    def _leak(self, res: _Resource, line: int, how: str) -> None:
+        maybe = " on some branch paths" if res.maybe else ""
+        names = "/".join(sorted(res.names)) or "<discarded>"
+        self._emit(line, f"{how} while `{names}` still holds "
+                         f"{res.proto.what} acquired at line {res.line}"
+                         f"{maybe} — the release is unreachable from "
+                         f"here", res)
+
+    # ------------------------------------------------------ call logic
+    def _call_names(self, call: ast.Call) -> Set[str]:
+        out: Set[str] = set()
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        return out
+
+    def _risky(self, call: ast.Call) -> bool:
+        """Can this call raise in a way the protocol must survive?
+        Project functions and unresolved self/instance-attribute calls
+        are risky; builtins/numpy/clock reads are not."""
+        f = call.func
+        path = dotted_path(f)
+        if path and path[-1] in _SAFE_TAILS:
+            return False
+        if self.an.cg.resolve_call(self.fi, call):
+            return True
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return True
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                return True
+        return False
+
+    def _protected(self, res: _Resource, guards: List[_TryGuard],
+                   on_exit: bool = False) -> bool:
+        """Is ``res`` released by an enclosing try — on the exception
+        path (default), or on EVERY exit (``on_exit``: finally-only,
+        the coverage a `return` inside the try needs)?"""
+        for g in guards:
+            protos = g.fin_protocols if on_exit else g.exc_protocols
+            names = g.fin_names if on_exit else g.exc_names
+            recvs = g.fin_receivers if on_exit else g.exc_receivers
+            if res.proto.name not in protos:
+                continue
+            if res.names & names:
+                return True
+            if res.receiver and res.receiver in recvs:
+                return True
+            if not res.names:      # discarded-result resources
+                return True
+        return False
+
+    def _handle_call(self, call: ast.Call, state: Dict[int, _Resource],
+                     guards: List[_TryGuard]) -> None:
+        an = self.an
+        got = an._match_protocol(self.fi, call, "release")
+        arg_names = self._call_names(call)
+        if got is not None:
+            proto, recv = got
+            an.releases.append({
+                "protocol": proto.name, "function": self.fi.short,
+                "file": self.fi.file.rel, "line": call.lineno,
+                "method": call.func.attr})
+            for rid, res in list(state.items()):
+                if res.proto.name != proto.name:
+                    continue
+                if (res.names & arg_names) or res.receiver == recv \
+                        or not res.names:
+                    del state[rid]
+            return
+        if an._match_protocol(self.fi, call, "neutral") is not None:
+            return
+        # escape: an unresolved/any call that RECEIVES the resource may
+        # release it downstream — stop tracking, never flag
+        escaped = [rid for rid, res in state.items()
+                   if res.names & arg_names]
+        for rid in escaped:
+            del state[rid]
+        # risky call while holding: the exception edge leaks unless an
+        # enclosing try releases
+        if not state or not self._risky(call):
+            return
+        for res in state.values():
+            if not res.proto.raise_paths or res.reported:
+                continue
+            if self._protected(res, guards):
+                continue
+            res.reported = True
+            names = "/".join(sorted(res.names)) or "<resource>"
+            self._emit(call.lineno,
+                       f"call can raise while `{names}` holds "
+                       f"{res.proto.what} acquired at line {res.line} "
+                       f"and no enclosing try releases it — the "
+                       f"exception path leaks the {res.proto.what}",
+                       res)
+
+    def _bind(self, targets: Sequence[ast.AST], proto: Protocol,
+              call: ast.Call, state: Dict[int, _Resource],
+              recv: str, via: str = "") -> None:
+        names: Set[str] = set()
+        escaped = False
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+                elif isinstance(n, (ast.Attribute, ast.Subscript)):
+                    escaped = True
+        self.an.acquires.append({
+            "protocol": proto.name, "function": self.fi.short,
+            "file": self.fi.file.rel, "line": call.lineno,
+            "names": sorted(names), "via": via})
+        if escaped and not names:
+            return      # stored straight into longer-lived state
+        self._serial += 1
+        chain: Tuple[str, ...] = ()
+        if via:
+            chain = (f"{via} [acquires {proto.what}]",
+                     f"{self.fi.short} @ {self.fi.file.rel}:{call.lineno}")
+        res = _Resource(proto, names, recv, call.lineno, chain=chain)
+        if not names:
+            self._leak(res, call.lineno, "acquire result is discarded")
+            return
+        state[self._serial] = res
+
+    # --------------------------------------------------------- staged
+    def _staged_acquire(self, stmt: ast.Assign,
+                        state: Dict[int, _Resource]) -> bool:
+        """``tmp = <path-building expr with a ".tmp" component>`` starts
+        the staged-file protocol for the bound name. Only PATH-BUILDING
+        forms register (f-strings, string concat/%%-format): a
+        conditional (``x if atomic else path``) or an arbitrary call
+        whose source merely mentions ".tmp" is not a staging site."""
+        if len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            return False
+        if not isinstance(stmt.value, (ast.JoinedStr, ast.BinOp)):
+            return False
+        has_tmp = any(
+            isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and ".tmp" in n.value for n in ast.walk(stmt.value))
+        if not has_tmp:
+            return False
+        name = stmt.targets[0].id
+        self._serial += 1
+        state[self._serial] = _Resource(
+            _STAGED_PROTO, {name}, "", stmt.lineno)
+        self.an.acquires.append({
+            "protocol": "staged-file", "function": self.fi.short,
+            "file": self.fi.file.rel, "line": stmt.lineno,
+            "names": [name], "via": ""})
+        return True
+
+    def _staged_release(self, call: ast.Call,
+                        state: Dict[int, _Resource]) -> None:
+        path = dotted_path(call.func)
+        if not path or path[-1] not in _STAGED_RELEASE:
+            return
+        arg_names = self._call_names(call)
+        for rid, res in list(state.items()):
+            if res.proto.name == "staged-file" and res.names & arg_names:
+                self.an.releases.append({
+                    "protocol": "staged-file", "function": self.fi.short,
+                    "file": self.fi.file.rel, "line": call.lineno,
+                    "method": path[-1]})
+                del state[rid]
+
+    def _staged_escape(self, call: ast.Call,
+                       state: Dict[int, _Resource]) -> None:
+        """Passing the staged path to a PROJECT call escapes it (the
+        helper may publish); ``open``/``fsync`` do not."""
+        if not self.an.cg.resolve_call(self.fi, call):
+            return
+        arg_names = self._call_names(call)
+        for rid, res in list(state.items()):
+            if res.proto.name == "staged-file" and res.names & arg_names:
+                del state[rid]
+
+    # ----------------------------------------------------------- scan
+    def _split_staged(self, state: Dict[int, _Resource]):
+        staged = {k: v for k, v in state.items()
+                  if v.proto.name == "staged-file"}
+        live = {k: v for k, v in state.items() if k not in staged}
+        return live, staged
+
+    def _process(self, expr: Optional[ast.AST],
+                 state: Dict[int, _Resource],
+                 guards: List[_TryGuard]) -> None:
+        """Run release/escape/risky logic for every call in ``expr``,
+        keeping the staged-file protocol's gentler escape rules."""
+        if expr is None:
+            return
+        live, staged = self._split_staged(state)
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._handle_call(node, live, guards)
+            self._staged_release(node, staged)
+            self._staged_escape(node, staged)
+        state.clear()
+        state.update(live)
+        state.update(staged)
+
+    def _try_guard(self, stmt: ast.Try) -> _TryGuard:
+        g = _TryGuard(set(), set(), set(), set(), set(), set())
+        blocks = [(h.body, False) for h in stmt.handlers]
+        blocks.append((stmt.finalbody, True))
+        for block, is_final in blocks:
+            for s in block:
+                for node in ast.walk(s):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    got = self.an._match_protocol(self.fi, node, "release")
+                    if got is None:
+                        continue
+                    g.exc_protocols.add(got[0].name)
+                    g.exc_receivers.add(got[1])
+                    g.exc_names |= self._call_names(node)
+                    if is_final:
+                        g.fin_protocols.add(got[0].name)
+                        g.fin_receivers.add(got[1])
+                        g.fin_names |= self._call_names(node)
+        return g
+
+    def _rebind(self, targets: Sequence[ast.AST],
+                state: Dict[int, _Resource]) -> None:
+        plain = set()
+        for t in targets:
+            if isinstance(t, ast.Name):
+                plain.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        plain.add(e.id)
+        if not plain:
+            return
+        for rid, res in list(state.items()):
+            lost = res.names & plain
+            if not lost:
+                continue
+            res.names -= lost
+            if not res.names:
+                del state[rid]
+                res.names = lost      # report the name it leaked under
+                self._leak(res, min(t.lineno for t in targets),
+                           "name is rebound")
+
+    def _scan(self, stmts: Sequence[ast.stmt],
+              state: Dict[int, _Resource],
+              guards: List[_TryGuard]) -> bool:
+        """Returns False when the block terminates (return/raise/...)."""
+        an = self.an
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Return):
+                transferred: Set[str] = set()
+                if s.value is not None:
+                    for n in ast.walk(s.value):
+                        if isinstance(n, ast.Name):
+                            transferred.add(n.id)
+                    self._process(s.value, state, guards)
+                for rid, res in list(state.items()):
+                    if res.names & transferred:
+                        # ownership transfer to the caller — and OUT of
+                        # this scan's state, so a loop's second symbolic
+                        # iteration doesn't resurrect it as a leak
+                        del state[rid]
+                        continue
+                    if self._protected(res, guards, on_exit=True):
+                        continue    # an enclosing finally releases it
+                    self._leak(res, s.lineno, "returns")
+                return False
+            if isinstance(s, ast.Raise):
+                self._process(s.exc, state, guards)
+                for res in state.values():
+                    if not res.proto.raise_paths:
+                        continue
+                    if self._protected(res, guards):
+                        continue
+                    self._leak(res, s.lineno, "raises")
+                return False
+            if isinstance(s, (ast.Break, ast.Continue)):
+                return False
+            if isinstance(s, ast.Assign):
+                handled = False
+                if isinstance(s.value, ast.Call):
+                    # neutral protocol call returning the SAME resource
+                    # (`hit = pool.trim(hit, n)`): the rebind continues
+                    # the hold, it neither releases nor leaks
+                    neut = an._match_protocol(self.fi, s.value, "neutral")
+                    if neut is not None:
+                        args = self._call_names(s.value)
+                        for res in state.values():
+                            if res.proto.name == neut[0].name \
+                                    and res.names & args:
+                                for t in s.targets:
+                                    for n in ast.walk(t):
+                                        if isinstance(n, ast.Name):
+                                            res.names.add(n.id)
+                                handled = True
+                        if handled:
+                            continue
+                    got = an._match_protocol(self.fi, s.value, "acquire")
+                    via = ""
+                    if got is None:
+                        proto = an.transfer_protocol(self.fi, s.value)
+                        if proto is not None:
+                            got = (proto, "")
+                            via = ast.unparse(s.value.func) \
+                                if hasattr(ast, "unparse") else "helper"
+                    if got is not None:
+                        # args of the acquire itself still release/escape
+                        for sub in ast.walk(s.value):
+                            if isinstance(sub, ast.Call) \
+                                    and sub is not s.value:
+                                self._handle_call(sub, state, guards)
+                        self._rebind(s.targets, state)
+                        self._bind(s.targets, got[0], s.value, state,
+                                   got[1], via=via)
+                        handled = True
+                if not handled and self._staged_acquire(s, state):
+                    handled = True
+                if not handled:
+                    self._process(s.value, state, guards)
+                    self._rebind(s.targets, state)
+            elif isinstance(s, ast.AugAssign):
+                self._process(s.value, state, guards)
+            elif isinstance(s, ast.AnnAssign):
+                if s.value is not None:
+                    self._process(s.value, state, guards)
+                    self._rebind([s.target], state)
+            elif isinstance(s, ast.Expr):
+                if isinstance(s.value, ast.Call):
+                    got = an._match_protocol(self.fi, s.value, "acquire")
+                    if got is not None:
+                        self._bind([], got[0], s.value, state, got[1])
+                        continue
+                self._process(s.value, state, guards)
+            elif isinstance(s, ast.If):
+                self._process(s.test, state, guards)
+                s1 = {k: _Resource(v.proto, set(v.names), v.receiver,
+                                   v.line, v.chain, v.reported, v.maybe)
+                      for k, v in state.items()}
+                s2 = {k: _Resource(v.proto, set(v.names), v.receiver,
+                                   v.line, v.chain, v.reported, v.maybe)
+                      for k, v in state.items()}
+                f1 = self._scan(s.body, s1, guards)
+                f2 = self._scan(s.orelse, s2, guards)
+                state.clear()
+                if f1 and f2:
+                    for k in set(s1) | set(s2):
+                        r = s1.get(k) or s2.get(k)
+                        if k in s1 and k in s2:
+                            state[k] = r
+                        else:
+                            r.maybe = True
+                            state[k] = r
+                elif f1:
+                    state.update(s1)
+                elif f2:
+                    state.update(s2)
+                else:
+                    return False
+            elif isinstance(s, (ast.For, ast.While)):
+                if isinstance(s, ast.For):
+                    self._process(s.iter, state, guards)
+                else:
+                    self._process(s.test, state, guards)
+                # two symbolic iterations: an acquire in the body whose
+                # name is rebound on pass 2 without a release is a
+                # loop-carried leak. A body that TERMINATES on every
+                # path (`while True: ... return`) has no iteration 2.
+                if self._scan(s.body, state, guards):
+                    self._scan(s.body, state, guards)
+                self._scan(s.orelse, state, guards)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    self._process(item.context_expr, state, guards)
+                if not self._scan(s.body, state, guards):
+                    return False
+            elif isinstance(s, ast.Try):
+                g = self._try_guard(s)
+                if not self._scan(s.body, state, guards + [g]):
+                    # the body terminated on every path; only handlers
+                    # that complete normally continue the function — the
+                    # post-try state is the UNION of their states (a
+                    # handler's release must actually remove the
+                    # resource here, or correct release-in-handler code
+                    # reads as a leak)
+                    survivors = []
+                    for h in s.handlers:
+                        hs = dict(state)
+                        if self._scan(h.body, hs, guards):
+                            survivors.append(hs)
+                    if not survivors:
+                        self._scan(s.finalbody, dict(state), guards)
+                        return False
+                    merged: Dict[int, _Resource] = {}
+                    for hs in survivors:
+                        for k, r in hs.items():
+                            if any(k not in o for o in survivors):
+                                r.maybe = True
+                            merged[k] = r
+                    state.clear()
+                    state.update(merged)
+                    if not self._scan(s.finalbody, state, guards):
+                        return False
+                    continue
+                for h in s.handlers:
+                    self._scan(h.body, dict(state), guards)
+                if not self._scan(s.finalbody, state, guards):
+                    return False
+            elif isinstance(s, ast.Assert):
+                self._process(s.test, state, guards)
+            elif isinstance(s, ast.Delete):
+                pass
+            else:
+                for child in ast.iter_child_nodes(s):
+                    if isinstance(child, ast.expr):
+                        self._process(child, state, guards)
+        return True
+
+
+def analyze_lifecycle(project: Project, cg: CallGraph) -> LifecycleAnalysis:
+    return LifecycleAnalysis(project, cg).run()

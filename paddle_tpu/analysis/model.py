@@ -54,7 +54,8 @@ def alias_modules(alias: tuple) -> List[str]:
         mods.append(f"{alias[1]}.{alias[2]}")
     return mods
 
-RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
+            "R9", "R10", "R11")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*tpu-lint:\s*(disable(?:-file)?)\s*=\s*(.*?)\s*$")

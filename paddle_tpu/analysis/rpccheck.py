@@ -1,0 +1,377 @@
+"""R11: rpc deadline / idempotence / transport-error discipline.
+
+PR 13's cross-host fleet stays correct only because three invariants are
+hand-enforced at every rpc surface. This rule family machine-checks
+them:
+
+- **deadline-bounded calls**: every direct ``rpc_sync`` / ``rpc_async``
+  / ``_invoke`` must carry an explicit ``timeout=`` /
+  ``connect_deadline=`` (or thread a ``resilience.Deadline`` /
+  caller-supplied ``timeout`` into one) — a call riding the transport's
+  120s default holds a crashed peer's failure for two minutes, blowing
+  every caller's classification budget. A ``Deadline`` threaded through
+  a helper parameter counts as bounded;
+- **non-idempotent calls never transport-retried**: a submit-shaped rpc
+  (name registry + ``# tpu-lint: rpc-non-idempotent`` annotations) whose
+  lost RESPONSE is indistinguishable from a lost REQUEST must never run
+  under a ``RetryPolicy`` with more than one attempt or inside a
+  hand-rolled retry loop — a retried submit double-admits
+  undecidably. ``# tpu-lint: rpc-idempotent`` on the def line clears a
+  name the registry would otherwise flag;
+- **transport errors never swallowed**: an ``except`` catching
+  ``RpcTransportError`` / ``ReplicaUnreachable`` (or ``ConnectionError``
+  in a function that itself makes rpc calls) must re-raise or classify —
+  a ``pass``-only handler hides a dead peer from every failure detector
+  above it.
+
+Scoped deliberately: the handler check only fires on the rpc-specific
+exception types (or bare ``ConnectionError`` in rpc-calling functions),
+so the KV-store/socket layers' intentional best-effort handlers stay
+out of scope unless they name the rpc types.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, dotted_path
+from .model import Finding, FunctionInfo, Project
+
+__all__ = ["analyze_rpc", "RPC_PRIMITIVES", "NON_IDEMPOTENT_MARKERS"]
+
+RPC_PRIMITIVES = frozenset({"rpc_sync", "rpc_async", "_invoke"})
+# name substrings that default a remote fn to NON-idempotent (a lost
+# response makes re-execution undecidable); override per-def with
+# `# tpu-lint: rpc-idempotent`
+NON_IDEMPOTENT_MARKERS = ("submit",)
+_TRANSPORT_TYPES = frozenset({"RpcTransportError", "ReplicaUnreachable"})
+_TRANSPORT_GENERIC = frozenset({"ConnectionError"})
+_BOUND_KWARGS = frozenset({"timeout", "connect_deadline", "deadline",
+                           "rpc_timeout"})
+_DEADLINEY_PARAMS = ("timeout", "deadline", "budget")
+
+_IDEMPOTENT_RE = re.compile(r"#\s*tpu-lint:\s*rpc-idempotent\b")
+_NON_IDEMPOTENT_RE = re.compile(r"#\s*tpu-lint:\s*rpc-non-idempotent\b")
+
+
+def _line_has(sf, line: int, rx) -> bool:
+    for cand in (line, line - 1):
+        if 1 <= cand <= len(sf.lines) and rx.search(sf.lines[cand - 1]):
+            return True
+    return False
+
+
+class RpcAnalysis:
+    def __init__(self, project: Project, cg: CallGraph):
+        self.project = project
+        self.cg = cg
+        self.findings: List[Finding] = []
+        self._idempotence: Dict[str, bool] = {}   # fn name -> idempotent?
+        self._collect_annotations()
+
+    # --------------------------------------------------------- registry
+    def _collect_annotations(self) -> None:
+        """The annotation registry: every project def annotated
+        ``rpc-idempotent`` / ``rpc-non-idempotent`` on (or directly
+        above) its ``def`` line."""
+        for fi in self.project.functions.values():
+            line = fi.node.lineno
+            if _line_has(fi.file, line, _IDEMPOTENT_RE):
+                self._idempotence[fi.name] = True
+            elif _line_has(fi.file, line, _NON_IDEMPOTENT_RE):
+                self._idempotence[fi.name] = False
+
+    def _non_idempotent(self, name: str) -> bool:
+        got = self._idempotence.get(name)
+        if got is not None:
+            return not got
+        return any(m in name.lower() for m in NON_IDEMPOTENT_MARKERS)
+
+    # ------------------------------------------------------------ utils
+    def _is_rpc_call(self, fi: FunctionInfo, call: ast.Call) -> bool:
+        path = dotted_path(call.func)
+        return bool(path) and path[-1] in RPC_PRIMITIVES
+
+    @staticmethod
+    def _fn_arg_name(call: ast.Call) -> Optional[str]:
+        """The remote-fn argument of an rpc primitive call: arg 1 of
+        ``rpc_sync(to, fn, ...)`` / ``_invoke(to, fn, ...)``."""
+        args = call.args
+        if len(args) >= 2:
+            a = args[1]
+            if isinstance(a, ast.Name):
+                return a.id
+            if isinstance(a, ast.Attribute):
+                return a.attr
+        for kw in call.keywords:
+            if kw.arg == "fn":
+                if isinstance(kw.value, ast.Name):
+                    return kw.value.id
+                if isinstance(kw.value, ast.Attribute):
+                    return kw.value.attr
+        return None
+
+    def _bounded(self, fi: FunctionInfo, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg in _BOUND_KWARGS:
+                return True
+        # positional timeout: rpc_sync(to, fn, args, kwargs, timeout)
+        if len(call.args) >= 5:
+            return True
+        # an argument derived from a Deadline / caller timeout in scope
+        deadline_names = self._deadline_names(fi)
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name) and n.id in deadline_names:
+                    return True
+        return False
+
+    def _deadline_names(self, fi: FunctionInfo) -> Set[str]:
+        names = {p for p in fi.params
+                 if any(p.startswith(d) or p.endswith(d)
+                        for d in _DEADLINEY_PARAMS)}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                path = dotted_path(node.value.func)
+                if path and path[-1] in ("Deadline", "remaining"):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                names.add(n.id)
+        return names
+
+    # -------------------------------------------------- retry resolution
+    def _policy_attempts(self, fi: FunctionInfo,
+                         expr: ast.AST) -> Optional[int]:
+        """``max_attempts`` of the RetryPolicy ``expr`` resolves to, or
+        None when unresolvable. Resolves locals and ``self._x``
+        assignments anywhere in the class."""
+        def from_call(call: ast.Call) -> Optional[int]:
+            path = dotted_path(call.func)
+            if not path or path[-1] != "RetryPolicy":
+                return None
+            if call.args:       # positional max_attempts
+                a0 = call.args[0]
+                if isinstance(a0, ast.Constant) \
+                        and isinstance(a0.value, int):
+                    return int(a0.value)
+                return None     # present but not a literal: unresolvable
+            for kw in call.keywords:
+                if kw.arg == "max_attempts":
+                    if isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, int):
+                        return int(kw.value.value)
+                    return None  # present but not a literal
+            return 0    # genuinely uncapped: deadline-bounded retries
+        if isinstance(expr, ast.Call):
+            return from_call(expr)
+        if isinstance(expr, ast.Name):
+            val = self.cg._local_assign_map(fi).get(expr.id)
+            if isinstance(val, ast.Call):
+                return from_call(val)
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and fi.cls is not None:
+            assigned = self.cg._class_attr_assign(fi.cls, expr.attr)
+            if isinstance(assigned, ast.Call):
+                return from_call(assigned)
+        return None
+
+    # -------------------------------------------------------------- run
+    def run(self) -> "RpcAnalysis":
+        for fi in self.project.functions.values():
+            self._check_function(fi)
+        return self
+
+    def _finding(self, fi: FunctionInfo, line: int, msg: str,
+                 hint: str) -> Finding:
+        return Finding("R11", fi.file.rel, line, msg, symbol=fi.short,
+                       snippet=fi.file.snippet(line), hint=hint,
+                       chain=fi.thread_chain if fi.thread_reachable
+                       else ())
+
+    def _check_function(self, fi: FunctionInfo) -> None:
+        rpc_calls = [c for c in self.cg.own_calls(fi)
+                     if self._is_rpc_call(fi, c)]
+        for call in rpc_calls:
+            # R11a: deadline discipline
+            if not self._bounded(fi, call):
+                name = dotted_path(call.func)[-1]
+                self.findings.append(self._finding(
+                    fi, call.lineno,
+                    f"`{name}` call rides the transport's default "
+                    f"timeout — a dead peer holds this caller for the "
+                    f"full 120s default instead of ITS deadline",
+                    hint="pass timeout= (or thread the caller's "
+                         "resilience.Deadline: "
+                         "timeout=deadline.remaining())"))
+            # R11b: idempotence vs retry (hand-rolled loop form)
+            fn_name = self._fn_arg_name(call)
+            if fn_name and self._non_idempotent(fn_name):
+                loop = self._retry_loop_around(fi, call)
+                if loop is not None:
+                    self.findings.append(self._finding(
+                        fi, call.lineno,
+                        f"non-idempotent rpc fn `{fn_name}` is retried "
+                        f"by the loop at line {loop} that swallows "
+                        f"transport errors — a lost RESPONSE "
+                        f"re-executes the submit (double admission is "
+                        f"undecidable)",
+                        hint="never transport-retry a submit: fail "
+                             "over/raise instead, or annotate the fn "
+                             "`# tpu-lint: rpc-idempotent` if "
+                             "re-execution is truly safe"))
+        # R11b: retry-policy forms
+        self._check_retry_policies(fi)
+        # R11c: swallowed transport errors
+        self._check_handlers(fi, bool(rpc_calls))
+
+    # ---- hand-rolled retry loop: rpc in a loop whose body swallows
+    # transport errors (except ConnectionError-ish without raise)
+    def _retry_loop_around(self, fi: FunctionInfo,
+                           call: ast.Call) -> Optional[int]:
+        loops: List[ast.stmt] = []
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                st = stack + ([child] if isinstance(
+                    child, (ast.For, ast.While)) else [])
+                if child is call:
+                    loops.extend(stack)
+                    return True
+                if walk(child, st):
+                    return True
+            return False
+
+        walk(fi.node, [])
+        for loop in loops:
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                caught = _caught_names(node)
+                if not (caught & (_TRANSPORT_TYPES | _TRANSPORT_GENERIC
+                                  | {"OSError", "Exception"})):
+                    continue
+                if not any(isinstance(n, (ast.Raise, ast.Return))
+                           for n in ast.walk(node)):
+                    return loop.lineno
+        return None
+
+    # ---- RetryPolicy forms: policy.call(fn)/until(fn) where fn rpc's a
+    # non-idempotent target, and helper(..., retry=<multi-attempt>)
+    def _check_retry_policies(self, fi: FunctionInfo) -> None:
+        for call in self.cg.own_calls(fi):
+            f = call.func
+            # helper(..., non_idempotent_fn, ..., retry=policy)
+            retry_kw = next((kw.value for kw in call.keywords
+                             if kw.arg in ("retry", "policy")), None)
+            if retry_kw is not None:
+                fn_names = [a.attr if isinstance(a, ast.Attribute)
+                            else a.id for a in call.args
+                            if isinstance(a, (ast.Name, ast.Attribute))]
+                bad = [n for n in fn_names if self._non_idempotent(n)]
+                if bad:
+                    attempts = self._policy_attempts(fi, retry_kw)
+                    if attempts is None or attempts == 1:
+                        continue    # single attempt (or unresolvable)
+                    self.findings.append(self._finding(
+                        fi, call.lineno,
+                        f"non-idempotent rpc fn `{bad[0]}` runs under a "
+                        f"RetryPolicy with "
+                        f"{'no attempt cap' if attempts == 0 else f'max_attempts={attempts}'}"
+                        f" — a transport blip re-submits it",
+                        hint="use a max_attempts=1 policy for submits "
+                             "(classification only, no re-send) and "
+                             "fail over at the router instead"))
+                continue
+            # policy.call(fn) / policy.until(fn)
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in ("call", "until") and call.args):
+                continue
+            attempts = self._policy_attempts(fi, f.value)
+            if attempts is None or attempts == 1:
+                continue
+            target = None
+            a0 = call.args[0]
+            if isinstance(a0, (ast.Name, ast.Attribute)):
+                target = self.cg._target_function(fi, a0)
+            body = target.node if target is not None else (
+                a0 if isinstance(a0, ast.Lambda) else None)
+            if body is None:
+                continue
+            for node in ast.walk(body):
+                if isinstance(node, ast.Call) \
+                        and self._is_rpc_call(fi, node):
+                    fn_name = self._fn_arg_name(node)
+                    if fn_name and self._non_idempotent(fn_name):
+                        self.findings.append(self._finding(
+                            fi, call.lineno,
+                            f"non-idempotent rpc fn `{fn_name}` is "
+                            f"dispatched inside a retried callable "
+                            f"(RetryPolicy "
+                            f"{'without attempt cap' if attempts == 0 else f'max_attempts={attempts}'}"
+                            f") — a lost response double-submits",
+                            hint="run submits single-attempt; retry "
+                                 "only idempotent calls (poll/probe/"
+                                 "snapshot)"))
+                        break
+
+    # ---- swallowed transport errors
+    def _check_handlers(self, fi: FunctionInfo, makes_rpc: bool) -> None:
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = _caught_names(node)
+            specific = caught & _TRANSPORT_TYPES
+            generic = caught & _TRANSPORT_GENERIC
+            if not specific and not (generic and makes_rpc):
+                continue
+            if not _swallows(node):
+                continue
+            names = ", ".join(sorted(specific or generic))
+            self.findings.append(self._finding(
+                fi, node.lineno,
+                f"`except {names}` swallows a transport failure "
+                f"(pass-only handler) — the dead peer disappears from "
+                f"every failure detector above this frame",
+                hint="re-raise, classify (wrap/mark the replica), or "
+                     "record the miss; if this site is truly "
+                     "best-effort, suppress with a reason"))
+
+
+def _caught_names(h: ast.ExceptHandler) -> Set[str]:
+    out: Set[str] = set()
+    t = h.type
+    exprs = []
+    if isinstance(t, ast.Tuple):
+        exprs = list(t.elts)
+    elif t is not None:
+        exprs = [t]
+    for e in exprs:
+        path = dotted_path(e)
+        if path:
+            out.add(path[-1])
+    return out
+
+
+def _swallows(h: ast.ExceptHandler) -> bool:
+    """True when the handler body does NOTHING (pass/continue/ellipsis
+    only) — anything else (a call, an assignment, a return value, a
+    raise) counts as classifying."""
+    for s in h.body:
+        if isinstance(s, ast.Pass) or isinstance(s, ast.Continue):
+            continue
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant):
+            continue    # docstring / ellipsis
+        return False
+    return True
+
+
+def analyze_rpc(project: Project, cg: CallGraph) -> List[Finding]:
+    return RpcAnalysis(project, cg).run().findings

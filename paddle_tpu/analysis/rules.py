@@ -57,6 +57,14 @@ RULE_DOCS = {
     "R8": "mesh-axis/sharding discipline (undeclared PartitionSpec "
           "axis, frozen program-axis resize, shard_map arity, "
           "donated-input reshard)",
+    "R9": "resource-lifecycle leak: an acquire (BlockPool lookup, "
+          "AdapterStore acquire, pin, staged .tmp file) with an "
+          "unreachable release on some path (incl. raise paths)",
+    "R10": "SPMD collective divergence: collective under a "
+           "rank-tainted branch/loop, or branch-asymmetric collective "
+           "sequences — a cross-rank deadlock",
+    "R11": "rpc discipline: unbounded rpc call, non-idempotent fn "
+           "under transport retry, or a swallowed transport error",
 }
 
 
@@ -1122,13 +1130,17 @@ def run_r5(project: Project, cg: CallGraph) -> List[Finding]:
 class RulesOutput:
     findings: List[Finding] = field(default_factory=list)
     lock_graph: dict = field(default_factory=dict)
+    lifecycle_graph: dict = field(default_factory=dict)
     rule_ms: Dict[str, float] = field(default_factory=dict)
 
 
 def run_rules(project: Project, cg: CallGraph,
               timer: Optional[FileTimer] = None) -> RulesOutput:
+    from .lifecycle import analyze_lifecycle
     from .locks import analyze_locks
+    from .rpccheck import analyze_rpc
     from .sharding import analyze_sharding
+    from .spmd import analyze_spmd
 
     global _CG_REF, _TIMER
     _CG_REF = cg
@@ -1155,5 +1167,10 @@ def run_rules(project: Project, cg: CallGraph,
     out.lock_graph = locks.lock_graph()
     out.findings.extend(staged("R8",
                                lambda: analyze_sharding(project, cg)))
+    life = staged("R9", lambda: analyze_lifecycle(project, cg))
+    out.findings.extend(life.findings)
+    out.lifecycle_graph = life.lifecycle_graph()
+    out.findings.extend(staged("R10", lambda: analyze_spmd(project, cg)))
+    out.findings.extend(staged("R11", lambda: analyze_rpc(project, cg)))
     _TIMER = None
     return out

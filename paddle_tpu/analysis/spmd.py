@@ -1,0 +1,476 @@
+"""R10: SPMD collective-divergence analysis.
+
+A collective (``psum`` / ``all_gather`` / ``all_to_all`` / ... ) is a
+*rendezvous*: every rank of the axis must issue the same collectives in
+the same order, or the fleet deadlocks — silently, with every chip
+spinning at 100% waiting for a peer that took the other branch. GSPMD
+(arXiv:2105.04663) assumes program-order collective agreement as an
+axiom; T3-style overlap scheduling makes the ordering even harder to
+eyeball. This rule family checks it statically:
+
+- **rank-divergent branch**: a Python ``if``/``while`` whose condition
+  is tainted by a *rank source* (``jax.process_index()``,
+  ``lax.axis_index``, ``get_rank()``, ``.rank`` attributes,
+  ``os.environ["...RANK/TRAINER_ID..."]``, per-host data like
+  ``local_device_count``/``gethostname``) and whose arms issue
+  *different* collective sequences — some ranks enter the collective,
+  others never arrive. A collective in BOTH arms in the SAME order is
+  clean (every rank still rendezvouses);
+- **rank-divergent loop**: a loop whose trip count is rank-tainted with
+  a collective in the body — ranks disagree on HOW MANY collectives run;
+- **asymmetric early exit**: a rank-tainted branch arm that returns
+  while collectives follow later in the function — the returning ranks
+  skip them.
+
+Collective-bearing calls are discovered transitively over the project
+call graph (the ``distributed/`` wrappers — ``all_reduce``,
+``broadcast``, ``alltoall``, ``eager_all_reduce``, ``pcast`` — count
+exactly like the ``lax`` primitives they wrap), so a branch arm that
+calls a helper which psums deep inside still registers.
+
+Rank taint is its own small engine (not R2's): rank values stay "rank"
+through host casts (``int(os.environ["RANK"])`` is still rank-dependent
+— precisely the kind of value R2's taint deliberately clears).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, dotted_path
+from .model import Finding, FunctionInfo, Project
+
+__all__ = ["analyze_spmd", "COLLECTIVE_TAILS", "RANK_SOURCE_CALLS"]
+
+# terminal collective primitives (jax.lax + the framework's compat shims)
+COLLECTIVE_TAILS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pcast", "pshuffle", "all_reduce",
+    "reduce_scatter", "alltoall", "allgather",
+})
+# rank / per-host data sources: tails of calls whose result differs
+# per process or per shard-program instance
+RANK_SOURCE_CALLS = frozenset({
+    "process_index", "axis_index", "get_rank", "local_rank",
+    "node_rank", "host_id", "process_count_local",
+    "local_device_count", "local_devices", "gethostname", "getpid",
+})
+_RANK_ATTRS = frozenset({"rank", "process_index", "local_rank",
+                         "node_rank"})
+_RANK_PARAMS = frozenset({"rank", "process_index", "local_rank",
+                          "node_rank", "trainer_id"})
+_RANK_ENV_MARKERS = ("RANK", "TRAINER_ID", "PROCESS_INDEX")
+
+
+def _is_rank_source(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        path = dotted_path(node.func)
+        if path and path[-1] in RANK_SOURCE_CALLS:
+            return True
+        # os.environ.get("PADDLE_TRAINER_ID") / os.getenv("RANK")
+        if path and path[-1] in ("get", "getenv"):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Constant) \
+                        and isinstance(a.value, str) \
+                        and any(m in a.value for m in _RANK_ENV_MARKERS):
+                    return True
+    elif isinstance(node, ast.Attribute) \
+            and isinstance(getattr(node, "ctx", None), ast.Load) \
+            and node.attr in _RANK_ATTRS:
+        return True
+    elif isinstance(node, ast.Subscript):
+        # os.environ["PADDLE_TRAINER_ID"]
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+                and any(m in sl.value for m in _RANK_ENV_MARKERS):
+            return True
+    return False
+
+
+class _RankTaint:
+    """Flow-insensitive rank-tainted-name set for one function. Unlike
+    the R2 taint engine, host casts do NOT clear it: ``int(rank)`` is
+    still rank-dependent."""
+
+    def __init__(self, fi: FunctionInfo):
+        self.fi = fi
+        self.names: Set[str] = {p for p in fi.params if p in _RANK_PARAMS}
+        self._propagate()
+
+    def _propagate(self) -> None:
+        for _ in range(6):
+            changed = False
+            for node in ast.walk(self.fi.node):
+                targets = None
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if targets is None or not self.tainted(value):
+                    continue
+                for name in self._plain_names(targets):
+                    if name not in self.names:
+                        self.names.add(name)
+                        changed = True
+            if not changed:
+                break
+
+    @staticmethod
+    def _plain_names(targets) -> List[str]:
+        """Plain Name targets only — ``self.rank = ...`` must not taint
+        ``self`` (that would rank-taint every later ``self.*`` read,
+        the exact over-taint R2's engine fixed once already)."""
+        out: List[str] = []
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, ast.Name):
+                out.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+        return out
+
+    def tainted(self, expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        for node in ast.walk(expr):
+            if _is_rank_source(node):
+                return True
+            if isinstance(node, ast.Name) and node.id in self.names:
+                return True
+        return False
+
+
+def _collective_tail(fi: FunctionInfo, call: ast.Call) -> Optional[str]:
+    path = dotted_path(call.func)
+    if path and path[-1] in COLLECTIVE_TAILS:
+        return path[-1]
+    return None
+
+
+class SpmdAnalysis:
+    def __init__(self, project: Project, cg: CallGraph):
+        self.project = project
+        self.cg = cg
+        self.findings: List[Finding] = []
+        # qualname -> flattened unconditional collective signature
+        self._sigs: Dict[str, Tuple] = {}
+        self._sig_stack: Set[str] = set()
+
+    # -------------------------------------------------- call signatures
+    def signature(self, fi: FunctionInfo, depth: int = 0) -> Tuple:
+        """Ordered collective events ``fi`` issues when called — terminal
+        collectives plus (recursively, depth-capped) project callees'.
+        Conditional structure inside the callee collapses to a choice
+        marker; an empty tuple means collective-free."""
+        got = self._sigs.get(fi.qualname)
+        if got is not None:
+            return got
+        if fi.qualname in self._sig_stack or depth > 3:
+            return ()
+        self._sig_stack.add(fi.qualname)
+        try:
+            events = _clean(self._seq(fi, fi.node.body, taint=None,
+                                      depth=depth))
+        finally:
+            self._sig_stack.discard(fi.qualname)
+        self._sigs[fi.qualname] = events
+        return events
+
+    def _call_events(self, fi: FunctionInfo, call: ast.Call,
+                     depth: int) -> Tuple:
+        tail = _collective_tail(fi, call)
+        if tail is not None:
+            return (tail,)
+        out: List = []
+        for callee in self.cg.resolve_call(fi, call):
+            sub = self.signature(callee, depth + 1)
+            if sub:
+                out.extend(sub)
+                break
+        return tuple(out)
+
+    # ------------------------------------------------ sequence modeling
+    def _expr_events(self, fi: FunctionInfo, expr: Optional[ast.AST],
+                     depth: int) -> Tuple:
+        if expr is None:
+            return ()
+        out: List = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                out.extend(self._call_events(fi, node, depth))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                continue
+        return tuple(out)
+
+    def _seq(self, fi: FunctionInfo, stmts: Sequence[ast.stmt],
+             taint: Optional[_RankTaint], depth: int,
+             emit: bool = False) -> Tuple:
+        """Collective-event sequence of a statement block. With
+        ``taint``+``emit`` set this is the checking pass: rank-divergent
+        constructs emit findings and contribute choice markers."""
+        events: List = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Return):
+                events.extend(self._expr_events(fi, s.value, depth))
+                events.append(("return",))
+                break
+            if isinstance(s, ast.Raise):
+                events.append(("return",))
+                break
+            if isinstance(s, (ast.Break, ast.Continue)):
+                break
+            if isinstance(s, ast.If):
+                events.extend(self._expr_events(fi, s.test, depth))
+                a = self._seq(fi, s.body, taint, depth, emit)
+                b = self._seq(fi, s.orelse, taint, depth, emit)
+                divergent = (taint is not None
+                             and taint.tainted(s.test))
+                if divergent and emit:
+                    if _terminates(a) != _terminates(b):
+                        # one arm exits the function: its schedule must
+                        # be compared against arm + the REST of this
+                        # block, path-sensitively (a uniform branch in
+                        # the suffix must not double-count)
+                        rest = self._seq(fi, stmts[i + 1:], None, depth)
+                        self._check_early_exit(fi, s, a, b, rest)
+                    else:
+                        self._check_branch(fi, s, a, b)
+                if a == b:
+                    events.extend(a)
+                elif _has_collectives(a) or _has_collectives(b):
+                    events.append(("choice", a, b))
+                # a terminating arm truncates the block's suffix for
+                # those paths — record it so callers comparing arms see
+                # the asymmetry
+                continue
+            if isinstance(s, (ast.For, ast.While)):
+                head = s.iter if isinstance(s, ast.For) else s.test
+                events.extend(self._expr_events(fi, head, depth))
+                body = self._seq(fi, s.body, taint, depth, emit)
+                divergent = (taint is not None and taint.tainted(head))
+                if divergent and emit and _has_collectives(body):
+                    self.findings.append(self._finding(
+                        fi, s.lineno,
+                        f"loop trip count is rank-dependent and the "
+                        f"body issues collective(s) "
+                        f"{_names(body)} — ranks disagree on how many "
+                        f"rendezvous to run, deadlocking the axis",
+                        hint="make the trip count rank-invariant "
+                             "(psum/broadcast the bound first), or "
+                             "hoist the collective out of the loop"))
+                if body:
+                    events.append(("loop",) + body)
+                events.extend(self._seq(fi, s.orelse, taint, depth, emit))
+                continue
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    events.extend(self._expr_events(
+                        fi, item.context_expr, depth))
+                events.extend(self._seq(fi, s.body, taint, depth, emit))
+                continue
+            if isinstance(s, ast.Try):
+                events.extend(self._seq(fi, s.body, taint, depth, emit))
+                for h in s.handlers:
+                    self._seq(fi, h.body, taint, depth, emit)
+                events.extend(self._seq(fi, s.finalbody, taint, depth,
+                                        emit))
+                continue
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    events.extend(self._expr_events(fi, child, depth))
+        return tuple(events)
+
+    # ----------------------------------------------------------- checks
+    def _check_branch(self, fi: FunctionInfo, node: ast.If,
+                      a: Tuple, b: Tuple) -> None:
+        a_coll = _clean(a)
+        b_coll = _clean(b)
+        if a_coll == b_coll:
+            return      # same collectives, same order, same exits: clean
+        self.findings.append(self._finding(
+            fi, node.lineno,
+            f"branch condition is rank-dependent and the arms issue "
+            f"different collective sequences ({_names(a) or 'none'} vs "
+            f"{_names(b) or 'none'}) — ranks taking different arms "
+            f"never rendezvous and the whole axis deadlocks",
+            hint="issue the same collectives in the same order on both "
+                 "arms (mask the CONTRIBUTION, not the call: psum of a "
+                 "zero is cheap, a missing psum is a hang), or hoist "
+                 "the rank test inside the traced program as a "
+                 "jnp.where"))
+
+    def _check_early_exit(self, fi: FunctionInfo, node: ast.If,
+                          a: Tuple, b: Tuple, rest: Tuple) -> None:
+        """One arm of a rank-divergent branch exits the function. The
+        exiting ranks' schedule (the arm's own collectives) must match
+        SOME possible schedule of the continuing path (other arm +
+        block suffix) — `if rank: return psum(x)` / `return psum(x)`
+        is clean; skipping or adding a rendezvous is a deadlock."""
+        a_term = _terminates(a)
+        term_alts = _alts(a if a_term else b)
+        cont_arm = _alts(b if a_term else a)
+        rest_alts = _alts(rest)
+        if term_alts is None or cont_arm is None or rest_alts is None:
+            return      # path-alternative blowup: stay silent
+        term_s = {sched for sched, _ in term_alts}
+        cont_s: set = set()
+        for sched, terminated in cont_arm:
+            if terminated:
+                cont_s.add(sched)
+            else:
+                cont_s |= {sched + r for r, _ in rest_alts}
+        if term_s & cont_s:
+            return      # a matching rendezvous schedule exists
+        if all(not sched for sched in term_s) \
+                and any(sched for sched in cont_s):
+            self.findings.append(self._finding(
+                fi, node.lineno,
+                f"rank-dependent early exit skips the collective(s) "
+                f"issued later in this function "
+                f"({'/'.join(sorted(cont_s, key=len)[-1][:4])}) — the "
+                f"exiting ranks never arrive at the rendezvous",
+                hint="every rank must reach every collective: gate "
+                     "the SIDE EFFECT on rank, not the collective "
+                     "itself"))
+        elif term_s != cont_s:
+            t = sorted(term_s)[0] if term_s else ()
+            c = sorted(cont_s)[0] if cont_s else ()
+            self.findings.append(self._finding(
+                fi, node.lineno,
+                f"rank-dependent branch: the exiting arm issues "
+                f"{'/'.join(t) or 'no collectives'} but the continuing "
+                f"path issues {'/'.join(c) or 'none'} — the two rank "
+                f"groups run different rendezvous schedules and "
+                f"deadlock",
+                hint="every rank must issue the same collectives in "
+                     "the same order on every path out of this "
+                     "function"))
+
+    def _finding(self, fi: FunctionInfo, line: int, msg: str,
+                 hint: str) -> Finding:
+        return Finding("R10", fi.file.rel, line, msg, symbol=fi.short,
+                       snippet=fi.file.snippet(line), hint=hint,
+                       chain=fi.trace_chain if fi.trace_reachable else ())
+
+    # -------------------------------------------------------------- run
+    def run(self) -> "SpmdAnalysis":
+        for fi in self.project.functions.values():
+            taint = _RankTaint(fi)
+            if not taint.names and not self._any_rank_source(fi):
+                continue
+            self._seq(fi, fi.node.body, taint, depth=0, emit=True)
+        return self
+
+    @staticmethod
+    def _any_rank_source(fi: FunctionInfo) -> bool:
+        return any(_is_rank_source(n) for n in ast.walk(fi.node))
+
+
+def _clean(seq: Tuple) -> Tuple:
+    """Normalize a raw event sequence down to its COLLECTIVE content:
+    drop ``("return",)`` control markers, recursively clean choice/loop
+    wrappers, and drop wrappers left empty — the comparison (and the
+    "does this arm rendezvous at all" question) must see only the
+    rendezvous structure, never the control scaffolding."""
+    out: List = []
+    for e in seq:
+        if isinstance(e, str):
+            out.append(e)
+        elif isinstance(e, tuple) and e and e[0] == "choice":
+            a, b = _clean(e[1]), _clean(e[2])
+            if a or b:
+                out.append(("choice", a, b))
+        elif isinstance(e, tuple) and e and e[0] == "loop":
+            body = _clean(e[1:])
+            if body:
+                out.append(("loop",) + body)
+    return tuple(out)
+
+
+def _terminates(seq: Tuple) -> bool:
+    return bool(seq) and seq[-1] == ("return",)
+
+
+def _flat(seq: Tuple) -> Tuple[str, ...]:
+    """A sequence flattened to its collective names, in order
+    (choice/loop wrappers contribute their contents; control markers
+    dropped)."""
+    out: List[str] = []
+
+    def rec(s):
+        for e in s:
+            if isinstance(e, str):
+                out.append(e)
+            elif isinstance(e, tuple) and e and e[0] == "choice":
+                rec(e[1])
+                rec(e[2])
+            elif isinstance(e, tuple) and e and e[0] == "loop":
+                rec(e[1:])
+
+    rec(seq)
+    return tuple(out)
+
+
+def _alts(seq: Tuple, cap: int = 16):
+    """The set of possible (schedule, terminated) pairs a raw event
+    sequence can realize — choice forks both ways, a loop body runs
+    zero or one symbolic time, ``("return",)`` terminates the path.
+    None when the alternative count exceeds ``cap`` (callers stay
+    silent rather than guess)."""
+    alts = {((), False)}
+    for e in seq:
+        new = set()
+        for sched, term in alts:
+            if term:
+                new.add((sched, True))
+                continue
+            if isinstance(e, str):
+                new.add((sched + (e,), False))
+            elif e == ("return",):
+                new.add((sched, True))
+            elif isinstance(e, tuple) and e and e[0] == "choice":
+                for branch in (e[1], e[2]):
+                    sub = _alts(branch, cap)
+                    if sub is None:
+                        return None
+                    for s2, t2 in sub:
+                        new.add((sched + s2, t2))
+            elif isinstance(e, tuple) and e and e[0] == "loop":
+                sub = _alts(e[1:], cap)
+                if sub is None:
+                    return None
+                new.add((sched, False))
+                for s2, t2 in sub:
+                    new.add((sched + s2, t2))
+            else:
+                new.add((sched, term))
+        alts = new
+        if len(alts) > cap:
+            return None
+    return alts
+
+
+def _has_collectives(seq: Tuple) -> bool:
+    return bool(_clean(seq))
+
+
+def _names(seq: Tuple) -> str:
+    flat = _flat(_clean(seq))
+    return "/".join(flat[:4]) + ("..." if len(flat) > 4 else "")
+
+
+def analyze_spmd(project: Project, cg: CallGraph) -> List[Finding]:
+    return SpmdAnalysis(project, cg).run().findings

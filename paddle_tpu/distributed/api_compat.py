@@ -155,14 +155,18 @@ def _my_rank() -> int:
     return me.rank if me is not None else env.get_rank()
 
 
-def send(tensor, dst=0, group=None, sync_op=True, tag: int = 0):
+def send(tensor, dst=0, group=None, sync_op=True, tag: int = 0,
+         timeout: float = 120.0):
     """Ship a host tensor to ``dst``'s mailbox (reference eager
     ``send``; requires ``rpc.init_rpc`` — the in-graph SPMD transport is
-    ``collective.ppermute``/``shift_*``)."""
+    ``collective.ppermute``/``shift_*``). Bounded by ``timeout`` like
+    the matching :func:`recv` (tpu_lint R11: a dead peer must fail this
+    caller at ITS deadline, not the transport's)."""
     from . import rpc
 
     payload = np.asarray(tensor)
-    rpc.rpc_sync(_peer_name(dst), _deliver, (_my_rank(), tag, payload))
+    rpc.rpc_sync(_peer_name(dst), _deliver, (_my_rank(), tag, payload),
+                 timeout=timeout)
 
 
 def recv(tensor=None, src=0, group=None, sync_op=True, tag: int = 0,
@@ -285,7 +289,8 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 _ag_generation = [0]
 
 
-def all_gather_object(object_list, obj, group=None):
+def all_gather_object(object_list, obj, group=None,
+                      timeout: float = 120.0):
     """Host-object all-gather (collective: every rank calls it): each
     rank mails its object to every peer, then drains one object per peer
     from its own mailbox. Generation counters keep successive gathers
@@ -305,10 +310,11 @@ def all_gather_object(object_list, obj, group=None):
     tag = ("allgather", gen)
     for info in infos:
         if info.rank != me:
-            rpc.rpc_sync(info.name, _deliver, (me, tag, obj))
+            rpc.rpc_sync(info.name, _deliver, (me, tag, obj),
+                         timeout=timeout)
     for info in infos:
         object_list.append(obj if info.rank == me
-                           else _box(info.rank, tag).get(timeout=120.0))
+                           else _box(info.rank, tag).get(timeout=timeout))
     return object_list
 
 

@@ -47,9 +47,14 @@ class _RpcExecutor:
     """Run the CPU stage on remote heter workers via the RPC agent
     (HeterClient role): requests round-robin across worker names."""
 
-    def __init__(self, cpu_stage: Callable, workers: Sequence[str]):
+    def __init__(self, cpu_stage: Callable, workers: Sequence[str],
+                 rpc_timeout: float = 120.0):
         self.cpu_stage = cpu_stage
         self.workers = list(workers)
+        # bounds every stage rpc (tpu_lint R11): a dead heter worker
+        # fails the micro-batch at the trainer's deadline, not the
+        # transport's — the trainer then reissues on the survivors
+        self.rpc_timeout = float(rpc_timeout)
         self._next = 0
         self._lock = threading.Lock()
 
@@ -59,7 +64,8 @@ class _RpcExecutor:
         with self._lock:
             w = self.workers[self._next % len(self.workers)]
             self._next += 1
-        return rpc_async(w, self.cpu_stage, args=(batch,))
+        return rpc_async(w, self.cpu_stage, args=(batch,),
+                         timeout=self.rpc_timeout)
 
     def stop(self):
         pass  # rpc lifetime belongs to init_rpc/shutdown

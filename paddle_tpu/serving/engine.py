@@ -420,15 +420,23 @@ class ContinuousBatchingEngine:
         namespaces the digest chain per adapter: a tenant only ever hits
         K/V its own adapter computed."""
         hit = self.pool.lookup(prompt, salt=salt)
-        matched = hit.tokens
-        while (matched > 0
-               and matched + self.bucket_for_prompt(L - matched)
-               > self.max_length):
-            matched -= self.pool.block_tokens
-        if matched != hit.tokens:
-            hit = self.pool.trim(hit, matched)
-        plan = self.pool.plan_store(prompt, matched, digests=hit.digests,
-                                    salt=salt)
+        # everything between the lookup (which PINS the matched blocks)
+        # and handing (hit, plan) to the caller runs under an abort
+        # guard: a raise out of trim/plan_store would otherwise leak
+        # the pins forever (tpu_lint R9 — the pool becomes unevictable)
+        try:
+            matched = hit.tokens
+            while (matched > 0
+                   and matched + self.bucket_for_prompt(L - matched)
+                   > self.max_length):
+                matched -= self.pool.block_tokens
+            if matched != hit.tokens:
+                hit = self.pool.trim(hit, matched)
+            plan = self.pool.plan_store(prompt, matched,
+                                        digests=hit.digests, salt=salt)
+        except Exception:
+            self.pool.abort(hit)
+            raise
         return hit, plan
 
 
@@ -489,12 +497,16 @@ class ContinuousBatchingEngine:
                         top_p, greedy)
                 else:
                     hit, plan = self._plan_hit(prompt, L, salt=a_salt)
-                    hit_tokens = hit.tokens
-                    suffix = L - hit_tokens
-                    bucket = self.bucket_for_prompt(suffix)
-                    ids_p = np.zeros((1, bucket), np.int32)
-                    ids_p[0, :suffix] = prompt[hit_tokens:]
+                    # the abort guard starts the statement AFTER the
+                    # pins land: a raise anywhere before the commit —
+                    # bucket planning as much as the dispatch itself —
+                    # must release them (tpu_lint R9)
                     try:
+                        hit_tokens = hit.tokens
+                        suffix = L - hit_tokens
+                        bucket = self.bucket_for_prompt(suffix)
+                        ids_p = np.zeros((1, bucket), np.int32)
+                        ids_p[0, :suffix] = prompt[hit_tokens:]
                         tok, done0, self.live_cache, tensors = (
                             self._prefill_compiled(
                                 self._params, self._buffers,

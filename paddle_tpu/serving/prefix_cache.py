@@ -352,17 +352,22 @@ class BlockPool:
             for e in hit.entries:
                 e.refs -= 1
 
-    def abort(self, hit: PrefixHit, plan: StorePlan) -> None:
+    def abort(self, hit: PrefixHit,
+              plan: Optional[StorePlan] = None) -> None:
         """Roll back a failed admission (dispatch never ran or raised):
-        release pins, return pending rows to the free list. The device
-        tensors are untouched on the host side — a fault AFTER dispatch
-        must instead go through :meth:`reset` (the engine's crash
-        recovery), because donated buffers may be half-written."""
+        release pins, return pending rows to the free list. ``plan`` is
+        optional — a failure between :meth:`lookup` and
+        :meth:`plan_store` (the tpu_lint R9 window) has pins to release
+        but no pending rows yet. The device tensors are untouched on
+        the host side — a fault AFTER dispatch must instead go through
+        :meth:`reset` (the engine's crash recovery), because donated
+        buffers may be half-written."""
         with self._lock:
             for e in hit.entries:
                 e.refs -= 1
-            for e in plan.pending:
-                self._free.append(e.index)
+            if plan is not None:
+                for e in plan.pending:
+                    self._free.append(e.index)
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:

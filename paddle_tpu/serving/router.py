@@ -930,6 +930,7 @@ class ReplicaRouter:
                 continue   # already declared dead: nothing to stop
             try:
                 rep.server.shutdown(drain=drain, timeout=timeout)
+            # tpu-lint: disable=R11(fleet exit: an already-dead peer IS the desired post-shutdown state; no detector routes to it again)
             except ReplicaUnreachable:
                 # the peer is gone — which is exactly the state
                 # shutdown wants; a corpse must not fail the fleet exit

@@ -188,3 +188,25 @@ def test_p2p_over_rpc_two_processes():
         if w1.poll() is None:
             w1.kill()
             w1.communicate()
+
+
+def test_p2p_rpc_calls_are_deadline_bounded(monkeypatch):
+    """tpu_lint R11 regression: send/all_gather_object must thread an
+    explicit timeout into rpc_sync instead of riding the transport's
+    120s default — a dead peer fails the caller at ITS deadline."""
+    import paddle_tpu.distributed.api_compat as ac
+    from paddle_tpu.distributed import rpc
+
+    seen = []
+
+    def fake_rpc_sync(to, fn, args=None, kwargs=None, timeout=None, **kw):
+        seen.append(timeout)
+        return 0
+
+    monkeypatch.setattr(ac, "_peer_name", lambda r: "w1")
+    monkeypatch.setattr(ac, "_my_rank", lambda: 0)
+    monkeypatch.setattr(rpc, "rpc_sync", fake_rpc_sync)
+    dist.send(np.ones(3, np.float32), dst=1, tag=1, timeout=3.5)
+    assert seen == [3.5]
+    dist.send(np.ones(3, np.float32), dst=1, tag=1)   # default stays finite
+    assert seen[-1] is not None and seen[-1] > 0

@@ -489,6 +489,44 @@ def test_block_pool_reset_and_abort():
     assert pool.stats()["blocks_stored"] == 2   # cumulative survives
 
 
+def test_block_pool_abort_without_plan_releases_pins():
+    """tpu_lint R9 regression: a failure between lookup and plan_store
+    has pins but no plan yet — abort(hit) alone must release them so
+    the blocks stay evictable."""
+    pool = BlockPool(_SpecModel(), block_tokens=4, max_bytes=1 << 20)
+    toks = np.arange(14, dtype=np.int32)
+    _commit_tokens(pool, toks)
+    hit = pool.lookup(toks)
+    assert hit.tokens == 12
+    assert pool.stats()["blocks_pinned"] == 3
+    pool.abort(hit)                          # no plan: pins only
+    assert pool.stats()["blocks_pinned"] == 0
+
+
+def test_plan_hit_failure_path_releases_pins(monkeypatch):
+    """tpu_lint R9 regression (the self-application fix): a raise out
+    of plan_store inside `_plan_hit` must abort the lookup's pins —
+    pre-fix they leaked forever, making the pool unevictable."""
+    from types import SimpleNamespace
+
+    from paddle_tpu.serving.engine import ContinuousBatchingEngine
+
+    pool = BlockPool(_SpecModel(), block_tokens=4, max_bytes=1 << 20)
+    toks = np.arange(14, dtype=np.int32)
+    _commit_tokens(pool, toks)
+
+    def boom(*a, **k):
+        raise RuntimeError("planner down")
+
+    monkeypatch.setattr(pool, "plan_store", boom)
+    fake = SimpleNamespace(pool=pool, max_length=64,
+                           bucket_for_prompt=lambda n: 32)
+    with pytest.raises(RuntimeError, match="planner down"):
+        ContinuousBatchingEngine._plan_hit(fake, toks,
+                                           int(toks.shape[0]))
+    assert pool.stats()["blocks_pinned"] == 0
+
+
 def test_gather_scatter_cache_blocks_roundtrip():
     """The paged-pool primitives (generation.py): scatter a cache row
     into pool blocks, gather it back at the same indices — identical;

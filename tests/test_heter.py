@@ -155,3 +155,23 @@ def test_heter_pipeline_rpc_workers(tmp_path):
             assert w.wait(timeout=60) == 0
     finally:
         os.unlink(stage_mod)
+
+
+def test_rpc_executor_bounds_stage_calls(monkeypatch):
+    """tpu_lint R11 regression: the heter RPC executor passes its
+    rpc_timeout into every stage call (a dead heter worker must fail
+    the micro-batch at the trainer's deadline, not hang 120s)."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.heter import _RpcExecutor
+
+    seen = []
+
+    def fake_rpc_async(to, fn, args=None, kwargs=None, timeout=None, **kw):
+        seen.append((to, timeout))
+        return "fut"
+
+    monkeypatch.setattr(rpc, "rpc_async", fake_rpc_async)
+    ex = _RpcExecutor(lambda b: b, ["w1", "w2"], rpc_timeout=7.0)
+    assert ex.submit([1]) == "fut"
+    assert ex.submit([2]) == "fut"
+    assert seen == [("w1", 7.0), ("w2", 7.0)]

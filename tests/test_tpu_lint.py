@@ -1,13 +1,14 @@
 """tpu_lint: trace-discipline static analysis.
 
-Per rule (R1–R8): >=2 true-positive fixtures modeled on real (pre-fix)
+Per rule (R1–R11): >=2 true-positive fixtures modeled on real (pre-fix)
 defect shapes from this repo, plus >=1 false-positive guard proving the
 idioms the codebase relies on stay clean. Then the policy layer
 (mandatory suppression reasons, baseline accept/new/stale semantics), the
-incremental engine (content-hash cache invalidation, ``--changed-only``),
-the CLI exit codes, and a whole-repo smoke run against the checked-in
-baseline asserting zero NEW findings (plus the real lock graph naming
-the serving/lora acquisition edges).
+incremental engine (content-hash cache invalidation, ``--changed-only``,
+the cache-schema bump), the SARIF round-trip, the CLI exit codes, and a
+whole-repo smoke run against the checked-in baseline asserting zero NEW
+findings (plus the real lock graph naming the serving/lora acquisition
+edges and the real lifecycle graph naming the engine pin sites).
 
 Everything here is pure-AST over tmp fixture trees — no jit, no device
 work — so the module stays far under the tier-1 time budget (the one
@@ -1015,6 +1016,523 @@ def test_r8_legal_shapes_are_clean(tmp_path):
     assert rules_at(fs, "R8") == []
 
 
+# ================================================================== R9
+def test_r9_risky_call_between_acquire_and_guard(tmp_path):
+    # pre-fix ContinuousBatchingEngine._plan_hit shape: the lookup pins
+    # blocks, then a project helper that can raise runs BEFORE any
+    # try/abort — the exception path leaks the pins
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def commit(self, hit, plan, t): ...
+            def abort(self, hit, plan=None): ...
+            def plan_store(self, toks, m): ...
+
+        def bucket_for(n):
+            raise ValueError(n)
+
+        def plan_hit(prompt):
+            pool = BlockPool()
+            hit = pool.lookup(prompt)
+            m = bucket_for(len(prompt))
+            plan = pool.plan_store(prompt, m)
+            return hit, plan
+    """)
+    r9 = rules_at(fs, "R9")
+    assert any("can raise while `hit`" in f.message
+               and "exception path leaks" in f.message for f in r9)
+
+
+def test_r9_one_hop_transfer_and_return_leak(tmp_path):
+    # the helper transfers ownership to its caller (one interprocedural
+    # hop, like R6): the CALLER's unguarded risky call flags, and an
+    # early return that drops the resource flags too
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def commit(self, hit, plan, t): ...
+            def abort(self, hit, plan=None): ...
+
+        def plan_hit(pool, prompt):
+            hit = pool.lookup(prompt)
+            return hit
+
+        def dispatch(x):
+            raise RuntimeError(x)
+
+        def admit(prompt):
+            pool = BlockPool()
+            hit = plan_hit(pool, prompt)
+            out = dispatch(prompt)
+            pool.commit(hit, None, out)
+
+        def admit_dropping(prompt):
+            pool = BlockPool()
+            hit = plan_hit(pool, prompt)
+            if prompt is None:
+                return None
+            pool.commit(hit, None, None)
+    """)
+    r9 = rules_at(fs, "R9")
+    assert any(f.symbol == "admit" and "can raise" in f.message
+               for f in r9)
+    assert any(f.symbol == "admit_dropping" and "returns" in f.message
+               for f in r9)
+
+
+def test_r9_adapter_pin_discarded_and_staged_tmp(tmp_path):
+    fs = lint(tmp_path, """
+        import os
+
+        class AdapterStore:
+            def acquire(self, name): ...
+            def release(self, slot): ...
+
+        def warm(store: AdapterStore, name):
+            store.acquire(name)     # pin discarded: nothing can release
+
+        def publish(path, raw):
+            tmp = path + ".tmp1"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            if not raw:
+                return False        # staged file never published here
+            os.replace(tmp, path)
+            return True
+    """)
+    r9 = rules_at(fs, "R9")
+    assert any(f.symbol == "warm" and "discarded" in f.message
+               for f in r9)
+    assert any(f.symbol == "publish" and "staged .tmp" in f.message
+               for f in r9)
+
+
+def test_r9_release_in_handler_of_terminating_try_is_clean(tmp_path):
+    # the body raises on every path; the handler that releases and
+    # completes normally must actually CLEAR the resource (a dict.update
+    # merge used to resurrect it, flagging the later return)
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def abort(self, hit, plan=None): ...
+
+        def salvage(pool: BlockPool, prompt):
+            hit = pool.lookup(prompt)
+            try:
+                raise ValueError(prompt)
+            except ValueError:
+                pool.abort(hit)
+            return None
+    """)
+    assert rules_at(fs, "R9") == []
+
+
+def test_r9_acquire_and_return_inside_retry_loop_is_clean(tmp_path):
+    # acquire-and-transfer inside a poll/retry loop: the return hands
+    # ownership out; the loop's second symbolic iteration must not
+    # resurrect the resource as a rebind/exit leak
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def abort(self, hit, plan=None): ...
+
+        def poll(pool: BlockPool, prompt):
+            while True:
+                hit = pool.lookup(prompt)
+                return hit
+    """)
+    assert rules_at(fs, "R9") == []
+
+
+def test_r9_finally_release_covers_return_inside_try(tmp_path):
+    # the canonical try/finally shape: the finally runs on the return
+    # too, so the release IS reachable from it
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def abort(self, hit, plan=None): ...
+
+        def compute(p):
+            raise RuntimeError(p)
+
+        def with_finally(pool: BlockPool, prompt):
+            hit = pool.lookup(prompt)
+            try:
+                return compute(prompt)
+            finally:
+                pool.abort(hit)
+    """)
+    assert rules_at(fs, "R9") == []
+
+
+def test_r9_abort_in_except_and_trim_rebind_are_clean(tmp_path):
+    # the FIXED admission discipline: abort-in-except IS a release,
+    # commit on success releases, a neutral trim() rebind keeps the
+    # resource alive, and a staged tmp that always publishes is clean
+    fs = lint(tmp_path, """
+        import os
+
+        class BlockPool:
+            def lookup(self, toks): ...
+            def trim(self, hit, n): ...
+            def plan_store(self, toks, m): ...
+            def commit(self, hit, plan, t): ...
+            def abort(self, hit, plan=None): ...
+
+        def dispatch(x):
+            raise RuntimeError(x)
+
+        def admit(prompt):
+            pool = BlockPool()
+            hit = pool.lookup(prompt)
+            try:
+                hit = pool.trim(hit, 8)
+                plan = pool.plan_store(prompt, 8)
+                out = dispatch(prompt)
+            except Exception:
+                pool.abort(hit)
+                raise
+            pool.commit(hit, plan, out)
+
+        def publish(path, raw):
+            tmp = path + ".tmp1"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, path)
+    """)
+    assert rules_at(fs, "R9") == []
+
+
+# ================================================================= R10
+def test_r10_collective_under_rank_branch(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from jax import lax
+
+        def reduce_metrics(x):
+            if jax.process_index() == 0:
+                x = lax.psum(x, "dp")
+            return x
+    """)
+    r10 = rules_at(fs, "R10")
+    assert any("rank-dependent" in f.message
+               and "deadlock" in f.message for f in r10)
+
+
+def test_r10_asymmetric_sequences_and_tainted_loop(tmp_path):
+    # if-arm issues 2 collectives, else-arm 1 => ordering mismatch; and
+    # a loop whose trip count came from a rank source
+    fs = lint(tmp_path, """
+        import os
+        import jax
+        from jax import lax
+
+        def step(x):
+            r = jax.process_index()
+            if r == 0:
+                x = lax.psum(x, "dp")
+                x = lax.all_gather(x, "dp")
+            else:
+                x = lax.psum(x, "dp")
+            return x
+
+        def sweep(x):
+            n = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            for _ in range(n):
+                x = lax.psum(x, "dp")
+            return x
+    """)
+    r10 = rules_at(fs, "R10")
+    assert any(f.symbol == "step"
+               and "different collective sequences" in f.message
+               for f in r10)
+    assert any(f.symbol == "sweep" and "trip count" in f.message
+               for f in r10)
+
+
+def test_r10_early_exit_skips_later_collective(tmp_path):
+    # the early-returning ranks never reach the psum below — through a
+    # project WRAPPER (the distributed/ collective.py shape), so the
+    # transitive collective signature must register
+    fs = lint(tmp_path, """
+        import jax
+        from jax import lax
+
+        def all_reduce(t):
+            return lax.psum(t, "dp")
+
+        def aggregate(x):
+            if jax.process_index() != 0:
+                return x
+            return all_reduce(x)
+    """)
+    r10 = rules_at(fs, "R10")
+    assert any("early exit skips" in f.message for f in r10)
+
+
+def test_r10_early_return_matching_fall_through_is_clean(tmp_path):
+    # every rank issues exactly one psum whichever path it takes — the
+    # early-return arm must be compared against arm+suffix, not against
+    # the other arm alone
+    fs = lint(tmp_path, """
+        import jax
+        from jax import lax
+
+        def reduce_either_way(x):
+            if jax.process_index() == 0:
+                return lax.psum(x, "dp")
+            return lax.psum(x, "dp")
+    """)
+    assert rules_at(fs, "R10") == []
+
+
+def test_r10_early_return_with_extra_collective_is_flagged(tmp_path):
+    # the exiting arm runs ONE rendezvous, the continuing path TWO —
+    # schedules diverge even though both arms "have collectives"
+    fs = lint(tmp_path, """
+        import jax
+        from jax import lax
+
+        def skewed(x):
+            if jax.process_index() == 0:
+                return lax.psum(x, "dp")
+            x = lax.psum(x, "dp")
+            return lax.all_gather(x, "dp")
+    """)
+    r10 = rules_at(fs, "R10")
+    assert any("different rendezvous schedules" in f.message
+               for f in r10)
+
+
+def test_r10_uniform_suffix_branches_and_nested_defs_are_clean(tmp_path):
+    # the suffix after a rank-gated early return is compared
+    # path-sensitively: a uniform if/else downstream where EVERY path
+    # issues one psum must not double-count, and a nested def's
+    # collective is not the enclosing function's
+    fs = lint(tmp_path, """
+        import jax
+        from jax import lax
+
+        def one_psum_every_path(x, training):
+            if jax.process_index() == 0:
+                return lax.psum(x, "dp")
+            if training:
+                return lax.psum(x, "dp")
+            return lax.psum(x * 2, "dp")
+
+        def only_nested_collective(x):
+            if jax.process_index() != 0:
+                return x
+            def helper(y):
+                return lax.psum(y, "dp")
+            return helper
+    """)
+    assert rules_at(fs, "R10") == []
+
+
+def test_r10_same_collectives_both_arms_is_clean(tmp_path):
+    # every rank still rendezvouses (same ops, same order): clean; a
+    # rank-0 branch with NO collectives (checkpoint gating) is clean;
+    # and a uniform (rank-independent) condition may differ freely
+    fs = lint(tmp_path, """
+        import jax
+        from jax import lax
+
+        def masked(x):
+            r = jax.process_index()
+            if r == 0:
+                y = lax.psum(x, "dp")
+            else:
+                y = lax.psum(x * 0, "dp")
+            return y
+
+        def save_gate(x, path):
+            if jax.process_index() == 0:
+                open(path, "w").write(str(len(x)))
+            return x
+
+        def uniform(x, training):
+            if training:
+                x = lax.psum(x, "dp")
+            return x
+    """)
+    assert rules_at(fs, "R10") == []
+
+
+# ================================================================= R11
+def test_r11_unbounded_rpc_and_deadline_threading(tmp_path):
+    # the bare call rides the 120s transport default: flagged; the
+    # helper that threads its caller's timeout/Deadline is clean
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed import rpc
+
+        def work(x):
+            return x
+
+        def bad(x):
+            return rpc.rpc_sync("w", work, args=(x,))
+
+        def good(x, timeout):
+            return rpc.rpc_sync("w", work, args=(x,), timeout=timeout)
+
+        def good_deadline(x, budget):
+            return rpc.rpc_sync("w", work, args=(x,),
+                                deadline=budget)
+    """)
+    r11 = rules_at(fs, "R11")
+    assert len(r11) == 1
+    assert r11[0].symbol == "bad" and "default timeout" in r11[0].message
+
+
+def test_r11_non_idempotent_under_retry_policy(tmp_path):
+    # the RemoteReplica invariant: submit through a multi-attempt retry
+    # kwarg flags; through the single-attempt policy it is clean
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed.resilience import RetryPolicy
+
+        def _host_submit(name, kwargs):
+            ...
+
+        class Replica:
+            def __init__(self):
+                self._retry = RetryPolicy(max_attempts=3)
+                self._no_retry = RetryPolicy(max_attempts=1)
+
+            def _call(self, fn, *args, retry=None):
+                ...
+
+            def submit_bad(self, kwargs):
+                return self._call(_host_submit, kwargs,
+                                  retry=self._retry)
+
+            def submit_ok(self, kwargs):
+                return self._call(_host_submit, kwargs,
+                                  retry=self._no_retry)
+    """)
+    r11 = rules_at(fs, "R11")
+    assert len(r11) == 1
+    assert r11[0].symbol == "Replica.submit_bad"
+    assert "max_attempts=3" in r11[0].message
+
+
+def test_r11_non_literal_max_attempts_is_unresolvable_not_uncapped(
+        tmp_path):
+    # max_attempts present but not a literal: the analyzer must stay
+    # silent (unresolvable), not report "no attempt cap"; positional
+    # literal 1 is single-attempt and clean too
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed.resilience import RetryPolicy
+
+        def _host_submit(x):
+            ...
+
+        class Replica:
+            def __init__(self, attempts=1):
+                self._retry = RetryPolicy(max_attempts=attempts)
+                self._one = RetryPolicy(1)
+
+            def _call(self, fn, *args, retry=None):
+                ...
+
+            def submit_param(self, kwargs):
+                return self._call(_host_submit, kwargs,
+                                  retry=self._retry)
+
+            def submit_pos_one(self, kwargs):
+                return self._call(_host_submit, kwargs,
+                                  retry=self._one)
+    """)
+    assert rules_at(fs, "R11") == []
+
+
+def test_r11_submit_inside_retried_callable_and_annotation(tmp_path):
+    # a submit-shaped rpc inside a policy.call() closure flags; the
+    # same shape with an `rpc-idempotent` annotation on the def is the
+    # documented opt-out
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.resilience import RetryPolicy
+
+        def _host_submit(x):
+            ...
+
+        def _host_submit_probe(x):  # tpu-lint: rpc-idempotent
+            ...
+
+        def resend(x):
+            policy = RetryPolicy(deadline=5.0)
+            def once():
+                return rpc.rpc_sync("w", _host_submit, args=(x,),
+                                    timeout=1.0)
+            return policy.call(once)
+
+        def reprobe(x):
+            policy = RetryPolicy(deadline=5.0)
+            def once():
+                return rpc.rpc_sync("w", _host_submit_probe, args=(x,),
+                                    timeout=1.0)
+            return policy.call(once)
+    """)
+    r11 = rules_at(fs, "R11")
+    assert len(r11) == 1
+    assert r11[0].symbol == "resend" and "double-submits" in r11[0].message
+
+
+def test_r11_swallowed_transport_error(tmp_path):
+    # a pass-only handler hides the dead peer; re-raising as a
+    # classified error is the clean shape, and a ConnectionError
+    # swallow in NON-rpc code is out of scope
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed import rpc
+
+        class RpcTransportError(ConnectionError):
+            ...
+
+        def work(x):
+            return x
+
+        def bad_poll(x):
+            try:
+                return rpc.rpc_sync("w", work, args=(x,), timeout=1.0)
+            except RpcTransportError:
+                pass
+
+        def good_poll(x):
+            try:
+                return rpc.rpc_sync("w", work, args=(x,), timeout=1.0)
+            except RpcTransportError as e:
+                raise ConnectionError(f"peer gone: {e}")
+
+        def local_cleanup(path):
+            try:
+                open(path).close()
+            except ConnectionError:
+                pass
+    """)
+    r11 = rules_at(fs, "R11")
+    assert len(r11) == 1
+    assert r11[0].symbol == "bad_poll" and "swallows" in r11[0].message
+
+
+def test_r11_hand_rolled_retry_loop_around_submit(tmp_path):
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed import rpc
+
+        def _host_submit(x):
+            ...
+
+        def stubborn(x):
+            while True:
+                try:
+                    return rpc.rpc_sync("w", _host_submit, args=(x,),
+                                        timeout=1.0)
+                except ConnectionError:
+                    continue
+    """)
+    r11 = rules_at(fs, "R11")
+    assert any("retried by the loop" in f.message for f in r11)
+
+
 # ======================================================= incremental
 def _git(cwd, *args):
     subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
@@ -1033,7 +1551,7 @@ def test_cache_hit_and_invalidation(tmp_path, monkeypatch, capsys):
 
     assert cli.main(["pkg", "--json", "--no-baseline"]) == 0
     d1 = json.loads(capsys.readouterr().out)
-    assert d1["schema_version"] == 2
+    assert d1["schema_version"] == 3
     assert d1["cache"]["hit"] is False
     # fresh runs carry the timing block: per-file parse/lint ms + rules
     assert "pkg/mod.py" in d1["timing"]["files"]
@@ -1117,15 +1635,18 @@ def test_changed_only_lints_just_the_diff(tmp_path, monkeypatch, capsys):
     assert d1["cache"]["closure_files"] >= 3
     assert {f["path"] for f in d1["new_findings"]} == {"pkg/b.py"}
 
-    # clean diff => clean exit (even over a stale cache: "nothing
-    # uncommitted" is a valid pre-commit answer)
+    # clean diff => the WHOLE-tree verdict (cache-served when fresh,
+    # re-analyzed when the committed tree drifted) — committing a
+    # violation and running the gate on the clean checkout must still
+    # fail; "no changed files" is not "no findings"
     _git(tmp_path, "add", ".")
     _git(tmp_path, "commit", "-qm", "wip")
     assert cli.main(["pkg", "--json", "--no-baseline",
-                     "--changed-only"]) == 0
+                     "--changed-only"]) == 1
     d2 = json.loads(capsys.readouterr().out)
     assert d2["cache"]["changed"] == []
-    assert d2["new_findings"] == []
+    assert "empty diff" in d2["cache"]["mode"]
+    assert {f["path"] for f in d2["new_findings"]} == {"pkg/b.py"}
 
     # but a NON-empty diff over a cache whose unchanged side drifted
     # (e.g. a pull landed commits since the last full run) must fall
@@ -1150,12 +1671,99 @@ def test_changed_only_lints_just_the_diff(tmp_path, monkeypatch, capsys):
     assert "fallback" in d3["cache"]["mode"]
     assert "stale" in d3["cache"]["mode"]
 
+    # once the tree is ACTUALLY clean, the empty-diff path is a
+    # cache-served whole-tree OK
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        from pkg.a import helper
 
-def test_baseline_v1_is_rejected_with_migration_pointer(tmp_path):
-    p = tmp_path / "bl.json"
-    p.write_text('{"version": 1, "findings": {"R2|x|y|z": 1}}')
-    with pytest.raises(ValueError, match="MIGRATION"):
-        load_baseline(str(p))
+        def use(x):
+            return helper(x)
+    """))
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "fix")
+    assert cli.main(["pkg", "--json", "--no-baseline",
+                     "--changed-only"]) == 0
+    d4 = json.loads(capsys.readouterr().out)
+    assert d4["cache"]["changed"] == [] and d4["new_findings"] == []
+
+
+def test_stale_baseline_versions_are_rejected_with_migration_pointer(
+        tmp_path):
+    # v3 re-keyed the baseline: a v2 file silently asserts "no R9–R11
+    # findings were accepted" without anyone having triaged them, so
+    # both old versions are hard-rejected
+    for version in (1, 2):
+        p = tmp_path / f"bl{version}.json"
+        p.write_text('{"version": %d, "findings": {"R2|x|y|z": 1}}'
+                     % version)
+        with pytest.raises(ValueError, match="MIGRATION"):
+            load_baseline(str(p))
+
+
+def test_cache_schema_bump_invalidates_old_entries(tmp_path, monkeypatch,
+                                                   capsys):
+    """A cache entry written by an older cache schema must be ignored
+    (full re-analysis), never mis-served — the schema_version 3 release
+    bumped CACHE_SCHEMA for the lifecycle_graph block."""
+    cli = _load_cli()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("def f(x):\n    return x\n")
+    monkeypatch.setattr(cli, "REPO", str(tmp_path))
+    assert cli.main(["pkg", "--json", "--no-baseline"]) == 0
+    capsys.readouterr()
+    cache_dir = tmp_path / ".tpu_lint_cache"
+    entries = list(cache_dir.glob("run_*.json"))
+    assert entries
+    data = json.loads(entries[0].read_text())
+    assert data["schema"] >= 2 and "lifecycle_graph" in data
+    data["schema"] = 1                      # a pre-bump entry
+    entries[0].write_text(json.dumps(data))
+    assert cli.main(["pkg", "--json", "--no-baseline"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["cache"]["hit"] is False       # re-analyzed, not served
+    # and the refreshed entry is back on the current schema
+    data = json.loads(entries[0].read_text())
+    assert data["schema"] >= 2
+
+
+def test_sarif_round_trips_against_json(tmp_path, monkeypatch, capsys):
+    """--sarif carries exactly the --json findings: same rules, paths,
+    lines, and baseline keys; `properties.new` mirrors new_findings."""
+    cli = _load_cli()
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x, n):
+            if n > 0:
+                return x
+            return x.item()
+    """))
+    monkeypatch.setattr(cli, "REPO", str(tmp_path))
+    assert cli.main([str(bad), "--no-baseline", "--json"]) == 1
+    d = json.loads(capsys.readouterr().out)
+    sarif_path = tmp_path / "out.sarif"
+    assert cli.main([str(bad), "--no-baseline", "--sarif",
+                     str(sarif_path)]) == 1
+    capsys.readouterr()
+    sarif = json.loads(sarif_path.read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"R1", "R2", "R9", "R10", "R11"} <= rule_ids
+    results = run["results"]
+    want = {(f["rule"], f["path"], f["line"], f["key"])
+            for f in d["findings"]}
+    got = {(r["ruleId"],
+            r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"],
+            r["partialFingerprints"]["tpuLintKey"]) for r in results}
+    assert got == want
+    assert sum(r["properties"]["new"] for r in results) == \
+        len(d["new_findings"])
+    assert all(r["level"] == "error" for r in results)  # all NEW here
 
 
 # ==================================================== CLI + repo smoke
@@ -1195,6 +1803,11 @@ def test_cli_nonzero_on_injected_violation(tmp_path, monkeypatch, capsys):
     # --update-baseline over a subtree would erase the accepted entries
     # outside it; the CLI must refuse
     assert cli.main([str(bad), "--update-baseline"]) == 2
+
+    # --update-baseline returns before findings gate, so a combined
+    # --sarif would silently write nothing: refused loudly instead
+    assert cli.main(["--update-baseline", "--sarif",
+                     str(tmp_path / "x.sarif")]) == 2
 
 
 def test_repo_is_clean_under_checked_in_baseline(capsys):
@@ -1240,3 +1853,41 @@ def test_repo_lock_graph_names_serving_and_lora_edges(capsys):
                for e in lg["edges"])
     # timing rides the same JSON (warm runs report the cached-run block)
     assert "timing" in data and data["timing"]
+
+
+def test_repo_lifecycle_graph_names_engine_pin_sites(capsys):
+    """The R9 acceptance shape: the --json lifecycle graph carries the
+    REAL acquire/release sites of the admission pin discipline — the
+    pool lookup inside `_plan_hit`, `admit`'s one-hop acquire THROUGH
+    `_plan_hit`, the adapter-pin acquire, and the commit/abort/release
+    pairs. (Rides the whole-repo cache the smoke test warmed.)"""
+    cli = _load_cli()
+    rc = cli.main(["--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    lg = data["lifecycle_graph"]
+    protos = {p["name"] for p in lg["protocols"]}
+    assert {"block-pin", "adapter-pin", "staged-file"} <= protos
+    acq = lg["acquires"]
+    assert any(a["protocol"] == "block-pin"
+               and a["function"] == "ContinuousBatchingEngine._plan_hit"
+               for a in acq)
+    # the one-hop ownership transfer is recorded with its via chain
+    assert any(a["protocol"] == "block-pin"
+               and a["function"] == "ContinuousBatchingEngine.admit"
+               and a["via"] == "self._plan_hit" for a in acq)
+    assert any(a["protocol"] == "adapter-pin"
+               and a["function"] == "ContinuousBatchingEngine.admit"
+               for a in acq)
+    rel = lg["releases"]
+    eng = [r for r in rel
+           if r["file"] == "paddle_tpu/serving/engine.py"]
+    assert {"commit", "abort"} <= {r["method"] for r in eng
+                                   if r["protocol"] == "block-pin"}
+    assert any(r["protocol"] == "adapter-pin" and r["method"] == "release"
+               for r in eng)
+    # tmp-stage→publish sites are first-class protocol sites too (the
+    # flight recorder's crash-safe dump is the canonical one)
+    assert any(a["protocol"] == "staged-file"
+               and a["file"] == "paddle_tpu/observability/flight.py"
+               for a in acq)

@@ -62,7 +62,7 @@ def scenario_rpc() -> None:
         s.bind(("127.0.0.1", 0))
         ep = f"127.0.0.1:{s.getsockname()[1]}"
     rpc.init_rpc(name="solo", rank=0, world_size=1, master_endpoint=ep)
-    assert rpc.rpc_sync("solo", int, args=(7,)) == 7
+    assert rpc.rpc_sync("solo", int, args=(7,), timeout=30.0) == 7
     rpc.shutdown(timeout=10.0)
 
 

@@ -208,7 +208,7 @@ def parent_main(args) -> int:
                                 "times": None, "delay": 0.08}], seed=5)
         for name in sorted(replicas):
             rpc.rpc_sync(name, remote_mod._host_install_plan,
-                         args=(load_plan.to_json(),))
+                         args=(load_plan.to_json(),), timeout=15.0)
         # saturate first (no deadlines) so every host's admission-
         # cadence EWMA is warm — and measured UNDER the load the burst
         # will see — before the deadline'd burst arrives
@@ -313,7 +313,7 @@ def parent_main(args) -> int:
                      f"no request waited out its deadline "
                      f"(expired={fleet_expired}, host sheds={fleet_shed})")
         for name in sorted(replicas):   # restore full speed everywhere
-            rpc.rpc_sync(name, remote_mod._host_clear_plan)
+            rpc.rpc_sync(name, remote_mod._host_clear_plan, timeout=15.0)
         log(f"overload done at {time.monotonic() - t_start:.0f}s")
 
         # ---- phase 3: slow replica -> hedge, token-identical ---------
@@ -323,14 +323,14 @@ def parent_main(args) -> int:
         slow_plan = FaultPlan([{"site": "serve.step", "kind": "slow",
                                 "times": None, "delay": 4.0}], seed=11)
         rpc.rpc_sync("r3", remote_mod._host_install_plan,
-                     args=(slow_plan.to_json(),))
+                     args=(slow_plan.to_json(),), timeout=15.0)
         p = prompt(12)
         want = solo(p, 8, seed=555)
         hedged_before = router.requests_hedged
         h = router.submit(p, max_new_tokens=8, do_sample=True,
                           temperature=0.8, seed=555, prefer="r3")
         got = h.result(timeout=120)
-        rpc.rpc_sync("r3", remote_mod._host_clear_plan)
+        rpc.rpc_sync("r3", remote_mod._host_clear_plan, timeout=15.0)
         check.expect(np.array_equal(got, want),
                      "hedged stream token-identical to solo")
         check.expect(router.requests_hedged > hedged_before,

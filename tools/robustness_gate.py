@@ -2,18 +2,23 @@
 """Robustness gate: ONE command CI can block on for the fault-tolerance
 story. Runs, in order:
 
-0. ``tools/tpu_lint.py --json --baseline .tpu_lint_baseline.json`` — the
-   static trace-discipline analyzer (host syncs, retrace hazards,
-   donation misuse, PRNG reuse, lock bypasses, lock-order/deadlock,
-   blocking-under-lock, sharding discipline — R1–R8). ONE whole-repo run
+0. ``tools/tpu_lint.py --json --changed-only --baseline
+   .tpu_lint_baseline.json`` — the static trace-discipline analyzer
+   (host syncs, retrace hazards, donation misuse, PRNG reuse, lock
+   bypasses, lock-order/deadlock, blocking-under-lock, sharding
+   discipline, resource-lifecycle leaks, SPMD collective divergence,
+   rpc deadline/idempotence — R1–R11). The stage rides the
+   ``.tpu_lint_cache/`` incremental engine by default (git diff +
+   one-hop import closure; the tool falls back to — and refreshes —
+   a full run whenever the cache is missing or the unchanged tree
+   drifted); ``--full-lint`` forces the whole-repo run. One stage
    covers every package, replacing the per-subsystem scoped runs the
-   ``--lora``/``--observability`` stages used to carry; the stage prints
-   a per-package parse/lint timing roll-up from the ``--json`` timing
+   ``--lora``/``--observability`` stages used to carry; it prints a
+   per-package parse/lint timing roll-up from the ``--json`` timing
    block so lint-perf regressions are visible in CI logs. First because
    it is the cheapest stage by two orders of magnitude (seconds cold,
-   milliseconds on a warm ``.tpu_lint_cache/``): a NEW unbaselined
-   finding fails the gate before any soak spends minutes proving the
-   same bug at runtime;
+   milliseconds warm): a NEW unbaselined finding fails the gate before
+   any soak spends minutes proving the same bug at runtime;
 1. ``tools/chaos_soak.py --quick`` — the self-healing train loop under
    NaN batches, a step stall, and a kill-and-restart (fails on any
    unrecovered fault, loss divergence beyond tolerance, or a steady-state
@@ -104,14 +109,24 @@ def _package_of(rel: str) -> str:
     return "/".join(parts[:2]) if len(parts) > 2 else parts[0]
 
 
-def _run_lint() -> bool:
-    """ONE whole-repo tpu_lint run (R1–R8, baseline-gated) with a
-    per-package parse/lint timing roll-up — the unified replacement for
-    the scoped per-subsystem runs the --lora/--observability stages used
-    to carry."""
+def _run_lint(full: bool = False) -> bool:
+    """ONE tpu_lint run (R1–R11, baseline-gated) with a per-package
+    parse/lint timing roll-up — the unified replacement for the scoped
+    per-subsystem runs the --lora/--observability stages used to carry.
+
+    Default is ``--changed-only``: the gate's lint step rides the
+    ``.tpu_lint_cache/`` incremental engine (git diff + one-hop import
+    closure) instead of re-linting every file — sub-second on a typical
+    diff, and the tool itself falls back to a full run (refreshing the
+    cache) whenever the cache is missing or the unchanged tree drifted.
+    ``--full-lint`` forces the whole-repo run (the nightly/CI-trunk
+    setting, and the one that refreshes the cache everyone else rides).
+    """
     name = "tpu_lint"
     cmd = [sys.executable, os.path.join(TOOLS, "tpu_lint.py"), "--json",
            "--baseline", os.path.join(REPO, ".tpu_lint_baseline.json")]
+    if not full:
+        cmd.append("--changed-only")
     print(f"[robustness_gate] === {name}: {' '.join(cmd[1:])}", flush=True)
     t0 = time.monotonic()
     env = dict(os.environ, PYTHONPATH=REPO)
@@ -188,11 +203,16 @@ def main() -> int:
                          "tracing overhead)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
+    ap.add_argument("--full-lint", action="store_true",
+                    help="force a whole-repo lint (default: "
+                         "--changed-only riding the incremental cache; "
+                         "the tool falls back to a full run on its own "
+                         "when the cache is missing or stale)")
     args = ap.parse_args()
 
     results = {}
     if not args.skip_lint:
-        results["tpu_lint"] = _run_lint()
+        results["tpu_lint"] = _run_lint(full=args.full_lint)
     elif args.lora or args.observability:
         # the scoped per-subsystem lints folded into stage 0; skipping
         # it now skips THEIR lint coverage too — say so loudly instead

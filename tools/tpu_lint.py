@@ -4,8 +4,10 @@
 Catches, before runtime: host syncs in trace-reachable/hot code (R1),
 retrace hazards (R2), donation-after-use (R3), PRNG key reuse (R4),
 unguarded shared state in threaded classes (R5), lock-order cycles and
-non-reentrant re-entry (R6), blocking work under held locks (R7), and
-mesh-axis/sharding discipline (R8). Pure-AST: no jax import, no backend.
+non-reentrant re-entry (R6), blocking work under held locks (R7),
+mesh-axis/sharding discipline (R8), exception-path resource-lifecycle
+leaks (R9), SPMD collective divergence (R10), and rpc deadline/
+idempotence discipline (R11). Pure-AST: no jax import, no backend.
 
     python tools/tpu_lint.py                          # paddle_tpu + tools
     python tools/tpu_lint.py paddle_tpu/serving       # a subtree
@@ -13,6 +15,7 @@ mesh-axis/sharding discipline (R8). Pure-AST: no jax import, no backend.
     python tools/tpu_lint.py --baseline .tpu_lint_baseline.json
     python tools/tpu_lint.py --baseline ... --update-baseline
     python tools/tpu_lint.py --json                   # machine-readable
+    python tools/tpu_lint.py --sarif out.sarif        # CI PR annotations
     python tools/tpu_lint.py --list-rules
 
 Incremental engine: full runs persist a content-hash result cache under
@@ -23,8 +26,12 @@ just their one-hop import closure — the sub-second pre-commit path (it
 falls back to a full run when no cache exists yet). ``--no-cache``
 disables both. ``--json`` carries ``schema_version``, a ``timing`` block
 (per-file parse/lint ms, per-rule totals), the R6 ``lock_graph`` (lock
-nodes, acquisition sites, held→acquired order edges), and a ``cache``
-block (hit/miss, mode, changed files).
+nodes, acquisition sites, held→acquired order edges), the R9
+``lifecycle_graph`` (protocols + per-function acquire/release sites),
+and a ``cache`` block (hit/miss, mode, changed files). ``--sarif PATH``
+writes the same findings as SARIF 2.1.0 so CI can annotate PR diffs
+(``-`` for stdout; NEW-vs-baseline status rides in each result's
+``properties.new``).
 
 Exit codes: 0 = clean (every finding suppressed or baselined);
 1 = NEW findings (beyond the baseline); 2 = usage error.
@@ -53,11 +60,52 @@ sys.path.insert(0, REPO)
 
 DEFAULT_PATHS = ("paddle_tpu", "tools")
 DEFAULT_BASELINE = os.path.join(REPO, ".tpu_lint_baseline.json")
-SCHEMA_VERSION = 2
+# 3: R9/R10/R11 rule families, the `lifecycle_graph` block, and the
+# baseline re-key (baseline format v3) — see MIGRATION.md
+SCHEMA_VERSION = 3
 
 
 def _emit_json(payload: dict) -> None:
     print(json.dumps(payload, indent=1))
+
+
+def to_sarif(findings, new_keys, rule_docs) -> dict:
+    """SARIF 2.1.0 for CI PR annotation. One result per finding;
+    ``partialFingerprints.tpuLintKey`` is the baseline key (stable
+    across line drift), ``properties.new`` marks findings beyond the
+    baseline — the ones a PR gate should comment on."""
+    results = []
+    for f in findings:
+        msg = f.message
+        if f.hint:
+            msg += f" (hint: {f.hint})"
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.key() in new_keys else "note",
+            "message": {"text": msg},
+            "partialFingerprints": {"tpuLintKey": f.key()},
+            "properties": {"new": f.key() in new_keys,
+                           "symbol": f.symbol,
+                           "chain": list(f.chain)},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": int(f.line)}}}],
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpu_lint",
+                "informationUri":
+                    "README.md#static-analysis-tpu_lint",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": doc}}
+                          for rid, doc in sorted(rule_docs.items())],
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -90,6 +138,9 @@ def main(argv=None) -> int:
     ap.add_argument("--cache-dir", default=None,
                     help="cache directory (default: "
                          "<repo>/.tpu_lint_cache)")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 (for CI "
+                         "PR annotation); '-' writes to stdout")
     args = ap.parse_args(argv)
 
     from paddle_tpu.analysis import (analyze, diff_baseline, load_baseline,
@@ -119,6 +170,13 @@ def main(argv=None) -> int:
         print("tpu_lint: --update-baseline needs the full view; drop "
               "--changed-only", file=sys.stderr)
         return 2
+    if args.update_baseline and args.sarif:
+        # the baseline rewrite returns before findings are gated, so a
+        # combined invocation would silently skip the SARIF write —
+        # reject loudly like the other --update-baseline combos
+        print("tpu_lint: --update-baseline does not emit SARIF; run "
+              "--sarif in a separate invocation", file=sys.stderr)
+        return 2
 
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline \
@@ -134,6 +192,7 @@ def main(argv=None) -> int:
     findings = None
     stats = None
     lock_graph = {}
+    lifecycle_graph = {}
     timing = {}
     changed = None
 
@@ -141,9 +200,9 @@ def main(argv=None) -> int:
         changed = git_changed_files(REPO, paths)
         entry = cache.cached_entry(paths) if cache is not None else None
         if entry is not None and changed:
-            # (an EMPTY diff short-circuits below without this check —
-            # "nothing uncommitted" is a clean pre-commit answer no
-            # matter how stale the cache is)
+            # (an EMPTY diff takes the whole-tree path below, where
+            # cache.load validates every digest itself — no staleness
+            # check needed here for that case)
             # the cached graph is only trustworthy for the UNCHANGED
             # side of the tree: if files outside the git diff drifted
             # since the last full run (a pull landed commits, a file
@@ -163,22 +222,16 @@ def main(argv=None) -> int:
             cache_info["mode"] = f"full (changed-only fallback: {why})"
             changed = None
         elif not changed:
-            elapsed = time.monotonic() - t0
-            cache_info.update(mode="changed-only", changed=[])
-            if args.as_json:
-                _emit_json({"schema_version": SCHEMA_VERSION,
-                            "stats": {}, "elapsed_s": round(elapsed, 3),
-                            "baseline": baseline_path, "cache": cache_info,
-                            "timing": {"total_ms":
-                                       round(elapsed * 1e3, 3)},
-                            "lock_graph": {}, "findings": [],
-                            "new_findings": [],
-                            "stale_baseline_keys": []})
-            else:
-                print(f"tpu_lint: no changed files under "
-                      f"{' '.join(paths)} ({elapsed:.2f}s)")
-                print("OK: no new findings")
-            return 0
+            # empty diff: there is no changed-file subset to gate, so
+            # the verdict is the WHOLE tree's — served from the cache
+            # when it matches (milliseconds), re-analyzed (and the
+            # cache refreshed) when the committed tree drifted. The
+            # old behavior ("nothing uncommitted" = instant OK) let a
+            # committed-but-never-linted violation pass a gate run on
+            # a clean checkout.
+            cache_info.update(mode="changed-only (empty diff: "
+                                   "whole-tree verdict)", changed=[])
+            changed = None
         else:
             # cached import graph for the unchanged side of the tree,
             # OVERLAID with the changed files' freshly parsed imports —
@@ -197,6 +250,7 @@ def main(argv=None) -> int:
             findings = [f for f in result.findings if f.path in keep]
             stats = result.stats()
             lock_graph = result.lock_graph
+            lifecycle_graph = result.lifecycle_graph
             timing = result.timing
 
     if findings is None:
@@ -207,6 +261,7 @@ def main(argv=None) -> int:
             findings = LintCache.findings_from(got)
             stats = got.get("stats", {})
             lock_graph = got.get("lock_graph", {})
+            lifecycle_graph = got.get("lifecycle_graph", {})
             timing = {"total_ms": round((time.monotonic() - t0) * 1e3, 3),
                       "cached_run": got.get("timing", {})}
         else:
@@ -214,10 +269,12 @@ def main(argv=None) -> int:
             findings = result.findings
             stats = result.stats()
             lock_graph = result.lock_graph
+            lifecycle_graph = result.lifecycle_graph
             timing = result.timing
             if cache is not None:
                 cache.store(paths, digests, findings, stats, lock_graph,
-                            result.project_imports(), timing)
+                            result.project_imports(), timing,
+                            lifecycle_graph=lifecycle_graph)
     elapsed = time.monotonic() - t0
 
     if args.update_baseline:
@@ -238,6 +295,15 @@ def main(argv=None) -> int:
     if changed is not None:
         stale = []      # a partial view cannot judge staleness
 
+    if args.sarif:
+        sarif = to_sarif(findings, {f.key() for f in new}, RULE_DOCS)
+        if args.sarif == "-":
+            print(json.dumps(sarif, indent=1))
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                json.dump(sarif, fh, indent=1)
+                fh.write("\n")
+
     if args.as_json:
         _emit_json({
             "schema_version": SCHEMA_VERSION,
@@ -247,6 +313,7 @@ def main(argv=None) -> int:
             "cache": cache_info,
             "timing": timing,
             "lock_graph": lock_graph,
+            "lifecycle_graph": lifecycle_graph,
             "findings": [f.as_dict() for f in findings],
             "new_findings": [f.as_dict() for f in new],
             "stale_baseline_keys": stale,
