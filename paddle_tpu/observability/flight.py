@@ -45,6 +45,13 @@ def _default_dir() -> str:
         ".", "flight_records")
 
 
+def _host_token() -> str:
+    """Short sanitized hostname for dump filenames: hosts sharing a
+    ``PT_FLIGHT_DIR`` (NFS, a bind-mounted artifact volume) must not
+    collide on pid alone — pids repeat across machines."""
+    return _sanitize(socket.gethostname())[:24] or "host"
+
+
 class FlightRecorder:
     """Per-process bounded event ring + crash-artifact writer."""
 
@@ -144,7 +151,7 @@ class FlightRecorder:
             os.makedirs(self.dump_dir, exist_ok=True)
             path = os.path.join(
                 self.dump_dir,
-                f"flight_{os.getpid()}_{serial:04d}_"
+                f"flight_{_host_token()}_{os.getpid()}_{serial:04d}_"
                 f"{_sanitize(reason)}.json")
             tmp = f"{path}.tmp{os.getpid()}"
             with open(tmp, "w") as f:

@@ -37,7 +37,7 @@ import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["MetricsRegistry", "default_registry", "labels_key",
-           "nearest_rank"]
+           "nearest_rank", "parse_qualified"]
 
 LabelsKey = Tuple[Tuple[str, str], ...]
 
@@ -66,6 +66,19 @@ def _qualified(name: str, lk: LabelsKey) -> str:
         return name
     inner = ",".join(f'{k}="{v}"' for k, v in lk)
     return f"{name}{{{inner}}}"
+
+
+_LABEL_RE = re.compile(r'([\w.:/-]+)="([^"]*)"')
+
+
+def parse_qualified(key: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of the label qualification snapshot keys carry:
+    ``'depth{replica="r1",server="s0"}' -> ("depth", {...})``. The fleet
+    roll-up uses it to re-label a scraped remote snapshot."""
+    if "{" not in key:
+        return key, {}
+    name, rest = key.split("{", 1)
+    return name, dict(_LABEL_RE.findall(rest.rstrip("}")))
 
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
@@ -136,6 +149,21 @@ class _Hist:
                 "max": round(self.max, 6)}
 
 
+class _FrozenHist:
+    """An already-summarized histogram absorbed from another process's
+    snapshot (the reservoir itself never crosses the wire); quacks just
+    enough of :class:`_Hist` for the export paths."""
+
+    __slots__ = ("_summary",)
+
+    def __init__(self, summary: dict):
+        self._summary = {k: v for k, v in summary.items()
+                         if _is_number(v)}
+
+    def summary(self) -> dict:
+        return dict(self._summary)
+
+
 class MetricsRegistry:
     """Thread-safe labeled counters/gauges/histograms + collectors.
 
@@ -166,6 +194,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[(str(name), labels_key(labels))] = float(value)
 
+    def set_counter(self, name: str, value: float, **labels) -> None:
+        """Set a counter to an ABSOLUTE value — the roll-up form: a
+        scraped remote counter is already cumulative, re-``inc``-ing it
+        on every scrape would double-count."""
+        with self._lock:
+            self._counters[(str(name), labels_key(labels))] = float(value)
+
     def observe(self, name: str, value: float, **labels) -> None:
         key = (str(name), labels_key(labels))
         with self._lock:
@@ -179,6 +214,33 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+
+    def absorb_snapshot(self, snap: dict,
+                        labels: Optional[dict] = None) -> None:
+        """Merge another registry's :meth:`snapshot` dict into this one,
+        qualifying every metric with ``labels`` on top of whatever labels
+        the source keys already carry — how the fleet roll-up turns N
+        per-process scrapes into one registry with ``replica=`` labels.
+        Counters are set absolutely (the source values are cumulative);
+        histograms arrive as frozen summaries (reservoirs don't cross
+        the wire)."""
+        extra = {str(k): str(v) for k, v in (labels or {}).items()}
+
+        def merged_key(qual: str):
+            name, lk = parse_qualified(qual)
+            lk.update(extra)
+            return name, labels_key(lk)
+
+        with self._lock:
+            for qual, v in (snap.get("counters") or {}).items():
+                if _is_number(v):
+                    self._counters[merged_key(qual)] = float(v)
+            for qual, v in (snap.get("gauges") or {}).items():
+                if _is_number(v):
+                    self._gauges[merged_key(qual)] = float(v)
+            for qual, summ in (snap.get("histograms") or {}).items():
+                if isinstance(summ, dict):
+                    self._hists[merged_key(qual)] = _FrozenHist(summ)
 
     # ------------------------------------------------------ collectors
     def register_collector(self, fn: Callable[[], dict],

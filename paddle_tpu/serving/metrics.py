@@ -207,13 +207,20 @@ class ServingMetrics:
             # smaller reservoir than the global histograms: one exists
             # per TENANT, and p50 stabilizes long before 4096 samples
             e = self._per_adapter[name] = {
-                "requests": 0, "tokens": 0,
+                "requests": 0, "tokens": 0, "failures": 0,
                 "ttft": LatencyHistogram(max_samples=512)}
         return e
 
     def adapter_request(self, adapter_id) -> None:
         with self._lock:
             self._adapter_locked(adapter_id)["requests"] += 1
+
+    def adapter_failure(self, adapter_id, n: int = 1) -> None:
+        """Book a failed/expired/shed request against its tenant — the
+        per-tenant availability signal the SLO burn-rate tracker
+        (``observability.slo``) diffs across scrapes."""
+        with self._lock:
+            self._adapter_locked(adapter_id)["failures"] += int(n)
 
     def adapter_tokens(self, adapter_id, n: int = 1) -> None:
         with self._lock:
@@ -275,8 +282,15 @@ class ServingMetrics:
                 **({"per_adapter": {
                     name: {"requests": e["requests"],
                            "tokens": e["tokens"],
+                           "failures": e["failures"],
                            "ttft_p50_ms": round(
-                               e["ttft"].percentile(50) * 1e3, 3)}
+                               e["ttft"].percentile(50) * 1e3, 3),
+                           # exact count/sum so downstream SLO windows
+                           # can diff an interval's mean TTFT across
+                           # scrapes (reservoir percentiles can't diff)
+                           "ttft_count": e["ttft"].count,
+                           "ttft_sum_ms": round(
+                               e["ttft"].total * 1e3, 3)}
                     for name, e in sorted(self._per_adapter.items())}}
                    if self._per_adapter else {}),
             }

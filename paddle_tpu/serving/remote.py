@@ -9,9 +9,10 @@ are in-process ones. This module splits that boundary across hosts:
 - **host side** — the process that owns the chips calls
   :func:`host_server` on its started ``InferenceServer`` (after
   ``rpc.init_rpc``); the module-level ``_host_*`` functions are the rpc
-  surface (submit / stream-poll / probe / snapshot / statusz / drain),
-  pickled by reference so any peer that imports this module can call
-  them;
+  surface (submit / stream-poll / probe / snapshot / statusz / drain,
+  plus the observability plane's metrics-snapshot and trace-export
+  reads), pickled by reference so any peer that imports this module can
+  call them;
 - **client side** — :class:`RemoteReplica` adapts that surface back into
   the duck type ``ReplicaRouter`` scores and submits to: a ``.engine`` /
   ``.scheduler`` load view refreshed from health probes, ``submit()``
@@ -36,6 +37,8 @@ where the router-assigned seed keeps the replayed stream token-identical.
 from __future__ import annotations
 
 import itertools
+import os
+import socket
 import threading
 import time
 import weakref
@@ -46,6 +49,8 @@ import numpy as np
 from ..distributed import rpc
 from ..distributed.resilience import Deadline, FaultPlan, RetryPolicy
 from ..distributed.rpc import RpcTransportError
+from ..observability import fleet as _fleet
+from ..observability import tracing as _tracing
 from .scheduler import Request
 from .server import RequestHandle
 
@@ -183,6 +188,45 @@ def _host_snapshot(name: str) -> dict:
 
 def _host_statusz(name: str) -> dict:
     return _get_server(name).statusz()
+
+
+def _host_metrics(name: str) -> dict:
+    """This PROCESS's unified-registry snapshot — the payload the
+    router's fleet scrape rolls up under a ``replica=`` label. The
+    hosted ``name`` is only an existence check (a peer that never
+    hosted anything should fail the scrape loudly, not export an empty
+    registry as if healthy); the registry itself is process-wide, so
+    co-hosted servers ride along under their own ``server=`` labels.
+    The wall-clock stamp lets the scraper refresh its clock-offset
+    estimate from the scrape's own RTT midpoint. The hosted server's
+    own ``snapshot()`` rides along under ``serving_snapshot`` so the
+    router's SLO ingest doesn't need a second rpc fan-out per scrape
+    round."""
+    srv = _get_server(name)
+    from ..observability import default_registry
+
+    snap = default_registry().snapshot()
+    snap["host"] = socket.gethostname()
+    snap["pid"] = os.getpid()
+    snap["time"] = time.time()
+    snap["serving_snapshot"] = srv.snapshot()
+    return snap
+
+
+def _host_trace_export(name: str, corr: Optional[str] = None,
+                       tail: Optional[int] = None) -> dict:
+    """Export this process's bounded span ring (optionally filtered to
+    one correlation id, optionally only the newest ``tail`` spans) —
+    remote trace collection with no dump files shipped between hosts.
+    Timestamps stay in THIS host's wall clock; the caller aligns them
+    with its clock-offset estimate (``observability.fleet``)."""
+    _get_server(name)
+    spans = _tracing.spans(corr=corr)
+    if tail is not None and tail >= 0:
+        spans = spans[-int(tail):]
+    return {"host": socket.gethostname(), "pid": os.getpid(),
+            "time": time.time(), "spans": spans,
+            "stats": _tracing.stats()}
 
 
 def _host_shutdown(name: str, drain: bool = True,
@@ -365,6 +409,54 @@ class RemoteReplica:
         self.engine = _EngineView()
         self.scheduler = _SchedulerView()
         self._handles: "weakref.WeakSet[RemoteHandle]" = weakref.WeakSet()
+        # clock alignment state, refreshed from every timestamped
+        # response (probe / metrics / trace export): the remote clock's
+        # offset vs ours, estimated at each call's RTT midpoint
+        # (observability.fleet.estimate_clock_offset), EWMA-smoothed
+        self._clock_lock = threading.Lock()
+        self._clock_offset_s: Optional[float] = None
+        self._rtt_ewma_s: Optional[float] = None
+        self._clock_samples = 0
+
+    # ---------------------------------------------------- clock tracking
+    def _note_clock(self, t0_wall: float, t1_wall: float,
+                    remote_t) -> None:
+        """Fold one timestamped round trip into the clock-offset/RTT
+        EWMAs the fleet trace stitcher aligns remote spans with."""
+        if not isinstance(remote_t, (int, float)):
+            return
+        off = _fleet.estimate_clock_offset(t0_wall, t1_wall, remote_t)
+        rtt = max(0.0, t1_wall - t0_wall)
+        with self._clock_lock:
+            self._clock_offset_s = (
+                off if self._clock_offset_s is None
+                else 0.8 * self._clock_offset_s + 0.2 * off)
+            self._rtt_ewma_s = (rtt if self._rtt_ewma_s is None
+                                else 0.8 * self._rtt_ewma_s + 0.2 * rtt)
+            self._clock_samples += 1
+
+    @property
+    def clock_offset_s(self) -> Optional[float]:
+        """Estimated remote-minus-local wall-clock offset (seconds;
+        ``None`` until a timestamped response has been seen)."""
+        with self._clock_lock:
+            return self._clock_offset_s
+
+    @property
+    def rtt_ewma_s(self) -> Optional[float]:
+        with self._clock_lock:
+            return self._rtt_ewma_s
+
+    def clock_stats(self) -> dict:
+        with self._clock_lock:
+            return {
+                "clock_offset_ms": (
+                    None if self._clock_offset_s is None
+                    else round(self._clock_offset_s * 1e3, 3)),
+                "rtt_ewma_ms": (None if self._rtt_ewma_s is None
+                                else round(self._rtt_ewma_s * 1e3, 3)),
+                "clock_samples": self._clock_samples,
+            }
 
     # ------------------------------------------------------------ plumbing
     def _call(self, fn, *args, what: str = "remote call",
@@ -451,9 +543,14 @@ class RemoteReplica:
         attempt, no transport retry: the failure detector calling this
         aggregates misses itself — stacking transport retries under
         each probe would only multiply its time-to-detection."""
+        t0 = time.time()
         out = self._call(_host_probe, self.hosted_name,
                          what="remote probe", retry=self._no_retry,
                          deadline=Deadline(self.rpc_timeout))
+        # probes double as clock-sync samples: small payload, single
+        # attempt, steady cadence — the tightest RTT-midpoint offset
+        # estimate the fleet trace stitcher can get for free
+        self._note_clock(t0, time.time(), out.get("time"))
         self.engine.active_count = int(out.get("active", 0))
         self.engine.slots = max(1, int(out.get("slots", 1)))
         self.scheduler.depth = int(out.get("queue_depth", 0))
@@ -471,11 +568,44 @@ class RemoteReplica:
 
     def statusz(self) -> dict:
         try:
-            return self._call(_host_statusz, self.hosted_name,
-                              what="remote statusz",
-                              deadline=Deadline(self.rpc_timeout))
+            out = self._call(_host_statusz, self.hosted_name,
+                             what="remote statusz",
+                             deadline=Deadline(self.rpc_timeout))
         except ReplicaUnreachable:
-            return {"state": "unreachable", "peer": self.peer}
+            out = {"state": "unreachable", "peer": self.peer}
+        # the client-side view rides along: what THIS process knows
+        # about the peer (wire latency, clock skew) that the peer
+        # cannot know about itself — one endpoint diagnoses a gray link
+        out["remote_client"] = {"peer": self.peer, **self.clock_stats()}
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        """The remote PROCESS's unified-registry snapshot (rpc
+        ``_host_metrics``) — what the router's fleet scrape rolls up
+        under this replica's label. Idempotent, transport-retried, and
+        Deadline-bounded like every other read; the response's
+        timestamp refreshes the clock-offset estimate."""
+        t0 = time.time()
+        out = self._call(_host_metrics, self.hosted_name,
+                         what="remote metrics",
+                         deadline=Deadline(self.rpc_timeout))
+        self._note_clock(t0, time.time(), out.get("time"))
+        return out
+
+    def trace_export(self, corr: Optional[str] = None,
+                     tail: Optional[int] = None) -> dict:
+        """The remote process's span ring (rpc ``_host_trace_export``),
+        annotated with this client's current clock-offset estimate so
+        the caller can align the spans onto the local timeline
+        (``observability.fleet.stitch_traces``)."""
+        t0 = time.time()
+        out = self._call(_host_trace_export, self.hosted_name, corr,
+                         tail, what="remote trace export",
+                         deadline=Deadline(self.rpc_timeout))
+        self._note_clock(t0, time.time(), out.get("time"))
+        out["offset_s"] = self.clock_offset_s or 0.0
+        out["rtt_s"] = self.rtt_ewma_s
+        return out
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:

@@ -59,12 +59,25 @@ bookkeeping around it:
   fire is counted, traced, and flight-dumped with the affected
   correlation id.
 
+- **fleet observability** (``fleet_scrape_interval=``): a scrape
+  thread pulls every remote replica's unified-registry snapshot over
+  rpc (Deadline-bounded, never under the router lock, never on the
+  placement path) into a fleet-level roll-up with ``replica=`` labels
+  — ``fleet_metrics_text()`` is Prometheus text for the whole fleet
+  from one endpoint, ``fleet_statusz()`` the detector + scrape + SLO
+  view, ``collect_fleet_trace()`` the cross-host span stitcher with
+  probe-RTT-midpoint clock alignment. A replica that stops answering
+  degrades to a stale-marked partial roll-up, never an error. With an
+  ``slo_policy``, each scrape feeds a per-tenant multi-window burn-rate
+  tracker whose fast-window burn flight-dumps its own evidence.
+
 The router is in-process and thread-safe: any number of client threads
 submit; each replica keeps its own single serving worker (local
-replicas) or rpc poller threads (remote ones). Defaults keep PR 8
+replicas) or rpc poller threads (remote ones). Defaults keep PR 8/13
 behavior bit-identical: no detector thread unless
 ``health_check_interval`` is set, no hedging unless
-``hedge_multiplier`` is set.
+``hedge_multiplier`` is set, no scrape thread unless
+``fleet_scrape_interval`` is set.
 """
 from __future__ import annotations
 
@@ -78,6 +91,7 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ..observability import fleet as _fleet
 from ..observability import flight as _flight
 from ..observability import registry as _obs_registry
 from ..observability import tracing as _tracing
@@ -477,7 +491,12 @@ class ReplicaRouter:
                  hedge_multiplier: Optional[float] = None,
                  hedge_min_s: float = 0.25,
                  hedge_warmup_tokens: int = 16,
-                 hedge_poll_interval: float = 0.02):
+                 hedge_poll_interval: float = 0.02,
+                 fleet_scrape_interval: Optional[float] = None,
+                 fleet_stale_after_s: Optional[float] = None,
+                 slo_policy=None,
+                 max_skew_correction_s: float =
+                 _fleet.DEFAULT_MAX_SKEW_CORRECTION_S):
         self.affinity_weight = float(affinity_weight)
         # a tenant placed where its adapter pages are already resident
         # skips a host->device page load (and an LRU eviction somewhere
@@ -515,6 +534,22 @@ class ReplicaRouter:
         _obs_registry.default_registry().register_collector(
             self._obs_collect, labels={"router": self._obs_label},
             name=f"router.{self._obs_label}")
+        # --- fleet observability plane (scrape thread off by default:
+        # PR 13 behavior bit-identical until an interval is set) ---
+        self.fleet_scrape_interval = fleet_scrape_interval
+        self.max_skew_correction_s = float(max_skew_correction_s)
+        self.fleet = _fleet.FleetAggregator(
+            stale_after_s=(fleet_stale_after_s
+                           if fleet_stale_after_s is not None
+                           else max(10.0, 3.0 * (fleet_scrape_interval
+                                                 or 0.0))))
+        self._slo = None
+        if slo_policy is not None:
+            from ..observability.slo import SloTracker
+
+            self._slo = SloTracker(slo_policy)
+        self._scrape_stop: Optional[threading.Event] = None
+        self._scrape_thread: Optional[threading.Thread] = None
         for r in replicas:
             self.add_replica(r)
         if self.health_check_interval:
@@ -523,6 +558,12 @@ class ReplicaRouter:
                 target=self._health_loop, name="pt-router-health",
                 daemon=True)
             self._health_thread.start()
+        if self.fleet_scrape_interval:
+            self._scrape_stop = threading.Event()
+            self._scrape_thread = threading.Thread(
+                target=self._scrape_loop, name="pt-router-fleet-scrape",
+                daemon=True)
+            self._scrape_thread.start()
 
     def _obs_collect(self) -> dict:
         with self._lock:
@@ -633,6 +674,175 @@ class ReplicaRouter:
                                   f"misses: {type(exc).__name__}: {exc}")
         elif transition is not None:
             self._note_transition(transition[0], rep.name, transition[1])
+
+    # ------------------------------------------- fleet observability
+    def _scrape_loop(self) -> None:
+        while not self._scrape_stop.wait(self.fleet_scrape_interval):
+            try:
+                self.fleet_scrape_now()
+            except Exception:   # pragma: no cover - scraping never dies
+                pass
+
+    def fleet_scrape_now(self) -> dict:
+        """One metrics-scrape round over the membership (the scrape
+        thread's body; public so tools/tests drive it synchronously).
+        Every rpc runs OUTSIDE the router lock and is Deadline-bounded
+        by the replica's own ``rpc_timeout`` — a hung peer stalls a
+        scrape round, never a placement. A failed scrape degrades that
+        replica to stale-marked-with-last-known-numbers in the roll-up;
+        it is NEVER an error (partial fleet visibility during an
+        incident is the whole point). Local (in-process) replicas share
+        this process's registry, which is scraped once under the
+        ``_local`` label. With an ``slo_policy`` configured, each round
+        also feeds the burn-rate tracker from the fleet snapshot
+        roll-up. Returns :meth:`FleetAggregator.statusz`."""
+        with self._lock:
+            reps = [(r.name, r.server, r.state)
+                    for r in self._replicas.values()]
+        saw_local = False
+        # per-replica serving snapshots for the SLO ingest, harvested
+        # from the SAME payloads the metrics scrape already fetched
+        # (remote `_host_metrics` piggybacks its server's snapshot) —
+        # no second rpc fan-out per round
+        slo_replicas: Dict[str, dict] = {}
+        for name, server, state in reps:
+            fn = getattr(server, "metrics_snapshot", None)
+            if fn is None:
+                saw_local = True
+                if self._slo is not None and state != DEAD:
+                    try:
+                        slo_replicas[name] = server.snapshot()
+                    except Exception:
+                        pass
+                continue
+            if state == DEAD:
+                # no rpc to a corpse: keep its last numbers, refresh
+                # only the stale marking
+                self.fleet.observe_scrape(name, error=f"replica {state}")
+                continue
+            try:
+                snap = fn()
+            except Exception as e:
+                self.fleet.observe_scrape(name, error=e)
+                continue
+            self.fleet.observe_scrape(
+                name, snapshot=snap,
+                clock_offset_s=getattr(server, "clock_offset_s", None),
+                rtt_s=getattr(server, "rtt_ewma_s", None))
+            serving = snap.get("serving_snapshot") if isinstance(
+                snap, dict) else None
+            if isinstance(serving, dict):
+                slo_replicas[name] = serving
+        if saw_local:
+            self.fleet.observe_scrape(
+                "_local",
+                snapshot=_obs_registry.default_registry().snapshot(),
+                clock_offset_s=0.0)
+        if self._slo is not None:
+            self._slo.ingest({"replicas": slo_replicas})
+        return self.fleet.statusz()
+
+    def fleet_metrics_text(self) -> str:
+        """Prometheus text for the WHOLE FLEET from one endpoint: every
+        replica's registry snapshot re-labeled ``replica=<name>``, plus
+        the ``fleet.*`` staleness/skew meta-series. Scrapes on demand
+        if no scrape was ever ATTEMPTED (so the call works with the
+        ``fleet_scrape_interval`` knob off) — but a fleet that is
+        currently all-unreachable serves its stale-marked roll-up
+        instead of re-blocking a full rpc round on every poll."""
+        if self.fleet.scrapes == 0 and self.fleet.scrape_errors == 0:
+            self.fleet_scrape_now()
+        return self.fleet.metrics_text()
+
+    def fleet_statusz(self) -> dict:
+        """Fleet-wide ``/statusz``: the membership + failure-detector
+        view (per-replica state, consecutive probe misses, probe-latency
+        EWMA), the scrape plane's per-replica staleness/clock metadata,
+        hedge/reroute counters, and the SLO report when a policy is
+        configured — a gray replica is diagnosable from this one
+        endpoint."""
+        return {
+            "time": round(time.time(), 3),
+            "pid": os.getpid(),
+            "detector": self.detector_statusz(),
+            "scrape": self.fleet.statusz(),
+            **({"slo": self._slo.report()}
+               if self._slo is not None else {}),
+        }
+
+    def detector_statusz(self) -> dict:
+        """Per-replica failure-detector + traffic state (the satellite
+        block ``statusz()`` embeds): lifecycle state, consecutive probe
+        misses, probe-latency EWMA, routed/in-flight counts — plus the
+        router's transition and hedge counters."""
+        with self._lock:
+            replicas = {
+                r.name: {
+                    "state": r.state,
+                    "misses": r.misses,
+                    "probe_latency_ewma_ms": (
+                        None if r.lat_ewma is None
+                        else round(r.lat_ewma * 1e3, 3)),
+                    "routed": r.routed,
+                    "inflight": len(r.inflight),
+                }
+                for r in self._replicas.values()}
+            servers = {r.name: r.server for r in self._replicas.values()}
+            counters = {
+                "requests_routed": self.requests_routed,
+                "requests_rerouted": self.requests_rerouted,
+                "requests_hedged": self.requests_hedged,
+                "hedge_wins": self.hedge_wins,
+                "replicas_failed": self.replicas_failed,
+                "replicas_suspected": self.replicas_suspected,
+                "replicas_revived": self.replicas_revived,
+            }
+        config = {
+            "health_check_interval": self.health_check_interval,
+            "suspect_misses": self.suspect_misses,
+            "dead_misses": self.dead_misses,
+            "hedge_multiplier": self.hedge_multiplier,
+            "fleet_scrape_interval": self.fleet_scrape_interval,
+        }
+        # client-side clock/link stats for remote replicas (what the
+        # peer can't know about itself) — read OUTSIDE the router lock
+        for name, entry in replicas.items():
+            stats = getattr(servers.get(name), "clock_stats", None)
+            if stats is not None:
+                entry["remote_client"] = stats()
+        return {"replicas": replicas, "counters": counters,
+                "config": config}
+
+    def collect_fleet_trace(self, corr: Optional[str] = None):
+        """Pull every live replica's span ring over rpc, align each
+        host's wall clock via its probe-RTT-midpoint offset estimate
+        (skew beyond ``max_skew_correction_s`` is reported, not
+        applied), and merge with this process's own spans into ONE
+        time-sorted span list — the request-lane view, no dump files
+        shipped. Returns ``(spans, skew_reports)``; feed the spans to
+        ``tools/trace_view.py`` (span-list input) or
+        ``tracing.chrome_trace`` to render."""
+        with self._lock:
+            reps = [(r.name, r.server, r.state)
+                    for r in self._replicas.values()]
+        remotes: Dict[str, dict] = {}
+        for name, server, state in reps:
+            fn = getattr(server, "trace_export", None)
+            if fn is None or state == DEAD:
+                continue
+            try:
+                remotes[name] = fn(corr=corr)
+            except Exception as e:
+                remotes[name] = {"spans": [], "offset_s": 0.0,
+                                 "error": e}
+        local = _tracing.spans(corr=corr)
+        return _fleet.stitch_traces(
+            local, remotes, max_correction_s=self.max_skew_correction_s)
+
+    def slo_report(self) -> Optional[dict]:
+        """The SLO tracker's per-tenant burn-rate report (``None`` when
+        no ``slo_policy`` was configured)."""
+        return None if self._slo is None else self._slo.report()
 
     # ---------------------------------------------------------- hedging
     def _note_inter_token(self, dt: float, count: int = 1) -> None:
@@ -922,6 +1132,10 @@ class ReplicaRouter:
             self._health_stop.set()
             if self._health_thread is not None:
                 self._health_thread.join(timeout=5.0)
+        if self._scrape_stop is not None:
+            self._scrape_stop.set()
+            if self._scrape_thread is not None:
+                self._scrape_thread.join(timeout=5.0)
         with self._lock:
             reps = list(self._replicas.values())
         errs = []
@@ -952,9 +1166,13 @@ class ReplicaRouter:
     # ------------------------------------------------------------- stats
     def statusz(self) -> dict:
         """Fleet ``/statusz``: membership table + the roll-up snapshot
-        (per-replica ``InferenceServer.statusz()`` is one hop away)."""
+        (per-replica ``InferenceServer.statusz()`` is one hop away),
+        plus the failure-detector block (per-replica state / miss
+        counts / probe-latency EWMA and the hedge counters) so a gray
+        replica is diagnosable from this one endpoint."""
         return {"time": round(time.time(), 3), "pid": os.getpid(),
-                "replicas": self.replicas(), "snapshot": self.snapshot()}
+                "replicas": self.replicas(), "snapshot": self.snapshot(),
+                "detector": self.detector_statusz()}
 
     def metrics_text(self) -> str:
         """Prometheus text for the whole process (all replicas share the
@@ -990,9 +1208,14 @@ class ReplicaRouter:
             tokens += snap.get("tokens_emitted", 0)
             for a_name, e in snap.get("per_adapter", {}).items():
                 agg = per_adapter.setdefault(
-                    a_name, {"requests": 0, "tokens": 0})
+                    a_name, {"requests": 0, "tokens": 0, "failures": 0,
+                             "ttft_count": 0, "ttft_sum_ms": 0.0})
                 agg["requests"] += e.get("requests", 0)
                 agg["tokens"] += e.get("tokens", 0)
+                agg["failures"] += e.get("failures", 0)
+                agg["ttft_count"] += e.get("ttft_count", 0)
+                agg["ttft_sum_ms"] = round(
+                    agg["ttft_sum_ms"] + e.get("ttft_sum_ms", 0.0), 3)
         seen = hit + miss
         return {
             "replicas": per_replica,

@@ -283,6 +283,7 @@ class InferenceServer:
                 # overload control (the request learns NOW, within
                 # microseconds of submit, not after its whole deadline)
                 self.metrics.inc("requests_shed")
+                self._adapter_fail(req)
                 _tracing.record_event("shed", corr=corr,
                                       queue_depth=self.scheduler.depth)
                 raise
@@ -438,11 +439,13 @@ class InferenceServer:
                 self._expire(req)
             else:
                 self.metrics.inc("requests_failed")
+                self._adapter_fail(req)
                 req.handle._fail(err)
         for slot, req in enumerate(list(self.engine.requests)):
             if req is not None:
                 self.engine.release(slot)
                 self.metrics.inc("requests_failed")
+                self._adapter_fail(req)
                 req.handle._fail(err)
         self.metrics.set_active_slots(0)
         self.metrics.set_queue_depth(0)
@@ -466,6 +469,7 @@ class InferenceServer:
                     # fails (unknown adapter / registry at pin capacity)
                     # — no reset, no requeue of innocents
                     self.metrics.inc("requests_failed")
+                    self._adapter_fail(req)
                     req.handle._fail(e)
                 except Exception as e:
                     # the failing request AND the rest of this admission
@@ -551,8 +555,17 @@ class InferenceServer:
                               tokens=req.handle._count())
         req.handle._finish()
 
+    def _adapter_fail(self, req: Request) -> None:
+        """Per-tenant failure accounting — the availability input the
+        SLO burn-rate tracker diffs across scrapes. Recorded only when
+        the engine serves through an adapter store, like every other
+        per-tenant metric."""
+        if self.engine.store is not None:
+            self.metrics.adapter_failure(req.adapter_id)
+
     def _expire(self, req: Request) -> None:
         self.metrics.inc("requests_expired")
+        self._adapter_fail(req)
         _tracing.record_event("expired", corr=req.corr_id)
         req.handle._fail(TimeoutError(
             f"request {req.id} expired in queue after "
@@ -564,6 +577,7 @@ class InferenceServer:
         retryably NOW (Overloaded, a ``ConnectionError``) instead of
         letting it ride the queue into a guaranteed ``TimeoutError``."""
         self.metrics.inc("requests_shed")
+        self._adapter_fail(req)
         _tracing.record_event("shed", corr=req.corr_id)
         req.handle._fail(Overloaded(
             f"request {req.id} shed from queue: predicted wait exceeds "
@@ -599,6 +613,7 @@ class InferenceServer:
         except Exception as reset_exc:  # pragma: no cover
             for req in inflight:
                 self.metrics.inc("requests_failed")
+                self._adapter_fail(req)
                 req.handle._fail(reset_exc)
             return
         # requeue newest-first via appendleft so the OLDEST submission
@@ -607,6 +622,7 @@ class InferenceServer:
         for req in sorted(inflight, key=lambda r: r.id, reverse=True):
             if req.attempts > self.max_request_retries:
                 self.metrics.inc("requests_failed")
+                self._adapter_fail(req)
                 req.handle._fail(exc)
             else:
                 self.metrics.inc("requests_requeued")

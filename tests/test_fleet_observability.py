@@ -1,0 +1,621 @@
+"""Fleet observability plane: cross-host metrics aggregation
+(``observability.fleet``), per-tenant SLO burn-rate tracking
+(``observability.slo``), clock-skew-aligned trace stitching, and the
+router/remote wiring (``fleet_scrape_now`` / ``fleet_metrics_text`` /
+``collect_fleet_trace`` / detector statusz).
+
+The clock-skew acceptance lives here: synthetic two-host span sets with
+±50ms injected skew must merge into one monotonic lane, and skew beyond
+the correction bound must be REPORTED, never silently corrected.
+
+Everything in this file runs on stubs — no model build, no rpc world —
+so the suite stays cheap; the real 2-process drill is
+``tools/fleet_obs_drill.py`` (robustness_gate --observability).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import flight
+from paddle_tpu.observability.fleet import (FleetAggregator, align_spans,
+                                            estimate_clock_offset,
+                                            stitch_traces)
+from paddle_tpu.observability.registry import (MetricsRegistry,
+                                               parse_qualified)
+from paddle_tpu.observability.slo import (FLEET_TENANT, SloPolicy,
+                                          SloTracker)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _restore_flight_dir():
+    """Tests repoint the GLOBAL flight recorder at their tmp dirs;
+    later test files must get the session default back."""
+    rec = flight.flight_recorder()
+    saved = rec.dump_dir
+    yield
+    flight.configure(dump_dir=saved)
+
+
+# ----------------------------------------------------- registry roll-up
+def test_parse_qualified_roundtrip():
+    assert parse_qualified("plain") == ("plain", {})
+    name, labels = parse_qualified(
+        'serving.queue_depth{replica="r1",server="s0"}')
+    assert name == "serving.queue_depth"
+    assert labels == {"replica": "r1", "server": "s0"}
+
+
+def test_absorb_snapshot_relabels_counters_gauges_hists():
+    r = MetricsRegistry()
+    r.absorb_snapshot(
+        {"counters": {'req{server="s0"}': 7, "plain": 2},
+         "gauges": {"depth": 3},
+         "histograms": {"ttft": {"count": 4, "p50": 0.1, "note": "x"}}},
+        labels={"replica": "r1"})
+    snap = r.snapshot()
+    assert snap["counters"]['req{replica="r1",server="s0"}'] == 7
+    assert snap["counters"]['plain{replica="r1"}'] == 2
+    assert snap["gauges"]['depth{replica="r1"}'] == 3
+    hist = snap["histograms"]['ttft{replica="r1"}']
+    assert hist["count"] == 4 and "note" not in hist  # numbers only
+    text = r.prometheus_text()
+    assert 'req{replica="r1",server="s0"} 7.0' in text
+    assert 'ttft_count{replica="r1"} 4' in text
+
+
+def test_set_counter_is_absolute_not_additive():
+    r = MetricsRegistry()
+    r.set_counter("c", 5)
+    r.set_counter("c", 5)
+    assert r.snapshot()["counters"]["c"] == 5
+
+
+# ------------------------------------------------------ clock alignment
+def test_estimate_clock_offset_midpoint():
+    # remote stamped 10.07 halfway through a [10.0, 10.02] round trip
+    # whose midpoint is 10.01 -> the remote clock runs 60ms ahead
+    assert estimate_clock_offset(10.0, 10.02, 10.07) == pytest.approx(
+        0.06)
+    assert estimate_clock_offset(10.0, 10.02, 9.97) == pytest.approx(
+        -0.04)
+
+
+def test_align_spans_shifts_within_bound():
+    spans = [{"name": "a", "corr": "c", "t0": 1.05, "t1": 1.10}]
+    out, rep = align_spans(spans, 0.05, max_correction_s=0.25,
+                           host="hA")
+    assert out[0]["t0"] == pytest.approx(1.0)
+    assert out[0]["t1"] == pytest.approx(1.05)
+    assert out[0]["host"] == "hA"
+    assert rep["applied_s"] == pytest.approx(0.05)
+    assert rep["clamped"] is False
+    assert spans[0]["t0"] == 1.05    # input not mutated
+
+
+def test_align_spans_beyond_bound_reported_not_hidden():
+    """The satellite contract: skew past the correction bound is
+    REPORTED (clamped flag + measured offset) and the spans come back
+    untouched — never silently corrected."""
+    spans = [{"name": "a", "corr": "c", "t0": 5.0, "t1": 5.1}]
+    out, rep = align_spans(spans, 0.4, max_correction_s=0.25)
+    assert out[0]["t0"] == 5.0 and out[0]["t1"] == 5.1
+    assert rep["clamped"] is True
+    assert rep["offset_s"] == pytest.approx(0.4)
+    assert rep["applied_s"] == 0.0
+
+
+def test_two_host_skew_merges_into_monotonic_lane():
+    """±50ms injected skew across two hosts: after alignment the merged
+    lane reads in true causal order with no overlaps — raw timestamps
+    would interleave it wrongly."""
+    corr = "req-x"
+    local = [{"name": "router:submit", "corr": corr,
+              "t0": 0.00, "t1": 0.01}]
+    # true prefill [0.02, 0.05] on a host running +50ms ahead
+    host_a = {"spans": [{"name": "prefill", "corr": corr,
+                         "t0": 0.07, "t1": 0.10}],
+              "offset_s": 0.05, "host": "hostA"}
+    # true decode [0.06, 0.08] on a host running -50ms behind
+    host_b = {"spans": [{"name": "decode", "corr": corr,
+                         "t0": 0.01, "t1": 0.03}],
+              "offset_s": -0.05, "host": "hostB"}
+    merged, reports = stitch_traces(local, {"a": host_a, "b": host_b})
+    assert [s["name"] for s in merged] == [
+        "router:submit", "prefill", "decode"]
+    for prev, nxt in zip(merged, merged[1:]):
+        assert nxt["t0"] >= prev["t1"] - 1e-9   # monotonic, no overlap
+    assert all(not r["clamped"] for r in reports)
+    # raw (unaligned) order would have been wrong: hostB's decode
+    # timestamp ties with the router submit's end instead of following
+    # hostA's prefill
+    assert host_b["spans"][0]["t0"] <= local[0]["t1"]
+    assert host_b["spans"][0]["t0"] < host_a["spans"][0]["t0"]
+
+
+def test_stitch_traces_flags_broken_clock():
+    corr = "req-y"
+    local = [{"name": "submit", "corr": corr, "t0": 0.0, "t1": 0.01}]
+    bad = {"spans": [{"name": "prefill", "corr": corr,
+                      "t0": 100.0, "t1": 100.1}],
+           "offset_s": 99.0, "host": "hostZ"}
+    merged, reports = stitch_traces(local, {"z": bad})
+    rep = next(r for r in reports if r["replica"] == "z")
+    assert rep["clamped"] is True and rep["offset_s"] == 99.0
+    # the broken host's spans survive, unshifted
+    assert any(s["name"] == "prefill" and s["t0"] == 100.0
+               for s in merged)
+
+
+# ------------------------------------------------------ fleet aggregator
+def _snap(completed=1):
+    return {"counters": {'serving.requests_completed{server="s0"}':
+                         completed},
+            "gauges": {}, "histograms": {}}
+
+
+def test_fleet_aggregator_partial_stale_rollup():
+    agg = FleetAggregator(stale_after_s=10.0)
+    agg.observe_scrape("r1", snapshot=_snap(5), clock_offset_s=0.002)
+    agg.observe_scrape("r2", snapshot=_snap(3))
+    # r2's next scrape fails: last-known numbers stay, stale-marked
+    agg.observe_scrape("r2", error=ConnectionError("partitioned"))
+    st = agg.statusz()
+    assert st["replicas"]["r1"]["stale"] is False
+    assert st["replicas"]["r2"]["stale"] is True
+    assert "partitioned" in st["replicas"]["r2"]["error"]
+    assert st["replicas"]["r2"]["has_snapshot"] is True
+    text = agg.metrics_text()
+    assert ('serving_requests_completed{replica="r1",server="s0"} 5.0'
+            in text)
+    assert ('serving_requests_completed{replica="r2",server="s0"} 3.0'
+            in text)   # partial: last-known, not dropped
+    assert 'fleet_replica_stale{replica="r2"} 1.0' in text
+    assert 'fleet_replica_stale{replica="r1"} 0.0' in text
+    assert 'fleet_clock_offset_s{replica="r1"} 0.002' in text
+
+
+def test_fleet_aggregator_staleness_by_age():
+    agg = FleetAggregator(stale_after_s=5.0)
+    agg.observe_scrape("r1", snapshot=_snap(), now=0.0)
+    import time as _time
+
+    now = _time.monotonic()
+    # scraped_at=0.0 is far older than stale_after vs the real clock
+    assert now > 5.0
+    assert agg.statusz()["replicas"]["r1"]["stale"] is True
+    agg.forget("r1")
+    assert agg.statusz()["replicas"] == {}
+
+
+# --------------------------------------------------------------- SLO
+def test_slo_policy_validation():
+    with pytest.raises(ValueError):
+        SloPolicy(target_availability=1.0)
+    with pytest.raises(ValueError):
+        SloPolicy(target_ttft_s=0.0)
+    with pytest.raises(ValueError):
+        SloPolicy(fast_window_s=100.0, slow_window_s=10.0)
+    assert SloPolicy(target_availability=0.99).error_budget == \
+        pytest.approx(0.01)
+
+
+def _server_snap(submitted=0, failed=0, expired=0, ttft_count=0,
+                 ttft_mean_ms=0.0, per_adapter=None):
+    return {"requests_submitted": submitted, "requests_failed": failed,
+            "requests_expired": expired,
+            "ttft": {"count": ttft_count, "mean_ms": ttft_mean_ms},
+            **({"per_adapter": per_adapter} if per_adapter else {})}
+
+
+def test_slo_fast_burn_dumps_with_tenant_label(tmp_path):
+    flight.configure(dump_dir=str(tmp_path))
+    clk = [0.0]
+    tr = SloTracker(
+        SloPolicy(target_ttft_s=0.1, target_availability=0.9,
+                  fast_window_s=60.0, slow_window_s=600.0,
+                  fast_burn_threshold=2.0),
+        registry=False, clock=lambda: clk[0])
+    base = _server_snap(per_adapter={"tenantA": {
+        "requests": 0, "failures": 0, "ttft_count": 0,
+        "ttft_sum_ms": 0.0}})
+    assert tr.ingest(base) is None       # baseline produces no buckets
+    clk[0] = 10.0
+    hot = _server_snap(submitted=6, ttft_count=6, ttft_mean_ms=50.0,
+                       per_adapter={"tenantA": {
+                           "requests": 6, "failures": 0,
+                           "ttft_count": 6, "ttft_sum_ms": 1200.0}})
+    rep = tr.ingest(hot)
+    # tenantA's interval mean TTFT (200ms) broke the 100ms target: all
+    # six requests burn the 10% budget at 10x
+    ten = rep["tenants"]["tenantA"]
+    assert ten["burn_fast"] == pytest.approx(10.0)
+    assert ten["alerting"] is True
+    # the fleet tenant stayed healthy (mean 50ms under target)
+    assert rep["tenants"][FLEET_TENANT]["burn_fast"] == 0.0
+    dumps = [f for f in os.listdir(tmp_path) if "slo_burn" in f]
+    assert len(dumps) == 1
+    with open(tmp_path / dumps[0]) as f:
+        dump = json.load(f)
+    assert dump["extra"]["tenant"] == "tenantA"
+    assert dump["extra"]["policy"]["target_ttft_s"] == 0.1
+    # edge-triggered: a still-burning next window does NOT re-dump
+    clk[0] = 20.0
+    hotter = _server_snap(submitted=12, ttft_count=12, ttft_mean_ms=50.0,
+                          per_adapter={"tenantA": {
+                              "requests": 12, "failures": 0,
+                              "ttft_count": 12, "ttft_sum_ms": 2400.0}})
+    tr.ingest(hotter)
+    assert len([f for f in os.listdir(tmp_path)
+                if "slo_burn" in f]) == 1
+    assert tr.burn_alerts == 1
+
+
+def test_slo_below_threshold_no_dump(tmp_path):
+    flight.configure(dump_dir=str(tmp_path))
+    clk = [0.0]
+    tr = SloTracker(SloPolicy(target_ttft_s=0.5,
+                              target_availability=0.9,
+                              fast_burn_threshold=10.0),
+                    registry=False, clock=lambda: clk[0])
+    tr.ingest(_server_snap())
+    clk[0] = 5.0
+    rep = tr.ingest(_server_snap(submitted=10, ttft_count=10,
+                                 ttft_mean_ms=100.0))
+    assert rep["tenants"][FLEET_TENANT]["burn_fast"] == 0.0
+    assert not [f for f in os.listdir(tmp_path) if "slo_burn" in f]
+
+
+def test_slo_availability_burn_and_window_expiry():
+    clk = [0.0]
+    pol = SloPolicy(target_ttft_s=10.0, target_availability=0.9,
+                    fast_window_s=10.0, slow_window_s=100.0,
+                    fast_burn_threshold=2.0)
+    tr = SloTracker(pol, registry=False, dump_on_burn=False,
+                    clock=lambda: clk[0])
+    tr.ingest(_server_snap())
+    clk[0] = 5.0
+    rep = tr.ingest(_server_snap(submitted=10, failed=5))
+    fleet = rep["tenants"][FLEET_TENANT]
+    # 5 bad / 10 total against a 10% budget = burn 5x
+    assert fleet["burn_fast"] == pytest.approx(5.0)
+    assert fleet["window_fast"]["availability"] == pytest.approx(0.5)
+    # a quiet later window: the bad bucket ages out of the fast window
+    # but stays in the slow one
+    clk[0] = 30.0
+    rep = tr.ingest(_server_snap(submitted=10, failed=5))
+    fleet = rep["tenants"][FLEET_TENANT]
+    assert fleet["window_fast"]["total"] == 0.0
+    assert fleet["burn_fast"] == 0.0
+    assert fleet["window_slow"]["bad"] == 5.0
+
+
+def test_slo_counter_regression_clamps_to_zero():
+    """A replica death shrinks the fleet roll-up's cumulative counters;
+    the delta must clamp at zero, not book negative traffic — and the
+    baseline keeps the HIGH-water marks, so the replica's revival does
+    NOT re-book its whole history as one interval's burn burst."""
+    clk = [0.0]
+    tr = SloTracker(SloPolicy(target_availability=0.9), registry=False,
+                    dump_on_burn=False, clock=lambda: clk[0])
+    roll = {"replicas": {"a": _server_snap(submitted=10),
+                         "b": _server_snap(submitted=8, failed=4)}}
+    tr.ingest(roll)
+    clk[0] = 5.0
+    shrunk = {"replicas": {"a": _server_snap(submitted=12),
+                           "b": {"state": "dead"}}}
+    rep = tr.ingest(shrunk)
+    fleet = rep["tenants"][FLEET_TENANT]
+    assert fleet["window_fast"]["total"] == 0.0   # 12 < 18: clamped
+    assert fleet["burn_fast"] == 0.0
+    # b revives with its old cumulative history: only traffic beyond
+    # the pre-death HIGH-water mark (18 total / 4 bad) may book — a's
+    # 3 new requests, and crucially NOT b's re-appearing 4 failures
+    clk[0] = 10.0
+    revived = {"replicas": {"a": _server_snap(submitted=13),
+                            "b": _server_snap(submitted=8, failed=4)}}
+    rep = tr.ingest(revived)
+    fleet = rep["tenants"][FLEET_TENANT]
+    assert fleet["window_fast"]["total"] == pytest.approx(3.0)
+    assert fleet["window_fast"]["bad"] == 0.0
+    assert fleet["burn_fast"] == 0.0
+
+
+def test_slo_sheds_burn_the_fleet_budget():
+    """Overload sheds are unavailability: a shed storm must burn the
+    __fleet__ budget even though door sheds never reach
+    requests_submitted."""
+    clk = [0.0]
+    tr = SloTracker(SloPolicy(target_availability=0.9,
+                              fast_burn_threshold=2.0),
+                    registry=False, dump_on_burn=False,
+                    clock=lambda: clk[0])
+    tr.ingest(_server_snap())
+    clk[0] = 5.0
+    snap = _server_snap(submitted=2, ttft_count=2, ttft_mean_ms=1.0)
+    snap["requests_shed"] = 18
+    rep = tr.ingest(snap)
+    fleet = rep["tenants"][FLEET_TENANT]
+    assert fleet["window_fast"]["bad"] == 18.0
+    assert fleet["window_fast"]["total"] == 20.0
+    assert fleet["burn_fast"] == pytest.approx(9.0)
+    assert fleet["alerting"] is True
+
+
+def test_slo_ingest_accepts_router_rollup_per_adapter():
+    clk = [0.0]
+    tr = SloTracker(SloPolicy(target_ttft_s=0.1,
+                              target_availability=0.9),
+                    registry=False, dump_on_burn=False,
+                    clock=lambda: clk[0])
+    r0 = {"replicas": {"a": _server_snap(per_adapter={
+        "t1": {"requests": 0, "failures": 0, "ttft_count": 0,
+               "ttft_sum_ms": 0.0}})}}
+    tr.ingest(r0)
+    clk[0] = 5.0
+    r1 = {"replicas": {
+        "a": _server_snap(submitted=4, per_adapter={
+            "t1": {"requests": 2, "failures": 1, "ttft_count": 2,
+                   "ttft_sum_ms": 20.0}}),
+        "b": _server_snap(submitted=2, per_adapter={
+            "t1": {"requests": 2, "failures": 1, "ttft_count": 2,
+                   "ttft_sum_ms": 30.0}})}}
+    rep = tr.ingest(r1)
+    t1 = rep["tenants"]["t1"]
+    # failures aggregate across replicas: 2 bad of 4 on a 10% budget
+    assert t1["window_fast"]["total"] == 4.0
+    assert t1["window_fast"]["bad"] == 2.0
+    assert t1["burn_fast"] == pytest.approx(5.0)
+
+
+# ------------------------------------------- serving metrics per-tenant
+def test_serving_metrics_per_adapter_failure_and_ttft_sums():
+    from paddle_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics(slots=2)
+    m.adapter_request("t1")
+    m.observe_adapter_ttft("t1", 0.2)
+    m.adapter_failure("t1")
+    m.adapter_failure(None)            # base tenant
+    snap = m.snapshot()
+    e = snap["per_adapter"]["t1"]
+    assert e["requests"] == 1 and e["failures"] == 1
+    assert e["ttft_count"] == 1
+    assert e["ttft_sum_ms"] == pytest.approx(200.0)
+    assert e["ttft_p50_ms"] == pytest.approx(200.0)   # key preserved
+    assert snap["per_adapter"]["base"]["failures"] == 1
+
+
+# ------------------------------------------------ router wiring (stubs)
+class _StubEngine:
+    active_count = 0
+    slots = 4
+    pool = None
+    store = None
+
+
+class _StubScheduler:
+    depth = 0
+    max_queue_depth = 8
+
+
+class _StubRemote:
+    """RemoteReplica-shaped stub: load views + the observability-plane
+    duck type (metrics_snapshot / trace_export / clock attrs)."""
+
+    clock_offset_s = 0.01
+    rtt_ewma_s = 0.002
+
+    def __init__(self, fail=False):
+        self.engine = _StubEngine()
+        self.scheduler = _StubScheduler()
+        self.fail = fail
+        self.per_adapter = None
+        self.submitted = 0
+
+    def start(self):
+        return self
+
+    def clock_stats(self):
+        return {"clock_offset_ms": 10.0, "rtt_ewma_ms": 2.0,
+                "clock_samples": 3}
+
+    def metrics_snapshot(self):
+        if self.fail:
+            raise ConnectionError("partitioned")
+        # _host_metrics shape: registry sections + the serving snapshot
+        # piggybacked so the router's SLO ingest needs no second rpc
+        return {"counters": {'serving.requests_completed{server="s0"}':
+                             self.submitted},
+                "gauges": {}, "histograms": {}, "host": "hostB",
+                "time": 0.0, "serving_snapshot": self.snapshot()}
+
+    def trace_export(self, corr=None):
+        if self.fail:
+            raise ConnectionError("partitioned")
+        return {"spans": [{"name": "prefill", "corr": "c1",
+                           "t0": 10.05, "t1": 10.06, "tags": {}}],
+                "offset_s": 0.05, "host": "hostB"}
+
+    def snapshot(self):
+        return {"requests_submitted": self.submitted,
+                "requests_completed": self.submitted,
+                "tokens_emitted": 0, "prefix_hit_tokens": 0,
+                "prefix_miss_tokens": 0,
+                "ttft": {"count": self.submitted, "mean_ms": 1.0},
+                **({"per_adapter": self.per_adapter}
+                   if self.per_adapter else {})}
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+
+def test_router_fleet_scrape_labels_and_partial_stale():
+    from paddle_tpu.serving import ReplicaRouter
+
+    good, bad = _StubRemote(), _StubRemote(fail=True)
+    r = ReplicaRouter()
+    r.add_replica(good, "good")
+    r.add_replica(bad, "bad")
+    st = r.fleet_scrape_now()         # must not raise on the failure
+    assert st["replicas"]["good"]["stale"] is False
+    assert st["replicas"]["bad"]["stale"] is True
+    text = r.fleet_metrics_text()
+    assert 'replica="good"' in text
+    assert 'fleet_replica_stale{replica="bad"} 1.0' in text
+    assert 'fleet_clock_offset_s{replica="good"} 0.01' in text
+
+
+def test_router_collect_fleet_trace_aligns_and_reports():
+    from paddle_tpu.serving import ReplicaRouter
+
+    r = ReplicaRouter()
+    r.add_replica(_StubRemote(), "good")
+    r.add_replica(_StubRemote(fail=True), "bad")
+    spans, reports = r.collect_fleet_trace()
+    remote = [s for s in spans if s.get("src") == "good"]
+    assert remote and remote[0]["t0"] == pytest.approx(10.0)  # -50ms
+    by_name = {rep["replica"]: rep for rep in reports}
+    assert by_name["good"]["applied_s"] == pytest.approx(0.05)
+    assert "error" in by_name["bad"]
+
+
+def test_router_statusz_detector_block():
+    from paddle_tpu.serving import ReplicaRouter
+
+    r = ReplicaRouter()
+    r.add_replica(_StubRemote(), "g")
+    dz = r.statusz()["detector"]
+    rep = dz["replicas"]["g"]
+    assert rep["state"] == "active" and rep["misses"] == 0
+    assert rep["remote_client"]["clock_offset_ms"] == 10.0
+    assert "requests_hedged" in dz["counters"]
+    assert "hedge_multiplier" in dz["config"]
+    # fleet_statusz composes detector + scrape (+ slo when configured)
+    fz = r.fleet_statusz()
+    assert "detector" in fz and "scrape" in fz and "slo" not in fz
+
+
+def test_router_scrape_feeds_slo_tracker(tmp_path):
+    from paddle_tpu.serving import ReplicaRouter
+
+    flight.configure(dump_dir=str(tmp_path))
+    stub = _StubRemote()
+    stub.per_adapter = {"tenantZ": {"requests": 0, "failures": 0,
+                                    "ttft_count": 0, "ttft_sum_ms": 0.0}}
+    r = ReplicaRouter(slo_policy=SloPolicy(
+        target_ttft_s=0.1, target_availability=0.9,
+        fast_burn_threshold=2.0))
+    r.add_replica(stub, "s")
+    r.fleet_scrape_now()              # baseline
+    stub.submitted = 4
+    stub.per_adapter = {"tenantZ": {"requests": 4, "failures": 4,
+                                    "ttft_count": 0, "ttft_sum_ms": 0.0}}
+    r.fleet_scrape_now()
+    rep = r.slo_report()
+    assert rep["tenants"]["tenantZ"]["alerting"] is True
+    dumped = [f for f in os.listdir(tmp_path) if "slo_burn" in f]
+    assert dumped
+    tenants = set()
+    for fname in dumped:
+        with open(tmp_path / fname) as f:
+            tenants.add(json.load(f)["extra"]["tenant"])
+    assert "tenantZ" in tenants
+    assert "slo" in r.fleet_statusz()
+
+
+def test_remote_replica_clock_ewma_without_rpc():
+    from paddle_tpu.serving.remote import RemoteReplica
+
+    rep = RemoteReplica("peer-x")
+    assert rep.clock_offset_s is None
+    rep._note_clock(10.0, 10.02, 10.07)      # +60ms
+    assert rep.clock_offset_s == pytest.approx(0.06)
+    assert rep.rtt_ewma_s == pytest.approx(0.02)
+    rep._note_clock(20.0, 20.02, 20.11)      # +100ms sample -> EWMA
+    assert rep.clock_offset_s == pytest.approx(0.8 * 0.06 + 0.2 * 0.10)
+    stats = rep.clock_stats()
+    assert stats["clock_samples"] == 2
+    assert stats["clock_offset_ms"] == pytest.approx(68.0)
+    rep._note_clock(30.0, 30.02, None)       # no timestamp: ignored
+    assert rep.clock_stats()["clock_samples"] == 2
+
+
+# -------------------------------------------------- flight + trace_view
+def test_flight_dump_filename_hostname_prefixed(tmp_path):
+    import socket
+
+    from paddle_tpu.observability.flight import (FlightRecorder,
+                                                 _host_token)
+
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    path = rec.dump("unit")
+    fname = os.path.basename(path)
+    assert fname.startswith(f"flight_{_host_token()}_{os.getpid()}_")
+    # sanity: the token really derives from this host's name
+    assert _host_token()[:8] in "".join(
+        c if (c.isalnum() or c in "_-") else "_"
+        for c in socket.gethostname())
+
+
+def test_trace_view_list_groups_by_host(tmp_path, capsys):
+    from trace_view import group_by_host, list_correlations, load_spans
+    from trace_view import main as tv_main
+
+    corr = "req-fleet-000001"
+    files = []
+    for host, pid in (("hostA", 11), ("hostB", 22)):
+        dump = {"format": "flight_recorder", "version": 1,
+                "reason": "t", "time": 0.0, "pid": pid, "host": host,
+                "correlation_id": corr,
+                "events": [],
+                "spans": [{"name": f"{host}:phase", "corr": corr,
+                           "t0": 1.0, "t1": 1.5, "tags": {}}],
+                "counters": {}, "metrics": None}
+        p = tmp_path / f"{host}.json"
+        with open(p, "w") as f:
+            json.dump(dump, f)
+        files.append(str(p))
+    spans = []
+    for p in files:
+        got, kind = load_spans(p)
+        assert kind == "flight"
+        assert got[0]["host"] in ("hostA", "hostB")
+        spans.extend(got)
+    groups = group_by_host(spans)
+    assert set(groups) == {"hostA", "hostB"}
+    rows = list_correlations(spans)
+    assert rows[0]["hosts"] == ["hostA", "hostB"]
+    assert tv_main(files + ["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "# host hostA:" in out and "# host hostB:" in out
+    # per-corr lines stay line-JSON (headers are '#'-prefixed)
+    data_lines = [ln for ln in out.splitlines()
+                  if ln and not ln.startswith("#")]
+    assert json.loads(data_lines[0])["corr"] == corr
+
+
+# ------------------------------------------------ bench_profile overlap
+def test_overlap_breakdown_classifies_and_splits():
+    from bench_profile import classify_span, overlap_breakdown
+
+    assert classify_span("bucketed_allreduce") == "collective"
+    assert classify_span("h2d_prefetch") == "host_stall"
+    assert classify_span("step") == "step"
+    assert classify_span("serve:decode") == "other"
+    spans = [("step", 0.0, 0.10), ("step", 0.10, 0.20),
+             ("psum_dp", 0.02, 0.04),          # inside step 0
+             ("h2d_prefetch", 0.12, 0.15)]     # inside step 1
+    b = overlap_breakdown(spans, compute_s=0.05)
+    s0, s1 = b["steps"]
+    assert s0["collective_ms"] == pytest.approx(20.0)
+    assert s0["compute_ms"] == pytest.approx(50.0)
+    assert s0["non_compute_ms"] == pytest.approx(30.0)
+    assert s1["host_stall_ms"] == pytest.approx(30.0)
+    assert b["mean"]["wall_ms"] == pytest.approx(100.0)
+    assert 0.0 < b["mean"]["non_compute_frac"] <= 1.0

@@ -480,7 +480,10 @@ def test_metrics_per_adapter_block():
     m.adapter_tokens(None, 2)
     snap = m.snapshot()
     assert snap["per_adapter"]["a"] == {
-        "requests": 1, "tokens": 5, "ttft_p50_ms": 100.0}
+        "requests": 1, "tokens": 5, "ttft_p50_ms": 100.0,
+        # PR 15: SLO-countable cumulative fields (failures per tenant,
+        # exact TTFT count/sum for window-mean deltas)
+        "failures": 0, "ttft_count": 1, "ttft_sum_ms": 100.0}
     assert snap["per_adapter"]["base"]["tokens"] == 2
     m.reset()
     assert "per_adapter" not in m.snapshot()

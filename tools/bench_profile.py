@@ -1,24 +1,45 @@
-"""Break down the b8 bench step: fwd / fwd+bwd / full step, flash variants.
+"""Break down the b8 bench step: fwd / fwd+bwd / full step, flash variants
+— plus the per-step collective-overlap breakdown (``--overlap``) that
+ROADMAP item 1 (overlap-scheduled distributed training) gates on.
 
-Run: python -m tools.bench_profile
+``--overlap`` runs N instrumented train steps under the profiler's host
+span recorder and splits each step's wall time into:
+
+- **compute** — the measured fwd+bwd program time (the part overlap
+  scheduling cannot shrink);
+- **collective** — host spans whose names mark collective work
+  (``allreduce``/``psum``/``all_gather``/... — today's serial schedule
+  runs them inside the one compiled program, so this column reads 0
+  until bucketed/async collectives land and register their own spans);
+- **host_stall** — input-pipeline / H2D spans (``h2d_prefetch`` et al.)
+  overlapping the step;
+- **non_compute residual** — step wall minus all of the above
+  (optimizer + dispatch + the collective time hiding inside the fused
+  program). The overlap work drives THIS number toward zero per step;
+  the table + JSON line make the trajectory visible per run.
+
+Printed as a table and emitted as one bench-style JSON line
+(``<model>_step_overlap_breakdown``), so ``bench_sweep``-style tooling
+can archive it next to the MFU numbers.
+
+Run: python -m tools.bench_profile            # classic fwd/bwd/step timings
+     python -m tools.bench_profile --overlap  # per-step breakdown table
 """
+import argparse
+import json
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import paddle_tpu
-from paddle_tpu import amp
-from paddle_tpu.framework.jit import TrainStep
-from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
-                                   gpt_flops_per_token, gpt_loss_fn)
-from paddle_tpu.nn.layer import buffer_state, functional_call, param_state
-from paddle_tpu.optimizer import AdamW
-from bench import _chip_peak_flops
+import numpy as np
 
 
 def timeit(fn, *args, n=10, warmup=2):
+    import jax
+
     for _ in range(warmup):
         out = fn(*args)
     # tpu-lint: disable=R1(benchmark warmup fence — the timed region must start with nothing in flight)
@@ -35,7 +56,174 @@ def timeit(fn, *args, n=10, warmup=2):
     return (time.perf_counter() - t0) / n
 
 
+# --------------------------------------------- overlap breakdown (pure)
+#: span-name classification for the breakdown — "existing profiler
+#: events" in, buckets out. Collective names cover the wrappers
+#: distributed/collective.py and future bucketed-allreduce spans will
+#: register; host-stall covers the input pipeline's spans.
+_COLLECTIVE_KEYS = ("allreduce", "all_reduce", "psum", "pmean",
+                    "all_gather", "allgather", "reduce_scatter",
+                    "all_to_all", "a2a", "collective", "ppermute")
+_HOST_STALL_KEYS = ("h2d", "prefetch", "stall", "data_wait")
+
+
+def classify_span(name: str) -> str:
+    low = str(name).lower()
+    if any(k in low for k in _COLLECTIVE_KEYS):
+        return "collective"
+    if any(k in low for k in _HOST_STALL_KEYS):
+        return "host_stall"
+    if low == "step":
+        return "step"
+    return "other"
+
+
+def _overlap_s(t0, t1, w0, w1):
+    """Seconds of [t0, t1] falling inside the window [w0, w1]."""
+    return max(0.0, min(t1, w1) - max(t0, w0))
+
+
+def overlap_breakdown(spans, compute_s=None):
+    """Split each recorded ``step`` span's wall time into compute /
+    collective / host_stall / residual using the other host spans that
+    overlap it. ``spans`` is ``[(name, t0, t1), ...]`` (the host event
+    recorder's shape); ``compute_s`` is the separately measured
+    compute-only (fwd+bwd) program time attributed to every step.
+    Returns ``{"steps": [per-step rows], "mean": aggregate row}``."""
+    steps = sorted(((t0, t1) for name, t0, t1 in spans
+                    if classify_span(name) == "step"),
+                   key=lambda w: w[0])
+    others = [(classify_span(name), t0, t1) for name, t0, t1 in spans
+              if classify_span(name) in ("collective", "host_stall")]
+    rows = []
+    for i, (w0, w1) in enumerate(steps):
+        wall = w1 - w0
+        coll = sum(_overlap_s(t0, t1, w0, w1)
+                   for kind, t0, t1 in others if kind == "collective")
+        stall = sum(_overlap_s(t0, t1, w0, w1)
+                    for kind, t0, t1 in others if kind == "host_stall")
+        comp = min(wall, compute_s) if compute_s is not None else 0.0
+        resid = max(0.0, wall - comp - coll - stall)
+        rows.append({"step": i, "wall_ms": round(wall * 1e3, 3),
+                     "compute_ms": round(comp * 1e3, 3),
+                     "collective_ms": round(coll * 1e3, 3),
+                     "host_stall_ms": round(stall * 1e3, 3),
+                     "non_compute_ms": round(resid * 1e3, 3)})
+    mean = {}
+    if rows:
+        for key in ("wall_ms", "compute_ms", "collective_ms",
+                    "host_stall_ms", "non_compute_ms"):
+            mean[key] = round(sum(r[key] for r in rows) / len(rows), 3)
+        mean["non_compute_frac"] = round(
+            (mean["collective_ms"] + mean["host_stall_ms"]
+             + mean["non_compute_ms"]) / mean["wall_ms"], 4) \
+            if mean["wall_ms"] else 0.0
+    return {"steps": rows, "mean": mean}
+
+
+def print_breakdown_table(breakdown) -> None:
+    cols = ("step", "wall_ms", "compute_ms", "collective_ms",
+            "host_stall_ms", "non_compute_ms")
+    print("".join(f"{c:>16}" for c in cols))
+    for r in breakdown["steps"]:
+        print("".join(f"{r[c]:>16}" for c in cols))
+    m = breakdown["mean"]
+    if m:
+        print("".join(f"{v:>16}" for v in
+                      ("mean", m["wall_ms"], m["compute_ms"],
+                       m["collective_ms"], m["host_stall_ms"],
+                       m["non_compute_ms"])))
+        print(f"non-compute fraction of step wall: "
+              f"{m['non_compute_frac']:.1%}  (the number the overlap "
+              f"scheduling work drives toward 0)")
+
+
+def run_overlap(batch=4, seq=128, steps=5, flash=False):
+    """The ``--overlap`` mode: instrumented steps on a small config
+    (CPU-safe), classic host spans in, breakdown table + JSON out."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu
+    from paddle_tpu import profiler
+    from paddle_tpu.framework.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     param_state)
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=seq,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=flash)
+    paddle_tpu.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4)
+    params = jax.tree.map(lambda x: jnp.array(x, copy=True),
+                          param_state(model))
+    buffers = buffer_state(model)
+    rng = np.random.default_rng(0)
+    ids = np.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                     np.int32)
+
+    @jax.jit
+    def fwdbwd(p, x):
+        def loss(p):
+            out, _ = functional_call(model, p, buffers,
+                                     jnp.asarray(x), jnp.asarray(x))
+            return out
+
+        return jax.value_and_grad(loss)(p)
+
+    t_compute = timeit(fwdbwd, params, ids, n=max(3, steps), warmup=2)
+    step = TrainStep(model, opt, loss_fn=None)
+    step((ids, ids))   # compile outside the recorded window
+
+    rec = profiler._recorder
+    prev_enabled = rec.enabled
+    rec.clear()
+    rec.enabled = True
+    try:
+        for _ in range(steps):
+            step((ids, ids))
+        # tpu-lint: disable=R1(benchmark fence — the last step's wall time must include its device work)
+        float(np.asarray(step((ids, ids))))
+        with rec.lock:
+            spans = list(rec.spans)
+    finally:
+        rec.enabled = prev_enabled
+    breakdown = overlap_breakdown(spans, compute_s=t_compute)
+    print_breakdown_table(breakdown)
+    record = {
+        "metric": "gpt_step_overlap_breakdown",
+        "value": breakdown["mean"].get("non_compute_frac", 0.0),
+        "unit": "frac_of_step_wall",
+        "extra": {"steps": len(breakdown["steps"]),
+                  **breakdown["mean"],
+                  # the raw fwd+bwd program time, distinct from the
+                  # per-step (wall-clamped) compute_ms mean above
+                  "fwdbwd_ms": round(t_compute * 1e3, 3),
+                  "batch": batch, "seq": seq,
+                  "backend": jax.default_backend()},
+    }
+    print(json.dumps(record))
+    return breakdown
+
+
 def main(batch=8, seq=1024, flash=True, loss_chunk=256):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu
+    from paddle_tpu import amp
+    from paddle_tpu.framework.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       gpt_flops_per_token, gpt_loss_fn)  # noqa: F401
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     param_state)
+    from paddle_tpu.optimizer import AdamW
+    from bench import _chip_peak_flops
+
     cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                     num_heads=16, max_position_embeddings=seq,
                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
@@ -81,7 +269,17 @@ def main(batch=8, seq=1024, flash=True, loss_chunk=256):
 
 
 if __name__ == "__main__":
-    import sys
-
-    flash = "--noflash" not in sys.argv
-    main(flash=flash)
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--noflash", action="store_true")
+    ap.add_argument("--overlap", action="store_true",
+                    help="per-step compute/collective/host-stall "
+                         "breakdown (table + JSON) instead of the b8 "
+                         "timings")
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+    if args.overlap:
+        # flash stays off here: the breakdown targets schedule structure,
+        # not kernel choice, and the small config must stay CPU-safe
+        run_overlap(steps=args.steps)
+        sys.exit(0)
+    main(flash=not args.noflash)

@@ -197,7 +197,29 @@ def parent_main(args) -> int:
             got = h.result(timeout=300)
             check.expect(np.array_equal(got, want),
                          f"warmup tokens identical on {name}")
+            last_corr = h.correlation_id
         log(f"warmup done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 1b: fleet observability — one scrape, all hosts ---
+        router.fleet_scrape_now()
+        obs_text = router.fleet_metrics_text()
+        check.expect(
+            all(f'replica="{n}"' in obs_text for n in replicas),
+            "one fleet_metrics_text scrape carries every replica's "
+            "labels")
+        check.expect("serving_requests_completed" in obs_text,
+                     "fleet scrape rolled up remote serving metrics")
+        tspans, tskew = router.collect_fleet_trace(corr=last_corr)
+        check.expect(
+            any(s.get("src") in replicas for s in tspans)
+            and all(s.get("corr") == last_corr for s in tspans),
+            f"remote trace collection stitched one corr lane "
+            f"({len(tspans)} spans)")
+        check.expect(all(not r.get("clamped") for r in tskew
+                         if not r.get("error")),
+                     f"host clock skew within correction bound "
+                     f"({[r.get('offset_s') for r in tskew]})")
+        log(f"fleet scrape done at {time.monotonic() - t_start:.0f}s")
 
         # ---- phase 2: 2x overload -> shed fast, accepted keep SLO ----
         # gpt_tiny decodes so fast on this box that honest queues never
@@ -389,6 +411,23 @@ def parent_main(args) -> int:
                      f"(rerouted={snap['requests_rerouted']}, "
                      f"hedge_wins={snap['hedge_wins']})")
         log(f"kill done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 5b: partial roll-up after deaths, no router stall -
+        t_scrape = time.monotonic()
+        obs_statz = router.fleet_scrape_now()
+        scrape_dur = time.monotonic() - t_scrape
+        check.expect(obs_statz["replicas"]["r1"]["stale"] is True
+                     and obs_statz["replicas"]["r2"]["stale"] is True,
+                     "dead/partitioned replicas stale-marked in the "
+                     "roll-up")
+        obs_text = router.fleet_metrics_text()
+        check.expect('replica="r3"' in obs_text
+                     and 'replica="r1"' in obs_text,
+                     "partial roll-up keeps the survivor fresh and the "
+                     "casualties' last-known numbers")
+        check.expect(scrape_dur < 30.0,
+                     f"post-kill scrape stayed bounded "
+                     f"({scrape_dur:.1f}s, no router stall)")
 
         # ---- teardown: stop survivors, collect their budget verdicts -
         part_plan.uninstall()   # r2 reachable again for its stop signal

@@ -1,0 +1,320 @@
+#!/usr/bin/env python
+"""Fleet observability drill: a 2-process rpc fleet proving the
+cross-host telemetry plane end to end.
+
+Topology: this process (rank 0, "router") runs a ``ReplicaRouter`` over
+one LOCAL ``InferenceServer`` (with a ``tenantA`` LoRA adapter store)
+and one REMOTE replica (rank 1, "r1") hosting a base server in a child
+process. The phases, in order:
+
+1. **scrape** — warmup traffic on both replicas, then one
+   ``fleet_metrics_text()`` scrape must return BOTH processes' serving
+   metrics with per-replica labels (``replica="r1"`` /
+   ``replica="_local"``), and the probe-fed clock-offset estimate for
+   the remote must exist and be sane;
+2. **remote trace** — a request served on r1 must come back from
+   ``collect_fleet_trace(corr)`` as ONE correlation-id lane stitching
+   the router's local spans with the replica's rpc-exported spans
+   (skew-aligned, no dump files shipped), renderable by
+   ``tools/trace_view.py``;
+3. **SLO burn** — a ``slow`` FaultPlan on the local ``serve.admit``
+   path stalls tenantA's TTFT past its SLO target; the next scrape's
+   burn-rate ingest must flight-dump an ``slo_burn`` artifact carrying
+   the RIGHT tenant label;
+4. **partition mid-scrape** — an rpc partition against r1 must degrade
+   the next scrape to a PARTIAL roll-up: r1 stale-marked with its
+   last-known numbers still present, the scrape returning (bounded, no
+   router stall) instead of raising.
+
+Exit 0 iff every check held. Wired into CI as part of
+``robustness_gate.py --observability``.
+
+    python tools/fleet_obs_drill.py
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+SLOTS = 2
+GEO = dict(max_length=64, prefill_buckets=(32,))
+SEED = 7
+
+
+def log(msg: str) -> None:
+    print(f"[fleet_obs_drill] {msg}", flush=True)
+
+
+def build_model():
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    pt.seed(SEED)
+    cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+# ---------------------------------------------------------------- child
+def child_main(endpoint: str) -> int:
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.serving import InferenceServer, remote
+
+    rpc.init_rpc(name="r1", rank=1, world_size=2,
+                 master_endpoint=endpoint)
+    model, _ = build_model()
+    server = InferenceServer(model, slots=SLOTS, max_queue_depth=16,
+                             **GEO)
+    remote.host_server(server, name="default")
+    log(f"child r1 (pid {os.getpid()}) hosting")
+    remote.wait_for_stop(timeout=600.0)
+    try:
+        server.shutdown(drain=False, timeout=20)
+    except Exception as e:
+        log(f"child shutdown: {e}")
+    rpc.shutdown(timeout=6.0)
+    return 0
+
+
+# --------------------------------------------------------------- parent
+class Check:
+    def __init__(self):
+        self.failures = []
+
+    def expect(self, ok: bool, what: str) -> bool:
+        log(f"{'PASS' if ok else 'FAIL'}: {what}")
+        if not ok:
+            self.failures.append(what)
+        return ok
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parent_main(args) -> int:
+    import numpy as np
+
+    flight_dir = tempfile.mkdtemp(prefix="fleet_obs_flight_")
+    os.environ["PT_FLIGHT_DIR"] = flight_dir
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.resilience import FaultPlan
+    from paddle_tpu.lora import (AdapterStore, LoraConfig, apply_lora,
+                                 lora_state)
+    from paddle_tpu.observability.slo import SloPolicy
+    from paddle_tpu.serving import (InferenceServer, RemoteReplica,
+                                    ReplicaRouter)
+    from paddle_tpu.serving import remote as remote_mod
+    from trace_view import main as trace_view_main
+
+    endpoint = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PT_FAULT_PLAN", None)
+    check = Check()
+    t_start = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--endpoint", endpoint], env=env)
+    try:
+        rpc.init_rpc(name="router", rank=0, world_size=2,
+                     master_endpoint=endpoint)
+        model, cfg = build_model()
+        rng = np.random.default_rng(1234)
+
+        def prompt(n):
+            return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+        # local replica carries the tenant (remote stays base-only: the
+        # drill's SLO phase must prove the PER-TENANT label plumbing)
+        lcfg = LoraConfig(rank=2, alpha=4.0)
+        apply_lora(model, lcfg)
+        zero = lora_state(model)
+        arng = np.random.default_rng(3)
+        store = AdapterStore(model, lcfg, max_loaded=2)
+        store.register("tenantA", {
+            k: arng.normal(0.0, 0.02, v.shape).astype(np.float32)
+            for k, v in zero.items()})
+        local = InferenceServer(model, slots=SLOTS, max_queue_depth=16,
+                                adapter_store=store, **GEO)
+        remote = RemoteReplica("r1", rpc_timeout=8.0,
+                               connect_deadline=0.75, poll_interval=0.01)
+        if not remote.wait_ready(timeout=300.0):
+            raise RuntimeError("r1 never hosted its server")
+        log(f"replicas ready at {time.monotonic() - t_start:.0f}s")
+        policy = SloPolicy(target_ttft_s=0.05, target_availability=0.9,
+                           fast_window_s=60.0, slow_window_s=600.0,
+                           fast_burn_threshold=2.0)
+        router = ReplicaRouter(slo_policy=policy)
+        router.add_replica(local, "local")
+        router.add_replica(remote, "r1")
+
+        # ---- phase 1: warmup + one-endpoint fleet scrape -------------
+        h_remote = router.submit(prompt(12), max_new_tokens=6,
+                                 prefer="r1")
+        h_remote.result(timeout=300)
+        for _ in range(2):
+            router.submit(prompt(8), max_new_tokens=4, prefer="local",
+                          adapter_id="tenantA").result(timeout=300)
+        statz = router.fleet_scrape_now()
+        text = router.fleet_metrics_text()
+        check.expect('replica="r1"' in text
+                     and 'replica="_local"' in text,
+                     "fleet_metrics_text carries per-replica labels "
+                     "for both processes")
+        check.expect("serving_requests_completed" in text,
+                     "fleet scrape rolled up remote serving counters")
+        check.expect(statz["replicas"]["r1"]["stale"] is False,
+                     "remote replica fresh after scrape")
+        off = remote.clock_offset_s
+        check.expect(off is not None and abs(off) < 1.0,
+                     f"probe-fed clock offset estimated "
+                     f"({0 if off is None else off * 1e3:.1f}ms)")
+        dz = router.statusz()["detector"]
+        check.expect(dz["replicas"]["r1"]["state"] == "active"
+                     and "remote_client" in dz["replicas"]["r1"],
+                     "statusz detector block carries remote state + "
+                     "client clock view")
+        log(f"scrape done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 2: remote trace = one corr lane, no files shipped -
+        corr = h_remote.correlation_id
+        spans, skew = router.collect_fleet_trace(corr=corr)
+        names = {s["name"] for s in spans}
+        remote_spans = [s for s in spans if s.get("src") == "r1"]
+        check.expect("router:submit" in names,
+                     "stitched trace has the router-side span")
+        check.expect(bool(remote_spans)
+                     and {"queue_wait", "prefill"} <= {
+                         s["name"] for s in remote_spans},
+                     f"stitched trace has the replica-side spans "
+                     f"({len(remote_spans)} remote)")
+        check.expect(all(s.get("corr") == corr for s in spans),
+                     "every stitched span keyed by the request corr id")
+        rep = next((r for r in skew if r.get("replica") == "r1"), {})
+        check.expect(rep and not rep.get("clamped", True),
+                     f"skew within correction bound "
+                     f"(offset {rep.get('offset_s')}s)")
+        check.expect(spans == sorted(
+            spans, key=lambda s: (s["t0"], s["t1"])),
+            "stitched spans time-ordered after alignment")
+        spans_path = os.path.join(flight_dir, "stitched_spans.json")
+        with open(spans_path, "w") as f:
+            json.dump(spans, f)
+        merged_path = os.path.join(flight_dir, "merged_trace.json")
+        rc = trace_view_main([spans_path, "-o", merged_path,
+                              "--corr", corr])
+        lanes = set()
+        if rc == 0:
+            with open(merged_path) as f:
+                merged = json.load(f)
+            lanes = {e["tid"] for e in merged["traceEvents"]
+                     if e["ph"] in ("X", "i")}
+        check.expect(rc == 0 and len(lanes) == 1,
+                     f"trace_view renders the remote request as ONE "
+                     f"lane (rc={rc}, lanes={len(lanes)})")
+        log(f"trace done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 3: SLO burn on an induced stall -> tenant-labeled
+        # flight dump -------------------------------------------------
+        plan = FaultPlan([{"site": "serve.admit", "kind": "slow",
+                           "times": None, "delay": 0.2}], seed=3)
+        plan.install(env=False)
+        try:
+            for _ in range(4):
+                router.submit(prompt(8), max_new_tokens=4,
+                              prefer="local",
+                              adapter_id="tenantA").result(timeout=300)
+        finally:
+            plan.uninstall()
+        router.fleet_scrape_now()   # ingests the burn window
+        slo = router.slo_report()
+        ten = (slo or {}).get("tenants", {}).get("tenantA", {})
+        check.expect(ten.get("alerting") is True,
+                     f"tenantA fast-window burn alerting "
+                     f"(burn={ten.get('burn_fast')})")
+        dumps = sorted(f for f in os.listdir(flight_dir)
+                       if "slo_burn" in f)
+        tenants_dumped = []
+        for fname in dumps:
+            with open(os.path.join(flight_dir, fname)) as f:
+                tenants_dumped.append(
+                    (json.load(f).get("extra") or {}).get("tenant"))
+        check.expect("tenantA" in tenants_dumped,
+                     f"slo_burn flight dump carries the tenant label "
+                     f"(dumped: {tenants_dumped})")
+        host_tok = "".join(
+            c if (c.isalnum() or c in "_-") else "_"
+            for c in socket.gethostname())[:24] or "host"
+        check.expect(bool(dumps) and all(host_tok in d for d in dumps),
+                     f"flight dumps hostname-prefixed ({dumps[:1]})")
+        log(f"slo burn done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 4: partition mid-scrape -> partial roll-up --------
+        part = FaultPlan([{"site": "rpc.connect.r1",
+                           "kind": "partition", "times": None}], seed=0)
+        part.install(env=False)
+        try:
+            t0 = time.monotonic()
+            statz = router.fleet_scrape_now()
+            dur = time.monotonic() - t0
+        finally:
+            part.uninstall()
+        check.expect(statz["replicas"]["r1"]["stale"] is True
+                     and statz["replicas"]["r1"]["error"] is not None,
+                     f"partitioned replica stale-marked "
+                     f"({statz['replicas']['r1']['error']})")
+        check.expect(dur < 30.0,
+                     f"partitioned scrape stayed bounded ({dur:.1f}s)")
+        text = router.fleet_metrics_text()
+        check.expect('replica="r1"' in text
+                     and 'fleet_replica_stale{replica="r1"} 1.0' in text,
+                     "partial roll-up keeps last-known r1 numbers, "
+                     "stale-marked")
+        log(f"partition done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- teardown ------------------------------------------------
+        try:
+            rpc.rpc_sync("r1", remote_mod._host_request_stop,
+                         timeout=10.0, connect_deadline=2.0)
+        except Exception as e:
+            check.expect(False, f"stop signal to r1: {e}")
+        local.shutdown(drain=False, timeout=20.0)
+        rpc.shutdown(timeout=8.0)
+        rc1 = proc.wait(timeout=120)
+        check.expect(rc1 == 0, f"child exited clean (rc={rc1})")
+        summary = {"elapsed_s": round(time.monotonic() - t_start, 1),
+                   "failures": check.failures}
+        print(json.dumps({"fleet_obs_drill": summary}), flush=True)
+        return 0 if not check.failures else 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--endpoint", default=None)
+    args = ap.parse_args()
+    if args.child:
+        return child_main(args.endpoint)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

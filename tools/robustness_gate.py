@@ -46,14 +46,21 @@ story. Runs, in order:
    (heartbeat misses -> DEAD -> abandoned handles fail over), hedge
    winners token-identical to solo generate, and overload sheds failing
    fast (< 10%% of their deadline) instead of timing out;
-5. with ``--observability``, the telemetry gate in two parts:
+5. with ``--observability``, the telemetry gate in three parts:
    ``tools/flight_drill.py`` (an injected serve-loop crash must leave a
    well-formed flight-recorder dump carrying the failing request's
-   correlation id, consumable by ``tools/trace_view.py``) and
-   ``tools/decode_bench.py --trace-overhead`` (per-token span recording
-   on the decode hot loop must cost <2% throughput, tracing-on vs
-   tracing-off). The old scoped ``tpu_lint paddle_tpu/observability``
-   run folded into stage 0's whole-repo lint;
+   correlation id, consumable by ``tools/trace_view.py``),
+   ``tools/fleet_obs_drill.py`` (a 2-process rpc fleet: one
+   ``fleet_metrics_text()`` scrape returns BOTH processes' serving
+   metrics with per-replica labels; a replica partitioned mid-scrape
+   degrades to a stale-marked partial roll-up, not an error; a remote
+   request's stitched trace renders as one skew-aligned corr-id lane;
+   an SLO burn on an induced stall flight-dumps with the right tenant
+   label), and ``tools/decode_bench.py --trace-overhead`` (per-token
+   span recording on the decode hot loop must cost <2% throughput,
+   tracing-on vs tracing-off). The old scoped ``tpu_lint
+   paddle_tpu/observability`` run folded into stage 0's whole-repo
+   lint;
 6. with ``--lora``, ``tools/lora_soak.py`` — the multi-tenant adapter
    lifecycle: fine-tune a tiny adapter 20 steps under the supervisor,
    hard-kill the process mid-checkpoint-save, resume from the newest
@@ -198,9 +205,9 @@ def main() -> int:
                          "+ scoped tpu_lint of paddle_tpu/lora)")
     ap.add_argument("--observability", action="store_true",
                     help="also run the telemetry gate (flight-recorder "
-                         "crash drill + scoped tpu_lint of "
-                         "paddle_tpu/observability + <2%% decode "
-                         "tracing overhead)")
+                         "crash drill + 2-process fleet observability "
+                         "drill [scrape/partition/SLO-burn/trace] + "
+                         "<2%% decode tracing overhead)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     ap.add_argument("--full-lint", action="store_true",
@@ -247,6 +254,10 @@ def main() -> int:
         results["flight_drill"] = _run(
             "flight_drill", [sys.executable,
                              os.path.join(TOOLS, "flight_drill.py")])
+        results["fleet_obs_drill"] = _run(
+            "fleet_obs_drill", [sys.executable,
+                                os.path.join(TOOLS,
+                                             "fleet_obs_drill.py")])
         results["trace_overhead"] = _run(
             "trace_overhead", [sys.executable,
                                os.path.join(TOOLS, "decode_bench.py"),

@@ -17,6 +17,10 @@ and merges every span into ONE chrome trace where each correlation id is
 a single named lane, regardless of which process recorded which piece.
 Wall-clock timestamps make the cross-process merge line up.
 
+Flight dumps are hostname-prefixed (``flight_<host>_<pid>_...``), so
+many hosts can share one dump dir (NFS); ``--list`` groups its summary
+by recording host when more than one contributed.
+
     python tools/trace_view.py flight_records/*.json -o merged.json
     python tools/trace_view.py --list flight_records/*.json
     python tools/trace_view.py --corr req-1f03ab-000004 dumps/*.json \\
@@ -70,12 +74,18 @@ def load_spans(path: str) -> Tuple[List[dict], str]:
     label = os.path.basename(path)
     if isinstance(obj, dict) and obj.get("format") == "flight_recorder":
         label = f"{label}:pid{obj.get('pid', '?')}"
+        host = obj.get("host")
         spans = []
         for rec in obj.get("spans", []):
             rec = dict(rec)
             rec["src"] = label
+            if host:
+                rec.setdefault("host", host)
             spans.append(rec)
-        spans.extend(_events_as_spans(obj.get("events", []), label))
+        for rec in _events_as_spans(obj.get("events", []), label):
+            if host:
+                rec.setdefault("host", host)
+            spans.append(rec)
         return spans, "flight"
     if isinstance(obj, dict) and "traceEvents" in obj:
         return _spans_from_chrome(obj, label), "chrome"
@@ -120,6 +130,8 @@ def merge_chrome(spans: List[dict], corr: Optional[str] = None) -> dict:
             args["correlation_id"] = s["corr"]
         if s.get("src"):
             args["source"] = s["src"]
+        if s.get("host"):
+            args["host"] = s["host"]
         t0, t1 = float(s["t0"]), float(s["t1"])
         ev = {"name": s.get("name", "?"), "pid": 1, "tid": tid,
               "ts": t0 * 1e6, "args": args}
@@ -139,7 +151,8 @@ def list_correlations(spans: List[dict]) -> List[dict]:
             continue
         e = by_corr.setdefault(c, {"corr": c, "spans": 0,
                                    "t0": s["t0"], "t1": s["t1"],
-                                   "names": [], "sources": set()})
+                                   "names": [], "sources": set(),
+                                   "hosts": set()})
         e["spans"] += 1
         e["t0"] = min(e["t0"], s["t0"])
         e["t1"] = max(e["t1"], s["t1"])
@@ -147,12 +160,26 @@ def list_correlations(spans: List[dict]) -> List[dict]:
             e["names"].append(s.get("name"))
         if s.get("src"):
             e["sources"].add(s["src"])
+        if s.get("host"):
+            e["hosts"].add(s["host"])
     out = []
     for e in sorted(by_corr.values(), key=lambda e: e["t0"]):
         e["duration_ms"] = round((e["t1"] - e["t0"]) * 1e3, 3)
         e["sources"] = sorted(e["sources"])
+        e["hosts"] = sorted(e["hosts"])
         out.append(e)
     return out
+
+
+def group_by_host(spans: List[dict]) -> dict:
+    """``{host: sorted source labels}`` — dumps from many hosts sharing
+    one flight dir (NFS) group under their recording host; spans with
+    no host annotation book under ``"local"``."""
+    by_host: dict = {}
+    for s in spans:
+        h = s.get("host") or "local"
+        by_host.setdefault(h, set()).add(s.get("src") or "?")
+    return {h: sorted(srcs) for h, srcs in sorted(by_host.items())}
 
 
 def main(argv=None) -> int:
@@ -185,6 +212,14 @@ def main(argv=None) -> int:
         return 2
 
     if args.list:
+        groups = group_by_host(spans)
+        if len(groups) > 1:
+            # multi-host flight dir (hostname-prefixed dumps): lead with
+            # a per-host roll-up so an operator sees which machines
+            # contributed; '#' lines keep per-corr output line-JSON
+            for host, sources in groups.items():
+                print(f"# host {host}: {len(sources)} source(s): "
+                      f"{', '.join(sources)}")
         for e in list_correlations(spans):
             if args.corr and args.corr not in e["corr"]:
                 continue
