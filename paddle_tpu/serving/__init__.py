@@ -7,7 +7,9 @@ control with backpressure and deadlines (``scheduler``), a threaded
 front end with per-request streaming and crash recovery (``server``),
 operator metrics (``metrics``), a paged prefix/KV block pool for
 cross-request prompt reuse (``prefix_cache``), a load-aware router
-over N replicas (``router``), and batched multi-tenant LoRA decode
+over N replicas (``router``), a burn-rate-driven autoscaler that
+closes the SLO control loop over that fleet (``autoscaler``), and
+batched multi-tenant LoRA decode
 (``adapter_store=`` on the engine + ``adapter_id=`` per request — see
 ``paddle_tpu.lora``). See README "Serving", "Fleet serving" and
 "Multi-tenant LoRA serving" for the architecture sketches.
@@ -24,6 +26,8 @@ over N replicas (``router``), and batched multi-tenant LoRA decode
         ...
 """
 from ..lora.store import (AdapterError, AdapterStore)  # noqa: F401
+from .autoscaler import (Autoscaler,  # noqa: F401
+                         ProcessReplicaSpawner)
 from .engine import ContinuousBatchingEngine, SlotEvent  # noqa: F401
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
 from .prefix_cache import BlockPool, PrefixHit, StorePlan  # noqa: F401
@@ -32,13 +36,16 @@ from .remote import (RemoteHandle, RemoteReplica,  # noqa: F401
 from .router import (ACTIVE, DEAD, DRAINING, SUSPECT,  # noqa: F401
                      NoReplicasAvailable, ReplicaRouter, RouterHandle)
 from .scheduler import (Backpressure, FifoScheduler,  # noqa: F401
-                        Overloaded, QueueFull, Request, SchedulerClosed)
+                        Overloaded, QueueFull, RateLimited, Request,
+                        SchedulerClosed, TokenBucket)
 from .server import InferenceServer, RequestHandle  # noqa: F401
 
 __all__ = [
     "ContinuousBatchingEngine", "SlotEvent", "InferenceServer",
     "RequestHandle", "FifoScheduler", "Request", "Backpressure",
-    "QueueFull", "Overloaded", "SchedulerClosed", "ServingMetrics",
+    "QueueFull", "Overloaded", "RateLimited", "TokenBucket",
+    "SchedulerClosed", "ServingMetrics", "Autoscaler",
+    "ProcessReplicaSpawner",
     "LatencyHistogram", "BlockPool", "PrefixHit", "StorePlan",
     "ReplicaRouter", "RouterHandle", "NoReplicasAvailable",
     "RemoteReplica", "RemoteHandle", "ReplicaUnreachable",

@@ -111,6 +111,7 @@ class ServingMetrics:
                 "serving.requests_rejected": self.requests_rejected,
                 "serving.requests_expired": self.requests_expired,
                 "serving.requests_shed": self.requests_shed,
+                "serving.requests_rate_limited": self.requests_rate_limited,
                 "serving.requests_failed": self.requests_failed,
                 "serving.requests_requeued": self.requests_requeued,
                 "serving.tokens_emitted": self.tokens_emitted,
@@ -148,6 +149,11 @@ class ServingMetrics:
             # (non-retryable faults): a client backs off a shed, gives
             # up on an expiry, and pages on a failure
             self.requests_shed = 0
+            # per-tenant token-bucket rejects (RateLimited, retryable):
+            # separate from requests_shed — a shed says the FLEET is
+            # over capacity, a rate-limit says one TENANT is over ITS
+            # allowance while everyone else is fine
+            self.requests_rate_limited = 0
             self.requests_failed = 0
             self.requests_requeued = 0
             self.tokens_emitted = 0
@@ -258,6 +264,7 @@ class ServingMetrics:
                 "requests_rejected": self.requests_rejected,
                 "requests_expired": self.requests_expired,
                 "requests_shed": self.requests_shed,
+                "requests_rate_limited": self.requests_rate_limited,
                 "requests_failed": self.requests_failed,
                 "requests_requeued": self.requests_requeued,
                 "tokens_emitted": self.tokens_emitted,

@@ -97,7 +97,8 @@ from ..observability import registry as _obs_registry
 from ..observability import tracing as _tracing
 from .prefix_cache import BlockPool  # noqa: F401  (re-export convenience)
 from .remote import ReplicaUnreachable
-from .scheduler import Backpressure, QueueFull, SchedulerClosed
+from .scheduler import (Backpressure, QueueFull, RateLimited,
+                        SchedulerClosed)
 from .server import InferenceServer, RequestHandle
 
 __all__ = ["ReplicaRouter", "RouterHandle", "NoReplicasAvailable",
@@ -550,6 +551,9 @@ class ReplicaRouter:
             self._slo = SloTracker(slo_policy)
         self._scrape_stop: Optional[threading.Event] = None
         self._scrape_thread: Optional[threading.Thread] = None
+        # --- autoscaler (attached by serving.autoscaler.Autoscaler;
+        # None = off, PR 15 behavior bit-identical) ---
+        self._autoscaler = None
         for r in replicas:
             self.add_replica(r)
         if self.health_check_interval:
@@ -872,6 +876,43 @@ class ReplicaRouter:
             return max(self.hedge_min_s,
                        self._itl_ewma * self.hedge_multiplier)
 
+    # ---------------------------------------------------- control loop
+    def _attach_autoscaler(self, autoscaler) -> None:
+        """Register the :class:`~paddle_tpu.serving.autoscaler.Autoscaler`
+        driving this fleet (called from its constructor): ``statusz()``
+        embeds its block and ``shutdown()`` stops its loop first."""
+        self._autoscaler = autoscaler
+
+    def register_adapter(self, name: str, state) -> Dict[str, bool]:
+        """Hot-swap one tenant's adapter fleet-wide: re-register
+        ``name`` on every live replica's :class:`AdapterStore`. The
+        store's version-salt machinery does the heavy lifting — new
+        requests acquire the new version (fresh salt, so the compile
+        cache and prefix pages can never serve stale weights) while
+        live streams finish on their pinned rows, which free when the
+        last pin drops. Returns ``{replica: True}`` per updated replica
+        (``False`` where the replica has no adapter store or the rpc
+        failed — placement keeps avoiding those via adapter affinity).
+        Store registration runs OUTSIDE the router lock: a remote
+        replica's store call is an rpc."""
+        with self._lock:
+            reps = [(r.name, r.server) for r in self._replicas.values()
+                    if r.state != DEAD]
+        out: Dict[str, bool] = {}
+        for rep_name, server in reps:
+            store = getattr(getattr(server, "engine", None), "store", None)
+            if store is None:
+                out[rep_name] = False
+                continue
+            try:
+                store.register(name, state)
+                out[rep_name] = True
+            except Exception:
+                out[rep_name] = False
+        _flight.note("adapter_swap", adapter=name,
+                     replicas=sum(out.values()))
+        return out
+
     # ------------------------------------------------------- membership
     def add_replica(self, server: InferenceServer,
                     name: Optional[str] = None) -> str:
@@ -1037,12 +1078,19 @@ class ReplicaRouter:
         kwargs = handle._kwargs
         prompt = kwargs["prompt"]
         saw_full = False
+        rate_limited = None
         for rep in self._candidates(prompt, prefer,
                                     kwargs.get("adapter_id")):
             if rep.name in exclude:
                 continue             # hedges skip the stalled replica
             try:
                 inner = rep.server.submit(**kwargs)
+            except RateLimited as e:
+                # the TENANT is over its per-replica allowance — another
+                # replica's bucket may still have tokens, so keep
+                # failing over; remember the verdict in case none does
+                rate_limited = e
+                continue
             except Backpressure:
                 # QueueFull (at depth) or Overloaded (deadline-aware
                 # shed): the replica is alive, just over capacity —
@@ -1061,7 +1109,13 @@ class ReplicaRouter:
                 rep.routed += 1
                 self.requests_routed += 1
             return
-        if saw_full:
+        if rate_limited is not None and not saw_full:
+            # EVERY rejection was this tenant's own rate limit: surface
+            # it (tenant + retry_after intact) — "no replicas" advice
+            # would send the client chasing membership instead of
+            # backing off its own traffic
+            raise rate_limited
+        if saw_full or rate_limited is not None:
             # at least one LIVE replica exists and rejected on
             # depth/deadline: backpressure, not a fleet-down condition
             raise QueueFull(
@@ -1128,6 +1182,10 @@ class ReplicaRouter:
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """Stop every replica (see ``InferenceServer.shutdown``)."""
+        if self._autoscaler is not None:
+            # the controller first: a scaling decision mid-shutdown
+            # would race the membership teardown below
+            self._autoscaler.stop()
         if self._health_stop is not None:
             self._health_stop.set()
             if self._health_thread is not None:
@@ -1172,7 +1230,9 @@ class ReplicaRouter:
         replica is diagnosable from this one endpoint."""
         return {"time": round(time.time(), 3), "pid": os.getpid(),
                 "replicas": self.replicas(), "snapshot": self.snapshot(),
-                "detector": self.detector_statusz()}
+                "detector": self.detector_statusz(),
+                **({"autoscaler": self._autoscaler.statusz()}
+                   if self._autoscaler is not None else {})}
 
     def metrics_text(self) -> str:
         """Prometheus text for the whole process (all replicas share the

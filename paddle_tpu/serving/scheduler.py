@@ -35,20 +35,37 @@ The prefill/decode interleaving policy also lives here:
 ``max_prefills_per_step`` bounds how many admissions (each one compiled
 prefill dispatch) may run between consecutive decode iterations, so a
 burst of arrivals cannot starve in-flight requests' inter-token latency.
+
+Per-tenant fairness (both knobs default off) closes the abusive-tenant
+hole: a token bucket per tenant (``tenant_rate``/``tenant_burst``, or
+per-tenant overrides via ``tenant_limits``) fast-fails an over-rate
+submit with :class:`RateLimited` — retryable ``Backpressure``, so a
+well-behaved client backs off while a 10x tenant stops starving the
+depth cap — and ``fair_queueing=True`` turns ``take()`` into deficit
+round-robin over tenant queues (weights via ``fair_weights``), so the
+admission order interleaves tenants instead of serving whoever flooded
+the FIFO first. Both compose with deadline-aware shedding unchanged:
+the shed request is still the one predicted to miss its SLO, and the
+head of the queue is still never shed.
 """
 from __future__ import annotations
 
 import itertools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..distributed.resilience import Deadline
 
-__all__ = ["Backpressure", "QueueFull", "Overloaded", "SchedulerClosed",
-           "Request", "FifoScheduler"]
+__all__ = ["Backpressure", "QueueFull", "Overloaded", "RateLimited",
+           "SchedulerClosed", "Request", "FifoScheduler", "TokenBucket",
+           "BASE_TENANT"]
+
+#: tenant key for requests with no adapter (the base model is a tenant
+#: too — otherwise un-adapted traffic would be exempt from fairness)
+BASE_TENANT = "__base__"
 
 _req_serial = itertools.count()
 
@@ -73,8 +90,64 @@ class Overloaded(Backpressure):
     actually lapsed in queue."""
 
 
+class RateLimited(Backpressure):
+    """Per-tenant token-bucket reject: this TENANT is over its admission
+    rate right now, independent of queue depth — the fleet may be idle
+    and the submit still fails. Retryable (``ConnectionError`` via
+    :class:`Backpressure`): the bucket refills at ``rate`` tokens/s, so
+    a client that backs off ``retry_after`` seconds is expected to get
+    in. Carries ``tenant`` so admission telemetry can attribute the
+    reject without parsing the message."""
+
+    def __init__(self, message: str, tenant: str = "?",
+                 retry_after: float = 0.0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after = float(retry_after)
+
+
 class SchedulerClosed(RuntimeError):
     """Submit after shutdown began — not retryable."""
+
+
+class TokenBucket:
+    """Classic token bucket over an injected monotonic clock reading.
+
+    Not itself thread-safe: the scheduler serializes every touch under
+    its own lock, and the caller passes ``now`` in so one lock-held
+    clock read covers every bucket consulted in that submit."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_t")
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0 or burst <= 0:
+            raise ValueError("token bucket rate and burst must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._t: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self._t is not None:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def try_take(self, now: float, n: float = 1.0) -> bool:
+        self._refill(now)
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    def level(self, now: float) -> float:
+        """Current token count (refilled to ``now``)."""
+        self._refill(now)
+        return self._tokens
+
+    def retry_after(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available (0 if already)."""
+        return max(0.0, (n - self._tokens) / self.rate)
 
 
 @dataclass
@@ -122,14 +195,36 @@ class FifoScheduler:
 
     def __init__(self, max_queue_depth: int = 64,
                  max_prefills_per_step: int = 2,
-                 shed_on_overload: bool = False):
+                 shed_on_overload: bool = False,
+                 tenant_rate: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 tenant_limits: Optional[Dict[str, Tuple[float, float]]] = None,
+                 fair_queueing: bool = False,
+                 fair_weights: Optional[Dict[str, float]] = None,
+                 clock=time.monotonic):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
         if max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
+        if tenant_rate is not None and tenant_rate <= 0:
+            raise ValueError("tenant_rate must be > 0 when set")
         self.max_queue_depth = int(max_queue_depth)
         self.max_prefills_per_step = int(max_prefills_per_step)
         self.shed_on_overload = bool(shed_on_overload)
+        # per-tenant admission rate limiting: default rate/burst for every
+        # tenant, with (rate, burst) overrides per tenant name. Both None
+        # and no overrides => no buckets, bit-identical admission.
+        self.tenant_rate = None if tenant_rate is None else float(tenant_rate)
+        self.tenant_burst = (float(tenant_burst) if tenant_burst is not None
+                             else (max(1.0, self.tenant_rate)
+                                   if self.tenant_rate is not None else None))
+        self._tenant_limits = dict(tenant_limits or {})
+        self.fair_queueing = bool(fair_queueing)
+        self._fair_weights = dict(fair_weights or {})
+        self._clock = clock          # buckets only; cadence EWMA stays on
+        self._buckets: Dict[str, TokenBucket] = {}   # time.monotonic
+        self._drr_deficit: Dict[str, float] = {}
+        self._drr_next: Optional[str] = None
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._closed = False
@@ -139,6 +234,36 @@ class FifoScheduler:
         # — no shedding decision is made on zero evidence.
         self._svc_ewma: Optional[float] = None
         self._last_admit_t: Optional[float] = None
+
+    @staticmethod
+    def tenant_of(request: Request) -> str:
+        """The fairness key: the request's adapter id, or
+        :data:`BASE_TENANT` for base-model traffic."""
+        return (request.adapter_id if request.adapter_id is not None
+                else BASE_TENANT)
+
+    def _bucket_locked(self, tenant: str) -> Optional[TokenBucket]:
+        bucket = self._buckets.get(tenant)
+        if bucket is not None:
+            return bucket
+        if tenant in self._tenant_limits:
+            rate, burst = self._tenant_limits[tenant]
+        elif self.tenant_rate is not None:
+            rate, burst = self.tenant_rate, self.tenant_burst
+        else:
+            return None   # rate limiting off for this tenant
+        bucket = TokenBucket(rate, burst)
+        self._buckets[tenant] = bucket
+        return bucket
+
+    def bucket_levels(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant token-bucket fill for statusz — only tenants that
+        have submitted since startup appear (buckets are lazy)."""
+        now = self._clock()
+        with self._lock:
+            return {t: {"tokens": round(b.level(now), 3),
+                        "rate": b.rate, "burst": b.burst}
+                    for t, b in sorted(self._buckets.items())}
 
     @property
     def depth(self) -> int:
@@ -161,6 +286,18 @@ class FifoScheduler:
         with self._lock:
             if self._closed:
                 raise SchedulerClosed("scheduler is shut down")
+            tenant = self.tenant_of(request)
+            bucket = self._bucket_locked(tenant)
+            if bucket is not None and not bucket.try_take(self._clock()):
+                # checked BEFORE the depth cap: an over-rate tenant gets
+                # the reject attributed to ITS rate, not to fleet
+                # capacity — and burns none of the shared queue
+                retry_after = bucket.retry_after()
+                raise RateLimited(
+                    f"tenant {tenant!r} over its admission rate "
+                    f"({bucket.rate:.3g}/s, burst {bucket.burst:.3g}); "
+                    f"retry in {retry_after:.3f}s",
+                    tenant=tenant, retry_after=retry_after)
             if len(self._q) >= self.max_queue_depth:
                 raise QueueFull(
                     f"admission queue full ({self.max_queue_depth} "
@@ -202,12 +339,15 @@ class FifoScheduler:
                 # idle: reset the cadence clock so the NEXT admission
                 # interval measures service, not the lull before it
                 self._last_admit_t = now
-            while self._q and len(admit) < budget:
-                req = self._q.popleft()
-                if req.deadline is not None and req.deadline.expired():
-                    expired.append(req)
-                    continue
-                admit.append(req)
+            if self.fair_queueing:
+                self._take_fair_locked(budget, admit, expired)
+            else:
+                while self._q and len(admit) < budget:
+                    req = self._q.popleft()
+                    if req.deadline is not None and req.deadline.expired():
+                        expired.append(req)
+                        continue
+                    admit.append(req)
             if admit and self._last_admit_t is not None:
                 per = max(0.0, now - self._last_admit_t) / len(admit)
                 self._svc_ewma = (per if self._svc_ewma is None else
@@ -216,6 +356,59 @@ class FifoScheduler:
             if admit:
                 self._last_admit_t = now
         return admit, expired
+
+    def _take_fair_locked(self, budget: int, admit: List[Request],
+                          expired: List[Request]) -> None:
+        """Deficit round-robin over per-tenant FIFO views of the queue.
+
+        Each round, every tenant with queued work earns ``weight``
+        deficit (default 1.0) and admits its oldest requests while the
+        deficit covers them (cost 1 each); a tenant whose queue empties
+        forfeits its unspent deficit — idle time must not bank credit a
+        returning flood could spend all at once. Service resumes after
+        the tenant served last (``_drr_next``), so fairness holds across
+        ``take()`` calls, not just within one. FIFO order is preserved
+        within each tenant, and expired requests are popped for the
+        caller to fail (costing no deficit) exactly as the plain path
+        does."""
+        per_tenant: "OrderedDict[str, deque]" = OrderedDict()
+        for req in self._q:
+            per_tenant.setdefault(self.tenant_of(req), deque()).append(req)
+        names = list(per_tenant)
+        if self._drr_next in per_tenant:
+            i = names.index(self._drr_next)
+            names = names[i:] + names[:i]
+        for t in list(self._drr_deficit):
+            if t not in per_tenant:   # no queued work: forfeit credit
+                del self._drr_deficit[t]
+        taken = set()
+        last_served: Optional[str] = None
+        while len(admit) < budget and any(per_tenant.values()):
+            for name in names:
+                q = per_tenant[name]
+                if not q:
+                    self._drr_deficit.pop(name, None)
+                    continue
+                self._drr_deficit[name] = (
+                    self._drr_deficit.get(name, 0.0)
+                    + max(1e-3, self._fair_weights.get(name, 1.0)))
+                while q and self._drr_deficit[name] >= 1.0 \
+                        and len(admit) < budget:
+                    req = q.popleft()
+                    taken.add(id(req))
+                    if req.deadline is not None and req.deadline.expired():
+                        expired.append(req)
+                        continue
+                    admit.append(req)
+                    last_served = name
+                    self._drr_deficit[name] -= 1.0
+                if len(admit) >= budget:
+                    break
+        if taken:
+            self._q = deque(r for r in self._q if id(r) not in taken)
+        if last_served is not None:
+            self._drr_next = names[(names.index(last_served) + 1)
+                                   % len(names)]
 
     def pop_expired(self) -> List[Request]:
         """Sweep expired requests out of the queue without admitting

@@ -48,8 +48,8 @@ from ..observability import registry as _obs_registry
 from ..observability import tracing as _tracing
 from .engine import ContinuousBatchingEngine
 from .metrics import ServingMetrics
-from .scheduler import (FifoScheduler, Overloaded, QueueFull, Request,
-                        SchedulerClosed)
+from .scheduler import (FifoScheduler, Overloaded, QueueFull, RateLimited,
+                        Request, SchedulerClosed)
 
 __all__ = ["InferenceServer", "RequestHandle"]
 
@@ -174,7 +174,12 @@ class InferenceServer:
                  top_k: int = 0, allow_top_p: bool = True,
                  max_request_retries: int = 1,
                  prefix_cache=None, adapter_store=None,
-                 shed_on_overload: bool = False):
+                 shed_on_overload: bool = False,
+                 tenant_rate: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 tenant_limits=None,
+                 fair_queueing: bool = False,
+                 fair_weights=None):
         self.engine = ContinuousBatchingEngine(
             network, slots=slots, max_length=max_length,
             prefill_buckets=prefill_buckets, top_k=top_k,
@@ -183,7 +188,10 @@ class InferenceServer:
         self.scheduler = FifoScheduler(
             max_queue_depth=max_queue_depth,
             max_prefills_per_step=max_prefills_per_step,
-            shed_on_overload=shed_on_overload)
+            shed_on_overload=shed_on_overload,
+            tenant_rate=tenant_rate, tenant_burst=tenant_burst,
+            tenant_limits=tenant_limits, fair_queueing=fair_queueing,
+            fair_weights=fair_weights)
         self.metrics = ServingMetrics(slots)
         self.max_request_retries = int(max_request_retries)
         self._cv = threading.Condition()
@@ -287,6 +295,19 @@ class InferenceServer:
                 _tracing.record_event("shed", corr=corr,
                                       queue_depth=self.scheduler.depth)
                 raise
+            except RateLimited as e:
+                # the tenant is over ITS admission rate — the system
+                # working as designed, not an availability failure: no
+                # _adapter_fail, so an abusive tenant's rejects cannot
+                # burn an SLO window and buy fleet capacity through the
+                # autoscaler. The flight note carries the tenant label
+                # into every subsequent dump (trace_view --list).
+                self.metrics.inc("requests_rate_limited")
+                _tracing.record_event("rate_limited", corr=corr,
+                                      tenant=e.tenant)
+                _flight.note("rate_limited", corr=corr, tenant=e.tenant,
+                             retry_after_s=round(e.retry_after, 3))
+                raise
             except QueueFull:
                 self.metrics.inc("requests_rejected")
                 _tracing.record_event("rejected", corr=corr,
@@ -363,6 +384,9 @@ class InferenceServer:
             "queue_depth": self.scheduler.depth,
             "prefill_buckets": list(self.engine.prefill_buckets),
             "snapshot": self.snapshot(),
+            # per-tenant token-bucket fill (empty dict when rate
+            # limiting is off or no tenant has submitted yet)
+            "token_buckets": self.scheduler.bucket_levels(),
             "flight": _flight.flight_recorder().stats(),
             "trace": _tracing.stats(),
         }

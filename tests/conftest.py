@@ -45,6 +45,31 @@ def pytest_configure(config):
         "markers", "slow: excluded from the tier-1 time budget")
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Tier-1 time-budget report: the slowest tests of this run, so the
+    next offender to move behind the ``slow`` marker is visible in every
+    CI log instead of requiring a separate ``--durations`` run. Call +
+    setup + teardown are summed per test (a fixture-heavy test is just
+    as much over budget as a slow body)."""
+    durations: dict = {}
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            dur = getattr(rep, "duration", None)
+            nodeid = getattr(rep, "nodeid", None)
+            if dur is None or not nodeid:
+                continue
+            durations[nodeid] = durations.get(nodeid, 0.0) + dur
+    if not durations:
+        return
+    top = sorted(durations.items(), key=lambda kv: -kv[1])[:10]
+    total = sum(durations.values())
+    tr = terminalreporter
+    tr.write_sep("=", "slowest tests (tier-1 time budget)")
+    for nodeid, dur in top:
+        tr.write_line(f"{dur:8.2f}s  {nodeid}")
+    tr.write_line(f"{total:8.2f}s  total across {len(durations)} tests")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu

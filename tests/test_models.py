@@ -69,6 +69,7 @@ def test_resnet18_forward():
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow   # ~19s compile on the CI box; resnet18 covers tier-1
 def test_resnet50_train_step():
     model = resnet50(num_classes=4)
     import paddle_tpu.nn.functional as F

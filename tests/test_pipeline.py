@@ -35,8 +35,13 @@ def _clean_mesh():
     set_mesh(None)
 
 
+@pytest.mark.slow
 def test_virtual_stages_parity():
-    """pp=2 x virtual=2 interleaved == sequential, incl. grads."""
+    """pp=2 x virtual=2 interleaved == sequential, incl. grads.
+
+    Slow-tier: the remat'd grad parity compiles ~22s on the CI box
+    (tier-1 slowest-tests report); test_virtual_stages_many_microbatches
+    keeps the interleaved path covered inside the budget."""
     pt.seed(5)
     m = init_mesh(pp=2, dp=4)
     set_mesh(None)
@@ -286,9 +291,13 @@ def test_pipeline_batchnorm_multi_micro_updates_once_per_microbatch():
                                    atol=1e-6, err_msg=k)
 
 
+@pytest.mark.slow
 def test_heterogeneous_pipeline_shards_params_over_pp():
     """Per-stage params live in ONE [pp, maxlen] stack sharded over pp —
-    a rank holds its own stage (+padding), not pp replicas of everything."""
+    a rank holds its own stage (+padding), not pp replicas of everything.
+
+    Slow-tier (~18s on the CI box); test_heterogeneous_pipeline_parity
+    keeps the mixed-stage path in the tier-1 budget."""
     pt.seed(11)
     m = init_mesh(pp=4)
     set_mesh(None)

@@ -126,8 +126,10 @@ def test_dataset_folder_npy(tmp_path):
     (lambda: models.vgg11(num_classes=7), (1, 3, 32, 32), 7),
     (lambda: models.mobilenet_v1(scale=0.25, num_classes=5), (1, 3, 32, 32), 5),
     (lambda: models.mobilenet_v2(scale=0.25, num_classes=5), (1, 3, 32, 32), 5),
-    (lambda: models.mobilenet_v3_small(scale=0.5, num_classes=5),
-     (1, 3, 64, 64), 5),
+    # mobilenet_v3's hard-swish/SE stack compiles ~27s on the CI box —
+    # slow-tier (v1/v2 keep the family's tier-1 coverage)
+    pytest.param(lambda: models.mobilenet_v3_small(scale=0.5, num_classes=5),
+                 (1, 3, 64, 64), 5, marks=pytest.mark.slow),
 ])
 def test_model_forward_shapes(ctor, in_shape, n_out):
     pt.seed(0)
@@ -224,7 +226,8 @@ def test_ppyoloe_tal_assigns_inside_anchors():
     assert np.asarray(fg2).sum() == 0
 
 
-def test_ppyoloe_trains():
+@pytest.mark.slow   # ~16s train-step compile; forward/decode/TAL/fuse
+def test_ppyoloe_trains():    # parity keep the head covered in tier-1
     from paddle_tpu.models.ppyoloe import ppyoloe_tiny
     from paddle_tpu.nn.layer import buffer_state, functional_call, param_state
 
@@ -287,8 +290,15 @@ def test_vision_models_zero_missing_vs_reference():
 
 
 @pytest.mark.parametrize("factory", [
-    "alexnet", "squeezenet1_1", "shufflenet_v2_x0_25", "densenet121",
-    "googlenet", "inception_v3", "mobilenet_v3_large", "resnext50_64x4d",
+    # the four deepest stems compile 20-45s EACH on the CI box (top of
+    # the tier-1 slowest-tests report) — slow-tier; the remaining four
+    # keep every code path (plain conv, fire, channel-shuffle, grouped)
+    # inside the budget
+    "alexnet", "squeezenet1_1", "shufflenet_v2_x0_25", "resnext50_64x4d",
+    pytest.param("densenet121", marks=pytest.mark.slow),
+    pytest.param("googlenet", marks=pytest.mark.slow),
+    pytest.param("inception_v3", marks=pytest.mark.slow),
+    pytest.param("mobilenet_v3_large", marks=pytest.mark.slow),
 ])
 def test_new_vision_family_forward(factory):
     import paddle_tpu.vision.models as M

@@ -252,6 +252,7 @@ def test_generate_proposals_pipeline():
     assert (np.diff(p0) <= 1e-6).all()
 
 
+@pytest.mark.slow   # ~19s grad compile on the CI box (tier-1 report)
 def test_yolo_loss_matching_and_grads():
     """Responsible-cell construction: loss decreases when predictions move
     toward the target; grads flow; ignore band suppresses high-IoU

@@ -39,6 +39,16 @@ story. Runs, in order:
    stay token-identical to a solo ``generate`` (no divergence across the
    reroute), and the survivor must hold its #buckets+1 compile budget
    with zero steady-state recompiles;
+4a. with ``--fairness``, ``tools/serve_bench.py --fairness`` — the
+   adversarial SLO-control-loop trace: one abusive tenant at 10x rate
+   (token-bucket throttled, its rejects booking ZERO tenant failures so
+   abuse cannot buy capacity) plus a traffic spike whose slow-window
+   burn must force a REAL burn-driven scale-out (child replica spawned
+   over the rpc fabric mid-run, its cold-start-to-first-token
+   reported); protected tenants' fast-window burn must never
+   edge-trigger, zero requests may be lost across the scale events, and
+   the #buckets+1 compile budget must hold on every replica, the
+   cold-started one included;
 4b. with ``--fleet-chaos``, ``tools/fleet_chaos.py --quick`` — the
    CROSS-HOST fleet soak: rpc remote replicas in child processes under
    SIGKILL + network partition + slow-replica (``slow`` fault) +
@@ -78,6 +88,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --skip-sweep   # lint + soak only
     python tools/robustness_gate.py --elastic      # + shrink/grow proof
     python tools/robustness_gate.py --fleet        # + serving-fleet crash
+    python tools/robustness_gate.py --fairness     # + SLO control loop
     python tools/robustness_gate.py --fleet-chaos  # + cross-host rpc soak
     python tools/robustness_gate.py --lora         # + adapter lifecycle
     python tools/robustness_gate.py --observability  # + telemetry gate
@@ -195,6 +206,11 @@ def main() -> int:
                     help="also run the serving-fleet replica-crash "
                          "scenario (router reroute, token parity, "
                          "compile budget)")
+    ap.add_argument("--fairness", action="store_true",
+                    help="also run the adversarial SLO-control-loop "
+                         "trace (10x abusive tenant + spike-driven "
+                         "burn scale-out over rpc, "
+                         "tools/serve_bench.py --fairness)")
     ap.add_argument("--fleet-chaos", action="store_true",
                     help="also run the cross-host rpc fleet soak "
                          "(SIGKILL + partition + slow replica + "
@@ -245,6 +261,11 @@ def main() -> int:
                       "--check", "--replicas", "2", "--prefix-cache-mb",
                       "4", "--prefix-tokens", "24", "--crash-replica",
                       "--verify", "3"])
+    if args.fairness:
+        results["fairness"] = _run(
+            "fairness", [sys.executable,
+                         os.path.join(TOOLS, "serve_bench.py"),
+                         "--fairness"])
     if args.fleet_chaos:
         results["fleet_chaos"] = _run(
             "fleet_chaos", [sys.executable,

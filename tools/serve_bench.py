@@ -135,7 +135,26 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-availability", type=float, default=0.99,
                     help="per-tenant availability target for the "
                          "slo_report burn rates")
+    # ---- adversarial fairness trace (SLO control loop, PR 16) ----
+    ap.add_argument("--fairness", action="store_true",
+                    help="adversarial SLO-control-loop trace: one "
+                         "abusive tenant at 10x rate (token-bucket "
+                         "throttled), a traffic spike that must force "
+                         "a REAL burn-driven scale-out (child replica "
+                         "over rpc), protected tenants' fast-window "
+                         "burn must never edge-trigger, zero requests "
+                         "lost across the scale events")
+    ap.add_argument("--child-replica", action="store_true",
+                    help="internal: host one replica for a --fairness "
+                         "parent (rpc rank 1)")
+    ap.add_argument("--endpoint", default=None,
+                    help="internal: rpc master endpoint for "
+                         "--child-replica")
     args = ap.parse_args(argv)
+    if args.child_replica:
+        return _child_replica_main(args)
+    if args.fairness:
+        return _fairness_main(args)
     if args.check:
         args.requests = min(args.requests, 8)
         args.rate = min(args.rate, 4.0)
@@ -511,6 +530,396 @@ def main(argv=None) -> int:
     if args.crash_replica and failed:
         print(f"FAIL: {failed} request(s) lost to the replica crash — "
               f"the router did not requeue them onto survivors",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+# --------------------------------------------------------------------
+# Adversarial fairness trace: the SLO control loop end to end.
+#
+# Topology: rank 0 (this process) runs the router over one local
+# replica; the burn-driven scale-out spawns rank 1 ("auto-r1") as a
+# CHILD serve_bench process hosting a second replica over the rpc
+# fabric (remote.host_server). Four tenants: "alice"/"bob" (protected,
+# unthrottled), "abuser" (10x offered rate, token-bucket limited), and
+# "spike" (a mid-run burst with a tight queue-wait deadline whose
+# expiries burn the slow window — the legitimate overload signal the
+# autoscaler must answer). Gates: the scale-out really happened and
+# was triggered by the spike/fleet burn (NEVER the abuser — rate-limit
+# rejects book no tenant failures, so abuse can't buy capacity), the
+# protected tenants' fast window never edge-triggered, zero requests
+# were lost (failed == 0) across the scale event, and the #buckets+1
+# compile budget held on BOTH replicas, the cold-started one included.
+
+_FAIR_TENANTS = ("alice", "bob", "abuser", "spike")
+_FAIR_PROTECTED = ("alice", "bob")
+_FAIR_ABUSER_RATE = 1.0      # admitted req/s the abuser is entitled to
+_FAIR_SPIKE_N = 48           # spike burst depth (~16 service times on
+                             # default slots: the tail MUST miss the
+                             # ~2-service-time deadline on any machine)
+
+
+def _fair_geometry(args):
+    return dict(slots=args.slots, prefill_buckets=tuple(args.buckets),
+                max_queue_depth=args.max_queue_depth,
+                tenant_limits={"abuser": (_FAIR_ABUSER_RATE, 2.0)},
+                fair_queueing=True)
+
+
+def _fair_server(args, model):
+    """One replica with the PR 16 admission knobs on: per-tenant DRR
+    fair queueing + the abuser's token bucket, plus the shared adapter
+    registry (per-tenant metrics need adapter-id traffic)."""
+    from paddle_tpu.lora import (AdapterStore, LoraConfig, apply_lora,
+                                 lora_state)
+    from paddle_tpu.serving import InferenceServer
+
+    lcfg = LoraConfig(rank=2, alpha=4.0)
+    apply_lora(model, lcfg)
+    zero = lora_state(model)
+    arng = np.random.default_rng(args.seed + 777)   # same seed both
+    store = AdapterStore(model, lcfg,                # ranks: same trees
+                         max_loaded=len(_FAIR_TENANTS))
+    for name in _FAIR_TENANTS:
+        store.register(name, {
+            k: arng.normal(0.0, 0.02, v.shape).astype(np.float32)
+            for k, v in zero.items()})
+    cfg_max_len = max(args.buckets) + args.new_tokens + 8
+    srv = InferenceServer(model, max_length=cfg_max_len,
+                          adapter_store=store, **_fair_geometry(args))
+    return srv
+
+
+def _fair_warm(srv, args, rng, vocab):
+    """Touch every prefill bucket + the decode program (greedy trace:
+    the budget must close at #buckets+1)."""
+    for b in srv.engine.prefill_buckets:
+        p = rng.integers(0, vocab, (b - 2,)).astype(np.int32)
+        srv.submit(p, max_new_tokens=4).result(timeout=args.timeout)
+
+
+def _child_replica_main(args) -> int:
+    """Rank 1 of the fairness drill: host one warmed replica and serve
+    until the parent signals stop. Spawned mid-run by the autoscaler —
+    everything from here to the first served token is the cold-start
+    window the parent reports as ``cold_start_ttft_s``."""
+    from decode_bench import build_model
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.serving import remote
+
+    rpc.init_rpc(name="auto-r1", rank=1, world_size=2,
+                 master_endpoint=args.endpoint)
+    model, cfg = build_model(args.model, args.preset)
+    srv = _fair_server(args, model)
+    # warm BEFORE hosting: wait_ready green means placeable at full
+    # speed, and the measured window stays recompile-free on this
+    # replica too
+    _fair_warm(srv, args, np.random.default_rng(args.seed + 1),
+               cfg.vocab_size)
+    remote.host_server(srv, name="default")
+    remote.wait_for_stop(timeout=900.0)
+    try:
+        srv.shutdown(drain=False, timeout=20.0)
+    except Exception:
+        pass
+    rpc.shutdown(timeout=6.0)
+    return 0
+
+
+def _fairness_main(args) -> int:
+    import socket
+    import subprocess
+
+    import jax
+
+    from decode_bench import build_model
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.framework import compile_cache
+    from paddle_tpu.observability.slo import SloPolicy
+    from paddle_tpu.serving import (Autoscaler, ProcessReplicaSpawner,
+                                    QueueFull, RateLimited,
+                                    ReplicaRouter)
+    from paddle_tpu.serving import remote as remote_mod
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        endpoint = f"127.0.0.1:{s.getsockname()[1]}"
+
+    model, cfg = build_model(args.model, args.preset)
+    local = _fair_server(args, model)
+    policy = SloPolicy(
+        # generous TTFT target: badness in this trace is AVAILABILITY
+        # (spike expiries), so the burn evidence is machine-speed-proof
+        target_ttft_s=30.0, target_availability=0.99,
+        fast_window_s=15.0, slow_window_s=180.0)
+    router = ReplicaRouter(slo_policy=policy)
+    router.add_replica(local, "r0")
+
+    child_argv = [
+        sys.executable, os.path.abspath(__file__),
+        "--child-replica", "--endpoint", endpoint,
+        "--model", args.model, "--preset", args.preset,
+        "--slots", str(args.slots),
+        "--new-tokens", str(args.new_tokens),
+        "--buckets", *[str(b) for b in args.buckets],
+        "--max-queue-depth", str(args.max_queue_depth),
+        "--seed", str(args.seed)]
+    spawner = ProcessReplicaSpawner(
+        child_argv, "auto-r1",
+        init=lambda: rpc.init_rpc(name="bench", rank=0, world_size=2,
+                                  master_endpoint=endpoint),
+        rpc_timeout=30.0, connect_deadline=2.0, ready_timeout=600.0,
+        env=dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu"))
+    cold = {}
+    rng = np.random.default_rng(args.seed)
+    lens = sorted(b - 2 for b in local.engine.prefill_buckets)
+
+    def prompt():
+        n = int(rng.integers(4, max(lens) + 1))
+        return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+    def spawn(name):
+        """The autoscaler's actuator, wrapped to time the warm-boot
+        window: child process start -> rpc rendezvous -> model build +
+        bucket warmup -> host_server -> first served token."""
+        t0 = time.perf_counter()
+        replica = spawner(name)
+        t_ready = time.perf_counter()
+        h = replica.submit(prompt=prompt(), max_new_tokens=4)
+        h.result(timeout=args.timeout)
+        cold["cold_start_ttft_s"] = round(
+            (t_ready - t0) + (h.ttft_s or 0.0), 3)
+        cold["probe_ttft_s"] = round(h.ttft_s or 0.0, 4)
+        return replica
+
+    auto = Autoscaler(
+        router, spawn, min_replicas=1, max_replicas=2,
+        sustain_ticks=2, cooldown_s=300.0, replica_prefix="auto-r")
+
+    _fair_warm(local, args, rng, cfg.vocab_size)
+    # one timed service round-trip calibrates the spike's queue-wait
+    # deadline to THIS machine (~2 service times): the 48-deep burst
+    # tail then misses it whatever the absolute hardware speed, so the
+    # burn evidence is deterministic, not host-dependent
+    t_cal = time.perf_counter()
+    local.submit(prompt(), max_new_tokens=args.new_tokens).result(
+        timeout=args.timeout)
+    spike_deadline = max(0.05, 2.0 * (time.perf_counter() - t_cal))
+    local.metrics.reset()
+    compiles_before = compile_cache.cache_stats()["compiles"]
+
+    # ---- the trace: per-tenant Poisson arrivals + one spike burst ----
+    protected_rate = 1.5
+    events = []        # (t, tenant, deadline)
+    for name, rate, t_end in (("alice", protected_rate, 16.0),
+                              ("bob", protected_rate, 16.0),
+                              ("abuser", 10 * protected_rate, 8.0)):
+        t = 0.0
+        while True:
+            t += float(rng.exponential(1.0 / rate))
+            if t >= t_end:
+                break
+            events.append((t, name, None))
+    spike_at = 6.0
+    for k in range(_FAIR_SPIKE_N):   # the legitimate overload: a burst
+        events.append((spike_at + 0.01 * k, "spike",   # too big for one
+                       spike_deadline))               # replica to hold
+    events.sort()
+
+    handles, rate_limited, rejected = [], 0, 0
+    protected_breached, abuser_breached = [], []
+    trigger = None
+    tick_every, next_tick = 1.0, 1.0
+    t0 = time.perf_counter()
+    for t_at, tenant, deadline in events:
+        now = time.perf_counter() - t0
+        if t_at > now:
+            time.sleep(t_at - now)
+        while time.perf_counter() - t0 >= next_tick:
+            d = auto.tick()
+            if d is not None and d["action"] == "scale_out":
+                trigger = d
+            rep = router.slo_report() or {}
+            for name, ten in rep.get("tenants", {}).items():
+                if name in _FAIR_PROTECTED and (ten["fast_breached"]
+                                                or ten["alerting"]):
+                    protected_breached.append(name)
+                if name == "abuser" and (ten["fast_breached"]
+                                         or ten["slow_breached"]):
+                    abuser_breached.append(ten)
+            next_tick += tick_every
+        try:
+            handles.append((tenant, deadline, router.submit(
+                prompt(), max_new_tokens=args.new_tokens,
+                adapter_id=tenant, deadline=deadline,
+                seed=args.seed)))
+        except RateLimited:
+            rate_limited += 1        # retryable fast-fail by design
+        except QueueFull:
+            rejected += 1
+    # a few ticks past the window so a just-sustained burn still fires
+    for _ in range(4):
+        if auto.scale_outs:
+            break
+        time.sleep(tick_every)
+        d = auto.tick()
+        if d is not None and d["action"] == "scale_out":
+            trigger = d
+
+    completed, expired, failed = 0, 0, 0
+    per_tenant = {n: {"offered": 0, "completed": 0, "expired": 0}
+                  for n in _FAIR_TENANTS}
+    for tenant, deadline, h in handles:
+        per_tenant[tenant]["offered"] += 1
+        try:
+            h.result(timeout=args.timeout)
+            completed += 1
+            per_tenant[tenant]["completed"] += 1
+        except TimeoutError:
+            if deadline is not None:
+                expired += 1         # spike deadline lapsed: SLO miss,
+                per_tenant[tenant]["expired"] += 1   # not a lost request
+            else:
+                failed += 1          # no deadline in play: a hung
+                                     # handle IS a lost request
+        except Exception:
+            failed += 1              # THIS is a lost request
+    # post-scale traffic: the grown fleet must serve cleanly too
+    post = {"offered": 0, "completed": 0}
+    for k in range(8):
+        post["offered"] += 1
+        try:
+            router.submit(prompt(), max_new_tokens=args.new_tokens,
+                          adapter_id=_FAIR_PROTECTED[k % 2],
+                          seed=args.seed).result(timeout=args.timeout)
+            post["completed"] += 1
+        except Exception:
+            failed += 1
+    steady = compile_cache.cache_stats()["compiles"] - compiles_before
+    auto.tick()
+    slo_final = router.slo_report() or {}
+    for name, ten in slo_final.get("tenants", {}).items():
+        if name in _FAIR_PROTECTED and (ten["fast_breached"]
+                                        or ten["alerting"]):
+            protected_breached.append(name)
+    statz = router.statusz()
+
+    # ---- per-replica compile budget, spawned replica included ----
+    budget = len(local.engine.prefill_buckets) + 1
+    budgets = {}
+    cc = local.engine.cache_stats()
+    budgets["r0"] = cc["prefill"]["compiles"] + cc["decode"]["compiles"]
+    remote_snap = None
+    for rep_name, state in router.replicas().items():
+        if rep_name == "r0" or state == "dead":
+            continue
+        try:
+            remote_snap = router._replicas[rep_name].server.snapshot()
+            ccr = remote_snap.get("compile_stats", {})
+            budgets[rep_name] = (ccr.get("prefill", {}).get("compiles", 0)
+                                 + ccr.get("decode", {}).get("compiles", 0))
+        except Exception:
+            budgets[rep_name] = -1
+    over_budget = {n: c for n, c in budgets.items()
+                   if c > budget or c < 0}
+
+    # ---- teardown: stop the child host, then the local plane ----
+    child_rcs = []
+    if spawner.procs:
+        try:
+            rpc.rpc_sync("auto-r1", remote_mod._host_request_stop,
+                         timeout=10.0, connect_deadline=2.0)
+        except Exception:
+            pass
+    local.shutdown(drain=True, timeout=60.0)
+    if spawner._init_done:
+        try:
+            rpc.shutdown(timeout=8.0)
+        except Exception:
+            pass
+    for proc in spawner.procs:
+        try:
+            child_rcs.append(proc.wait(timeout=120))
+        except Exception:
+            proc.kill()
+            child_rcs.append(-1)
+
+    record = {
+        "metric": f"{args.model}_serve_fairness_goodput",
+        "value": round(
+            sum(per_tenant[n]["completed"] for n in _FAIR_PROTECTED)
+            / max(1, sum(per_tenant[n]["offered"]
+                         for n in _FAIR_PROTECTED)), 4),
+        "unit": "goodput",
+        "extra": {
+            "completed": completed, "expired": expired, "failed": failed,
+            "rate_limited_at_submit": rate_limited,
+            "rate_limited_counter":
+                local.metrics.snapshot()["requests_rate_limited"],
+            "rejected": rejected,
+            "spike_deadline_s": round(spike_deadline, 4),
+            "per_tenant": per_tenant,
+            "post_scale": post,
+            "scale_outs": auto.scale_outs,
+            "scale_decision": trigger,
+            **cold,
+            "compile_budget_per_replica": budget,
+            "per_replica_compiles": budgets,
+            "steady_state_recompiles": steady,
+            "protected_fast_breaches": sorted(set(protected_breached)),
+            "abuser_breaches": len(abuser_breached),
+            "slo_tenants": {
+                n: {"burn_fast": t["burn_fast"],
+                    "burn_slow": t["burn_slow"],
+                    "alerting": t["alerting"]}
+                for n, t in slo_final.get("tenants", {}).items()},
+            "autoscaler": statz.get("autoscaler"),
+            "child_rcs": child_rcs,
+            "backend": jax.default_backend(),
+        },
+    }
+    print(json.dumps(record))
+    rc = 0
+    if not auto.scale_outs or trigger is None:
+        print("FAIL: the spike never forced a scale-out — the SLO "
+              "control loop did not close", file=sys.stderr)
+        rc = 1
+    elif trigger.get("tenant") not in ("spike", "__fleet__"):
+        print(f"FAIL: scale-out was triggered by "
+              f"{trigger.get('tenant')!r} — an abusive/protected "
+              f"tenant bought fleet capacity", file=sys.stderr)
+        rc = 1
+    if protected_breached:
+        print(f"FAIL: protected tenant(s) "
+              f"{sorted(set(protected_breached))} edge-triggered a "
+              f"fast-window burn — fairness did not hold under the "
+              f"abuser", file=sys.stderr)
+        rc = 1
+    if abuser_breached:
+        print(f"FAIL: the abuser's burn windows breached "
+              f"({len(abuser_breached)} ticks) — rate-limit rejects "
+              f"leaked into its SLO accounting", file=sys.stderr)
+        rc = 1
+    if failed:
+        print(f"FAIL: {failed} request(s) lost across the scale "
+              f"events", file=sys.stderr)
+        rc = 1
+    if rate_limited == 0:
+        print("FAIL: the 10x abuser was never rate-limited",
+              file=sys.stderr)
+        rc = 1
+    if over_budget:
+        print(f"FAIL: compile budget ({budget}) exceeded: "
+              f"{over_budget}", file=sys.stderr)
+        rc = 1
+    if steady:
+        print(f"FAIL: {steady} local recompile(s) during the measured "
+              f"window", file=sys.stderr)
+        rc = 1
+    if any(c != 0 for c in child_rcs):
+        print(f"FAIL: child replica exit codes {child_rcs}",
               file=sys.stderr)
         rc = 1
     return rc
