@@ -9,8 +9,13 @@ from . import collective  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_reduce, alltoall, broadcast, ppermute,
-    reduce_scatter, shift_left, shift_right,
+    ReduceOp, all_gather, all_reduce, all_reduce_buckets, alltoall,
+    broadcast, ppermute, reduce_scatter, shift_left, shift_right,
+)
+from . import overlap  # noqa: F401
+from .overlap import (  # noqa: F401
+    GradBucket, bucket_order, bucketed_reduce, build_buckets,
+    weight_update_specs,
 )
 from .env import (  # noqa: F401
     barrier, get_rank, get_world_size, init_parallel_env, is_initialized,

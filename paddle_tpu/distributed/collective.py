@@ -116,6 +116,26 @@ def shift_left(tensor, group=None):
     return lax.ppermute(tensor, axis, perm=perm)
 
 
+def all_reduce_buckets(tensors: Sequence, op=ReduceOp.SUM, group=None):
+    """Bucketed list all-reduce — the explicit-SPMD (shard_map) analogue
+    of ``overlap.bucketed_reduce``: each tensor in ``tensors`` is reduced
+    as its own schedulable unit, chained with ``optimization_barrier`` so
+    the collectives issue in list order (reverse-backward order when the
+    caller follows ``overlap.bucket_order``) instead of fusing into one
+    tail reduction. Values are identical to mapping :func:`all_reduce`
+    over the list; only the schedule differs."""
+    out = []
+    anchor = None
+    for t in tensors:
+        if anchor is not None:
+            t, _ = jax.lax.optimization_barrier((t, anchor))
+        r = all_reduce(t, op=op, group=group)
+        (r,) = jax.lax.optimization_barrier((r,))
+        anchor = r
+        out.append(r)
+    return out
+
+
 def axis_index(group=None):
     return lax.axis_index(_axis(group))
 

@@ -13,6 +13,13 @@ training scripts run unchanged: ``forward`` delegates, ``scale_loss`` is
 identity (the mean over the global batch already includes the dp factor),
 ``no_sync`` is a no-op context (there is no per-step collective to
 suppress; gradient merge lives in ``TrainStep(grad_accum_steps=k)``).
+
+One reference knob survives with real meaning: ``comm_buffer_size`` (MB)
+— the C++ ``Reducer``'s allreduce bucket size — is kept as the
+``_comm_buffer_mb`` hint that ``DistributedTrainStep`` reads as its
+default ``bucket_size_mb`` when ``overlap_grad_reduce=True``, so a
+ported script's bucket tuning carries over to the GSPMD overlap
+schedule (``distributed.overlap``).
 """
 from __future__ import annotations
 
@@ -29,6 +36,8 @@ class DataParallel(Layer):
                  group=None):
         super().__init__()
         self._layers = layers
+        # bucket-size hint (MB) for the overlap schedule; see module doc
+        self._comm_buffer_mb = float(comm_buffer_size)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

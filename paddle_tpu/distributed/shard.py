@@ -13,6 +13,7 @@ ZeRO mapping (reference ``group_sharded_parallel`` levels, SURVEY §2.3):
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -24,6 +25,8 @@ from ..nn.layer import Layer, buffer_state, functional_call, param_state
 from ..framework import random as framework_random
 from ..framework.jit import StepSeams
 from .mesh import get_mesh, require_mesh
+from .overlap import (build_buckets, bucketed_reduce, shard_first_free_dim,
+                      weight_update_specs)
 
 P = PartitionSpec
 
@@ -103,11 +106,19 @@ def shard_params(params: Dict[str, Any], specs: Dict[str, PartitionSpec], mesh=N
 
 
 def opt_state_specs(opt_state, params_specs: Dict[str, PartitionSpec],
-                    shard_axis: Optional[str] = None, mesh=None):
+                    shard_axis: Optional[str] = None, mesh=None,
+                    on_fallback: Optional[Callable[[str], None]] = None):
     """Specs for optimizer state: moment slots inherit their parameter's
     spec; with ``shard_axis`` (ZeRO-1/2 weight-update sharding, cf.
     "Automatic Cross-Replica Sharding" in PAPERS.md) unsharded dims of the
-    slots are additionally sharded over that axis."""
+    slots are additionally sharded over that axis — by the SAME dim rule
+    as ``overlap.weight_update_specs`` (one shared helper), so the param
+    shard and its moment shards always land on the same dim.
+
+    A slot with no ``shard_axis``-divisible dim stays at its base spec —
+    a silently REPLICATED piece of a nominally sharded update; each such
+    param path is reported once through ``on_fallback`` so callers can
+    count it instead of shipping a mis-sharded run invisibly."""
     mesh = mesh or require_mesh()
 
     def spec_for(path_key, leaf):
@@ -116,20 +127,13 @@ def opt_state_specs(opt_state, params_specs: Dict[str, PartitionSpec],
         base = params_specs.get(path_key)
         if base is None:
             return PartitionSpec()
-        spec = list(base) + [None] * (leaf.ndim - len(list(base)))
         if shard_axis and shard_axis in mesh.shape:
-            used = set()
-            for s in spec:
-                if isinstance(s, (tuple, list)):
-                    used.update(s)
-                elif s is not None:
-                    used.add(s)
-            if shard_axis not in used:
-                ax = mesh.shape[shard_axis]
-                for i in range(leaf.ndim):
-                    if spec[i] is None and leaf.shape[i] % ax == 0 and leaf.shape[i] >= ax:
-                        spec[i] = shard_axis
-                        break
+            spec, ok = shard_first_free_dim(list(base), leaf.shape,
+                                            shard_axis, mesh)
+            if not ok and on_fallback is not None:
+                on_fallback(path_key)
+            return spec
+        spec = list(base) + [None] * (leaf.ndim - len(list(base)))
         return PartitionSpec(*spec)
 
     out = {}
@@ -151,6 +155,12 @@ class DistributedTrainStep(StepSeams):
       - tensor parallel: layer-declared "mp" specs
       - ZeRO: ``sharding_stage`` 1/2 -> opt-state (+grad) sharded over "sdp";
         3 -> params too
+      - overlap: ``overlap_grad_reduce=True`` -> bucketed gradient
+        reduction in reverse-backward order (``overlap.build_buckets`` /
+        ``bucketed_reduce``) + the weight update computed on each
+        replica's ``sdp`` shard under ``sharding_stage >= 1`` (default
+        off => the serial schedule, bit-identical to before the knob
+        existed)
       - recompute: wrap blocks with paddle_tpu.distributed.recompute
       - sp/pp: see sequence_parallel.py / pipeline.py
     """
@@ -159,7 +169,9 @@ class DistributedTrainStep(StepSeams):
                  mesh=None, batch_axes=("dp", "sdp"), sharding_stage: int = 0,
                  grad_transform=None, donate: bool = True,
                  grad_accum_steps: int = 1, grad_accum_avg: bool = True,
-                 scaler=None):
+                 scaler=None, overlap_grad_reduce: bool = False,
+                 bucket_size_mb: Optional[float] = None,
+                 bucket_count: Optional[int] = None):
         from ..framework.jit import (DEFAULT_RNG_STREAMS, _grad_dtype,
                                      resolve_inputs_fn)
 
@@ -176,6 +188,7 @@ class DistributedTrainStep(StepSeams):
         # downgraded by a caller's heuristic default (Engine passes 2)
         sharding_stage = max(sharding_stage,
                              getattr(optimizer, "_group_sharded_stage", 0))
+        self.sharding_stage = sharding_stage
         zero3 = "sdp" if sharding_stage >= 3 else None
         self.specs = param_specs(model, self.mesh, zero3_axis=zero3)
         self.params = shard_params(param_state(model), self.specs, self.mesh)
@@ -183,8 +196,57 @@ class DistributedTrainStep(StepSeams):
                         for k, v in buffer_state(model).items()}
         opt_state = optimizer.init(self.params)
         shard_axis = "sdp" if sharding_stage >= 1 else None
-        self.opt_specs = opt_state_specs(opt_state, self.specs, shard_axis, self.mesh)
+        # every param whose update stays replicated because no dim divides
+        # the sdp axis — counted, logged, and surfaced in statusz() so a
+        # mis-sharded run is visible instead of silently replicated
+        self.zero_fallback_params: list = []
+
+        def _note_fallback(name):
+            if name not in self.zero_fallback_params:
+                self.zero_fallback_params.append(name)
+
+        self.opt_specs = opt_state_specs(opt_state, self.specs, shard_axis,
+                                         self.mesh,
+                                         on_fallback=_note_fallback)
         self.opt_state = self._shard_opt_state(opt_state)
+
+        # ---- overlap schedule (ROADMAP item 1): bucketed grad reduction
+        # in reverse-backward order + ZeRO weight-update sharding. All of
+        # it is OFF by default; the serial path below is untouched.
+        self.overlap_grad_reduce = bool(overlap_grad_reduce)
+        if bucket_size_mb is None:
+            # ported DataParallel scripts carry their comm_buffer_size
+            # (MB) — honor it as the bucket size hint
+            bucket_size_mb = getattr(model, "_comm_buffer_mb", None) or 25.0
+        self.bucket_size_mb = float(bucket_size_mb)
+        self.update_specs = weight_update_specs(
+            self.specs, {k: v.shape for k, v in self.params.items()},
+            shard_axis, self.mesh, on_fallback=_note_fallback)
+        self._sharded_update = bool(self.overlap_grad_reduce and shard_axis)
+        self._reduce_specs = (self.update_specs if self._sharded_update
+                              else self.specs)
+        self._buckets = None
+        if self.overlap_grad_reduce:
+            sizes = {k: int(v.size) * int(jnp.dtype(v.dtype).itemsize)
+                     for k, v in self.params.items()}
+            self._buckets = build_buckets(
+                sizes, int(self.bucket_size_mb * 2 ** 20), bucket_count)
+        if self.zero_fallback_params and shard_axis:
+            from ..observability.registry import default_registry
+
+            reg = default_registry()
+            reg.inc("distributed.zero_fallback_params_total",
+                    len(self.zero_fallback_params))
+            reg.set_gauge("distributed.zero_fallback_params",
+                          len(self.zero_fallback_params),
+                          step=type(model).__name__,
+                          stage=str(sharding_stage))
+            logging.getLogger(__name__).warning(
+                "ZeRO stage %d: %d param(s) have no sdp-divisible dim; "
+                "their update stays REPLICATED: %s", sharding_stage,
+                len(self.zero_fallback_params),
+                ", ".join(self.zero_fallback_params[:8])
+                + ("..." if len(self.zero_fallback_params) > 8 else ""))
 
         batch_spec = PartitionSpec(tuple(a for a in batch_axes if a in self.mesh.shape) or None)
         self._batch_sharding = NamedSharding(self.mesh, batch_spec)
@@ -193,7 +255,10 @@ class DistributedTrainStep(StepSeams):
         self._count = 0
         self._rng_streams = DEFAULT_RNG_STREAMS
         # gradient merge (reference gradient_merge_optimizer.py): accumulator
-        # sharded like the params (grads inherit param shardings under GSPMD)
+        # sharded like the grads it receives — the param specs on the
+        # serial path, the reduce-scattered update specs under the overlap
+        # schedule (so accumulation happens on each replica's shard and
+        # the sdp memory win extends to the accumulator)
         self.grad_accum_steps = int(grad_accum_steps)
         self.grad_accum_avg = grad_accum_avg
         self._grad_accum = None
@@ -201,7 +266,7 @@ class DistributedTrainStep(StepSeams):
             self._grad_accum = {
                 k: put_global(
                     np.zeros(v.shape, _grad_dtype(v.dtype)),
-                    NamedSharding(self.mesh, self.specs[k]))
+                    NamedSharding(self.mesh, self._reduce_specs[k]))
                 for k, v in self.params.items()}
         self._init_seams(scaler, self.grad_accum_steps)
         # scale state is replicated: every device applies the same skip/grow
@@ -234,6 +299,27 @@ class DistributedTrainStep(StepSeams):
         from ..framework import compile_cache
 
         return compile_cache.cache_stats(self._cc_name)
+
+    def collective_schedule(self) -> list:
+        """The bucketed reduction schedule as plain dicts (``[]`` on the
+        serial path) — what ``bench_profile --overlap`` names its
+        per-bucket collective spans after."""
+        return [b.to_dict() for b in (self._buckets or [])]
+
+    def statusz(self) -> dict:
+        """Introspection snapshot of the sharding/overlap configuration —
+        the training-side ``/statusz`` handle. A nonzero
+        ``zero_fallback_params`` under ``sharding_stage >= 1`` means that
+        many updates silently run replicated (no sdp-divisible dim)."""
+        return {
+            "sharding_stage": self.sharding_stage,
+            "overlap_grad_reduce": self.overlap_grad_reduce,
+            "bucket_size_mb": self.bucket_size_mb,
+            "buckets": self.collective_schedule(),
+            "params": len(self.params),
+            "zero_fallback_params": list(self.zero_fallback_params),
+            "grad_accum_steps": self.grad_accum_steps,
+        }
 
     def _shard_opt_state(self, opt_state):
         out = {}
@@ -274,6 +360,15 @@ class DistributedTrainStep(StepSeams):
 
         (_, (new_buffers, loss)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(params)
+        if self._buckets:
+            # overlap schedule: pin each reverse-backward-ordered bucket
+            # of grads to its reduction placement (reduce-scattered over
+            # sdp under sharding_stage >= 1) as its own schedulable unit,
+            # so XLA's latency-hiding scheduler issues bucket k's
+            # collective while bucket k+1's grads are still being
+            # computed. Placement only — values are untouched.
+            grads = bucketed_reduce(grads, self._buckets,
+                                    self._reduce_specs, self.mesh)
         accum = accumulate_grads(accum, grads)
         if not do_update:
             return loss, params, new_buffers, opt_state, accum, scaler_state
@@ -285,7 +380,18 @@ class DistributedTrainStep(StepSeams):
             from ..amp.grad_scaler import unscale_and_check
 
             grads, found = unscale_and_check(grads, scaler_state)
-        new_params, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        upd_params = params
+        if self._sharded_update:
+            # ZeRO weight-update sharding (arXiv:2004.13336): constrain
+            # the update's param input to the sdp-sharded update specs so
+            # the whole optimizer computation runs on each replica's
+            # shard (grads and moments already live there); the param
+            # constraint right below is the all-gather back.
+            upd_params = {k: jax.lax.with_sharding_constraint(
+                v, NamedSharding(self.mesh, self.update_specs[k]))
+                for k, v in params.items()}
+        new_params, new_opt_state = self.optimizer.update(grads, opt_state,
+                                                          upd_params)
         new_params = {k: jax.lax.with_sharding_constraint(
             v, NamedSharding(self.mesh, self.specs[k])) for k, v in new_params.items()}
         if use_scaler:
@@ -348,7 +454,7 @@ class DistributedTrainStep(StepSeams):
         count, do_update = self._next_count()
         compile_cache.record_call(self._cc_name)
         poison = self._take_poison()
-        with self.mesh:
+        with self.mesh, self._step_span():
             if not do_update:
                 loss, self.params, self.buffers, self.opt_state, \
                     self._grad_accum, _ = \
@@ -367,7 +473,7 @@ class DistributedTrainStep(StepSeams):
         count, do_update = self._next_count()
         compile_cache.record_call(self._cc_name)
         poison = self._take_poison()
-        with self.mesh:
+        with self.mesh, self._step_span():
             if do_update and (self.scaler_state is not None
                               or flags.flag("FLAGS_check_nan_inf")):
                 loss, ok, found = self._checked_call(batch, count, poison)
@@ -417,7 +523,7 @@ class DistributedTrainStep(StepSeams):
             elif spec is not None:
                 out[f"opt_state/{slot}"] = NamedSharding(self.mesh, P())
         if self._grad_accum is not None:
-            for k, spec in self.specs.items():
+            for k, spec in self._reduce_specs.items():
                 out[f"grad_accum/{k}"] = NamedSharding(self.mesh, spec)
         out["base_key"] = NamedSharding(self.mesh, P())
         if self.scaler_state is not None:
@@ -464,7 +570,7 @@ class DistributedTrainStep(StepSeams):
         if self._grad_accum is not None and "grad_accum" in state:
             self._grad_accum = {
                 k: put(state["grad_accum"][k],
-                       NamedSharding(self.mesh, self.specs[k]))
+                       NamedSharding(self.mesh, self._reduce_specs[k]))
                 for k in self._grad_accum}
         if self.scaler_state is not None and "scaler_state" in state:
             self.scaler_state = {

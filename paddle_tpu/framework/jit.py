@@ -230,6 +230,15 @@ class StepSeams:
                      or self._count % self.grad_accum_steps == 0)
         return count, do_update
 
+    def _step_span(self):
+        """The per-step host span both step classes dispatch under — ONE
+        name ("step"), because ``tools/bench_profile.py``'s overlap
+        breakdown classifies recorder spans by it; a drifted name would
+        silently empty the breakdown."""
+        from ..profiler import RecordEvent
+
+        return RecordEvent("step")
+
 
 class TrainStep(StepSeams):
     """One-call training: ``loss = step(batch)``.
@@ -435,12 +444,10 @@ class TrainStep(StepSeams):
         with all three LAZY (the numerics watchdog batches the host sync
         every ``check_interval`` steps). ``ok``/``found_inf`` are ``None``
         on accumulate-only calls (no update happened to check)."""
-        from ..profiler import RecordEvent
-
         count, do_update = self._next_count()
         compile_cache.record_call(self._cc_name)
         poison = self._take_poison()
-        with RecordEvent("step"):
+        with self._step_span():
             if not do_update:
                 (loss,) = self._plain_call(batch, count, poison, False)
                 return loss, None, None
@@ -449,12 +456,11 @@ class TrainStep(StepSeams):
 
     def __call__(self, batch):
         from . import flags
-        from ..profiler import RecordEvent
 
         count, do_update = self._next_count()
         compile_cache.record_call(self._cc_name)
         poison = self._take_poison()
-        with RecordEvent("step"):
+        with self._step_span():
             if do_update and (self.scaler_state is not None
                               or flags.flag("FLAGS_check_nan_inf")):
                 loss, ok, found = self._checked_call(batch, count, poison)

@@ -79,6 +79,17 @@ story. Runs, in order:
    recompiles, token parity vs solo generate. (Its old scoped
    ``tpu_lint paddle_tpu/lora`` companion folded into stage 0's
    whole-repo lint.)
+7. with ``--overlap``, the step-schedule regression gate:
+   ``tools/bench_profile.py --overlap --distributed`` measures the
+   pre-PR serial schedule (stage 0: fused tail all-reduce + replicated
+   weight update) against the bucketed overlap schedule
+   (``overlap_grad_reduce=True`` + ZeRO sharded update) on the same
+   model/batch; FAILS if the bucketed ``non_compute_frac`` regresses
+   past the ``.overlap_baseline.json`` threshold or the serial->
+   bucketed reduction drops below its floor. A scoped tpu_lint of the
+   restructured step files (jit.py / shard.py / overlap.py /
+   bench_profile.py) rides along so the R10 collective-divergence
+   discipline is asserted even under ``--skip-lint``.
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -92,6 +103,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --fleet-chaos  # + cross-host rpc soak
     python tools/robustness_gate.py --lora         # + adapter lifecycle
     python tools/robustness_gate.py --observability  # + telemetry gate
+    python tools/robustness_gate.py --overlap      # + step-schedule gate
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -101,6 +113,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -194,6 +207,62 @@ def _run_lint(full: bool = False) -> bool:
     return ok
 
 
+def _run_overlap_gate() -> bool:
+    """``--overlap``: the step-schedule regression gate. Runs
+    ``tools/bench_profile.py --overlap --distributed`` (pre-PR serial
+    stage-0 schedule vs bucketed+ZeRO schedule, same model/batch) and
+    fails if the bucketed schedule's ``non_compute_frac`` regresses past
+    the stored ``.overlap_baseline.json`` threshold or the serial->
+    bucketed reduction factor drops below its floor. Also scope-lints
+    the restructured step files so ``--overlap --skip-lint`` still
+    asserts the SPMD collective-divergence discipline (R10) on them."""
+    name = "overlap"
+    baseline_path = os.path.join(REPO, ".overlap_baseline.json")
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except OSError as e:
+        print(f"[robustness_gate] === {name}: FAIL "
+              f"(no {baseline_path}: {e})", flush=True)
+        return False
+    out = os.path.join(tempfile.gettempdir(),
+                       f"overlap_gate_{os.getpid()}.json")
+    ok = _run(name, [sys.executable,
+                     os.path.join(TOOLS, "bench_profile.py"),
+                     "--overlap", "--distributed", "--steps", "2",
+                     "--json-out", out])
+    if not ok:
+        return False
+    try:
+        with open(out) as f:
+            summary = json.load(f)
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    frac = summary["bucketed"]["value"]
+    reduction = summary["non_compute_frac_reduction"]
+    max_frac = baseline["max_bucketed_non_compute_frac"]
+    min_red = baseline["min_reduction"]
+    ok = frac <= max_frac and reduction >= min_red
+    print(f"[robustness_gate] === {name}: bucketed non_compute_frac="
+          f"{frac:.4f} (max {max_frac}), reduction={reduction}x "
+          f"(min {min_red}) -> {'PASS' if ok else 'FAIL'}", flush=True)
+    if not ok:
+        return False
+    # scoped self-application: the restructured step files must carry
+    # zero unbaselined findings (R1 host-sync, R10 collective divergence)
+    return _run(f"{name}_lint",
+                [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
+                 "--baseline",
+                 os.path.join(REPO, ".tpu_lint_baseline.json"),
+                 os.path.join(REPO, "paddle_tpu/framework/jit.py"),
+                 os.path.join(REPO, "paddle_tpu/distributed/shard.py"),
+                 os.path.join(REPO, "paddle_tpu/distributed/overlap.py"),
+                 os.path.join(REPO, "tools/bench_profile.py")])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-soak", action="store_true")
@@ -224,6 +293,11 @@ def main() -> int:
                          "crash drill + 2-process fleet observability "
                          "drill [scrape/partition/SLO-burn/trace] + "
                          "<2%% decode tracing overhead)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="also run the step-schedule regression gate "
+                         "(bench_profile --overlap --distributed vs the "
+                         ".overlap_baseline.json threshold + scoped "
+                         "tpu_lint of the restructured step files)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     ap.add_argument("--full-lint", action="store_true",
@@ -286,6 +360,8 @@ def main() -> int:
     if args.lora:
         results["lora"] = _run(
             "lora", [sys.executable, os.path.join(TOOLS, "lora_soak.py")])
+    if args.overlap:
+        results["overlap"] = _run_overlap_gate()
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,
