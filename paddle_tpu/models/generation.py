@@ -46,7 +46,8 @@ from ..nn.layer import buffer_state, functional_call, param_state
 from ..io.batching import bucket_for
 from ..observability import tracing as _tracing
 
-__all__ = ["GenerationEngine", "generate", "init_cache", "sample_logits",
+__all__ = ["GenerationEngine", "generate", "init_cache", "cache_nbytes",
+           "normalize_kv_dtype", "sample_logits", "filter_logits",
            "sample_logits_rows", "per_row_keys", "slice_cache_rows",
            "scatter_cache_rows", "gather_cache_blocks",
            "scatter_cache_blocks", "cache_sharding_spec",
@@ -79,23 +80,55 @@ def cache_sharding_spec(batch: int, n_kv_heads: int, mesh=None):
     return sharding(batch_axes or None, None, head_axis, None, mesh=mesh)
 
 
+def normalize_kv_dtype(kv_dtype):
+    """Canonicalize a ``kv_dtype`` knob: ``None``/``"none"`` -> None
+    (full-precision cache, the PR 9-bit-identical default), ``"int8"`` ->
+    ``"int8"``. Anything else is an error at construction time, not a
+    silent full-precision fallback."""
+    if kv_dtype is None or kv_dtype in ("none", "fp", "full"):
+        return None
+    if str(kv_dtype) == "int8":
+        return "int8"
+    raise ValueError(f"unsupported kv_dtype {kv_dtype!r}; expected None "
+                     f"or 'int8'")
+
+
 def init_cache(model, batch: int, max_length: Optional[int] = None,
-               dtype=None):
+               dtype=None, kv_dtype=None):
     """Preallocate the KV cache pytree for ``model``: a tuple (one entry
     per layer) of ``(k, v)`` pairs, each ``[batch, max_length,
     n_kv_heads, head_dim]`` zeros. Placed in its GSPMD layout when a mesh
-    is installed."""
+    is installed.
+
+    ``kv_dtype="int8"`` allocates the quantized layout instead: each
+    ``k``/``v`` entry is a ``(int8 values, float32 scales [B, S, Hkv,
+    1])`` pair (see :mod:`paddle_tpu.quantization`), roughly halving the
+    cache's HBM footprint at head_dim 64+. The scale leaf shares the
+    value leaf's sharding spec (batch over dp/sdp, kv heads over mp)."""
     spec = model.cache_spec()
     max_length = int(max_length or spec["max_length"])
     dtype = convert_dtype(dtype or spec["dtype"])
+    kv_dtype = normalize_kv_dtype(kv_dtype)
     shape = (batch, max_length, spec["num_kv_heads"], spec["head_dim"])
     shd = cache_sharding_spec(batch, spec["num_kv_heads"])
 
-    def leaf():
-        z = jnp.zeros(shape, dtype)
+    def put(z):
         return jax.device_put(z, shd) if shd is not None else z
 
+    def leaf():
+        if kv_dtype == "int8":
+            return (put(jnp.zeros(shape, jnp.int8)),
+                    put(jnp.zeros(shape[:-1] + (1,), jnp.float32)))
+        return put(jnp.zeros(shape, dtype))
+
     return tuple((leaf(), leaf()) for _ in range(spec["num_layers"]))
+
+
+def cache_nbytes(cache) -> int:
+    """Total bytes of a cache pytree (quantized scale leaves included) —
+    the number the HBM-per-slot accounting asserts on."""
+    return int(jax.tree.reduce(
+        lambda acc, x: acc + x.nbytes, cache, 0))
 
 
 def _constrain_cache(cache, batch: int, n_kv_heads: int):
@@ -183,19 +216,19 @@ def scatter_cache_blocks(pool, row_cache, block_indices):
 
 
 # -------------------------------------------------------------- sampling
-def sample_logits(logits, key=None, temperature=1.0, top_k: int = 0,
-                  top_p=1.0, greedy: bool = False,
+def filter_logits(logits, temperature=1.0, top_k: int = 0, top_p=1.0,
                   use_top_p: Optional[bool] = None):
-    """Batched next-token selection on ``logits`` [B, V].
+    """The temperature/top-k/top-p transform :func:`sample_logits` draws
+    from, returned as float32 logits [..., V] (``-inf`` on filtered
+    entries). Factored out so speculative verification can materialize
+    the EXACT sampling distribution — ``softmax(filter_logits(...))`` is
+    the p (and q) of the acceptance rule — instead of approximating it.
 
-    ``greedy``/``top_k``/``use_top_p`` are static (``top_k`` feeds
+    ``top_k``/``use_top_p`` are static (``top_k`` feeds
     ``ops.search.topk``, whose k is a compile-time constant; nucleus
-    filtering costs an O(V log V) sort per step, so it compiles in only
-    when requested); ``temperature``/``top_p`` may be traced scalars, so
-    sweeping their VALUES does NOT recompile the decode step.
-    """
-    if greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtering costs an O(V log V) sort, so it compiles in only when
+    requested); ``temperature``/``top_p`` may be traced scalars, so
+    sweeping their VALUES does NOT recompile."""
     from ..ops.search import topk as ops_topk
 
     l = logits.astype(jnp.float32) / jnp.maximum(
@@ -229,6 +262,19 @@ def sample_logits(logits, key=None, temperature=1.0, top_k: int = 0,
         # filter in unconditionally and relies on value-level equality
         # with the unfiltered solo graph
         l = jnp.where(top_p >= 1.0, l, jnp.where(l < cutoff, -jnp.inf, l))
+    return l
+
+
+def sample_logits(logits, key=None, temperature=1.0, top_k: int = 0,
+                  top_p=1.0, greedy: bool = False,
+                  use_top_p: Optional[bool] = None):
+    """Batched next-token selection on ``logits`` [B, V]: categorical
+    draw over :func:`filter_logits` (or argmax under ``greedy``).
+    ``greedy``/``top_k``/``use_top_p`` are static; ``temperature``/
+    ``top_p`` may be traced scalars (value sweeps don't recompile)."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = filter_logits(logits, temperature, top_k, top_p, use_top_p)
     return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
 
 
@@ -287,10 +333,12 @@ class GenerationEngine:
     """
 
     def __init__(self, model, max_length: Optional[int] = None,
-                 prefill_buckets: Optional[Sequence[int]] = None):
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 kv_dtype=None):
         self.model = model
         spec = model.cache_spec()
         self.spec = spec
+        self.kv_dtype = normalize_kv_dtype(kv_dtype)
         self.max_length = int(max_length or spec["max_length"])
         if self.max_length > spec["max_length"]:
             # position tables slice with CLAMPED dynamic_slice: positions
@@ -433,7 +481,8 @@ class GenerationEngine:
         try:
             params = param_state(self.model)
             buffers = buffer_state(self.model)
-            cache = init_cache(self.model, B, self.max_length)
+            cache = init_cache(self.model, B, self.max_length,
+                               kv_dtype=self.kv_dtype)
             tokens = []
             dones = []
             interval = max(1, int(done_check_interval))
@@ -516,26 +565,30 @@ class GenerationEngine:
         return out, stats
 
 
-def _engine_for(model, max_length, prefill_buckets) -> GenerationEngine:
-    """One engine per (max_length, buckets) geometry, cached on the model
-    instance so repeated ``generate()`` calls reuse the compiled steps."""
+def _engine_for(model, max_length, prefill_buckets,
+                kv_dtype=None) -> GenerationEngine:
+    """One engine per (max_length, buckets, kv_dtype) geometry, cached on
+    the model instance so repeated ``generate()`` calls reuse the
+    compiled steps."""
     engines = model.__dict__.setdefault("_generation_engines", {})
     key = (max_length,
-           tuple(prefill_buckets) if prefill_buckets else None)
+           tuple(prefill_buckets) if prefill_buckets else None,
+           normalize_kv_dtype(kv_dtype))
     if key not in engines:
         engines[key] = GenerationEngine(model, max_length=max_length,
-                                        prefill_buckets=prefill_buckets)
+                                        prefill_buckets=prefill_buckets,
+                                        kv_dtype=kv_dtype)
     return engines[key]
 
 
 def generate(model, input_ids, max_new_tokens: int = 32, *,
              max_length: Optional[int] = None,
              prefill_buckets: Optional[Sequence[int]] = None,
-             **sampling_kwargs):
+             kv_dtype=None, **sampling_kwargs):
     """Module-level entry point surfaced as ``model.generate(...)`` on
     :class:`~paddle_tpu.models.gpt.GPTForCausalLM` /
     :class:`~paddle_tpu.models.llama.LlamaForCausalLM` and
     ``hapi.Model.generate``. See :meth:`GenerationEngine.generate` for the
     sampling knobs."""
-    engine = _engine_for(model, max_length, prefill_buckets)
+    engine = _engine_for(model, max_length, prefill_buckets, kv_dtype)
     return engine.generate(input_ids, max_new_tokens, **sampling_kwargs)

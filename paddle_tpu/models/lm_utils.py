@@ -74,6 +74,21 @@ def repeat_kv(x, groups: int):
     return jnp.repeat(x, groups, axis=2)
 
 
+def _write_window(buf, new, pos):
+    """Write ``new`` into ``buf`` along the length axis at ``pos`` —
+    scalar offset (one dynamic_update_slice) or per-row [B] vector (the
+    vmapped windowed write)."""
+    zero = jnp.zeros((), jnp.int32)
+    if pos.ndim == 1:
+        def write(c, n, p):
+            return jax.lax.dynamic_update_slice(
+                c, n.astype(c.dtype), (p,) + (zero,) * (c.ndim - 1))
+
+        return jax.vmap(write)(buf, new, pos)
+    start = (zero, pos) + (zero,) * (buf.ndim - 2)
+    return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype), start)
+
+
 def update_kv_cache(cache, k_new, v_new, position_offset):
     """Write ``k_new``/``v_new`` [B, L, Hkv, D] into the preallocated
     ``(k, v)`` cache pair at ``position_offset`` along the length axis.
@@ -83,26 +98,26 @@ def update_kv_cache(cache, k_new, v_new, position_offset):
     program serves every position) or a traced ``[B]`` vector — the
     continuous-batching decode step, where every slot of the live batch
     sits at its own position (one per-row windowed write, still one
-    program)."""
+    program).
+
+    Quantized caches (``kv_dtype="int8"``: each entry a ``(values,
+    scales)`` pair, see :mod:`paddle_tpu.quantization`) quantize on
+    write — new keys/values are reduced to int8 + per-head scale here,
+    so the full-precision window never lands in the cache buffers."""
+    from ..quantization import is_quantized_kv, kv_quantize
+
     k_cache, v_cache = cache
     pos = jnp.asarray(position_offset, jnp.int32)
-    if pos.ndim == 1:
-        zero = jnp.zeros((), jnp.int32)
-
-        def write(c, n, p):
-            return jax.lax.dynamic_update_slice(
-                c, n.astype(c.dtype), (p, zero, zero))
-
-        k_cache = jax.vmap(write)(k_cache, k_new, pos)
-        v_cache = jax.vmap(write)(v_cache, v_new, pos)
-        return k_cache, v_cache
-    zero = jnp.zeros((), jnp.int32)
-    start = (zero, pos, zero, zero)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k_new.astype(k_cache.dtype), start)
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v_new.astype(v_cache.dtype), start)
-    return k_cache, v_cache
+    # tpu-lint: disable=R2(is_quantized_kv reads pytree STRUCTURE — tuple pair vs bare array — fixed at trace time, one program per cache layout)
+    if is_quantized_kv(k_cache):
+        kq, ks = kv_quantize(k_new)
+        vq, vs = kv_quantize(v_new)
+        return ((_write_window(k_cache[0], kq, pos),
+                 _write_window(k_cache[1], ks, pos)),
+                (_write_window(v_cache[0], vq, pos),
+                 _write_window(v_cache[1], vs, pos)))
+    return (_write_window(k_cache, k_new, pos),
+            _write_window(v_cache, v_new, pos))
 
 
 def cached_attention(q, k_cache, v_cache, position_offset):
@@ -113,7 +128,15 @@ def cached_attention(q, k_cache, v_cache, position_offset):
     leak in. ``position_offset`` may be a scalar or a per-row ``[B]``
     vector (continuous-batching decode: each slot masks at its own
     position). GQA is a grouped einsum — the kv heads are never repeated
-    into [B, S, H, D]."""
+    into [B, S, H, D]. int8-quantized caches (``(values, scales)``
+    entries) dequantize here, on read — the [B, S, Hkv, D] buffers stay
+    int8 in HBM and only this program's working set pays the upcast."""
+    from ..quantization import is_quantized_kv, kv_dequantize
+
+    # tpu-lint: disable=R2(is_quantized_kv reads pytree STRUCTURE — tuple pair vs bare array — fixed at trace time, one program per cache layout)
+    if is_quantized_kv(k_cache):
+        k_cache = kv_dequantize(*k_cache, dtype=q.dtype)
+        v_cache = kv_dequantize(*v_cache, dtype=q.dtype)
     B, L, H, D = q.shape
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
     groups = H // Hkv
@@ -147,6 +170,9 @@ def attend_with_cache(q, k_new, v_new, cache, position_offset,
     is_prefill = (q.shape[1] > 1 and isinstance(position_offset, int)
                   and position_offset == 0)
     if is_prefill:
+        # prefill attends over the un-quantized k_new/v_new block — the
+        # quantized values land in the cache for LATER reads only, so
+        # prefill logits stay bit-identical across kv_dtype settings
         groups = q.shape[2] // k_new.shape[2]
         out = causal_attention(q, repeat_kv(k_new, groups),
                                repeat_kv(v_new, groups), dropout_p=0.0,

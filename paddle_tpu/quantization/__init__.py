@@ -25,6 +25,8 @@ __all__ = [
     "fake_quant", "quant_dequant", "AbsmaxObserver",
     "MovingAverageAbsmaxObserver", "QuantConfig", "QAT", "PTQ",
     "QuantedLinear", "QuantedConv2D",
+    "QuantedColumnParallelLinear", "QuantedRowParallelLinear",
+    "kv_quantize", "kv_dequantize", "is_quantized_kv",
 ]
 
 
@@ -56,6 +58,43 @@ def _qdq_bwd(bits, res, g):
 
 quant_dequant.defvjp(_qdq_fwd, _qdq_bwd)
 fake_quant = quant_dequant
+
+
+# ----------------------------------------------------- int8 KV-cache quant
+# The decode engines store KV-cache entries as either a plain array
+# [B, S, Hkv, D] or, under ``kv_dtype="int8"``, a ``(values, scales)``
+# pair: int8 values plus per-(row, position, head) float32 abs-max scales
+# [B, S, Hkv, 1]. Keeping the scale 4-D (trailing axis 1 instead of a
+# squeezed [B, S, Hkv]) means every cache pytree primitive in
+# ``models/generation.py`` — row slice/scatter, block gather/scatter,
+# sharding constraints — works on both leaves unchanged via jax.tree
+# maps. Symmetric quantization to ±127 so dequant is a single multiply.
+
+KV_QUANT_EPS = 1e-8
+
+
+def kv_quantize(x, eps: float = KV_QUANT_EPS):
+    """Quantize ``x`` [..., D] to ``(int8 values, float32 scales)`` with a
+    per-head abs-max scale over the trailing (head_dim) axis. All-zero
+    heads get the ``eps`` floor so dequant stays exact-zero instead of
+    0/0."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, eps) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`kv_quantize`: ``q * scale`` cast to ``dtype``."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def is_quantized_kv(entry) -> bool:
+    """True when a cache entry is a quantized ``(int8 values, scales)``
+    pair rather than a plain full-precision array."""
+    return (isinstance(entry, (tuple, list)) and len(entry) == 2
+            and getattr(entry[0], "dtype", None) == jnp.int8)
 
 
 # ---------------------------------------------------------------- observers
@@ -133,6 +172,16 @@ class _QuantedBase(Layer):
         wq = quant_dequant(weight, w_scale, cfg.bits)
         return xq, wq
 
+    # LoRA targets layers by (in_features, out_features); delegate so an
+    # adapter can inject onto a quantized base projection
+    @property
+    def in_features(self):
+        return self.inner.in_features
+
+    @property
+    def out_features(self):
+        return self.inner.out_features
+
 
 class QuantedLinear(_QuantedBase):
     def forward(self, x):
@@ -148,21 +197,61 @@ class QuantedConv2D(_QuantedBase):
                         c.groups, c.data_format)
 
 
+class QuantedColumnParallelLinear(_QuantedBase):
+    """Fake-quant wrapper for the mp-sharded projections GPT/Llama decoder
+    blocks are built from (the PTQ path a small draft model takes before
+    serving). Per-shard abs-max weight scale — same locality as the
+    inner layer's sharding."""
+
+    def forward(self, x):
+        from ..distributed.parallel.mp_layers import _constrain
+
+        xq, wq = self._observe_and_quant(x, self.inner.weight)
+        out = F.linear(xq, wq, self.inner.bias)
+        if self.inner.gather_output:
+            return _constrain(out, "dp", None, None)
+        return _constrain(out, "dp", None, "mp")
+
+
+class QuantedRowParallelLinear(_QuantedBase):
+    def forward(self, x):
+        from ..distributed.parallel.mp_layers import _constrain
+
+        if self.inner.input_is_parallel:
+            x = _constrain(x, "dp", None, "mp")
+        xq, wq = self._observe_and_quant(x, self.inner.weight)
+        out = jnp.matmul(xq, wq)
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return _constrain(out, "dp", None, None)
+
+
+def _quantable() -> Dict[Type[Layer], Type[_QuantedBase]]:
+    from ..distributed.parallel.mp_layers import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+
+    table = dict(_QUANTABLE)
+    table[ColumnParallelLinear] = QuantedColumnParallelLinear
+    table[RowParallelLinear] = QuantedRowParallelLinear
+    return table
+
+
 _QUANTABLE: Dict[Type[Layer], Type[_QuantedBase]] = {
     nn.Linear: QuantedLinear,
     nn.Conv2D: QuantedConv2D,
 }
 
 
-def _swap_layers(layer: Layer, config: QuantConfig) -> None:
+def _swap_layers(layer: Layer, config: QuantConfig, table=None) -> None:
+    table = _quantable() if table is None else table
     for name, sub in list(layer._sub_layers.items()):
         if sub is None:
             continue
-        cls = _QUANTABLE.get(type(sub))
+        cls = table.get(type(sub))
         if cls is not None:
             layer._sub_layers[name] = cls(sub, config)
         else:
-            _swap_layers(sub, config)
+            _swap_layers(sub, config, table)
 
 
 class QAT:
@@ -174,7 +263,7 @@ class QAT:
         self.config = config or QuantConfig()
 
     def quantize(self, model: Layer) -> Layer:
-        cls = _QUANTABLE.get(type(model))
+        cls = _quantable().get(type(model))
         if cls is not None:
             return cls(model, self.config)
         _swap_layers(model, self.config)

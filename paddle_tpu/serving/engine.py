@@ -47,7 +47,8 @@ from ..observability import tracing as _tracing
 from ..framework.dtype import convert_dtype
 from ..io.batching import bucket_for
 from ..models.generation import (DEFAULT_PREFILL_BUCKETS, _constrain_cache,
-                                 gather_cache_blocks, init_cache,
+                                 cache_nbytes, gather_cache_blocks,
+                                 init_cache, normalize_kv_dtype,
                                  per_row_keys, sample_logits_rows,
                                  scatter_cache_blocks, scatter_cache_rows)
 from ..lora import adapter_rows as _adapter_rows_ctx
@@ -100,12 +101,13 @@ class ContinuousBatchingEngine:
                  max_length: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  top_k: int = 0, allow_top_p: bool = True,
-                 prefix_cache=None, adapter_store=None):
+                 prefix_cache=None, adapter_store=None, kv_dtype=None):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
         self.model = model
         spec = model.cache_spec()
         self.spec = spec
+        self.kv_dtype = normalize_kv_dtype(kv_dtype)
         self.slots = int(slots)
         self.max_length = int(max_length or spec["max_length"])
         if self.max_length > spec["max_length"]:
@@ -163,7 +165,8 @@ class ContinuousBatchingEngine:
                 prefix_cache, bool) and prefix_cache <= 0:
             return None
         if isinstance(prefix_cache, BlockPool):
-            prefix_cache.compatible_with(self.spec, self.max_length)
+            prefix_cache.compatible_with(self.spec, self.max_length,
+                                         kv_dtype=self.kv_dtype)
             owner = getattr(prefix_cache, "_owner", None)
             if owner is not None and owner is not self:
                 # each admit program DONATES the pool tensors; a second
@@ -180,11 +183,13 @@ class ContinuousBatchingEngine:
         elif prefix_cache is not True:
             kwargs = {"max_bytes": int(prefix_cache)}
         kwargs.setdefault("max_length", self.max_length)
+        kwargs.setdefault("kv_dtype", self.kv_dtype)
         pool = BlockPool(self.model, **kwargs)
         # same geometry gate as the ready-pool branch: an explicit
         # kwargs max_length larger than the engine cache would otherwise
         # only surface as a reshape error inside the admit program
-        pool.compatible_with(self.spec, self.max_length)
+        pool.compatible_with(self.spec, self.max_length,
+                             kv_dtype=self.kv_dtype)
         pool._owner = self
         return pool
 
@@ -223,7 +228,8 @@ class ContinuousBatchingEngine:
         may leave donated buffers half-written, so recovery starts clean."""
         self._params = param_state(self.model)
         self._buffers = buffer_state(self.model)
-        self.live_cache = init_cache(self.model, self.slots, self.max_length)
+        self.live_cache = init_cache(self.model, self.slots, self.max_length,
+                                     kv_dtype=self.kv_dtype)
         if self.pool is not None:
             self.pool.reset()
         if self.store is not None:
@@ -283,8 +289,20 @@ class ContinuousBatchingEngine:
         shape = (1, self.max_length, self.spec["num_kv_heads"],
                  self.spec["head_dim"])
         dtype = convert_dtype(self.spec["dtype"])
-        return tuple((jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+        def entry():
+            if self.kv_dtype == "int8":
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(shape[:-1] + (1,), jnp.float32))
+            return jnp.zeros(shape, dtype)
+
+        return tuple((entry(), entry())
                      for _ in range(self.spec["num_layers"]))
+
+    def cache_bytes_per_slot(self) -> int:
+        """HBM bytes one slot's KV occupies in the live batch — the
+        number the ``kv_dtype="int8"`` halving claim is asserted on."""
+        return cache_nbytes(self.live_cache) // self.slots
 
     def _prefill_fn(self, params, buffers, live_cache, ids, slot,
                     last_index, key, eos_id, temperature, top_p, greedy):

@@ -121,8 +121,9 @@ class BlockPool:
     def __init__(self, model, block_tokens: int = 16,
                  max_bytes: int = 64 << 20,
                  max_length: Optional[int] = None,
-                 max_blocks: int = 4096):
+                 max_blocks: int = 4096, kv_dtype=None):
         from ..framework.dtype import convert_dtype
+        from ..models.generation import normalize_kv_dtype
 
         spec = model.cache_spec()
         self.spec = spec
@@ -136,11 +137,17 @@ class BlockPool:
                 f"block_tokens {block_tokens} exceeds max_length "
                 f"{self.max_length}: no prompt could ever cache a block")
         self._dtype = convert_dtype(spec["dtype"])
+        self.kv_dtype = normalize_kv_dtype(kv_dtype)
         itemsize = (2 if "bfloat16" in str(self._dtype)
                     else np.dtype(self._dtype).itemsize)
+        if self.kv_dtype == "int8":
+            # int8 value + one float32 per-(position, head) scale: the
+            # byte budget buys ~itemsize*D/(D+4) times more blocks
+            per_pos_head = spec["head_dim"] + 4
+        else:
+            per_pos_head = spec["head_dim"] * itemsize
         self.block_bytes = (2 * spec["num_layers"] * self.block_tokens
-                            * spec["num_kv_heads"] * spec["head_dim"]
-                            * itemsize)
+                            * spec["num_kv_heads"] * per_pos_head)
         budget_blocks = max(1, int(max_bytes) // max(self.block_bytes, 1))
         # +1: row 0 is the reserved dump block, never allocated
         self.num_blocks = 1 + min(budget_blocks, int(max_blocks))
@@ -163,17 +170,33 @@ class BlockPool:
 
         shape = (self.num_blocks, self.block_tokens,
                  self.spec["num_kv_heads"], self.spec["head_dim"])
-        return tuple((jnp.zeros(shape, self._dtype),
-                      jnp.zeros(shape, self._dtype))
+
+        def entry():
+            if self.kv_dtype == "int8":
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(shape[:-1] + (1,), jnp.float32))
+            return jnp.zeros(shape, self._dtype)
+
+        return tuple((entry(), entry())
                      for _ in range(self.spec["num_layers"]))
 
-    def compatible_with(self, spec: dict, max_length: int) -> None:
+    def compatible_with(self, spec: dict, max_length: int,
+                        kv_dtype=None) -> None:
         """Raise when this pool cannot serve an engine's geometry."""
+        from ..models.generation import normalize_kv_dtype
+
         for k in ("num_layers", "num_kv_heads", "head_dim"):
             if self.spec[k] != spec[k]:
                 raise ValueError(
                     f"prefix cache built for {k}={self.spec[k]} cannot "
                     f"serve a model with {k}={spec[k]}")
+        if normalize_kv_dtype(kv_dtype) != self.kv_dtype:
+            # gather_cache_blocks copies pool leaves into the slot cache
+            # verbatim — a dtype mismatch would either fail at trace time
+            # (structure) or silently reinterpret int8 payload as values
+            raise ValueError(
+                f"prefix cache kv_dtype={self.kv_dtype!r} cannot serve "
+                f"an engine with kv_dtype={normalize_kv_dtype(kv_dtype)!r}")
         if self.block_tokens > int(max_length):
             raise ValueError(
                 f"prefix cache block_tokens {self.block_tokens} exceeds "
@@ -377,6 +400,7 @@ class BlockPool:
             seen = self.hit_tokens + self.miss_tokens
             return {
                 "block_tokens": self.block_tokens,
+                "kv_dtype": self.kv_dtype or "full",
                 "blocks_total": self.num_blocks - 1,   # dump row excluded
                 "blocks_in_use": in_use,
                 "blocks_pinned": pinned,

@@ -179,12 +179,12 @@ class InferenceServer:
                  tenant_burst: Optional[float] = None,
                  tenant_limits=None,
                  fair_queueing: bool = False,
-                 fair_weights=None):
+                 fair_weights=None, kv_dtype=None):
         self.engine = ContinuousBatchingEngine(
             network, slots=slots, max_length=max_length,
             prefill_buckets=prefill_buckets, top_k=top_k,
             allow_top_p=allow_top_p, prefix_cache=prefix_cache,
-            adapter_store=adapter_store)
+            adapter_store=adapter_store, kv_dtype=kv_dtype)
         self.scheduler = FifoScheduler(
             max_queue_depth=max_queue_depth,
             max_prefills_per_step=max_prefills_per_step,

@@ -99,6 +99,7 @@ def test_graft_entry_single_chip():
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+@pytest.mark.slow   # ~15s 8-device entry compile (tier-1 report)
 def test_graft_entry_multichip():
     import importlib.util
 
@@ -195,6 +196,7 @@ def test_bert_pretraining_masked_lm():
     assert float(jnp.abs(g[key]).sum()) > 0
 
 
+@pytest.mark.slow   # ~15s backbone+loss+nms compile (tier-1 report)
 def test_yolov3_detector_end_to_end():
     """The PP-YOLOE-class pipeline: conv backbone -> 3-scale heads ->
     vectorized yolo_loss training signal -> yolo_box + matrix_nms

@@ -94,6 +94,7 @@ def test_virtual_stages_many_microbatches():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow   # ~15s multi-stage compile (tier-1 report)
 def test_heterogeneous_pipeline_parity():
     """Different layer types per stage (reference PipelineLayer hetero)."""
 

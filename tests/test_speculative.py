@@ -1,0 +1,164 @@
+"""Draft-model speculative decoding (models/speculative.py).
+
+The load-bearing guarantees:
+
+1. **Exactness** — greedy speculative decode is token-identical to the
+   solo :class:`GenerationEngine` (the Leviathan accept rule degenerates
+   to ``d_i == argmax``), and eos handling matches the solo done-mask;
+2. **Determinism** — a fixed seed replays the same tokens AND the same
+   per-round acceptance trace (the per-(stream, position, row) key
+   discipline: restructuring the round must not move a single draw);
+3. **Compile discipline** — a generate() across both prefill buckets
+   compiles exactly ``2 * #buckets + 1`` programs (target prefill +
+   draft prefill per bucket, ONE fused decode round) and the steady
+   state compiles nothing.
+
+Tier-1 budget: one module-scoped gpt_tiny target + 1-layer draft; the
+greedy tests share one engine's compiled programs.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework import compile_cache
+
+GEO = dict(max_length=64, prefill_buckets=(16, 32))
+
+
+@pytest.fixture(scope="module")
+def target_model():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    pt.seed(7)
+    cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+@pytest.fixture(scope="module")
+def draft_model(target_model):
+    from paddle_tpu.models.speculative import build_draft_model
+
+    model, _ = target_model
+    return build_draft_model(model, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def engine(target_model, draft_model):
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    model, _ = target_model
+    return SpeculativeEngine(model, draft_model, k=4, **GEO)
+
+
+@pytest.fixture(scope="module")
+def solo(target_model):
+    from paddle_tpu.models.generation import GenerationEngine
+
+    model, _ = target_model
+    return GenerationEngine(model, **GEO)
+
+
+def _prompt(rows=3, length=12, seed=7):
+    return np.random.default_rng(seed).integers(
+        1, 64, (rows, length)).astype(np.int32)
+
+
+def test_greedy_parity_with_solo(engine, solo):
+    ids = _prompt()
+    ref = solo.generate(ids, max_new_tokens=20)
+    out = engine.generate(ids, max_new_tokens=20)
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_greedy_parity_second_bucket(engine, solo):
+    ids = _prompt(rows=2, length=24, seed=3)   # falls in the 32 bucket
+    ref = solo.generate(ids, max_new_tokens=16)
+    out = engine.generate(ids, max_new_tokens=16)
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_eos_parity_with_solo(engine, solo):
+    ids = _prompt()
+    ref_free = solo.generate(ids, max_new_tokens=20)
+    eos = int(ref_free[0, 5])   # a token the free run actually emits
+    ref = solo.generate(ids, max_new_tokens=20, eos_token_id=eos)
+    out = engine.generate(ids, max_new_tokens=20, eos_token_id=eos)
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_fixed_seed_replay_deterministic(engine):
+    ids = _prompt()
+    kw = dict(max_new_tokens=20, do_sample=True, temperature=0.9,
+              top_k=20, seed=123, return_stats=True)
+    o1, s1 = engine.generate(ids, **kw)
+    o2, s2 = engine.generate(ids, **kw)
+    np.testing.assert_array_equal(o1, o2)
+    t1, t2 = s1["acceptance_trace"], s2["acceptance_trace"]
+    assert len(t1) == len(t2) == s1["rounds"]
+    for a, b in zip(t1, t2):
+        np.testing.assert_array_equal(a, b)
+    # trace rows are per-round emit counts in 0..K+1, B wide
+    assert all(r.shape == (ids.shape[0],) for r in t1)
+    assert all(0 <= int(v) <= engine.k + 1 for r in t1 for v in r)
+
+
+def test_self_draft_accepts_everything(target_model):
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    model, _ = target_model
+    eng = SpeculativeEngine(model, model, k=4, **GEO)
+    _, stats = eng.generate(_prompt(), max_new_tokens=16,
+                            return_stats=True)
+    assert stats["acceptance_rate"] == pytest.approx(1.0)
+    # every round emits the full window: K accepted + the bonus token
+    assert stats["tokens_per_target_dispatch"] > eng.k
+
+
+def test_compile_budget(target_model, draft_model):
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    model, _ = target_model
+    eng = SpeculativeEngine(model, draft_model, k=4, **GEO)
+    before = compile_cache.cache_stats()["compiles"]
+    for plen in (12, 24):                       # spans both buckets
+        eng.generate(_prompt(rows=2, length=plen), max_new_tokens=8)
+    compiled = compile_cache.cache_stats()["compiles"] - before
+    budget = 2 * len(GEO["prefill_buckets"]) + 1
+    assert compiled == budget, (
+        f"{compiled} programs for 2 buckets (budget {budget} = "
+        f"2 prefill families + one fused decode round)")
+    # steady state: same shapes compile nothing
+    for plen in (12, 24):
+        eng.generate(_prompt(rows=2, length=plen), max_new_tokens=8)
+    assert compile_cache.cache_stats()["compiles"] - before == budget
+    per_family = eng.cache_stats()
+    assert per_family["decode_round"]["compiles"] == 1
+
+
+def test_int8_kv_replay_and_greedy(target_model, draft_model):
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    model, _ = target_model
+    eng = SpeculativeEngine(model, draft_model, k=4, kv_dtype="int8",
+                            **GEO)
+    ids = _prompt()
+    a = eng.generate(ids, max_new_tokens=16, do_sample=True, seed=5)
+    b = eng.generate(ids, max_new_tokens=16, do_sample=True, seed=5)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_validation():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.models.speculative import SpeculativeEngine
+
+    pt.seed(0)
+    model = GPTForCausalLM(gpt_tiny(use_flash_attention=False))
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        SpeculativeEngine(model, model, k=0, **GEO)
+    eng = SpeculativeEngine(model, model, k=8, **GEO)
+    # the last verify window must fit in max_length
+    with pytest.raises(ValueError, match="exceeds max_length"):
+        eng.generate(_prompt(length=12), max_new_tokens=60)

@@ -164,6 +164,7 @@ def test_random_crop_pad_if_needed_narrow_image():
 
 
 # ------------------------------------------------------------ pp-yoloe
+@pytest.mark.slow   # ~13s forward+decode compile (tier-1 report)
 def test_ppyoloe_forward_and_decode():
     from paddle_tpu.models.ppyoloe import ppyoloe_tiny
 

@@ -116,6 +116,36 @@ def run_generate():
     return engine.cache_stats()
 
 
+SPEC_K = 4
+
+
+def run_speculative():
+    """Drive the speculative draft/verify engine across the same two
+    prefill buckets and return its per-family compile stats. The
+    declared budget is ``2 * #buckets + 1``: a target prefill AND a
+    draft prefill per bucket, plus ONE fused decode-round program (the
+    K-step draft chain and the [B, K+1] verify live in the same
+    program)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.models.speculative import (SpeculativeEngine,
+                                               build_draft_model)
+
+    pt.seed(0)
+    model = GPTForCausalLM(gpt_tiny(hidden_dropout_prob=0.0,
+                                    attention_dropout_prob=0.0,
+                                    use_flash_attention=False))
+    model.eval()
+    draft = build_draft_model(model, num_layers=1)
+    engine = SpeculativeEngine(model, draft, k=SPEC_K, max_length=64,
+                               prefill_buckets=GEN_BUCKETS)
+    for plen in GEN_PROMPT_LENS:
+        ids = np.random.default_rng(plen).integers(
+            1, VOCAB, (2, plen)).astype(np.int32)
+        engine.generate(ids, max_new_tokens=GEN_NEW_TOKENS)
+    return engine.cache_stats()
+
+
 _LINT_CACHE = []   # one (baseline, analysis) pass even if both budgets fail
 
 
@@ -176,8 +206,10 @@ def main(argv=None) -> int:
                     help="disable pad_batches/length_buckets to show the "
                          "per-shape recompile behavior")
     ap.add_argument("--generate", action="store_true",
-                    help="also run the KV-cache generation engine and "
-                         "report its prefill/decode compile rows")
+                    help="also run the KV-cache generation engine (and "
+                         "the speculative draft/verify engine) and "
+                         "report their compile rows against the "
+                         "declared program-family budgets")
     ap.add_argument("--epochs", type=int, default=2)
     args = ap.parse_args(argv)
 
@@ -212,6 +244,26 @@ def main(argv=None) -> int:
                   f"{gen_budget} (#prefill buckets + one decode step)",
                   file=sys.stderr)
             _print_lint_pointers(("prefill", "decode", "generate"))
+            gen_fail = True
+        # speculative decoding's declared program family rides the same
+        # gate: target + draft prefills per bucket, ONE fused decode
+        # round (draft chain + verify in a single program)
+        spec = run_speculative()
+        for kind in ("target_prefill", "draft_prefill", "decode_round"):
+            _print_rows(kind, spec[kind]["signatures"])
+        spec_compiles = sum(v["compiles"] for v in spec.values())
+        spec_calls = sum(v["calls"] for v in spec.values())
+        spec_budget = 2 * len(GEN_BUCKETS) + 1
+        print(f"{'TOTAL':<9}{'speculative (2 prefill families + round)':<63}"
+              f"{spec_compiles:>9}   (calls {spec_calls}, budget "
+              f"{spec_budget} = 2 * #buckets + 1)")
+        if spec_compiles > spec_budget:
+            print(f"FAIL: speculative decoding compiled {spec_compiles} "
+                  f"programs > {spec_budget} (target prefill + draft "
+                  f"prefill per bucket + one fused decode round)",
+                  file=sys.stderr)
+            _print_lint_pointers(("speculative", "draft", "verify",
+                                  "round"))
             gen_fail = True
 
     if budget is not None and stats["compiles"] > budget:

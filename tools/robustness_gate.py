@@ -90,6 +90,16 @@ story. Runs, in order:
    restructured step files (jit.py / shard.py / overlap.py /
    bench_profile.py) rides along so the R10 collective-divergence
    discipline is asserted even under ``--skip-lint``.
+8. with ``--decode``, the raw-decode-speed regression gate:
+   ``tools/decode_bench.py`` runs the ``small`` preset (compute-bound —
+   the dispatch-bound ``tiny`` config hides model-level wins in launch
+   overhead) with speculative decoding + int8 KV on, paired against the
+   plain engine in the same process, and FAILS if the speedup drops
+   below the ``.decode_baseline.json`` floor, the quantized cache stops
+   halving, or the timed run recompiles. A ``--trace-overhead`` run
+   rides the same baseline's threshold, and a scoped tpu_lint of the
+   speculative/quantization files holds the R1/R9 line under
+   ``--skip-lint``.
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -104,6 +114,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --lora         # + adapter lifecycle
     python tools/robustness_gate.py --observability  # + telemetry gate
     python tools/robustness_gate.py --overlap      # + step-schedule gate
+    python tools/robustness_gate.py --decode       # + decode-speed gate
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -263,6 +274,86 @@ def _run_overlap_gate() -> bool:
                  os.path.join(REPO, "tools/bench_profile.py")])
 
 
+def _run_decode_gate() -> bool:
+    """``--decode``: the raw-decode-speed regression gate. Runs
+    ``tools/decode_bench.py`` on the compute-bound ``small`` preset with
+    the checked-in speculative/int8 config paired against the plain
+    engine (same process, same box — the ratio is host-independent
+    where absolute tokens/s is not) and fails if the speedup drops
+    below the ``.decode_baseline.json`` floor or the quantized cache
+    stops halving. The bench itself fails the stage on steady-state
+    recompiles. A ``--trace-overhead`` run rides the same baseline's
+    threshold, and the speculative/quantization files are scope-linted
+    so R1 (host-sync in the round loop) and R9 stay asserted under
+    ``--skip-lint``."""
+    name = "decode"
+    baseline_path = os.path.join(REPO, ".decode_baseline.json")
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except OSError as e:
+        print(f"[robustness_gate] === {name}: FAIL "
+              f"(no {baseline_path}: {e})", flush=True)
+        return False
+    bench = baseline["bench"]
+    out = os.path.join(tempfile.gettempdir(),
+                       f"decode_gate_{os.getpid()}.json")
+    ok = _run(name, [sys.executable,
+                     os.path.join(TOOLS, "decode_bench.py"),
+                     "--preset", str(bench["preset"]),
+                     "--batch", str(bench["batch"]),
+                     "--new-tokens", str(bench["new_tokens"]),
+                     "--speculative", str(bench["speculative_k"]),
+                     "--draft-layers", str(bench["draft_layers"]),
+                     "--kv-dtype", str(bench["kv_dtype"]),
+                     "--json-out", out])
+    if not ok:
+        return False
+    try:
+        with open(out) as f:
+            summary = json.load(f)
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    speedup = summary["speedup"]
+    min_speedup = baseline["min_speedup"]
+    cache_frac = (summary["after"]["extra"]["cache_bytes"]
+                  / max(summary["before"]["extra"]["cache_bytes"], 1))
+    max_frac = baseline["max_cache_bytes_frac"]
+    ok = speedup >= min_speedup and cache_frac <= max_frac
+    print(f"[robustness_gate] === {name}: speedup={speedup}x "
+          f"(min {min_speedup}), cache_frac={cache_frac:.3f} "
+          f"(max {max_frac}), acceptance="
+          f"{summary['after']['extra'].get('acceptance_rate')} -> "
+          f"{'PASS' if ok else 'FAIL'}", flush=True)
+    if not ok:
+        return False
+    # trace overhead on the SAME compute-bound preset: on tiny the span
+    # recorder's fixed cost is a visible fraction of the ~launch-bound
+    # step and the number is pure noise; on small it must stay inside
+    # the baseline's budget (best-of-5 per mode filters box noise)
+    if not _run(f"{name}_trace_overhead",
+                [sys.executable, os.path.join(TOOLS, "decode_bench.py"),
+                 "--preset", str(bench["preset"]),
+                 "--batch", str(bench["batch"]),
+                 "--trace-overhead", "5", "--trace-overhead-pct",
+                 str(baseline["max_trace_overhead_pct"])]):
+        return False
+    # scoped self-application: the speculative round loop and the
+    # quantize-on-write path must carry zero unbaselined findings
+    return _run(f"{name}_lint",
+                [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
+                 "--baseline",
+                 os.path.join(REPO, ".tpu_lint_baseline.json"),
+                 os.path.join(REPO, "paddle_tpu/models/speculative.py"),
+                 os.path.join(REPO, "paddle_tpu/models/generation.py"),
+                 os.path.join(REPO, "paddle_tpu/models/lm_utils.py"),
+                 os.path.join(REPO, "paddle_tpu/quantization/__init__.py"),
+                 os.path.join(REPO, "tools/decode_bench.py")])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-soak", action="store_true")
@@ -298,6 +389,12 @@ def main() -> int:
                          "(bench_profile --overlap --distributed vs the "
                          ".overlap_baseline.json threshold + scoped "
                          "tpu_lint of the restructured step files)")
+    ap.add_argument("--decode", action="store_true",
+                    help="also run the raw-decode-speed regression gate "
+                         "(decode_bench small preset, speculative + int8 "
+                         "KV vs plain engine, against the "
+                         ".decode_baseline.json floor + scoped tpu_lint "
+                         "of the speculative/quantization files)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     ap.add_argument("--full-lint", action="store_true",
@@ -362,6 +459,8 @@ def main() -> int:
             "lora", [sys.executable, os.path.join(TOOLS, "lora_soak.py")])
     if args.overlap:
         results["overlap"] = _run_overlap_gate()
+    if args.decode:
+        results["decode"] = _run_decode_gate()
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,

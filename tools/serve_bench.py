@@ -77,6 +77,52 @@ def _pct(values, p):
     return float(np.percentile(np.asarray(values, np.float64), p))
 
 
+def _kv_logit_error(model, prompt, steps, max_length):
+    """Max relative logit error of an int8-quantized KV cache against
+    full precision, over a teacher-forced decode (same token sequence
+    through both caches, so every step compares like with like).
+    Prefill attends over the un-quantized fresh block, so the error
+    budget is spent exactly where the quantized path reads the cache:
+    the decode steps."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.generation import init_cache
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     param_state)
+
+    was_training = model.training
+    model.eval()
+    try:
+        params, buffers = param_state(model), buffer_state(model)
+        ids = jnp.asarray(prompt[None].astype(np.int32))
+        seqs = {}
+        for name, kv in (("full", None), ("int8", "int8")):
+            cache = init_cache(model, 1, max_length, kv_dtype=kv)
+            (lg, cache), _ = functional_call(
+                model, params, buffers, ids, cache=cache,
+                position_offset=0)
+            per_step = [np.asarray(lg[:, -1], np.float32)]
+            pos = int(prompt.shape[0])
+            for s in range(steps):
+                if name == "full":
+                    tok = int(np.argmax(per_step[-1]))
+                    seqs.setdefault("toks", []).append(tok)
+                else:
+                    tok = seqs["toks"][s]   # teacher-forced: same tokens
+                (lg, cache), _ = functional_call(
+                    model, params, buffers,
+                    jnp.full((1, 1), tok, jnp.int32), cache=cache,
+                    position_offset=pos + s)
+                per_step.append(np.asarray(lg[:, -1], np.float32))
+            seqs[name] = np.concatenate(per_step, axis=0)
+    finally:
+        if was_training:
+            model.train()
+    ref, quant = seqs["full"], seqs["int8"]
+    scale = max(float(np.max(np.abs(ref))), 1e-9)
+    return float(np.max(np.abs(ref - quant))) / scale
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", choices=("gpt", "llama"), default="gpt")
@@ -99,6 +145,12 @@ def main(argv=None) -> int:
                          "actually destroys")
     ap.add_argument("--check", action="store_true",
                     help="small fixed workload for CI / bench.py probing")
+    ap.add_argument("--kv-dtype", choices=("none", "int8"), default="none",
+                    help="KV-cache storage dtype for every replica "
+                         "(int8 = quantized slots + pool blocks)")
+    ap.add_argument("--kv-logit-tol", type=float, default=0.05,
+                    help="max relative logit error (vs full-precision "
+                         "KV) the quantized --verify gate accepts")
     # ---- fleet knobs ----
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
@@ -224,6 +276,7 @@ def main(argv=None) -> int:
         zipf_w = np.array([1.0 / (k + 1) ** 1.1
                            for k in range(args.adapters)])
         zipf_w /= zipf_w.sum()
+    kv_dtype = None if args.kv_dtype == "none" else args.kv_dtype
     servers = [
         InferenceServer(
             model, slots=args.slots, max_length=max_length,
@@ -232,7 +285,8 @@ def main(argv=None) -> int:
             prefix_cache=(dict(max_bytes=prefix_cache,
                                block_tokens=args.block_tokens)
                           if prefix_cache else None),
-            adapter_store=stores[i] if stores else None)
+            adapter_store=stores[i] if stores else None,
+            kv_dtype=kv_dtype)
         for i in range(args.replicas)]
     fleet = args.replicas > 1
     router = None
@@ -386,13 +440,27 @@ def main(argv=None) -> int:
                 clear_adapter(model)
             else:
                 set_adapter(model, tenant_trees[tid])
+        # the solo reference runs with the SAME kv storage dtype, so the
+        # served stream stays token-EXACT even when quantized (fidelity
+        # of quantization itself is the separate logit-error gate below)
         solo = model.generate(
             p[None], max_new_tokens=args.new_tokens,
-            max_length=max_length, prefill_buckets=tuple(args.buckets))[0]
+            max_length=max_length, prefill_buckets=tuple(args.buckets),
+            kv_dtype=kv_dtype)[0]
         if not np.array_equal(np.asarray(got), solo):
             verify_failures += 1
     if verify_solo and stores:
         clear_adapter(model)
+    # quantized fidelity gate: the token-parity probes above prove the
+    # served stream matches solo-with-int8; this bounds how far the
+    # int8 cache's LOGITS drift from full precision (the bitwise gate's
+    # replacement for a lossy representation)
+    kv_logit_err = None
+    if kv_dtype is not None and args.verify:
+        probe = prompt(lens[0])
+        kv_logit_err = _kv_logit_error(model, probe,
+                                       steps=min(args.new_tokens, 8),
+                                       max_length=max_length)
     # the solo engine above compiles its own programs; they are not
     # serving-loop recompiles
     live = [s for i, s in enumerate(servers)
@@ -492,6 +560,10 @@ def main(argv=None) -> int:
             "device_kind": jax.devices()[0].device_kind,
             "preset": args.preset,
             "check": bool(args.check),
+            "kv_dtype": args.kv_dtype,
+            **({"kv_logit_err": round(kv_logit_err, 6),
+                "kv_logit_tol": args.kv_logit_tol}
+               if kv_logit_err is not None else {}),
             "metrics": metrics_snap,
             "slo_report": slo_report,
             **({"crashed_replica": crashed_replica,
@@ -526,6 +598,11 @@ def main(argv=None) -> int:
         print(f"FAIL: {verify_failures}/{verify_compared} completed "
               f"seeded-greedy probes diverged from solo generate "
               f"(placement/reroute changed tokens)", file=sys.stderr)
+        rc = 1
+    if kv_logit_err is not None and kv_logit_err > args.kv_logit_tol:
+        print(f"FAIL: int8 KV cache drifts logits by "
+              f"{kv_logit_err:.4f} (rel) > tol {args.kv_logit_tol} — "
+              f"quantization error is out of bounds", file=sys.stderr)
         rc = 1
     if args.crash_replica and failed:
         print(f"FAIL: {failed} request(s) lost to the replica crash — "
