@@ -59,6 +59,7 @@ class SloPolicy:
 
     def __init__(self, target_ttft_s: float = 0.5,
                  target_availability: float = 0.999, *,
+                 target_itl_s: Optional[float] = None,
                  fast_window_s: float = 60.0,
                  slow_window_s: float = 1800.0,
                  fast_burn_threshold: float = 10.0,
@@ -75,7 +76,17 @@ class SloPolicy:
             raise ValueError(
                 f"windows must satisfy 0 < fast ({fast_window_s}) <= "
                 f"slow ({slow_window_s})")
+        if target_itl_s is not None and target_itl_s <= 0:
+            raise ValueError(f"target_itl_s must be > 0, got "
+                             f"{target_itl_s}")
         self.target_ttft_s = float(target_ttft_s)
+        # inter-token latency promise (None = untracked). TTFT and ITL
+        # burn are ALSO tracked as separate signals (burn_*_ttft /
+        # burn_*_itl in reports) so a disaggregated fleet can scale its
+        # prefill pool on TTFT burn and its decode pool on ITL burn —
+        # the two pools bottleneck independently
+        self.target_itl_s = (None if target_itl_s is None
+                             else float(target_itl_s))
         self.target_availability = float(target_availability)
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
@@ -88,6 +99,7 @@ class SloPolicy:
 
     def as_dict(self) -> dict:
         return {"target_ttft_s": self.target_ttft_s,
+                "target_itl_s": self.target_itl_s,
                 "target_availability": self.target_availability,
                 "fast_window_s": self.fast_window_s,
                 "slow_window_s": self.slow_window_s,
@@ -111,7 +123,7 @@ def _cum_from_snapshot(snapshot: dict) -> Dict[str, dict]:
     else:
         servers = [snapshot]
     fleet = {"total": 0.0, "bad": 0.0, "ttft_count": 0.0,
-             "ttft_sum_s": 0.0}
+             "ttft_sum_s": 0.0, "itl_count": 0.0, "itl_sum_s": 0.0}
     tenants: Dict[str, dict] = {FLEET_TENANT: fleet}
     for s in servers:
         shed = s.get("requests_shed", 0) or 0
@@ -129,10 +141,16 @@ def _cum_from_snapshot(snapshot: dict) -> Dict[str, dict]:
         cnt = ttft.get("count", 0) or 0
         fleet["ttft_count"] += cnt
         fleet["ttft_sum_s"] += cnt * (ttft.get("mean_ms", 0.0) or 0.0) / 1e3
+        itl = s.get("inter_token") or {}
+        icnt = itl.get("count", 0) or 0
+        fleet["itl_count"] += icnt
+        fleet["itl_sum_s"] += icnt * (itl.get("mean_ms", 0.0) or 0.0) / 1e3
         for name, e in (s.get("per_adapter") or {}).items():
             t = tenants.setdefault(name, {"total": 0.0, "bad": 0.0,
                                           "ttft_count": 0.0,
-                                          "ttft_sum_s": 0.0})
+                                          "ttft_sum_s": 0.0,
+                                          "itl_count": 0.0,
+                                          "itl_sum_s": 0.0})
             t["total"] += e.get("requests", 0) or 0
             t["bad"] += e.get("failures", 0) or 0
             t["ttft_count"] += e.get("ttft_count", 0) or 0
@@ -203,29 +221,45 @@ class SloTracker:
                 if p is None or c is None:
                     merged[name] = dict(c if p is None else p)
                 else:
-                    merged[name] = {k: max(p[k], c[k]) for k in p}
+                    merged[name] = {k: max(p.get(k, 0.0), c.get(k, 0.0))
+                                    for k in set(p) | set(c)}
             self._last = merged
             horizon = now - self.policy.slow_window_s
             for name, c in cum.items():
-                p = prev.get(name) or {"total": 0.0, "bad": 0.0,
-                                       "ttft_count": 0.0,
-                                       "ttft_sum_s": 0.0}
-                d_total = max(0.0, c["total"] - p["total"])
-                d_bad = max(0.0, c["bad"] - p["bad"])
-                d_cnt = max(0.0, c["ttft_count"] - p["ttft_count"])
-                d_sum = max(0.0, c["ttft_sum_s"] - p["ttft_sum_s"])
+                p = prev.get(name) or {}
+                d_total = max(0.0, c["total"] - p.get("total", 0.0))
+                d_bad = max(0.0, c["bad"] - p.get("bad", 0.0))
+                d_cnt = max(0.0, c["ttft_count"]
+                            - p.get("ttft_count", 0.0))
+                d_sum = max(0.0, c["ttft_sum_s"]
+                            - p.get("ttft_sum_s", 0.0))
+                d_icnt = max(0.0, c.get("itl_count", 0.0)
+                             - p.get("itl_count", 0.0))
+                d_isum = max(0.0, c.get("itl_sum_s", 0.0)
+                             - p.get("itl_sum_s", 0.0))
+                ttft_bad = 0.0
                 if d_cnt > 0 and (d_sum / d_cnt
                                   > self.policy.target_ttft_s):
                     # the interval's mean TTFT broke the latency
                     # promise: its requests count against the budget
                     d_bad += d_cnt
+                    ttft_bad = d_cnt
+                # inter-token latency is a SEPARATE signal with its own
+                # denominator (token gaps, not requests) — it never
+                # feeds the combined burn, so existing verdicts are
+                # unchanged whether or not a target_itl_s is set
+                itl_bad = 0.0
+                if (self.policy.target_itl_s is not None and d_icnt > 0
+                        and d_isum / d_icnt > self.policy.target_itl_s):
+                    itl_bad = d_icnt
                 # a failed request that never reached admission (shed,
                 # expired in queue) is bad traffic that the admission
                 # counters never saw — widen the interval total so
                 # availability can't read 100% on pure failures
                 d_total = max(d_total, d_bad)
                 buckets = self._buckets.setdefault(name, deque())
-                buckets.append((now, d_total, d_bad))
+                buckets.append((now, d_total, d_bad,
+                                d_cnt, ttft_bad, d_icnt, itl_bad))
                 while buckets and buckets[0][0] < horizon:
                     buckets.popleft()
             report = self._report_locked(now)
@@ -260,6 +294,13 @@ class SloTracker:
                 reg.set_gauge("slo.burn_alerting",
                               1.0 if ten["alerting"] else 0.0,
                               tenant=name)
+                if self.policy.target_itl_s is not None:
+                    # per-signal gauges only under an ITL policy — the
+                    # registry's series set is unchanged without one
+                    reg.set_gauge("slo.burn_rate_slow_ttft",
+                                  ten["burn_slow_ttft"], tenant=name)
+                    reg.set_gauge("slo.burn_rate_slow_itl",
+                                  ten["burn_slow_itl"], tenant=name)
             reg.set_counter("slo.burn_alerts", self.burn_alerts)
         for alert in fired:
             from . import flight as _flight
@@ -281,16 +322,30 @@ class SloTracker:
     # ---------------------------------------------------------- report
     def _window(self, buckets, now: float, span: float) -> dict:
         total = bad = 0.0
-        for t, d_total, d_bad in buckets:
-            if t >= now - span:
-                total += d_total
-                bad += d_bad
+        tcnt = tbad = icnt = ibad = 0.0
+        for b in buckets:
+            if b[0] >= now - span:
+                total += b[1]
+                bad += b[2]
+                tcnt += b[3]
+                tbad += b[4]
+                icnt += b[5]
+                ibad += b[6]
         avail = 1.0 - (bad / total) if total > 0 else 1.0
         burn = ((bad / total) / self.policy.error_budget
                 if total > 0 else 0.0)
         return {"total": round(total, 3), "bad": round(bad, 3),
                 "availability": round(avail, 6),
-                "burn_rate": round(burn, 4)}
+                "burn_rate": round(burn, 4),
+                # per-signal burns over their OWN denominators: TTFT
+                # over admitted requests, ITL over token gaps — the
+                # disagg autoscaler's per-pool scaling signals
+                "burn_ttft": round(
+                    (tbad / tcnt) / self.policy.error_budget, 4)
+                if tcnt > 0 else 0.0,
+                "burn_itl": round(
+                    (ibad / icnt) / self.policy.error_budget, 4)
+                if icnt > 0 else 0.0}
 
     def _report_locked(self, now: float) -> dict:
         tenants = {}
@@ -301,6 +356,10 @@ class SloTracker:
                 "window_fast": fast, "window_slow": slow,
                 "burn_fast": fast["burn_rate"],
                 "burn_slow": slow["burn_rate"],
+                "burn_fast_ttft": fast["burn_ttft"],
+                "burn_slow_ttft": slow["burn_ttft"],
+                "burn_fast_itl": fast["burn_itl"],
+                "burn_slow_itl": slow["burn_itl"],
                 "fast_breached": (fast["burn_rate"]
                                   >= self.policy.fast_burn_threshold
                                   and fast["total"] > 0),

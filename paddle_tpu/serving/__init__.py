@@ -28,6 +28,8 @@ batched multi-tenant LoRA decode
 from ..lora.store import (AdapterError, AdapterStore)  # noqa: F401
 from .autoscaler import (Autoscaler,  # noqa: F401
                          ProcessReplicaSpawner)
+from .disagg import (DisaggClient, PrefixIndex,  # noqa: F401
+                     warm_boot_env)
 from .engine import ContinuousBatchingEngine, SlotEvent  # noqa: F401
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
 from .prefix_cache import BlockPool, PrefixHit, StorePlan  # noqa: F401
@@ -50,5 +52,5 @@ __all__ = [
     "ReplicaRouter", "RouterHandle", "NoReplicasAvailable",
     "RemoteReplica", "RemoteHandle", "ReplicaUnreachable",
     "AdapterStore", "AdapterError", "ACTIVE", "SUSPECT", "DRAINING",
-    "DEAD",
+    "DEAD", "DisaggClient", "PrefixIndex", "warm_boot_env",
 ]

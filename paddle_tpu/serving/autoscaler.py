@@ -136,7 +136,12 @@ class Autoscaler:
                  cooldown_s: float = 60.0,
                  drain_timeout: Optional[float] = 120.0,
                  replica_prefix: str = "auto",
+                 burn_signal: Optional[str] = None,
                  clock=time.monotonic):
+        if burn_signal not in (None, "ttft", "itl"):
+            raise ValueError(
+                f"burn_signal must be None, 'ttft' or 'itl', got "
+                f"{burn_signal!r}")
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
@@ -152,6 +157,13 @@ class Autoscaler:
         #: report's own ``slow_breached`` verdict (the SloPolicy line)
         self.scale_out_burn = (None if scale_out_burn is None
                                else float(scale_out_burn))
+        #: which burn track drives scaling: ``None`` = the combined
+        #: availability+TTFT burn (PR 16 behavior, bit-identical);
+        #: ``"ttft"`` / ``"itl"`` read the per-signal burns an
+        #: ``SloPolicy(target_itl_s=...)`` tracker reports — a
+        #: disaggregated fleet runs TWO autoscalers over one router,
+        #: the prefill pool's on TTFT burn, the decode pool's on ITL
+        self.burn_signal = burn_signal
         self.scale_in_burn = float(scale_in_burn)
         self.scale_in_load = float(scale_in_load)
         self.sustain_ticks = int(sustain_ticks)
@@ -228,22 +240,34 @@ class Autoscaler:
 
     def _burn_evidence(self, report: Optional[dict]):
         """(hot tenant evidence or None, worst slow burn) — the tenant
-        whose slow window burns hottest above the scale-out line."""
+        whose slow window burns hottest above the scale-out line. With
+        a ``burn_signal`` the per-signal burn track is read instead of
+        the combined one (and ``slow_breached`` — a combined-burn
+        verdict — no longer applies, so the signal is judged against
+        ``scale_out_burn`` or the policy's slow threshold)."""
+        sig = self.burn_signal
+        key = "burn_slow" if sig is None else f"burn_slow_{sig}"
+        fast_key = "burn_fast" if sig is None else f"burn_fast_{sig}"
+        threshold = self.scale_out_burn
+        if threshold is None and sig is not None:
+            threshold = float(((report or {}).get("policy") or {})
+                              .get("slow_burn_threshold") or 2.0)
         worst = None
         hot = None
         for name, ten in ((report or {}).get("tenants") or {}).items():
-            burn = float(ten.get("burn_slow") or 0.0)
+            burn = float(ten.get(key) or 0.0)
             if worst is None or burn > worst[1]:
                 worst = (name, burn)
-            if self.scale_out_burn is None:
+            if threshold is None:
                 breached = bool(ten.get("slow_breached"))
             else:
-                breached = (burn >= self.scale_out_burn
+                breached = (burn >= threshold
                             and (ten.get("window_slow") or {})
                             .get("total", 0) > 0)
             if breached and (hot is None or burn > hot["burn_slow"]):
                 hot = {"tenant": name, "burn_slow": burn,
-                       "burn_fast": float(ten.get("burn_fast") or 0.0)}
+                       "burn_fast": float(ten.get(fast_key) or 0.0),
+                       **({"signal": sig} if sig else {})}
         return hot, (worst[1] if worst else 0.0)
 
     def tick(self) -> Optional[dict]:
@@ -278,7 +302,11 @@ class Autoscaler:
                         burn_slow=round(hot["burn_slow"], 4),
                         burn_fast=round(hot["burn_fast"], 4),
                         replicas=len(live),
-                        sustained_ticks=self._hot_ticks)
+                        sustained_ticks=self._hot_ticks,
+                        # which burn track fired (per-pool scaling
+                        # evidence); absent on the combined signal
+                        **({"signal": hot["signal"]}
+                           if "signal" in hot else {}))
             elif (hot is None and len(live) > self.min_replicas
                   and worst_burn <= self.scale_in_burn
                   and load is not None and load <= self.scale_in_load):
@@ -413,6 +441,7 @@ class Autoscaler:
                     "interval": self.interval,
                     "min_replicas": self.min_replicas,
                     "max_replicas": self.max_replicas,
+                    "burn_signal": self.burn_signal,
                     "scale_out_burn": self.scale_out_burn,
                     "scale_in_burn": self.scale_in_burn,
                     "scale_in_load": self.scale_in_load,

@@ -417,6 +417,56 @@ class ContinuousBatchingEngine:
                 f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
                 f"exceeds the engine's max_length {self.max_length}")
 
+    def warmup(self, max_new_tokens: int = 2) -> dict:
+        """Compile every program this engine can ever dispatch — one
+        prefill per bucket plus the shared decode step — by pushing one
+        dummy greedy request per bucket through :meth:`admit` +
+        :meth:`step` on an idle engine. With the persistent compile
+        cache enabled (``framework.compile_cache.enable_persistent_cache``)
+        the traced programs deserialize from disk instead of
+        recompiling, so a freshly spawned replica boots WARM: its first
+        real request pays dispatch cost, not compile cost. The prefix
+        pool is reset afterwards so the dummy prompt's blocks never
+        match real traffic. ``max_new_tokens=1`` warms the prefill
+        programs ONLY — a disaggregated prefill replica serves nothing
+        but single-token requests, so its decode program must never be
+        traced (#buckets programs, not #buckets+1). Returns the compile
+        counts the warmup actually incurred (all zeros on a warm
+        persistent cache)."""
+        from .scheduler import Request
+
+        if self.requests[0] is not None:
+            raise RuntimeError("warmup() needs an idle engine — run it "
+                               "before admitting traffic")
+        before_p = compile_cache.cache_stats(self._cc_prefill)["compiles"]
+        before_d = compile_cache.cache_stats(self._cc_decode)["compiles"]
+        mnt = max(1, int(max_new_tokens))
+        seen = set()
+        for b in self.prefill_buckets:
+            L = max(1, min(int(b), self.max_length - mnt))
+            bucket = self.bucket_for_prompt(L)
+            if bucket in seen:
+                continue
+            seen.add(bucket)
+            prompt = (np.arange(L, dtype=np.int32) % 97) + 1
+            req = Request(prompt=prompt, max_new_tokens=mnt, greedy=True,
+                          seed=0)
+            self.admit(req, 0)
+            if mnt > 1:
+                self.step()  # the first step compiles the decode program
+            self.release(0)
+        if self.pool is not None:
+            self.pool.reset()
+        return {
+            "buckets": sorted(seen),
+            "prefill_compiles":
+                compile_cache.cache_stats(self._cc_prefill)["compiles"]
+                - before_p,
+            "decode_compiles":
+                compile_cache.cache_stats(self._cc_decode)["compiles"]
+                - before_d,
+        }
+
     def _request_key(self, request) -> np.ndarray:
         seed = getattr(request, "seed", None)
         if seed is None:
@@ -514,33 +564,41 @@ class ContinuousBatchingEngine:
                         np.int32(slot), np.int32(L - 1), key, eos, temp,
                         top_p, greedy)
                 else:
-                    hit, plan = self._plan_hit(prompt, L, salt=a_salt)
-                    # the abort guard starts the statement AFTER the
-                    # pins land: a raise anywhere before the commit —
-                    # bucket planning as much as the dispatch itself —
-                    # must release them (tpu_lint R9)
-                    try:
-                        hit_tokens = hit.tokens
-                        suffix = L - hit_tokens
-                        bucket = self.bucket_for_prompt(suffix)
-                        ids_p = np.zeros((1, bucket), np.int32)
-                        ids_p[0, :suffix] = prompt[hit_tokens:]
-                        tok, done0, self.live_cache, tensors = (
-                            self._prefill_compiled(
-                                self._params, self._buffers,
-                                self.live_cache, self.pool.tensors,
-                                *lora_args, ids_p, np.int32(slot),
-                                np.int32(suffix - 1), np.int32(hit_tokens),
-                                hit.read_idx, plan.write_idx, key, eos,
-                                temp, top_p, greedy))
-                    except Exception:
-                        # dispatch never completed: unpin + free the
-                        # plan's rows (a post-dispatch device fault
-                        # instead goes through reset(), which rebuilds
-                        # the pool tensors)
-                        self.pool.abort(hit, plan)
-                        raise
-                    self.pool.commit(hit, plan, tensors)
+                    # device_lock spans plan -> dispatch -> commit: the
+                    # dispatch DONATES pool.tensors and commit rebinds
+                    # them, so a migration export/import on an rpc
+                    # thread (serving.disagg) must never interleave —
+                    # it would read invalidated buffers or scatter into
+                    # tensors the adopt is about to replace
+                    with self.pool.device_lock:
+                        hit, plan = self._plan_hit(prompt, L, salt=a_salt)
+                        # the abort guard starts the statement AFTER the
+                        # pins land: a raise anywhere before the commit —
+                        # bucket planning as much as the dispatch itself —
+                        # must release them (tpu_lint R9)
+                        try:
+                            hit_tokens = hit.tokens
+                            suffix = L - hit_tokens
+                            bucket = self.bucket_for_prompt(suffix)
+                            ids_p = np.zeros((1, bucket), np.int32)
+                            ids_p[0, :suffix] = prompt[hit_tokens:]
+                            tok, done0, self.live_cache, tensors = (
+                                self._prefill_compiled(
+                                    self._params, self._buffers,
+                                    self.live_cache, self.pool.tensors,
+                                    *lora_args, ids_p, np.int32(slot),
+                                    np.int32(suffix - 1),
+                                    np.int32(hit_tokens),
+                                    hit.read_idx, plan.write_idx, key, eos,
+                                    temp, top_p, greedy))
+                        except Exception:
+                            # dispatch never completed: unpin + free the
+                            # plan's rows (a post-dispatch device fault
+                            # instead goes through reset(), which
+                            # rebuilds the pool tensors)
+                            self.pool.abort(hit, plan)
+                            raise
+                        self.pool.commit(hit, plan, tensors)
         except Exception:
             if self.store is not None:
                 # the request never reached a slot: its page pin is void

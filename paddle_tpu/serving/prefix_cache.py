@@ -43,10 +43,44 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["BlockPool", "PrefixHit", "StorePlan", "chain_digests"]
+__all__ = ["BlockPool", "PrefixHit", "StorePlan", "chain_digests",
+           "KV_WIRE_VERSION", "DEFAULT_MIGRATE_CHUNK_BYTES",
+           "last_migrate_stats"]
 
 
 _EMPTY = b"paddle_tpu.prefix_cache.root"
+
+#: Version tag on every exported KV-block payload. Bump on ANY change to
+#: the payload layout — an importer rejects versions it does not speak,
+#: so a mixed-version fleet degrades to recompute, never to corrupt K/V.
+KV_WIRE_VERSION = 1
+
+#: Per-chunk ceiling for device->host (and host->device) staging during
+#: block export/import — the same bounded-residency discipline as
+#: checkpoint resharding's shard cache (``distributed.checkpoint``):
+#: the full payload is bounded by one prompt's block span, and the
+#: transfer working set on top of it is bounded by this.
+DEFAULT_MIGRATE_CHUNK_BYTES = 8 << 20
+
+# migration accounting, mirroring checkpoint's _LOAD_STATS: cumulative
+# process-wide, read via last_migrate_stats() (tests + serve_bench)
+_MIGRATE_STATS = {
+    "exports": 0, "imports": 0,
+    "bytes_out": 0, "bytes_in": 0,
+    "blocks_out": 0, "blocks_in": 0,
+    "blocks_skipped": 0,       # import found the digest already resident
+    "chunks": 0,
+    "peak_chunk_bytes": 0,     # largest single staging transfer
+}
+
+
+def last_migrate_stats() -> dict:
+    return dict(_MIGRATE_STATS)
+
+
+def _reset_migrate_stats() -> None:
+    for k in _MIGRATE_STATS:
+        _MIGRATE_STATS[k] = 0
 
 
 def chain_digests(tokens, block_tokens: int,
@@ -153,6 +187,15 @@ class BlockPool:
         self.num_blocks = 1 + min(budget_blocks, int(max_blocks))
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
+        # serializes TENSOR access (gather/scatter/donation/adopt)
+        # against concurrent rpc-thread export/import: the engine's
+        # fused admit DONATES the pool tensors to XLA, so a reader
+        # racing the dispatch would touch invalidated buffers — and a
+        # migration scatter racing the adopt would be silently lost
+        # when the engine rebinds the program's output. RLock: the
+        # engine holds it across dispatch+commit, which call back into
+        # pool methods. Lock order: device_lock, then _lock.
+        self.device_lock = threading.RLock()
         self._tick = 0
         # cumulative counters survive reset() — the operator's totals
         self.lookups = 0
@@ -391,6 +434,241 @@ class BlockPool:
             if plan is not None:
                 for e in plan.pending:
                     self._free.append(e.index)
+
+    # -------------------------------------------------------- migration
+    def digests(self) -> List[str]:
+        """Hex digests of every COMMITTED block — the payload a replica
+        publishes to the fleet-wide prefix index. Pending (un-committed)
+        entries are invisible here exactly as they are to lookups."""
+        with self._lock:
+            return [e.digest.hex() for e in self._entries.values()]
+
+    def _chunk_rows(self, max_chunk_bytes: Optional[int]) -> int:
+        """Fixed rows-per-staging-chunk for ``max_chunk_bytes``: every
+        gather/scatter during migration moves exactly this many pool
+        rows (short chunks pad with dump row 0), so the eager transfer
+        ops stay shape-stable — one compiled gather + one scatter per
+        (pool geometry, chunk size), never per prompt length."""
+        budget = int(max_chunk_bytes or DEFAULT_MIGRATE_CHUNK_BYTES)
+        return max(1, min(self.blocks_per_prompt,
+                          budget // max(self.block_bytes, 1)))
+
+    def export_payload(self, tokens, salt: bytes = b"",
+                       max_chunk_bytes: Optional[int] = None):
+        """Serialize this pool's matched blocks for ``tokens`` into a
+        versioned, host-resident payload another pool can
+        :meth:`inject_payload`. Returns ``None`` when nothing matches.
+
+        The matched entries are PINNED (via :meth:`lookup`) for the
+        whole device read and released in a ``finally`` — a failed
+        export can never leak refs (tpu_lint R9). Device->host staging
+        is chunked under ``max_chunk_bytes`` with fixed-shape padded
+        gathers (see :meth:`_chunk_rows`); the payload itself is
+        bounded by one prompt's block span. The payload carries the
+        covered TOKEN IDS, not digests: the importer re-derives the
+        chain itself, so a corrupt or mismatched payload can only
+        miss, never alias someone else's prefix."""
+        import jax
+        import jax.numpy as jnp
+
+        toks = np.asarray(tokens, np.int32).ravel()
+        hit = self.lookup(toks, salt)
+        try:
+            n = len(hit.entries)
+            if n == 0:
+                return None
+            rows = hit.read_idx[:n].astype(np.int32)
+            chunk_rows = self._chunk_rows(max_chunk_bytes)
+            # [layer][kv] -> list of host chunks, concatenated at the end
+            n_layers = self.spec["num_layers"]
+            parts = [[[], []] for _ in range(n_layers)]
+            chunks = 0
+            with self.device_lock:
+                tensors = self.tensors
+                for s in range(0, n, chunk_rows):
+                    idx = np.zeros(chunk_rows, np.int32)   # pad = dump row
+                    take = rows[s:s + chunk_rows]
+                    idx[:take.shape[0]] = take
+                    idx_arr = jnp.asarray(idx)
+                    chunks += 1
+                    chunk_bytes = 0
+                    for li, (k, v) in enumerate(tensors):
+                        for kvi, t in enumerate((k, v)):
+                            if isinstance(t, tuple):       # int8 (vals, scales)
+                                got = tuple(
+                                    # tpu-lint: disable=R1(migration export IS the wire transfer — the chunked readback bounds peak host memory), R7(device_lock is the donation fence: admit donates these buffers mid-step; device reads must serialize behind it)
+                                    np.asarray(jax.device_get(x[idx_arr]))
+                                    [:take.shape[0]] for x in t)
+                                chunk_bytes += sum(g.nbytes for g in got)
+                            else:
+                                # tpu-lint: disable=R1(migration export IS the wire transfer — the chunked readback bounds peak host memory), R7(device_lock is the donation fence: admit donates these buffers mid-step; device reads must serialize behind it)
+                                got = np.asarray(jax.device_get(
+                                    t[idx_arr]))[:take.shape[0]]
+                                chunk_bytes += got.nbytes
+                            parts[li][kvi].append(got)
+                    _MIGRATE_STATS["peak_chunk_bytes"] = max(
+                        _MIGRATE_STATS["peak_chunk_bytes"], chunk_bytes)
+
+            def cat(chunk_list):
+                if isinstance(chunk_list[0], tuple):
+                    return tuple(np.concatenate([c[i] for c in chunk_list])
+                                 for i in range(len(chunk_list[0])))
+                return np.concatenate(chunk_list)
+
+            leaves = [(cat(parts[li][0]), cat(parts[li][1]))
+                      for li in range(n_layers)]
+
+            def nbytes(leaf):
+                return (sum(x.nbytes for x in leaf)
+                        if isinstance(leaf, tuple) else leaf.nbytes)
+
+            payload_bytes = sum(nbytes(x) for kv in leaves for x in kv)
+            _MIGRATE_STATS["exports"] += 1
+            _MIGRATE_STATS["bytes_out"] += payload_bytes
+            _MIGRATE_STATS["blocks_out"] += n
+            _MIGRATE_STATS["chunks"] += chunks
+            return {
+                "version": KV_WIRE_VERSION,
+                "block_tokens": self.block_tokens,
+                "kv_dtype": self.kv_dtype or "full",
+                "num_layers": n_layers,
+                "num_kv_heads": self.spec["num_kv_heads"],
+                "head_dim": self.spec["head_dim"],
+                "salt": salt.hex() if salt else "",
+                "tokens": toks[:n * self.block_tokens],
+                "n_blocks": n,
+                "payload_bytes": payload_bytes,
+                "leaves": leaves,
+            }
+        finally:
+            self.abort(hit)
+
+    def inject_payload(self, payload: dict,
+                       max_chunk_bytes: Optional[int] = None) -> int:
+        """Scatter a peer's :meth:`export_payload` into THIS pool and
+        publish the blocks; returns matchable tokens added (0 when every
+        block was already resident — import is idempotent by digest, so
+        a retried or duplicate migration is a no-op, never a double
+        store). Raises ``ValueError`` on a wire-version or geometry
+        mismatch; on any failure past row allocation the pending rows
+        are returned to the free list before re-raising."""
+        import jax.numpy as jnp
+
+        if not isinstance(payload, dict) or \
+                payload.get("version") != KV_WIRE_VERSION:
+            raise ValueError(
+                f"KV payload version {payload.get('version')!r} != "
+                f"{KV_WIRE_VERSION}; refusing cross-version import")
+        for k, want in (("block_tokens", self.block_tokens),
+                        ("kv_dtype", self.kv_dtype or "full"),
+                        ("num_layers", self.spec["num_layers"]),
+                        ("num_kv_heads", self.spec["num_kv_heads"]),
+                        ("head_dim", self.spec["head_dim"])):
+            if payload.get(k) != want:
+                raise ValueError(
+                    f"KV payload {k}={payload.get(k)!r} does not match "
+                    f"this pool's {k}={want!r}")
+        salt = bytes.fromhex(payload.get("salt") or "")
+        toks = np.asarray(payload["tokens"], np.int32).ravel()
+        n = int(payload["n_blocks"])
+        if toks.shape[0] != n * self.block_tokens:
+            raise ValueError(
+                f"KV payload covers {toks.shape[0]} tokens but declares "
+                f"{n} blocks of {self.block_tokens}")
+        n = min(n, self.blocks_per_prompt)
+        # re-derive identity from the payload's own tokens: the chain
+        # commits each block to its full left context + salt, so a
+        # payload can only ever install blocks its tokens actually name
+        digests = _chain_digests(toks, self.block_tokens, n, salt)
+        pending: List[_Entry] = []
+        write_rows: List[Tuple[int, int]] = []   # (payload block, pool row)
+        with self._lock:
+            self._tick += 1
+            for i in range(n):
+                d = digests[i]
+                existing = self._entries.get(d)
+                if existing is not None:
+                    existing.last_use = self._tick
+                    _MIGRATE_STATS["blocks_skipped"] += 1
+                    continue
+                row = self._free.pop() if self._free \
+                    else self._evict_one_locked()
+                if row is None:
+                    break      # saturated: the chain prefix still lands
+                parent = digests[i - 1] if i > 0 else None
+                e = _Entry(digest=d, index=row, parent=parent,
+                           last_use=self._tick)
+                pending.append(e)
+                write_rows.append((i, row))
+        if not write_rows:
+            _MIGRATE_STATS["imports"] += 1
+            return 0
+        try:
+            chunk_rows = self._chunk_rows(max_chunk_bytes)
+            chunks = 0
+            _MIGRATE_STATS["peak_chunk_bytes"] = max(
+                _MIGRATE_STATS["peak_chunk_bytes"],
+                chunk_rows * self.block_bytes)
+            with self.device_lock:
+                tensors = list(self.tensors)
+                for s in range(0, len(write_rows), chunk_rows):
+                    batch = write_rows[s:s + chunk_rows]
+                    idx = np.zeros(chunk_rows, np.int32)   # pad = dump row
+                    idx[:len(batch)] = [r for _, r in batch]
+                    idx_arr = jnp.asarray(idx)
+                    chunks += 1
+
+                    def staged(src):
+                        # fixed [chunk_rows, ...] staging buffer; the
+                        # padded tail scatters into dump row 0, whose
+                        # content is never read
+                        out = np.zeros((chunk_rows,) + src.shape[1:],
+                                       src.dtype)
+                        for j, (bi, _) in enumerate(batch):
+                            out[j] = src[bi]
+                        return out
+
+                    for li in range(self.spec["num_layers"]):
+                        k, v = tensors[li]
+                        new_kv = []
+                        for t, leaf in zip((k, v), payload["leaves"][li]):
+                            if isinstance(t, tuple):
+                                new_kv.append(tuple(
+                                    # tpu-lint: disable=R7(device_lock is the donation fence: admit donates these buffers mid-step; the migration scatter must serialize behind it — the contended metadata lock `_lock` is NOT held here)
+                                    x.at[idx_arr].set(jnp.asarray(staged(l)))
+                                    for x, l in zip(t, leaf)))
+                            else:
+                                # tpu-lint: disable=R7(device_lock is the donation fence: admit donates these buffers mid-step; the migration scatter must serialize behind it — the contended metadata lock `_lock` is NOT held here)
+                                new_kv.append(t.at[idx_arr].set(
+                                    jnp.asarray(staged(leaf))))
+                        tensors[li] = tuple(new_kv)
+                self.tensors = tuple(tensors)
+        except BaseException:
+            with self._lock:
+                for e in pending:
+                    self._free.append(e.index)
+            raise
+        with self._lock:
+            for e in pending:
+                self._entries[e.digest] = e
+                self.blocks_stored += 1
+                if e.parent is not None:
+                    parent = self._entries.get(e.parent)
+                    if parent is not None:
+                        parent.children += 1
+        added = len(pending) * self.block_tokens
+
+        def nbytes(leaf):
+            return (sum(x.nbytes for x in leaf)
+                    if isinstance(leaf, tuple) else leaf.nbytes)
+
+        _MIGRATE_STATS["imports"] += 1
+        _MIGRATE_STATS["blocks_in"] += len(pending)
+        _MIGRATE_STATS["chunks"] += chunks
+        _MIGRATE_STATS["bytes_in"] += int(
+            payload.get("payload_bytes")
+            or sum(nbytes(x) for kv in payload["leaves"] for x in kv))
+        return added
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:

@@ -607,6 +607,57 @@ class RemoteReplica:
         out["rtt_s"] = self.rtt_ewma_s
         return out
 
+    # ------------------------------------------- disagg migration surface
+    def kv_prefill(self, prompt, *, timeout_s: Optional[float] = None,
+                   correlation_id: Optional[str] = None) -> dict:
+        """Run an admission-only prefill on the peer, leaving the
+        prompt's blocks committed in its pool (rpc
+        ``disagg._host_kv_prefill``). Idempotent at the pool level
+        (content-addressed chain), so transport blips retry; the call
+        is bounded by ``timeout_s`` on BOTH sides of the wire."""
+        from . import disagg
+
+        budget = float(timeout_s if timeout_s is not None
+                       else self.rpc_timeout)
+        return self._call(
+            disagg._host_kv_prefill, self.hosted_name,
+            np.asarray(prompt, np.int32).ravel(),
+            {"timeout_s": budget, "correlation_id": correlation_id},
+            what="remote kv prefill",
+            rpc_timeout=budget + 2.0, deadline=Deadline(budget + 2.0))
+
+    def kv_export(self, prompt, *, corr: Optional[str] = None,
+                  max_chunk_bytes: Optional[int] = None):
+        """Pull the peer's matched KV blocks for ``prompt`` as a
+        versioned payload (``None`` on a pool miss)."""
+        from . import disagg
+
+        return self._call(
+            disagg._host_kv_export, self.hosted_name,
+            np.asarray(prompt, np.int32).ravel(), corr, max_chunk_bytes,
+            what="remote kv export", deadline=Deadline(self.rpc_timeout))
+
+    def kv_import(self, payload: dict, *,
+                  corr: Optional[str] = None) -> int:
+        """Push an exported payload into the peer's pool; returns
+        matchable tokens added there. Idempotent by digest — a
+        duplicate delivery after a lost response is a no-op."""
+        from . import disagg
+
+        return self._call(
+            disagg._host_kv_import, self.hosted_name, payload, corr,
+            what="remote kv import", deadline=Deadline(self.rpc_timeout))
+
+    def prefix_digests(self) -> dict:
+        """The peer pool's committed digest listing (hex) for the
+        fleet :class:`~paddle_tpu.serving.disagg.PrefixIndex`."""
+        from . import disagg
+
+        return self._call(
+            disagg._host_prefix_digests, self.hosted_name,
+            what="remote prefix digests",
+            deadline=Deadline(self.rpc_timeout))
+
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         self._call(_host_shutdown, self.hosted_name, drain, timeout,

@@ -496,9 +496,18 @@ class ReplicaRouter:
                  fleet_scrape_interval: Optional[float] = None,
                  fleet_stale_after_s: Optional[float] = None,
                  slo_policy=None,
+                 prefix_index=None, remote_hit_weight: float = 0.5,
                  max_skew_correction_s: float =
                  _fleet.DEFAULT_MAX_SKEW_CORRECTION_S):
         self.affinity_weight = float(affinity_weight)
+        # --- fleet prefix tier (None = off: scoring bit-identical) ---
+        # a prefix resident ANYWHERE in the fleet is reachable from any
+        # replica via KV-block migration (serving.disagg); the remote
+        # term is the local affinity discounted by remote_hit_weight —
+        # the migration-cost : recompute-cost price ratio
+        self.prefix_index = prefix_index
+        self.remote_hit_weight = float(remote_hit_weight)
+        self.prefix_remote_hits = 0
         # a tenant placed where its adapter pages are already resident
         # skips a host->device page load (and an LRU eviction somewhere
         # else); like prefix affinity, load eventually outweighs warmth
@@ -744,6 +753,27 @@ class ReplicaRouter:
                 clock_offset_s=0.0)
         if self._slo is not None:
             self._slo.ingest({"replicas": slo_replicas})
+        if self.prefix_index is not None:
+            # same round, same bounded-rpc discipline: refresh the
+            # fleet prefix tier from each replica's committed digests.
+            # A failed fetch REMOVES the replica's entry — absent only
+            # forfeits a warm-source preference, stale would misroute
+            for name, server, state in reps:
+                if state == DEAD:
+                    self.prefix_index.remove(name)
+                    continue
+                try:
+                    fetch = getattr(server, "prefix_digests", None)
+                    if fetch is not None:
+                        self.prefix_index.publish(
+                            name, fetch()["digests"])
+                    else:
+                        pool = server.engine.pool
+                        if pool is not None:
+                            self.prefix_index.publish(name,
+                                                      pool.digests())
+                except Exception:
+                    self.prefix_index.remove(name)
         return self.fleet.statusz()
 
     def fleet_metrics_text(self) -> str:
@@ -772,6 +802,11 @@ class ReplicaRouter:
             "scrape": self.fleet.statusz(),
             **({"slo": self._slo.report()}
                if self._slo is not None else {}),
+            **({"prefix_index": {
+                    **self.prefix_index.statusz(),
+                    "remote_hit_weight": self.remote_hit_weight,
+                    "score_remote_hits": self.prefix_remote_hits}}
+               if self.prefix_index is not None else {}),
         }
 
     def detector_statusz(self) -> dict:
@@ -1030,6 +1065,21 @@ class ReplicaRouter:
                     prompt, bs, salt)
             affinity = (self.affinity_weight * pool.match_digests(digests)
                         / float(prompt.shape[0]))
+            if self.prefix_index is not None and adapter_id is None:
+                # fleet tier: blocks resident on ANOTHER replica are
+                # reachable here via migration, priced below a local
+                # hit by remote_hit_weight (ship bytes vs recompute).
+                # max, not sum — the migration only helps for chain
+                # blocks the local pool would otherwise recompute
+                blocks, _src = self.prefix_index.match(
+                    digests, exclude=rep.name)
+                remote = (self.remote_hit_weight * self.affinity_weight
+                          * blocks * bs / float(prompt.shape[0]))
+                if remote > affinity:
+                    affinity = remote
+                    self.prefix_remote_hits += 1
+                    _obs_registry.default_registry().inc(
+                        "fleet.prefix_remote_hits", source="router")
         if adapter_id is not None and store is not None \
                 and store.resident(adapter_id):
             affinity += self.adapter_affinity_weight

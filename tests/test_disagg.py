@@ -1,0 +1,550 @@
+"""Disaggregated prefill/decode serving (PR 19), device-free.
+
+The acceptance contract on top of the PR 8 block pool + PR 13 fabric:
+
+1. **The wire is exact and self-identifying** — an exported payload
+   carries versioned geometry + the covered TOKEN IDS; the importer
+   re-derives the digest chain itself, so a corrupt or cross-version
+   payload can only miss (ValueError / shorter match), never alias
+   another prompt's K/V;
+2. **Import is idempotent by digest** — a duplicated or raced migration
+   is a no-op, and a failed import returns its rows to the free list;
+3. **Export never leaks pins** (tpu_lint R9 for the migration path) and
+   chunks the device->host staging under a byte ceiling;
+4. **The coordinator degrades, never loses** — any failed migration leg
+   falls back to decode-local recompute and counts itself;
+5. **The per-pool control surfaces exist** — fleet prefix index with
+   consecutive-chain matching, per-signal (TTFT/ITL) SLO burn tracks,
+   an autoscaler that scales one pool on one signal, and router scoring
+   that prices fleet-remote prefixes below local ones.
+
+Everything here runs on tiny `_SpecModel` pools and stub replicas (no
+model build, no rpc) — the real two-process fleet is `fleet_chaos.py
+--disagg` / `serve_bench.py --disagg`, wired as `robustness_gate.py
+--disagg`. The one real-engine test (warmup compile budget) is slow.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.observability.slo import (FLEET_TENANT, SloPolicy,
+                                          SloTracker)
+from paddle_tpu.serving import (Autoscaler, BlockPool, DisaggClient,
+                                PrefixIndex, ReplicaRouter,
+                                warm_boot_env)
+from paddle_tpu.serving.prefix_cache import (KV_WIRE_VERSION,
+                                             _reset_migrate_stats,
+                                             chain_digests,
+                                             last_migrate_stats)
+
+BS = 4
+
+
+class _SpecModel:
+    def cache_spec(self):
+        return {"num_layers": 2, "num_kv_heads": 2, "head_dim": 4,
+                "max_length": 64, "dtype": "float32"}
+
+
+def _pool(**kw):
+    kw.setdefault("block_tokens", BS)
+    kw.setdefault("max_bytes", 1 << 20)
+    return BlockPool(_SpecModel(), **kw)
+
+
+def _commit_tokens(pool, toks):
+    """Host-side store of a prompt's full blocks (the engine does this
+    around its fused dispatch)."""
+    hit = pool.lookup(toks)
+    plan = pool.plan_store(toks, hit.tokens)
+    pool.commit(hit, plan, pool.tensors)
+
+
+def _paint(pool, value):
+    """Overwrite every pool leaf with ``value`` so a roundtrip can
+    assert actual K/V content moved, not just metadata."""
+    import jax.numpy as jnp
+
+    def fill(t):
+        if isinstance(t, tuple):
+            return tuple(jnp.full(x.shape, value, x.dtype) for x in t)
+        return jnp.full(t.shape, value, t.dtype)
+
+    pool.tensors = tuple((fill(k), fill(v)) for k, v in pool.tensors)
+
+
+def _no_pins(pool):
+    return all(e.refs == 0 for e in pool._entries.values())
+
+
+# ------------------------------------------------------------- wire format
+def test_export_import_roundtrip_moves_kv_content():
+    src, dst = _pool(), _pool()
+    toks = np.arange(2 * BS + 3, dtype=np.int32)     # 2 full blocks
+    _commit_tokens(src, toks)
+    _paint(src, 7.0)
+    payload = src.export_payload(toks)
+    assert payload["version"] == KV_WIRE_VERSION
+    assert payload["n_blocks"] == 2
+    assert payload["payload_bytes"] > 0
+    np.testing.assert_array_equal(payload["tokens"], toks[:2 * BS])
+    assert dst.match(toks) == 0
+    added = dst.inject_payload(payload)
+    assert added == 2 * BS
+    assert dst.match(toks) == 2 * BS
+    # the K/V content landed, block-aligned, on the importer's own rows
+    hit = dst.lookup(toks)
+    try:
+        rows = hit.read_idx[:2]
+        for k, v in dst.tensors:
+            got = np.asarray(k)[rows]
+            np.testing.assert_array_equal(got, np.full_like(got, 7.0))
+    finally:
+        dst.abort(hit)
+    assert _no_pins(src) and _no_pins(dst)
+
+
+def test_export_import_roundtrip_int8_value_scale_pairs():
+    src, dst = _pool(kv_dtype="int8"), _pool(kv_dtype="int8")
+    toks = np.arange(3 * BS + 1, dtype=np.int32)
+    _commit_tokens(src, toks)
+    payload = src.export_payload(toks)
+    assert payload["kv_dtype"] == "int8"
+    for k, v in payload["leaves"]:
+        for leaf in (k, v):
+            vals, scales = leaf                  # (int8 values, f32 scales)
+            assert vals.dtype == np.int8
+            assert scales.dtype == np.float32
+    assert dst.inject_payload(payload) == 3 * BS
+    assert dst.match(toks) == 3 * BS
+
+
+def test_inject_rejects_cross_version_and_geometry():
+    src = _pool()
+    toks = np.arange(BS + 1, dtype=np.int32)
+    _commit_tokens(src, toks)
+    payload = src.export_payload(toks)
+    bad = dict(payload, version=KV_WIRE_VERSION + 1)
+    with pytest.raises(ValueError, match="version"):
+        _pool().inject_payload(bad)
+    with pytest.raises(ValueError, match="block_tokens"):
+        _pool(block_tokens=8).inject_payload(payload)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _pool(kv_dtype="int8").inject_payload(payload)
+    # a mixed-version fleet degrades to recompute, never corrupt K/V
+    assert _pool().inject_payload(payload) == BS
+
+
+def test_import_is_idempotent_by_digest():
+    src, dst = _pool(), _pool()
+    toks = np.arange(2 * BS + 1, dtype=np.int32)
+    _commit_tokens(src, toks)
+    payload = src.export_payload(toks)
+    assert dst.inject_payload(payload) == 2 * BS
+    before = last_migrate_stats()
+    assert dst.inject_payload(payload) == 0      # duplicate: no-op
+    after = last_migrate_stats()
+    assert after["blocks_skipped"] - before["blocks_skipped"] == 2
+    assert dst.stats()["blocks_in_use"] == 2     # never double-stored
+
+
+def test_tampered_tokens_cannot_alias_the_original_prompt():
+    """The payload's identity IS its tokens: corrupting them re-derives
+    a different chain on import, so the original prompt still misses —
+    the failure mode is a wasted migration, never wrong K/V."""
+    src, dst = _pool(), _pool()
+    toks = np.arange(2 * BS + 1, dtype=np.int32)
+    _commit_tokens(src, toks)
+    payload = src.export_payload(toks)
+    forged = dict(payload, tokens=payload["tokens"].copy())
+    forged["tokens"][0] = 999
+    dst.inject_payload(forged)
+    assert dst.match(toks) == 0
+
+
+def test_export_miss_returns_none_and_releases_pins():
+    pool = _pool()
+    toks = np.arange(2 * BS, dtype=np.int32)
+    assert pool.export_payload(toks) is None     # nothing committed
+    _commit_tokens(pool, np.arange(BS + 1, dtype=np.int32))
+    pool.export_payload(np.arange(BS + 1, dtype=np.int32))
+    assert _no_pins(pool)                        # R9: finally released
+
+
+def test_export_chunks_bound_host_staging():
+    pool = _pool()
+    n_blocks = 4
+    toks = np.arange(n_blocks * BS + 1, dtype=np.int32)
+    _commit_tokens(pool, toks)
+    _reset_migrate_stats()     # peak_chunk_bytes is a process-wide max
+    payload = pool.export_payload(toks, max_chunk_bytes=pool.block_bytes)
+    after = last_migrate_stats()
+    assert payload["n_blocks"] == n_blocks
+    # one row per chunk: the staging working set never exceeds a block
+    assert after["chunks"] == n_blocks
+    assert after["peak_chunk_bytes"] <= 2 * pool.block_bytes
+
+
+def test_saturated_importer_lands_the_chain_prefix():
+    src = _pool()
+    dst = _pool(max_bytes=2 * _pool().block_bytes)   # tiny destination
+    toks = np.arange(6 * BS + 1, dtype=np.int32)
+    _commit_tokens(src, toks)
+    payload = src.export_payload(toks)
+    added = dst.inject_payload(payload)
+    assert 0 < added < 6 * BS
+    assert added % BS == 0
+    assert dst.match(toks) == added              # a CONSECUTIVE prefix
+
+
+# ------------------------------------------------------------ prefix index
+def test_prefix_index_consecutive_chain_match():
+    idx = PrefixIndex()
+    toks = np.arange(4 * BS + 1, dtype=np.int32)
+    digests = chain_digests(toks, BS)
+    idx.publish("pre0", [d.hex() for d in digests[:3]])
+    # holds blocks 0..2 plus an unrelated block — chain stops at 3
+    idx.publish("pre1", [digests[0].hex(), digests[2].hex()])
+    blocks, who = idx.match(digests)
+    assert (blocks, who) == (3, "pre0")
+    blocks, who = idx.match(digests, exclude="pre0")
+    assert (blocks, who) == (1, "pre1")          # gap at block 1
+    idx.remove("pre0")
+    assert idx.replicas() == ["pre1"]
+    assert idx.match(digests)[1] == "pre1"
+    st = idx.statusz()
+    assert st["replicas"]["pre1"]["blocks"] == 2
+    assert st["distinct_blocks"] == 2
+
+
+def test_prefix_index_fleet_miss_is_zero_none():
+    idx = PrefixIndex()
+    assert idx.match(chain_digests(np.arange(9), BS)) == (0, None)
+    assert idx.statusz() == {"replicas": {}, "distinct_blocks": 0}
+
+
+# ------------------------------------------------------------- coordinator
+class _StubReplica:
+    """The RemoteReplica duck type: submit + the migration surface."""
+
+    def __init__(self, name, payload=None, fail=None,
+                 digests=(), import_tokens=2 * BS):
+        self.name = name
+        self.payload = payload
+        self.fail = fail                 # exception raised by any kv leg
+        self._digests = list(digests)
+        self.import_tokens = import_tokens
+        self.calls = []
+
+    def submit(self, **kw):
+        self.calls.append(("submit", kw))
+        return "handle"
+
+    def kv_prefill(self, prompt, timeout_s=None, correlation_id=None):
+        self.calls.append(("kv_prefill", len(prompt)))
+        if self.fail is not None:
+            raise self.fail
+
+    def kv_export(self, prompt, corr=None, max_chunk_bytes=None):
+        self.calls.append(("kv_export", len(prompt)))
+        if self.fail is not None:
+            raise self.fail
+        return self.payload
+
+    def kv_import(self, payload, corr=None):
+        self.calls.append(("kv_import", payload["payload_bytes"]))
+        if self.fail is not None:
+            raise self.fail
+        return self.import_tokens
+
+    def prefix_digests(self):
+        if self.fail is not None:
+            raise self.fail
+        return {"block_tokens": BS, "digests": list(self._digests),
+                "time": 0.0}
+
+    def called(self, kind):
+        return [c for c in self.calls if c[0] == kind]
+
+
+def _payload(n_blocks=2):
+    return {"payload_bytes": 4096 * n_blocks, "n_blocks": n_blocks}
+
+
+def test_disagg_client_migrates_then_submits_to_decode():
+    pre = _StubReplica("pre0", payload=_payload())
+    dec = _StubReplica("dec0")
+    c = DisaggClient([pre], [dec], block_tokens=BS)
+    h = c.submit(np.arange(3 * BS, dtype=np.int32), max_new_tokens=4)
+    assert h == "handle"
+    assert pre.called("kv_prefill") and pre.called("kv_export")
+    assert dec.called("kv_import") and dec.called("submit")
+    st = c.statusz()
+    assert st["migrations"] == 1 and st["fallbacks"] == 0
+    assert st["migrated_bytes"] == 8192
+    assert st["migrated_tokens"] == 2 * BS
+    assert st["migrate_s"] >= 0
+    # the decode submit carries a correlation id (the cross-host lane)
+    assert dec.called("submit")[0][1]["correlation_id"]
+
+
+def test_disagg_client_falls_back_on_any_failed_leg():
+    pre = _StubReplica("pre0", fail=ConnectionError("replica gone"))
+    dec = _StubReplica("dec0")
+    c = DisaggClient([pre], [dec], block_tokens=BS)
+    assert c.submit(np.arange(3 * BS, dtype=np.int32),
+                    max_new_tokens=4) == "handle"
+    assert dec.called("submit")          # the request is never lost
+    assert not dec.called("kv_import")   # the migration leg was dropped
+    assert c.statusz() == {**c.statusz(), "fallbacks": 1, "migrations": 0}
+
+
+def test_disagg_client_skips_migration_below_min_tokens():
+    pre = _StubReplica("pre0", payload=_payload())
+    dec = _StubReplica("dec0")
+    c = DisaggClient([pre], [dec], block_tokens=BS)
+    assert c.min_migrate_tokens == BS + 1    # < one full block: recompute
+    c.submit(np.arange(BS, dtype=np.int32), max_new_tokens=4)
+    assert not pre.calls and dec.called("submit")
+    assert c.statusz()["migrations"] == 0 == c.statusz()["fallbacks"]
+
+
+def test_disagg_client_skips_adapter_salted_requests():
+    """Per-tenant chains are salted with a replica-private adapter salt
+    — they cannot be addressed fleet-wide, so migration must not try."""
+    pre = _StubReplica("pre0", payload=_payload())
+    dec = _StubReplica("dec0")
+    c = DisaggClient([pre], [dec], block_tokens=BS)
+    c.submit(np.arange(3 * BS, dtype=np.int32), max_new_tokens=4,
+             adapter_id="tenant-a")
+    assert not pre.calls
+    assert dec.called("submit")[0][1]["adapter_id"] == "tenant-a"
+
+
+def test_disagg_client_prefers_warm_indexed_source():
+    toks = np.arange(3 * BS, dtype=np.int32)
+    digests = chain_digests(toks, BS)
+    warm = _StubReplica("warm", payload=_payload())
+    cold = _StubReplica("cold", payload=_payload())
+    idx = PrefixIndex()
+    idx.publish("warm", [d.hex() for d in digests])
+    c = DisaggClient([cold, warm], [_StubReplica("dec0")],
+                     block_tokens=BS, index=idx)
+    c.submit(toks, max_new_tokens=4)
+    assert warm.called("kv_export") and not warm.called("kv_prefill")
+    assert not cold.calls                    # round-robin was bypassed
+    assert c.statusz()["remote_hits"] == 1
+
+
+def test_disagg_client_stale_index_reprefills_then_exports():
+    """The index is a scraped VIEW: when it names a source whose blocks
+    were since evicted (export -> None), the client runs the prefill
+    after all instead of failing the migration."""
+    toks = np.arange(3 * BS, dtype=np.int32)
+    warm = _StubReplica("warm", payload=None)    # stale: nothing matches
+
+    def prefill(prompt, timeout_s=None, correlation_id=None):
+        warm.calls.append(("kv_prefill", len(prompt)))
+        warm.payload = _payload()                # now it really holds it
+
+    warm.kv_prefill = prefill
+    idx = PrefixIndex()
+    idx.publish("warm", [d.hex() for d in chain_digests(toks, BS)])
+    dec = _StubReplica("dec0")
+    c = DisaggClient([warm], [dec], block_tokens=BS, index=idx)
+    c.submit(toks, max_new_tokens=4)
+    assert warm.called("kv_prefill") and len(warm.called("kv_export")) == 2
+    assert dec.called("kv_import")
+    assert c.statusz()["migrations"] == 1
+
+
+def test_scrape_index_publishes_and_drops_unreachable():
+    toks = np.arange(2 * BS + 1, dtype=np.int32)
+    digests = [d.hex() for d in chain_digests(toks, BS)]
+    up = _StubReplica("up", digests=digests)
+    down = _StubReplica("down", digests=digests)
+    idx = PrefixIndex()
+    c = DisaggClient([up, down], [_StubReplica("dec0")],
+                     block_tokens=BS, index=idx)
+    assert c.scrape_index() == 2
+    assert idx.replicas() == ["down", "up"]
+    down.fail = ConnectionError("partitioned")
+    assert c.scrape_index() == 1
+    assert idx.replicas() == ["up"]          # absent beats stale
+
+
+def test_disagg_client_needs_both_pools():
+    with pytest.raises(ValueError, match="prefill"):
+        DisaggClient([], [_StubReplica("d")])
+    with pytest.raises(ValueError, match="decode"):
+        DisaggClient([_StubReplica("p")], [])
+
+
+# ----------------------------------------------------- router remote hits
+def test_router_scores_fleet_remote_prefix_below_local():
+    """A prefix resident on another host is reachable via migration:
+    the router's score must count it (discounted), so shared-prefix
+    traffic is not scattered as if the fleet were cold."""
+    from test_fleet_serving import _StubServer
+
+    toks = np.arange(3 * BS, dtype=np.int32)
+    idx = PrefixIndex()
+    idx.publish("pre0", [d.hex()
+                         for d in chain_digests(toks, _StubServer()
+                                                .engine.pool.block_tokens)])
+    router = ReplicaRouter(prefix_index=idx, remote_hit_weight=0.5)
+    router.add_replica(_StubServer(), "a")
+    router.submit(toks, max_new_tokens=2)
+    assert router.prefix_remote_hits >= 1
+    block = router.fleet_statusz()["prefix_index"]
+    assert block["remote_hit_weight"] == 0.5
+    assert block["score_remote_hits"] >= 1
+    assert "pre0" in block["replicas"]
+
+
+def test_router_without_index_has_no_prefix_index_block():
+    from test_fleet_serving import _StubServer
+
+    router = ReplicaRouter()
+    router.add_replica(_StubServer(), "a")
+    router.submit(np.arange(8, dtype=np.int32), max_new_tokens=2)
+    assert "prefix_index" not in router.fleet_statusz()
+    assert router.prefix_remote_hits == 0
+
+
+# ------------------------------------------------------- per-signal burns
+def _snap(total, ttft_ms=1.0, itl_ms=1.0, ttft_n=None, itl_n=None):
+    return {"requests_submitted": total, "requests_failed": 0,
+            "requests_expired": 0, "requests_shed": 0,
+            "ttft": {"count": ttft_n if ttft_n is not None else total,
+                     "mean_ms": ttft_ms},
+            "inter_token": {"count": itl_n if itl_n is not None
+                            else 10 * total, "mean_ms": itl_ms}}
+
+
+def test_slo_itl_burn_is_a_separate_signal():
+    """An ITL breach books burn on the ITL track ONLY — the combined
+    verdict (and with it every PR 16 behavior) is unchanged."""
+    clock = [0.0]
+    tr = SloTracker(SloPolicy(target_ttft_s=0.5, target_itl_s=0.02),
+                    registry=False, dump_on_burn=False,
+                    clock=lambda: clock[0])
+    tr.ingest(_snap(0))
+    clock[0] = 10.0
+    rep = tr.ingest(_snap(20, ttft_ms=1.0, itl_ms=50.0))
+    ten = rep["tenants"][FLEET_TENANT]
+    assert ten["burn_slow_itl"] > 0
+    assert ten["burn_slow_ttft"] == 0.0
+    assert ten["burn_slow"] == 0.0           # combined: no failed requests
+    assert not ten["slow_breached"]
+
+
+def test_slo_ttft_burn_tracks_its_own_signal():
+    clock = [0.0]
+    tr = SloTracker(SloPolicy(target_ttft_s=0.05, target_itl_s=0.02),
+                    registry=False, dump_on_burn=False,
+                    clock=lambda: clock[0])
+    tr.ingest(_snap(0))
+    clock[0] = 10.0
+    rep = tr.ingest(_snap(20, ttft_ms=500.0, itl_ms=1.0))
+    ten = rep["tenants"][FLEET_TENANT]
+    assert ten["burn_slow_ttft"] > 0
+    assert ten["burn_slow_itl"] == 0.0
+    assert ten["burn_slow"] > 0              # TTFT feeds the combined burn
+
+
+def test_slo_policy_rejects_bad_itl_target():
+    with pytest.raises(ValueError, match="target_itl_s"):
+        SloPolicy(target_itl_s=0.0)
+
+
+# -------------------------------------------------- per-pool autoscaling
+def _signal_report(**burns):
+    ten = {"burn_slow": 0.0, "burn_fast": 0.0,
+           "burn_slow_ttft": 0.0, "burn_fast_ttft": 0.0,
+           "burn_slow_itl": 0.0, "burn_fast_itl": 0.0,
+           "slow_breached": False, "fast_breached": False,
+           "alerting": False, "window_slow": {"total": 10},
+           "window_fast": {"total": 10}}
+    ten.update(burns)
+    return {"policy": {"slow_burn_threshold": 2.0},
+            "tenants": {"spike": ten}}
+
+
+def _auto_fleet(**kw):
+    from test_slo_control_loop import _StubServer
+
+    router = ReplicaRouter([_StubServer()])
+    clock = [0.0]
+    auto = Autoscaler(router, lambda name: _StubServer(),
+                      sustain_ticks=1, cooldown_s=0.0, max_replicas=3,
+                      clock=lambda: clock[0], **kw)
+    return router, auto
+
+
+def test_autoscaler_scales_decode_pool_on_itl_burn_only():
+    """The disagg split: a decode-pool autoscaler on burn_signal='itl'
+    fires on ITL burn that the combined verdict never saw — and a
+    TTFT-signal (prefill-pool) autoscaler ignores the same report."""
+    router, auto = _auto_fleet(burn_signal="itl")
+    router.slo_report = lambda: _signal_report(burn_slow_itl=5.0,
+                                               burn_fast_itl=6.0)
+    d = auto.tick()
+    assert d["action"] == "scale_out"
+    assert d["signal"] == "itl" and d["burn_slow"] == pytest.approx(5.0)
+
+    router2, auto2 = _auto_fleet(burn_signal="ttft")
+    router2.slo_report = lambda: _signal_report(burn_slow_itl=5.0,
+                                                burn_fast_itl=6.0)
+    assert auto2.tick() is None
+
+    router3, auto3 = _auto_fleet()           # combined signal: PR 16
+    router3.slo_report = lambda: _signal_report(burn_slow_itl=5.0,
+                                                burn_fast_itl=6.0)
+    assert auto3.tick() is None
+
+
+def test_autoscaler_rejects_unknown_burn_signal():
+    from test_slo_control_loop import _StubServer
+
+    router = ReplicaRouter([_StubServer()])
+    with pytest.raises(ValueError, match="burn_signal"):
+        Autoscaler(router, lambda name: _StubServer(),
+                   burn_signal="goodput")
+    assert "autoscaler" not in router.statusz()
+
+
+def test_autoscaler_statusz_names_its_signal():
+    router, auto = _auto_fleet(burn_signal="ttft")
+    router.slo_report = _signal_report
+    auto.tick()
+    assert router.statusz()["autoscaler"]["config"]["burn_signal"] \
+        == "ttft"
+
+
+# --------------------------------------------------------------- warm boot
+def test_warm_boot_env_points_the_persistent_cache(tmp_path):
+    env = warm_boot_env(tmp_path / "cc")
+    assert env == {"FLAGS_persistent_compile_cache": "1",
+                   "FLAGS_compile_cache_dir": str(tmp_path / "cc")}
+
+
+@pytest.mark.slow
+def test_prefill_warmup_traces_no_decode_program():
+    """A prefill replica serves nothing but max_new_tokens=1 requests:
+    warmup(max_new_tokens=1) must compile the #buckets prefill programs
+    and NEVER trace decode — the disagg compile-budget contract."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingEngine
+
+    pt.seed(7)
+    cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ContinuousBatchingEngine(model, slots=2, max_length=64,
+                                   prefill_buckets=(32,))
+    eng.warmup(max_new_tokens=1)
+    cc = eng.cache_stats()
+    assert cc["prefill"]["compiles"] == 1
+    assert cc["decode"]["compiles"] == 0

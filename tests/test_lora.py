@@ -161,6 +161,7 @@ def test_set_adapter_rejects_mismatch(lm, tenants):
 
 
 # -------------------------------------------------------- training (fit)
+@pytest.mark.slow   # ~13s fit() train-step compile (tier-1 report)
 def test_fit_trains_only_adapter_pytree():
     from paddle_tpu import hapi
     from paddle_tpu.models.gpt import GPTForCausalLM

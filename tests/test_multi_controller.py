@@ -135,6 +135,7 @@ def _worker_env(rank, nproc, coord_port, kv_addr, ckpt_dir, out_path,
     return env
 
 
+@pytest.mark.slow   # ~13s two-subprocess mesh spin-up (tier-1 report)
 def test_two_process_mesh_loss_parity_with_single_process(tmp_path):
     from paddle_tpu.distributed.launch import KVServer
 

@@ -1533,6 +1533,116 @@ def test_r11_hand_rolled_retry_loop_around_submit(tmp_path):
     assert any("retried by the loop" in f.message for f in r11)
 
 
+# ============================================== migration rpc surface
+def test_r11_kv_migration_rpc_must_be_deadline_bounded(tmp_path):
+    # the PR 19 disagg shape: a kv_export leg riding the 120s transport
+    # default stalls the whole migration on a dead prefill replica;
+    # the Deadline-threaded variant (what DisaggClient actually does)
+    # is clean
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed import rpc
+
+        def _host_kv_export(name, prompt):
+            ...
+
+        def migrate_bad(prompt):
+            return rpc.rpc_sync("pre0", _host_kv_export,
+                                args=("default", prompt))
+
+        def migrate_good(prompt, deadline):
+            return rpc.rpc_sync("pre0", _host_kv_export,
+                                args=("default", prompt),
+                                timeout=deadline.remaining())
+    """)
+    r11 = rules_at(fs, "R11")
+    assert len(r11) == 1
+    assert r11[0].symbol == "migrate_bad"
+    assert "default timeout" in r11[0].message
+
+
+def test_r11_kv_migration_idempotence_annotations_under_retry(tmp_path):
+    # the migration surface's annotation contract: a migration fn
+    # declared rpc-non-idempotent flags under a multi-attempt policy,
+    # while `_host_kv_import` — idempotent BY DIGEST (a replayed
+    # payload is a no-op), annotated exactly as serving/disagg.py does
+    # — is retriable
+    fs = lint(tmp_path, """
+        from paddle_tpu.distributed.resilience import RetryPolicy
+
+        def _host_kv_scatter(name, payload):  # tpu-lint: rpc-non-idempotent
+            ...
+
+        def _host_kv_import(name, payload):  # tpu-lint: rpc-idempotent
+            ...
+
+        class Replica:
+            def __init__(self):
+                self._retry = RetryPolicy(max_attempts=3)
+
+            def _call(self, fn, *args, retry=None):
+                ...
+
+            def kv_scatter_bad(self, payload):
+                return self._call(_host_kv_scatter, payload,
+                                  retry=self._retry)
+
+            def kv_import_ok(self, payload):
+                return self._call(_host_kv_import, payload,
+                                  retry=self._retry)
+    """)
+    r11 = rules_at(fs, "R11")
+    assert len(r11) == 1
+    assert r11[0].symbol == "Replica.kv_scatter_bad"
+    assert "_host_kv_scatter" in r11[0].message
+
+
+def test_r9_kv_export_must_abort_pins_on_failure(tmp_path):
+    # the migration pin-lifecycle contract: export pins matched blocks
+    # via lookup, then the device readback can raise — without a
+    # try/finally abort the failed export leaks the pins and the
+    # evictor can never reclaim those rows
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def abort(self, hit, plan=None): ...
+
+        def stage_chunk(rows):
+            raise RuntimeError(rows)
+
+        def export_leaky(pool: BlockPool, toks):
+            hit = pool.lookup(toks)
+            leaves = stage_chunk(toks)
+            pool.abort(hit)
+            return leaves
+    """)
+    r9 = rules_at(fs, "R9")
+    assert any(f.symbol == "export_leaky" and "can raise" in f.message
+               and "exception path leaks" in f.message for f in r9)
+
+
+def test_r9_kv_export_finally_abort_is_clean(tmp_path):
+    # the FIXED export_payload discipline: pins released in a finally,
+    # covering the miss early-return and the raising readback alike
+    fs = lint(tmp_path, """
+        class BlockPool:
+            def lookup(self, toks): ...
+            def abort(self, hit, plan=None): ...
+
+        def stage_chunk(rows):
+            raise RuntimeError(rows)
+
+        def export_clean(pool: BlockPool, toks):
+            hit = pool.lookup(toks)
+            try:
+                if not toks:
+                    return None
+                return stage_chunk(toks)
+            finally:
+                pool.abort(hit)
+    """)
+    assert rules_at(fs, "R9") == []
+
+
 # ======================================================= incremental
 def _git(cwd, *args):
     subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",

@@ -54,6 +54,7 @@ SLOTS = 2
 GEO = dict(max_length=64, prefill_buckets=(32,))
 N_REPLICAS = 3
 SEED = 7
+BLOCK_TOKENS = 8
 
 
 def log(msg: str) -> None:
@@ -73,26 +74,38 @@ def build_model():
 
 
 # ---------------------------------------------------------------- child
-def child_main(rank: int, endpoint: str) -> int:
+def child_main(rank: int, endpoint: str, role: str = None,
+               world: int = None) -> int:
     from paddle_tpu.distributed import rpc
     from paddle_tpu.serving import InferenceServer, remote
 
     name = f"r{rank}"
-    rpc.init_rpc(name=name, rank=rank, world_size=N_REPLICAS + 1,
+    rpc.init_rpc(name=name, rank=rank,
+                 world_size=(N_REPLICAS + 1) if world is None else world,
                  master_endpoint=endpoint)
     model, _ = build_model()
-    server = InferenceServer(model, slots=SLOTS, max_queue_depth=16,
-                             shed_on_overload=True, **GEO)
+    kw = dict(slots=SLOTS, max_queue_depth=16, shed_on_overload=True)
+    if role is not None:
+        # disagg replicas carry the paged KV pool the migration fills
+        kw["prefix_cache"] = dict(max_bytes=4 << 20,
+                                  block_tokens=BLOCK_TOKENS)
+    server = InferenceServer(model, **kw, **GEO)
+    if role is not None:
+        # prefill replicas serve max_new_tokens=1 only: their decode
+        # program must never be traced (#buckets, not #buckets+1)
+        server.engine.warmup(max_new_tokens=1 if role == "prefill" else 2)
     remote.host_server(server, name="default")
-    log(f"child {name} (pid {os.getpid()}) hosting")
+    log(f"child {name} (pid {os.getpid()}) hosting role={role}")
     remote.wait_for_stop(timeout=600.0)
     cc = server.engine.cache_stats()
     n_buckets = len(server.engine.prefill_buckets)
+    want_decode = 0 if role == "prefill" else 1
     budget_ok = (cc["prefill"]["compiles"] == n_buckets
-                 and cc["decode"]["compiles"] == 1)
+                 and cc["decode"]["compiles"] == want_decode)
     log(f"child {name} compile budget: prefill "
         f"{cc['prefill']['compiles']}/{n_buckets}, decode "
-        f"{cc['decode']['compiles']}/1 -> {'OK' if budget_ok else 'OVER'}")
+        f"{cc['decode']['compiles']}/{want_decode} "
+        f"-> {'OK' if budget_ok else 'OVER'}")
     try:
         server.shutdown(drain=False, timeout=20)
     except Exception as e:
@@ -467,16 +480,191 @@ def parent_main(args) -> int:
                 proc.kill()
 
 
+# ------------------------------------------------- disagg chaos (PR 19)
+def disagg_main(args) -> int:
+    """SIGKILL a prefill replica mid-migration: the decode replica must
+    fall back to local recompute with zero lost requests and
+    token-identical streams, and keep serving after the prefill pool is
+    gone entirely.
+
+    Topology: rank 0 (this parent) drives a
+    ``serving.disagg.DisaggClient`` over two children — r1 hosts the
+    prefill replica, r2 the decode replica, both with KV block pools.
+    A seeded ``slow`` FaultPlan is rpc-installed on r1's
+    ``disagg.kv_export`` fault point so the kill provably lands
+    mid-migration, not between requests."""
+    import numpy as np
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.resilience import FaultPlan
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving import RemoteReplica
+    from paddle_tpu.serving import remote as remote_mod
+    from paddle_tpu.serving.disagg import DisaggClient, PrefixIndex
+
+    endpoint = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PT_FAULT_PLAN", None)
+    world = 3
+    procs = {}
+    check = Check()
+    t_start = time.monotonic()
+    try:
+        for rank, role in ((1, "prefill"), (2, "decode")):
+            procs[f"r{rank}"] = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child",
+                 "--rank", str(rank), "--endpoint", endpoint,
+                 "--role", role, "--world", str(world)],
+                env=env)
+        rpc.init_rpc(name="router", rank=0, world_size=world,
+                     master_endpoint=endpoint)
+        model, cfg = build_model()
+        rng = np.random.default_rng(1234)
+
+        def prompt(n):
+            return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+        def solo(p, n, seed=None):
+            return model.generate(
+                p[None], max_new_tokens=n,
+                do_sample=seed is not None,
+                temperature=0.8 if seed is not None else 1.0,
+                seed=seed, **GEO)[0]
+
+        pre = RemoteReplica("r1", rpc_timeout=8.0, connect_deadline=0.75,
+                            poll_interval=0.01)
+        dec = RemoteReplica("r2", rpc_timeout=8.0, connect_deadline=0.75,
+                            poll_interval=0.01)
+        for name, rep in (("r1", pre), ("r2", dec)):
+            if not rep.wait_ready(timeout=300.0):
+                raise RuntimeError(f"{name} never hosted its server")
+        log(f"replicas ready at {time.monotonic() - t_start:.0f}s")
+        client = DisaggClient([pre], [dec], block_tokens=BLOCK_TOKENS,
+                              index=PrefixIndex())
+
+        # ---- phase 1: migrated streams token-identical ---------------
+        # prompts past one full block so the migration path engages;
+        # greedy + seeded-sampled both checked against parent-side solo
+        p1, p2 = prompt(2 * BLOCK_TOKENS + 3), prompt(2 * BLOCK_TOKENS + 5)
+        want1, want2 = solo(p1, 8), solo(p2, 8, seed=321)
+        got1 = client.submit(p1, max_new_tokens=8).result(timeout=300)
+        got2 = client.submit(p2, max_new_tokens=8, do_sample=True,
+                             temperature=0.8, seed=321).result(timeout=300)
+        check.expect(np.array_equal(got1, want1),
+                     "migrated greedy stream token-identical to solo")
+        check.expect(np.array_equal(got2, want2),
+                     "migrated seeded-sampled stream token-identical")
+        check.expect(client.migrations == 2 and client.fallbacks == 0,
+                     f"both requests really migrated "
+                     f"(migrations={client.migrations}, "
+                     f"fallbacks={client.fallbacks})")
+        client.scrape_index()
+        check.expect("r1" in client.index.replicas(),
+                     "prefix index scraped the prefill replica")
+        log(f"migration parity done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 2: SIGKILL the prefill replica MID-migration ------
+        # the slow fault pins the export leg for seconds, the kill lands
+        # inside it, and the in-flight request must fall back to the
+        # decode replica's local recompute — token-identical, not lost
+        slow_plan = FaultPlan([{"site": "disagg.kv_export",
+                                "kind": "slow", "times": None,
+                                "delay": 5.0}], seed=3)
+        rpc.rpc_sync("r1", remote_mod._host_install_plan,
+                     args=(slow_plan.to_json(),), timeout=15.0)
+        p3 = prompt(2 * BLOCK_TOKENS + 7)
+        want3 = solo(p3, 8)
+        box = {}
+
+        def submit_mid_kill():
+            h = client.submit(p3, max_new_tokens=8)
+            box["out"] = h.result(timeout=300)
+
+        th = threading.Thread(target=submit_mid_kill, daemon=True)
+        th.start()
+        time.sleep(1.2)   # the export leg is now sleeping in the fault
+        procs["r1"].kill()
+        th.join(timeout=300)
+        check.expect(np.array_equal(box.get("out"), want3),
+                     "mid-migration kill: stream fell back "
+                     "token-identical")
+        check.expect(client.fallbacks >= 1,
+                     f"the killed migration was absorbed as a fallback "
+                     f"(fallbacks={client.fallbacks})")
+        events = tracing.spans(name="kv_migrate:fallback")
+        check.expect(len(events) >= 1,
+                     f"fallback left a kv_migrate:fallback trace event "
+                     f"({len(events)})")
+        log(f"mid-migration kill done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- phase 3: prefill pool dead — decode keeps serving -------
+        lost = 0
+        for k in range(4):
+            p = prompt(2 * BLOCK_TOKENS + 2 + k)
+            want = solo(p, 6)
+            try:
+                got = client.submit(p, max_new_tokens=6).result(timeout=300)
+            except Exception:
+                lost += 1
+                continue
+            if not np.array_equal(got, want):
+                lost += 1
+        check.expect(lost == 0,
+                     "decode pool served 4/4 token-identical with the "
+                     "prefill pool dead")
+        client.scrape_index()
+        check.expect("r1" not in client.index.replicas(),
+                     "dead prefill replica dropped from the prefix index")
+        log(f"prefill-dead serving done at {time.monotonic() - t_start:.0f}s")
+
+        # ---- teardown ------------------------------------------------
+        try:
+            rpc.rpc_sync("r2", remote_mod._host_request_stop,
+                         timeout=10.0, connect_deadline=2.0)
+        except Exception as e:
+            check.expect(False, f"stop signal to r2: {e}")
+        rpc.shutdown(timeout=8.0)
+        rc1 = procs["r1"].wait(timeout=30)
+        check.expect(rc1 == -9, f"r1 died by SIGKILL (rc={rc1})")
+        rc2 = procs["r2"].wait(timeout=120)
+        check.expect(rc2 == 0,
+                     f"decode replica exited clean with its "
+                     f"#buckets+1 budget held (rc={rc2})")
+
+        summary = {
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+            "migrations": client.migrations,
+            "fallbacks": client.fallbacks,
+            "migrated_bytes": client.migrated_bytes,
+            "failures": check.failures,
+        }
+        print(json.dumps({"fleet_chaos_disagg": summary}), flush=True)
+        return 0 if not check.failures else 1
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="smaller overload burst (the CI gate shape)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disagg scenario: SIGKILL a prefill replica "
+                         "mid-migration; decode must fall back to local "
+                         "recompute with zero lost requests")
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--rank", type=int, default=0)
     ap.add_argument("--endpoint", default=None)
+    ap.add_argument("--role", choices=("prefill", "decode"), default=None)
+    ap.add_argument("--world", type=int, default=None)
     args = ap.parse_args()
     if args.child:
-        return child_main(args.rank, args.endpoint)
+        return child_main(args.rank, args.endpoint, role=args.role,
+                          world=args.world)
+    if args.disagg:
+        return disagg_main(args)
     return parent_main(args)
 
 

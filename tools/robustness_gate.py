@@ -100,6 +100,16 @@ story. Runs, in order:
    rides the same baseline's threshold, and a scoped tpu_lint of the
    speculative/quantization files holds the R1/R9 line under
    ``--skip-lint``.
+9. with ``--disagg``, the disaggregated prefill/decode gate:
+   ``tools/fleet_chaos.py --disagg`` (KV-block migration parity — greedy
+   and seeded-sampled migrated streams token-identical to solo generate
+   — then SIGKILL the prefill replica MID-migration: the decode replica
+   must fall back to local recompute with zero lost requests and the
+   dead replica must drop from the fleet prefix index), followed by
+   ``tools/serve_bench.py --disagg --check`` regression-gated against
+   ``.disagg_baseline.json``: warm replica boot via the persistent
+   compile cache must keep cutting cold TTFT by the stored floor, and
+   migration overhead must stay under its ceiling.
 
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
@@ -115,6 +125,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --observability  # + telemetry gate
     python tools/robustness_gate.py --overlap      # + step-schedule gate
     python tools/robustness_gate.py --decode       # + decode-speed gate
+    python tools/robustness_gate.py --disagg       # + prefill/decode split
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -354,6 +365,74 @@ def _run_decode_gate() -> bool:
                  os.path.join(REPO, "tools/decode_bench.py")])
 
 
+def _run_disagg_gate() -> bool:
+    """``--disagg``: the disaggregated prefill/decode gate, two stages.
+
+    First ``tools/fleet_chaos.py --disagg`` — the migration fault drill:
+    a dedicated prefill replica fills KV blocks and ships them to a
+    decode replica over rpc; greedy AND seeded-sampled migrated streams
+    must be token-identical to solo ``generate``, then the prefill
+    replica is SIGKILLed MID-migration (a ``slow`` fault holds the
+    export) and the decode replica must fall back to local recompute —
+    zero lost requests, the fallback traced, the dead replica dropped
+    from the fleet prefix index, and the prefill replica's #buckets
+    (decode-free) compile budget held at exit.
+
+    Then ``tools/serve_bench.py --disagg --check`` — the performance
+    regression half: warm replica boot (persistent compile cache) and
+    migration overhead are compared against the stored
+    ``.disagg_baseline.json`` floors (warm boot must keep cutting cold
+    TTFT by ``min_warm_boot_reduction_frac``; shipping prefilled blocks
+    must stay under ``max_migration_overhead_frac`` of the window).
+    The bench itself already fails the stage on lost requests, verify
+    divergence, a post-scale-out p99 TTFT spike, steady-state
+    recompiles, or a compile-budget breach on any replica."""
+    name = "disagg"
+    baseline_path = os.path.join(REPO, ".disagg_baseline.json")
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except OSError as e:
+        print(f"[robustness_gate] === {name}: FAIL "
+              f"(no {baseline_path}: {e})", flush=True)
+        return False
+    if not _run(f"{name}_chaos",
+                [sys.executable, os.path.join(TOOLS, "fleet_chaos.py"),
+                 "--disagg"]):
+        return False
+    bench = baseline["bench"]
+    out = os.path.join(tempfile.gettempdir(),
+                       f"disagg_gate_{os.getpid()}.json")
+    ok = _run(name, [sys.executable,
+                     os.path.join(TOOLS, "serve_bench.py"),
+                     "--disagg", "--check",
+                     "--requests", str(bench["requests"]),
+                     "--prefill-ratio", str(bench["prefill_ratio"]),
+                     "--verify", str(bench["verify"]),
+                     "--json-out", out])
+    if not ok:
+        return False
+    try:
+        with open(out) as f:
+            summary = json.load(f)
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    extra = summary["extra"]
+    red = extra["cold_start_ttft_s"]["reduction_frac"]
+    min_red = baseline["min_warm_boot_reduction_frac"]
+    overhead = extra["migration"]["overhead_frac"]
+    max_overhead = baseline["max_migration_overhead_frac"]
+    ok = red >= min_red and overhead <= max_overhead
+    print(f"[robustness_gate] === {name}: warm-boot reduction_frac="
+          f"{red:.4f} (min {min_red}), migration overhead_frac="
+          f"{overhead:.4f} (max {max_overhead}) -> "
+          f"{'PASS' if ok else 'FAIL'}", flush=True)
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-soak", action="store_true")
@@ -389,6 +468,12 @@ def main() -> int:
                          "(bench_profile --overlap --distributed vs the "
                          ".overlap_baseline.json threshold + scoped "
                          "tpu_lint of the restructured step files)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="also run the disaggregated prefill/decode "
+                         "gate (fleet_chaos --disagg migration fault "
+                         "drill + serve_bench --disagg warm-boot and "
+                         "migration-overhead regression vs the "
+                         ".disagg_baseline.json floors)")
     ap.add_argument("--decode", action="store_true",
                     help="also run the raw-decode-speed regression gate "
                          "(decode_bench small preset, speculative + int8 "
@@ -459,6 +544,8 @@ def main() -> int:
             "lora", [sys.executable, os.path.join(TOOLS, "lora_soak.py")])
     if args.overlap:
         results["overlap"] = _run_overlap_gate()
+    if args.disagg:
+        results["disagg"] = _run_disagg_gate()
     if args.decode:
         results["decode"] = _run_decode_gate()
     if not args.skip_sweep:

@@ -77,6 +77,16 @@ def _pct(values, p):
     return float(np.percentile(np.asarray(values, np.float64), p))
 
 
+def _emit(record, json_out=None):
+    """Print the one-line JSON record; mirror it to ``--json-out`` so
+    the robustness gate can diff it against a checked-in baseline."""
+    line = json.dumps(record)
+    print(line)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(line + "\n")
+
+
 def _kv_logit_error(model, prompt, steps, max_length):
     """Max relative logit error of an int8-quantized KV cache against
     full precision, over a teacher-forced decode (same token sequence
@@ -202,11 +212,44 @@ def main(argv=None) -> int:
     ap.add_argument("--endpoint", default=None,
                     help="internal: rpc master endpoint for "
                          "--child-replica")
+    # ---- disaggregated prefill/decode fleet (PR 19) ----
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated fleet: dedicated prefill "
+                         "replicas fill KV blocks and migrate them to "
+                         "decode replicas over rpc (serving.disagg); "
+                         "measures cold vs warm replica boot through "
+                         "the persistent compile cache, per-pool "
+                         "occupancy/goodput, and migration overhead")
+    ap.add_argument("--prefill-ratio", type=float, default=0.5,
+                    help="share of --replicas dedicated to the prefill "
+                         "pool in --disagg mode (at least one replica "
+                         "per pool; the PR 16 autoscaler scales each "
+                         "pool on its own burn signal)")
+    ap.add_argument("--json-out", default=None, metavar="PATH",
+                    help="also write the one-line JSON record to PATH "
+                         "(the regression-gate input)")
+    ap.add_argument("--disagg-child", choices=("prefill", "decode"),
+                    default=None,
+                    help="internal: host one disagg replica of this "
+                         "role for a --disagg parent")
+    ap.add_argument("--rpc-name", default=None,
+                    help="internal: rpc worker name for --disagg-child")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="internal: rpc rank for --disagg-child")
+    ap.add_argument("--world", type=int, default=None,
+                    help="internal: rpc world size for --disagg-child")
+    ap.add_argument("--wait-file", default=None,
+                    help="internal: defer the model build until this "
+                         "file exists (the warm-boot release gate)")
     args = ap.parse_args(argv)
     if args.child_replica:
         return _child_replica_main(args)
+    if args.disagg_child:
+        return _disagg_child_main(args)
     if args.fairness:
         return _fairness_main(args)
+    if args.disagg:
+        return _disagg_main(args)
     if args.check:
         args.requests = min(args.requests, 8)
         args.rate = min(args.rate, 4.0)
@@ -583,7 +626,7 @@ def main(argv=None) -> int:
                if stores else {}),
         },
     }
-    print(json.dumps(record))
+    _emit(record, args.json_out)
     rc = 0
     if steady:
         print(f"FAIL: {steady} recompile(s) during the measured window — "
@@ -957,7 +1000,7 @@ def _fairness_main(args) -> int:
             "backend": jax.default_backend(),
         },
     }
-    print(json.dumps(record))
+    _emit(record, args.json_out)
     rc = 0
     if not auto.scale_outs or trigger is None:
         print("FAIL: the spike never forced a scale-out — the SLO "
@@ -994,6 +1037,423 @@ def _fairness_main(args) -> int:
     if steady:
         print(f"FAIL: {steady} local recompile(s) during the measured "
               f"window", file=sys.stderr)
+        rc = 1
+    if any(c != 0 for c in child_rcs):
+        print(f"FAIL: child replica exit codes {child_rcs}",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+# --------------------------------------------------------------------
+# Disaggregated prefill/decode fleet (PR 19).
+#
+# Topology: rank 0 (this process) runs the DisaggClient; dedicated
+# prefill replicas (ranks 1..P) fill KV blocks for max_new_tokens=1
+# requests and export them over rpc; decode replicas import the blocks
+# into their own pool and serve the stream through the normal
+# pool-admit path. Every child process points its persistent XLA
+# compile cache at a shared per-role directory (serving.disagg
+# .warm_boot_env): the FIRST decode replica boots cold and pays every
+# compile; the deferred warm-boot replica — released mid-window by a
+# wait-file touch, the scale-out moment — deserializes them and must
+# boot in a fraction of the cold window (PR 16 measured ~7.4s cold).
+#
+# Gates: migrated-prefill streams token-identical to a solo generate
+# (greedy + seeded), zero lost requests (fallback-to-local-recompute
+# absorbs every failed migration leg), warm boot strictly faster than
+# cold, at least one real migration, and the per-role compile budgets:
+# #buckets prefill-only programs on a prefill replica (its decode
+# program is never traced), #buckets+1 on a decode replica.
+
+def _disagg_max_length(args, cfg):
+    prefix_pad = args.prefix_tokens + args.block_tokens
+    return min(cfg.max_position_embeddings,
+               max(args.buckets) + args.new_tokens + 8
+               + (prefix_pad if args.prefix_tokens else 0))
+
+
+def _disagg_child_main(args) -> int:
+    """One disagg replica host. Joins the rendezvous immediately (the
+    fabric needs every rank), but a ``--wait-file`` child defers its
+    model build + compile until the parent touches the file — the
+    released-to-first-token window IS the warm-boot measurement."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.serving import remote
+
+    rpc.init_rpc(name=args.rpc_name, rank=args.rank,
+                 world_size=args.world, master_endpoint=args.endpoint)
+    if args.wait_file:
+        deadline = time.time() + 600.0
+        while not os.path.exists(args.wait_file):
+            if time.time() > deadline:
+                return 3
+            time.sleep(0.02)
+    from decode_bench import build_model
+    from paddle_tpu.serving import InferenceServer
+
+    model, cfg = build_model(args.model, args.preset)
+    srv = InferenceServer(
+        model, slots=args.slots, max_length=_disagg_max_length(args, cfg),
+        prefill_buckets=args.buckets,
+        max_queue_depth=args.max_queue_depth,
+        prefix_cache=dict(
+            max_bytes=int(args.prefix_cache_mb * (1 << 20)),
+            block_tokens=args.block_tokens),
+        kv_dtype=None if args.kv_dtype == "none" else args.kv_dtype)
+    # a prefill replica serves max_new_tokens=1 requests only — its
+    # decode program is never traced, so the warmup must not trace it
+    # either (#buckets programs, not #buckets+1)
+    srv.engine.warmup(
+        max_new_tokens=1 if args.disagg_child == "prefill" else 2)
+    remote.host_server(srv, name="default")
+    remote.wait_for_stop(timeout=900.0)
+    try:
+        srv.shutdown(drain=False, timeout=20.0)
+    except Exception:
+        pass
+    rpc.shutdown(timeout=6.0)
+    return 0
+
+
+def _disagg_main(args) -> int:
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+
+    from decode_bench import build_model
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.framework import compile_cache
+    from paddle_tpu.serving import remote as remote_mod
+    from paddle_tpu.serving.disagg import (DisaggClient, PrefixIndex,
+                                           warm_boot_env)
+    from paddle_tpu.serving.remote import RemoteReplica
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        endpoint = f"127.0.0.1:{s.getsockname()[1]}"
+
+    n_total = max(2, args.replicas)
+    n_prefill = max(1, min(n_total - 1,
+                           int(round(args.prefill_ratio * n_total))))
+    n_decode = n_total - n_prefill
+    # +1: the deferred warm-boot decode replica; +1: this parent
+    world = 1 + n_prefill + n_decode + 1
+    if args.prefix_cache_mb <= 0:
+        args.prefix_cache_mb = 8.0     # both pools need KV blocks
+    if args.prefix_tokens == 0:
+        # prefix-heavy by default: migration needs prompts past one
+        # full block, and the cold shared-prefix prompt must still fit
+        # the largest declared bucket (the main-mode invariant)
+        args.prefix_tokens = max(args.buckets) - args.block_tokens
+    if args.check:
+        args.requests = min(args.requests, 12)
+        args.rate = min(args.rate, 4.0)
+        args.new_tokens = min(args.new_tokens, 10)
+
+    work = tempfile.mkdtemp(prefix="disagg-bench-")
+    # per-role cache dirs: the prefill pool must not pre-populate the
+    # decode programs, or the "cold" decode boot would silently warm
+    decode_cache = os.path.join(work, "cache-decode")
+    prefill_cache = os.path.join(work, "cache-prefill")
+    wait_file = os.path.join(work, "warm.go")
+
+    def child_argv(role, name, rank, deferred=False):
+        argv = [sys.executable, os.path.abspath(__file__),
+                "--disagg-child", role, "--rpc-name", name,
+                "--rank", str(rank), "--world", str(world),
+                "--endpoint", endpoint,
+                "--model", args.model, "--preset", args.preset,
+                "--slots", str(args.slots),
+                "--new-tokens", str(args.new_tokens),
+                "--buckets", *[str(b) for b in args.buckets],
+                "--max-queue-depth", str(args.max_queue_depth),
+                "--block-tokens", str(args.block_tokens),
+                "--prefix-cache-mb", str(args.prefix_cache_mb),
+                "--prefix-tokens", str(args.prefix_tokens),
+                "--kv-dtype", args.kv_dtype,
+                "--seed", str(args.seed)]
+        if deferred:
+            argv += ["--wait-file", wait_file]
+        return argv
+
+    def child_env(cache_dir):
+        # children serve on host CPU (a real fleet maps each to its own
+        # accelerator); the warm_boot_env flags point their persistent
+        # compile cache at the shared per-role directory
+        return dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu",
+                    **warm_boot_env(cache_dir))
+
+    plan = []      # (role, rpc name, rank, cache dir, deferred)
+    rank = 1
+    for i in range(n_prefill):
+        plan.append(("prefill", f"pre{i}", rank, prefill_cache, False))
+        rank += 1
+    for i in range(n_decode):
+        plan.append(("decode", f"dec{i}", rank, decode_cache, False))
+        rank += 1
+    plan.append(("decode", "dec-warm", rank, decode_cache, True))
+
+    procs = []
+    t_fleet0 = time.perf_counter()
+    for role, name, r, cache, deferred in plan:
+        procs.append(subprocess.Popen(
+            child_argv(role, name, r, deferred=deferred),
+            env=child_env(cache)))
+    rpc.init_rpc(name="bench", rank=0, world_size=world,
+                 master_endpoint=endpoint)
+    reps = {name: RemoteReplica(name, rpc_timeout=60.0,
+                                connect_deadline=2.0)
+            for _, name, _, _, _ in plan}
+    lens = sorted(b - 2 for b in args.buckets)
+    # vocab-independent probe (any model's vocab covers ids 1..97), so
+    # the cold measurement needs no local model build first
+    probe_prompt = ((np.arange(lens[0]) % 97) + 1).astype(np.int32)
+
+    # ---- cold boot: fleet spawn -> first token on the cold decode ----
+    if not reps["dec0"].wait_ready(timeout=600.0):
+        print("FAIL: cold decode replica never hosted", file=sys.stderr)
+        return 1
+    h = reps["dec0"].submit(prompt=probe_prompt, max_new_tokens=4)
+    h.result(timeout=args.timeout)
+    cold_s = round(time.perf_counter() - t_fleet0, 3)
+    for role, name, _, _, deferred in plan:
+        if not deferred and not reps[name].wait_ready(timeout=600.0):
+            print(f"FAIL: replica {name} never hosted", file=sys.stderr)
+            return 1
+
+    rng = np.random.default_rng(args.seed)
+    model, cfg = build_model(args.model, args.preset)
+    max_length = _disagg_max_length(args, cfg)
+
+    def prompt(n):
+        return rng.integers(0, cfg.vocab_size, (int(n),)).astype(np.int32)
+
+    index = PrefixIndex()
+    client = DisaggClient(
+        [reps[f"pre{i}"] for i in range(n_prefill)],
+        [reps[f"dec{i}"] for i in range(n_decode)],
+        block_tokens=args.block_tokens, index=index,
+        prefill_timeout_s=min(args.timeout, 60.0))
+
+    shared_prefix = prompt(args.prefix_tokens)
+
+    def trace_prompt():
+        if rng.random() < args.prefix_frac:
+            sfx = prompt(int(rng.integers(2, args.block_tokens + 1)))
+            return np.concatenate([shared_prefix, sfx])
+        return prompt(int(rng.integers(4, max(lens) + 1)))
+
+    # ---- warm boot: released on another thread mid-window, like a
+    # burn-driven scale-out; the decode pool grows when it lands ----
+    warm = {}
+
+    def release_warm():
+        with open(wait_file, "w") as f:
+            f.write("go\n")
+        t0 = time.perf_counter()
+        if not reps["dec-warm"].wait_ready(timeout=600.0):
+            warm["error"] = "never hosted"
+            return
+        hw = reps["dec-warm"].submit(prompt=probe_prompt,
+                                     max_new_tokens=4)
+        hw.result(timeout=args.timeout)
+        warm["warm_boot_s"] = round(time.perf_counter() - t0, 3)
+        warm["t_added"] = time.perf_counter()
+        client.decode.append(reps["dec-warm"])
+
+    warm_thread = threading.Thread(target=release_warm, daemon=True)
+
+    # ---- measured open-loop window through the DisaggClient ----
+    compiles_before = compile_cache.cache_stats()["compiles"]
+    interarrival = rng.exponential(1.0 / max(args.rate, 1e-6),
+                                   args.requests)
+    release_at = args.requests // 3
+    verify_idx = set(range(min(args.verify or 2, args.requests)))
+    verify_solo = {}
+    handles, failed = [], 0
+    ttft_pre_add, ttft_post_add = [], []
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        target = t0 + float(interarrival[:i + 1].sum())
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        if i == release_at:
+            warm_thread.start()
+        if i and i % 8 == 0:
+            client.scrape_index()
+        # verify probes always carry the shared prefix: they must take
+        # the MIGRATED path to prove token identity end to end
+        p = (np.concatenate([shared_prefix,
+                             prompt(int(rng.integers(2,
+                                        args.block_tokens + 1)))])
+             if i in verify_idx else trace_prompt())
+        kw = dict(max_new_tokens=args.new_tokens, seed=args.seed + i)
+        if i in verify_idx:
+            verify_solo[i] = p
+        else:
+            kw.update(do_sample=bool(i % 2), temperature=0.8, top_p=0.95)
+        handles.append((i, time.perf_counter(), client.submit(p, **kw)))
+    completed, results = 0, {}
+    for i, sub_t, h in handles:
+        try:
+            results[i] = h.result(timeout=args.timeout)
+            completed += 1
+            if getattr(h, "ttft_s", None) is not None:
+                # p99-spike gate input: requests submitted after the
+                # warm replica joined vs before
+                (ttft_post_add
+                 if sub_t >= warm.get("t_added", float("inf"))
+                 else ttft_pre_add).append(h.ttft_s)
+        except Exception:
+            failed += 1
+    elapsed = time.perf_counter() - t0
+    warm_thread.join(timeout=600.0)
+    steady = compile_cache.cache_stats()["compiles"] - compiles_before
+    warm_s = warm.get("warm_boot_s")
+
+    # ---- verify: migrated streams == cold solo generate ----
+    verify_failures = 0
+    for i, p in verify_solo.items():
+        got = results.get(i)
+        if got is None:
+            continue
+        solo = model.generate(
+            p[None], max_new_tokens=args.new_tokens,
+            max_length=max_length, prefill_buckets=tuple(args.buckets),
+            kv_dtype=None if args.kv_dtype == "none" else args.kv_dtype)[0]
+        if not np.array_equal(np.asarray(got), solo):
+            verify_failures += 1
+
+    # ---- per-pool blocks + per-role compile budgets ----
+    pools = {"prefill": {"replicas": [], "budget": len(args.buckets)},
+             "decode": {"replicas": [], "budget": len(args.buckets) + 1}}
+    over_budget = {}
+    for role, name, _, _, deferred in plan:
+        if deferred and warm_s is None:
+            continue
+        try:
+            sn = reps[name].snapshot()
+        except Exception:
+            over_budget[name] = -1
+            continue
+        cc = sn.get("compile_stats", {})
+        compiles = (cc.get("prefill", {}).get("compiles", 0)
+                    + cc.get("decode", {}).get("compiles", 0))
+        pools[role]["replicas"].append({
+            "name": name,
+            "slot_occupancy": round(sn.get("slot_occupancy", 0.0), 4),
+            "tokens_emitted": sn.get("tokens_emitted", 0),
+            "completed": sn.get("requests_completed", 0),
+            "prefix_hit_tokens": sn.get("prefix_hit_tokens", 0),
+            "compiles": compiles})
+        if compiles > pools[role]["budget"]:
+            over_budget[name] = compiles
+    for role, blk in pools.items():
+        rs = blk["replicas"]
+        blk["occupancy"] = round(
+            sum(r["slot_occupancy"] for r in rs) / max(1, len(rs)), 4)
+        blk["tokens_per_sec"] = round(
+            sum(r["tokens_emitted"] for r in rs) / max(elapsed, 1e-9), 2)
+    mig = client.statusz()
+    pools["prefill"]["goodput"] = round(
+        mig["migrations"] / max(1, mig["migrations"] + mig["fallbacks"]),
+        4)
+    pools["decode"]["goodput"] = round(
+        completed / max(1, args.requests), 4)
+
+    # ---- teardown ----
+    child_rcs = []
+    for _, name, _, _, deferred in plan:
+        try:
+            rpc.rpc_sync(name, remote_mod._host_request_stop,
+                         timeout=10.0, connect_deadline=2.0)
+        except Exception:
+            pass
+    try:
+        rpc.shutdown(timeout=8.0)
+    except Exception:
+        pass
+    for proc in procs:
+        try:
+            child_rcs.append(proc.wait(timeout=120))
+        except Exception:
+            proc.kill()
+            child_rcs.append(-1)
+
+    record = {
+        "metric": f"{args.model}_serve_disagg_requests_per_sec",
+        "value": round(completed / max(elapsed, 1e-9), 3),
+        "unit": "req/s",
+        "extra": {
+            "goodput": round(completed / max(args.requests, 1), 4),
+            "offered_requests": args.requests,
+            "completed": completed,
+            "failed": failed,
+            "elapsed_s": round(elapsed, 3),
+            "prefill_replicas": n_prefill,
+            "decode_replicas": n_decode,
+            "prefill_ratio": args.prefill_ratio,
+            "cold_start_ttft_s": {
+                "cold": cold_s,
+                "warm": warm_s,
+                "reduction_frac": (round(1.0 - warm_s / cold_s, 4)
+                                   if warm_s else None)},
+            "ttft_p99_pre_add_ms": round(
+                _pct(ttft_pre_add, 99) * 1e3, 3),
+            "ttft_p99_post_add_ms": round(
+                _pct(ttft_post_add, 99) * 1e3, 3),
+            "pools": pools,
+            "migration": {**mig,
+                          "overhead_frac": round(
+                              mig["migrate_s"] / max(elapsed, 1e-9), 4)},
+            "verified": len(verify_solo),
+            "verify_failures": verify_failures,
+            "steady_state_recompiles": steady,
+            "compile_budget": {r: pools[r]["budget"] for r in pools},
+            "child_rcs": child_rcs,
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            "check": bool(args.check),
+        },
+    }
+    _emit(record, args.json_out)
+    rc = 0
+    if verify_failures:
+        print(f"FAIL: {verify_failures} migrated stream(s) diverged "
+              f"from solo generate — block migration changed tokens",
+              file=sys.stderr)
+        rc = 1
+    if failed:
+        print(f"FAIL: {failed} request(s) lost — migration fallback "
+              f"must absorb every failed leg", file=sys.stderr)
+        rc = 1
+    if mig["migrations"] == 0:
+        print("FAIL: no migration ever succeeded — the disagg path "
+              "never ran", file=sys.stderr)
+        rc = 1
+    if over_budget:
+        print(f"FAIL: per-role compile budget exceeded: {over_budget} "
+              f"(prefill={len(args.buckets)}, "
+              f"decode={len(args.buckets) + 1})", file=sys.stderr)
+        rc = 1
+    if warm_s is None:
+        print(f"FAIL: warm-boot replica never served "
+              f"({warm.get('error', 'unknown')})", file=sys.stderr)
+        rc = 1
+    elif warm_s >= cold_s:
+        print(f"FAIL: warm boot ({warm_s}s) not faster than cold "
+              f"({cold_s}s) — the persistent compile cache did not "
+              f"deserialize", file=sys.stderr)
+        rc = 1
+    if steady:
+        print(f"FAIL: {steady} parent-side recompile(s) during the "
+              f"measured window", file=sys.stderr)
         rc = 1
     if any(c != 0 for c in child_rcs):
         print(f"FAIL: child replica exit codes {child_rcs}",

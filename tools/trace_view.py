@@ -101,10 +101,30 @@ def load_spans(path: str) -> Tuple[List[dict], str]:
                      f"chrome trace")
 
 
+def _migrated_corrs(spans: List[dict]) -> set:
+    """Correlation ids whose KV blocks moved between replicas: the
+    prefill side records ``kv_migrate:send``, the decode side
+    ``kv_migrate:recv``, under the SAME corr id — seeing both halves
+    (usually from different hosts' dumps) marks the request migrated."""
+    sends, recvs = set(), set()
+    for s in spans:
+        c = s.get("corr")
+        if c is None:
+            continue
+        if s.get("name") == "kv_migrate:send":
+            sends.add(c)
+        elif s.get("name") == "kv_migrate:recv":
+            recvs.add(c)
+    return sends & recvs
+
+
 def merge_chrome(spans: List[dict], corr: Optional[str] = None) -> dict:
     """One merged chrome trace: pid 1 = the merged view, one tid lane
     per correlation id (sorted by first-span time so lanes read in
-    arrival order), lane 0 for uncorrelated spans."""
+    arrival order), lane 0 for uncorrelated spans. A migrated request
+    (kv_migrate:send + recv under one corr) keeps a SINGLE lane even
+    though its halves were recorded on different hosts — the lane name
+    carries a ``[migrated]`` marker."""
     spans = [s for s in spans
              if corr is None or (s.get("corr") or "").find(corr) >= 0]
     first_seen = {}
@@ -118,9 +138,11 @@ def merge_chrome(spans: List[dict], corr: Optional[str] = None) -> dict:
                "args": {"name": "merged fleet trace"}},
               {"ph": "M", "name": "thread_name", "pid": 1, "tid": 0,
                "args": {"name": "untraced"}}]
+    migrated = _migrated_corrs(spans)
     for c, tid in lanes.items():
+        lane_name = f"{c} [migrated]" if c in migrated else c
         events.append({"ph": "M", "name": "thread_name", "pid": 1,
-                       "tid": tid, "args": {"name": c}})
+                       "tid": tid, "args": {"name": lane_name}})
         events.append({"ph": "M", "name": "thread_sort_index", "pid": 1,
                        "tid": tid, "args": {"sort_index": tid}})
     for s in spans:
@@ -144,6 +166,7 @@ def merge_chrome(spans: List[dict], corr: Optional[str] = None) -> dict:
 
 
 def list_correlations(spans: List[dict]) -> List[dict]:
+    migrated = _migrated_corrs(spans)
     by_corr = {}
     for s in spans:
         c = s.get("corr")
@@ -167,6 +190,7 @@ def list_correlations(spans: List[dict]) -> List[dict]:
         e["duration_ms"] = round((e["t1"] - e["t0"]) * 1e3, 3)
         e["sources"] = sorted(e["sources"])
         e["hosts"] = sorted(e["hosts"])
+        e["migrated"] = e["corr"] in migrated
         out.append(e)
     return out
 
