@@ -41,7 +41,7 @@ import tempfile
 import threading
 import time
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -159,6 +159,7 @@ def _write_file_durable(path: str, raw: bytes, atomic: bool) -> None:
 def save_state(state: Any, directory: str, *, async_=False,
                io_threads: int = 8,
                extra_meta: Optional[Dict[str, Any]] = None,
+               extra_files: Optional[Dict[str, bytes]] = None,
                ) -> Optional["_PendingSave"]:
     """Save a pytree of arrays as a sharded checkpoint directory.
 
@@ -184,6 +185,12 @@ def save_state(state: Any, directory: str, *, async_=False,
     ``format: "lora_adapter"`` so :func:`load_state` can refuse to
     restore an adapter as a full model). The structural keys
     (``leaves``/``process_count``/``mesh``) cannot be overridden.
+
+    ``extra_files`` are sidecar records (name -> raw bytes) written by
+    process 0 INSIDE the publish barrier — before metadata, so they
+    appear atomically with the checkpoint (the integrity ledger
+    ``integrity.json`` rides here). Names must not collide with
+    ``metadata*.json`` or shard files.
     """
     flat, _ = _flatten(state)
     proc = jax.process_index()
@@ -272,6 +279,10 @@ def save_state(state: Any, directory: str, *, async_=False,
         else:
             for job in jobs:
                 write(job)
+        if extra_files and proc == 0:
+            for name, raw in extra_files.items():
+                _write_file_durable(os.path.join(stage_dir, name),
+                                    bytes(raw), atomic=multiproc)
         # metadata written last = this process's commit marker (and, via
         # the dir rename below, the single-process publish barrier)
         fault_point("ckpt.publish")
@@ -476,7 +487,8 @@ class _LeafReader:
 
 
 def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
-               template: Any = None, verify: bool = True,
+               template: Any = None,
+               verify: Union[bool, str] = True,
                max_shard_cache_bytes: Optional[int] =
                DEFAULT_SHARD_CACHE_BYTES) -> Dict[str, Any]:
     """Load a checkpoint directory.
@@ -497,7 +509,13 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
     byte length and crc32 recorded at save time; a missing/truncated/
     corrupted shard or missing metadata raises
     :class:`CheckpointCorruptError` naming the file, the writer rank, and
-    the mismatch.
+    the mismatch. The sharded-load path reads LAZILY per device, so with
+    plain ``verify=True`` a shard no device asks for is never
+    content-checked; ``verify="proactive"`` closes that hole by running a
+    full :func:`validate_checkpoint` crc pass over EVERY recorded shard
+    up front, before any leaf is materialised — the mode supervisor
+    restores use. Each byte is still read+checked exactly once (per-read
+    re-verification is skipped after the proactive pass).
     """
     _reset_load_stats()
     try:
@@ -551,6 +569,12 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
                 f"{directory}: metadata missing for process(es) "
                 f"{sorted(absent)} — a peer was killed before committing; "
                 f"its shards are not recoverable from this directory")
+    read_verify = bool(verify)
+    if verify == "proactive":
+        problem = validate_checkpoint(directory, checksums=True)
+        if problem is not None:
+            raise CheckpointCorruptError(problem)
+        read_verify = False  # every shard just passed a full crc pass
     flat_out: Dict[str, Any] = {}
     for key, rec in meta["leaves"].items():
         if rec["kind"] == "scalar":
@@ -559,7 +583,7 @@ def load_state(directory: str, shardings: Optional[Dict[str, Any]] = None,
         if rec["kind"] == "str":
             flat_out[key] = rec["value"]
             continue
-        reader = _LeafReader(directory, rec, verify=verify,
+        reader = _LeafReader(directory, rec, verify=read_verify,
                              max_cache_bytes=max_shard_cache_bytes)
         _LOAD_STATS["leaves"] += 1
         shape = tuple(rec["shape"])
@@ -904,13 +928,15 @@ class AutoCheckpoint:
         self.save(step, state)
         return True
 
-    def save(self, step: int, state: Any):
+    def save(self, step: int, state: Any,
+             extra_files: Optional[Dict[str, bytes]] = None):
         if self._pending is not None:
             self._pending.wait()
         directory = os.path.join(self.root, f"step_{step}")
         # save_state publishes atomically (staging dir + os.replace), so a
         # kill mid-save leaves only a .tmp-pt orphan — never a half dir
-        pending = save_state(state, directory, async_=self.async_save)
+        pending = save_state(state, directory, async_=self.async_save,
+                             extra_files=extra_files)
 
         if pending is None:
             self._gc()

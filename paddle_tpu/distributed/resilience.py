@@ -30,8 +30,11 @@ them so ``rpc``, ``ps.service``, ``launch.kv_server`` and
   model: a replica that stays alive but each matching call drags by a
   different, replayable amount), ``crash`` (``os._exit(CRASH_EXIT)`` —
   the process dies as hard as a SIGKILL, no atexit/finally),
-  ``partition`` (a contiguous outage window of calls). All randomness is
-  seeded per rule,
+  ``partition`` (a contiguous outage window of calls), ``bitflip``
+  (raise :class:`InjectedBitflip` — the owner of the site flips one
+  seeded bit in one rank's physical tensor copies: the
+  silent-data-corruption model of ``distributed/integrity.py``). All
+  randomness is seeded per rule,
   so a plan replays identically. Activating a plan (``with plan:`` or
   ``plan.install(env=True)``) also exports it via the ``PT_FAULT_PLAN``
   env var, so subprocesses spawned under the plan inherit it.
@@ -52,9 +55,9 @@ from typing import Callable, List, Optional, Sequence, Tuple, Type, Union
 
 __all__ = [
     "RetryPolicy", "Unavailable", "with_timeout", "Deadline",
-    "FaultPlan", "FaultRule", "InjectedFault", "fault_point",
-    "active_plan", "CRASH_EXIT", "FAULT_PLAN_ENV",
-    "EXIT_PREEMPTED", "EXIT_HANG",
+    "FaultPlan", "FaultRule", "InjectedFault", "InjectedBitflip",
+    "fault_point", "active_plan", "CRASH_EXIT", "FAULT_PLAN_ENV",
+    "EXIT_PREEMPTED", "EXIT_HANG", "EXIT_EVICTED",
 ]
 
 # Exit codes of the self-healing training layer (framework/supervisor.py).
@@ -66,6 +69,12 @@ __all__ = [
 # the budget — a hang may be a real bug, not an infra blip).
 EXIT_PREEMPTED = 44
 EXIT_HANG = 45
+# the integrity escalation ladder convicted a host of sticky silent data
+# corruption (distributed/integrity.py): the quarantine record is already
+# durable next to the checkpoints, and the launcher restarts the job on the
+# surviving capacity — elastic_mesh absorbs the shrink like a preemption,
+# but the restart DOES charge the budget (a conviction names real hardware)
+EXIT_EVICTED = 46
 
 
 class Deadline:
@@ -228,6 +237,25 @@ class InjectedFault(ConnectionError):
     like a real transport failure."""
 
 
+class InjectedBitflip(InjectedFault):
+    """An injected ``bitflip`` fault: the raising site's OWNER must flip
+    one bit of tensor ``tensor`` in the physical copies held by vote-axis
+    rank ``rank`` (``distributed.integrity.apply_bitflip`` is the
+    canonical realiser). ``draw`` is a per-activation seeded integer —
+    the realiser derives every remaining choice (which matching tensor,
+    which element, which bit) from it, so a plan replays the identical
+    corruption. Subclasses :class:`InjectedFault` so a site without
+    tensor context degrades to an ordinary transport-failure drop."""
+
+    def __init__(self, message: str, *, tensor: Optional[str] = None,
+                 rank: int = 0, bit: Optional[int] = None, draw: int = 0):
+        super().__init__(message)
+        self.tensor = tensor
+        self.rank = int(rank)
+        self.bit = bit
+        self.draw = int(draw)
+
+
 @dataclass
 class FaultRule:
     """One fault at matching call sites.
@@ -248,6 +276,12 @@ class FaultRule:
     - ``crash``: ``os._exit(CRASH_EXIT)`` — no cleanup, like SIGKILL.
     - ``partition``: every matching call in ``[after, after+times)`` fails
       (contiguous outage window; ``times=None`` = never heals).
+    - ``bitflip``: raise :class:`InjectedBitflip` carrying ``tensor``
+      (fnmatch pattern over parameter names), ``rank`` (vote-axis rank
+      whose physical copies get corrupted) and ``bit`` (``None`` = seeded
+      draw) — silent-data-corruption injection. The site's owner realises
+      the flip (``integrity.apply_bitflip``); ``times=1`` models a
+      transient cosmic-ray hit, ``times=None`` a sticky lying chip.
     """
 
     site: str
@@ -256,8 +290,11 @@ class FaultRule:
     prob: float = 1.0
     delay: float = 0.05
     after: int = 0
+    tensor: Optional[str] = None   # bitflip: parameter-name pattern
+    rank: int = 0                  # bitflip: vote-axis rank to corrupt
+    bit: Optional[int] = None      # bitflip: fixed bit (None = seeded)
 
-    _KINDS = ("drop", "delay", "slow", "crash", "partition")
+    _KINDS = ("drop", "delay", "slow", "crash", "partition", "bitflip")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -296,7 +333,9 @@ class FaultPlan:
         return json.dumps({
             "seed": self.seed,
             "rules": [{"site": r.site, "kind": r.kind, "times": r.times,
-                       "prob": r.prob, "delay": r.delay, "after": r.after}
+                       "prob": r.prob, "delay": r.delay, "after": r.after,
+                       **({"tensor": r.tensor, "rank": r.rank,
+                           "bit": r.bit} if r.kind == "bitflip" else {})}
                       for r in self.rules]})
 
     @classmethod
@@ -361,12 +400,21 @@ class FaultPlan:
                 # the RNG lives under the lock (prob draws share it);
                 # the sleep itself happens after release
                 sleep_s = rule.delay
+                draw = 0
                 if rule.kind == "slow":
                     sleep_s = rule.delay * (0.5 + self._rngs[i].random())
+                elif rule.kind == "bitflip":
+                    draw = self._rngs[i].randrange(1 << 31)
             if rule.kind in ("delay", "slow"):
                 time.sleep(sleep_s)
             elif rule.kind == "crash":
                 os._exit(CRASH_EXIT)
+            elif rule.kind == "bitflip":
+                raise InjectedBitflip(
+                    f"injected bitflip at {site} "
+                    f"(rule {i}, hit {self.fired[i]}, rank {rule.rank})",
+                    tensor=rule.tensor, rank=rule.rank, bit=rule.bit,
+                    draw=draw)
             else:  # drop / partition
                 raise InjectedFault(
                     f"injected {rule.kind} at {site} "

@@ -285,15 +285,61 @@ class DistributedTrainStep(StepSeams):
                                  static_argnames=("do_update",))
         self._donate_argnums = donate_argnums
         self._compiled_checked = None
+        # silent-data-corruption defense (distributed/integrity.py):
+        # None = off, and the traced programs stay bit-identical to a
+        # build without the feature (with_fp is never passed)
+        self._integrity = None
+        self._fp_compiled = None
+        self._last_fp = None
+
+    def enable_integrity(self, vote_axis="dp"):
+        """Turn on in-program cross-replica fingerprints (``None``
+        disables). The checked/scaler step specializations are rebuilt so
+        they emit an extra lazy ``uint32[vote_size, 1 + n_buckets]``
+        output; :meth:`take_fingerprint` hands it to the supervisor's
+        :class:`~paddle_tpu.distributed.integrity.IntegrityMonitor`
+        without forcing a host sync. Returns the checker (or ``None``)."""
+        from .integrity import IntegrityChecker
+
+        if vote_axis is None:
+            self._integrity = None
+        else:
+            self._integrity = IntegrityChecker(
+                self.mesh, vote_axis, param_specs=self.specs,
+                opt_specs=self.opt_specs, grad_specs=self._reduce_specs,
+                buckets=self._buckets)
+        self._compiled_checked = None
+        self._fp_compiled = None
+        self._last_fp = None
+        return self._integrity
+
+    def take_fingerprint(self):
+        """The last checked call's lazy fingerprint array (once)."""
+        fp, self._last_fp = self._last_fp, None
+        return fp
 
     def _checked_compiled(self):
         import functools
 
         if self._compiled_checked is None:
+            kwargs = ({"with_check": True, "with_fp": True}
+                      if self._integrity is not None
+                      else {"with_check": True})
             self._compiled_checked = jax.jit(
-                functools.partial(self._traced, with_check=True),
+                functools.partial(self._traced, **kwargs),
                 donate_argnums=self._donate_argnums)
         return self._compiled_checked
+
+    def _scaler_compiled(self):
+        import functools
+
+        if self._integrity is None:
+            return self._compiled
+        if self._fp_compiled is None:
+            self._fp_compiled = jax.jit(
+                functools.partial(self._traced, with_fp=True),
+                donate_argnums=self._donate_argnums)
+        return self._fp_compiled
 
     def cache_stats(self) -> dict:
         from ..framework import compile_cache
@@ -335,7 +381,8 @@ class DistributedTrainStep(StepSeams):
         return out
 
     def _step(self, params, buffers, opt_state, accum, scaler_state, batch,
-              key, count, poison, with_check=False, do_update=True):
+              key, count, poison, with_check=False, do_update=True,
+              with_fp=False):
         from ..framework.jit import (accumulate_grads, finite_guard,
                                      merge_accumulated, split_rng_streams)
 
@@ -404,14 +451,23 @@ class DistributedTrainStep(StepSeams):
                     loss, found, scaler_state,
                     (new_params, new_buffers, new_opt_state),
                     (params, buffers, opt_state))
-            return (loss, new_params, new_buffers, new_opt_state, accum,
-                    new_scaler_state, ok, found_inf)
+            out = (loss, new_params, new_buffers, new_opt_state, accum,
+                   new_scaler_state, ok, found_inf)
+            if with_fp:
+                # fingerprint the GUARDED state the step actually keeps
+                out += (self._integrity.fingerprints(
+                    new_params, new_opt_state, grads),)
+            return out
         if with_check:
             ok, (new_params, new_buffers, new_opt_state) = finite_guard(
                 grads, (new_params, new_buffers, new_opt_state),
                 (params, buffers, opt_state), extra_ok=jnp.isfinite(loss))
-            return (loss, new_params, new_buffers, new_opt_state, accum,
-                    scaler_state, ok, jnp.zeros((), jnp.bool_))
+            out = (loss, new_params, new_buffers, new_opt_state, accum,
+                   scaler_state, ok, jnp.zeros((), jnp.bool_))
+            if with_fp:
+                out += (self._integrity.fingerprints(
+                    new_params, new_opt_state, grads),)
+            return out
         return loss, new_params, new_buffers, new_opt_state, accum, scaler_state
 
     def _put_batch(self, batch):
@@ -429,20 +485,26 @@ class DistributedTrainStep(StepSeams):
 
     def _checked_call(self, batch, count, poison):
         if self.scaler_state is not None:
+            out = self._scaler_compiled()(
+                self.params, self.buffers, self.opt_state,
+                self._grad_accum, self.scaler_state, batch,
+                self._base_key, count, poison)
+            if self._integrity is not None:
+                *out, self._last_fp = out
             (loss, self.params, self.buffers, self.opt_state,
-             self._grad_accum, self.scaler_state, ok, found) = \
-                self._compiled(self.params, self.buffers, self.opt_state,
-                               self._grad_accum, self.scaler_state, batch,
-                               self._base_key, count, poison)
+             self._grad_accum, self.scaler_state, ok, found) = out
             if self.scaler is not None:
                 self.scaler._note_step(found)
                 self.scaler.state = dict(self.scaler_state)
             return loss, ok, found
+        out = self._checked_compiled()(self.params, self.buffers,
+                                       self.opt_state, self._grad_accum,
+                                       None, batch, self._base_key, count,
+                                       poison)
+        if self._integrity is not None:
+            *out, self._last_fp = out
         (loss, self.params, self.buffers, self.opt_state, self._grad_accum,
-         _, ok, found) = \
-            self._checked_compiled()(self.params, self.buffers,
-                                     self.opt_state, self._grad_accum, None,
-                                     batch, self._base_key, count, poison)
+         _, ok, found) = out
         return loss, ok, found
 
     def watchdog_call(self, batch):

@@ -32,11 +32,26 @@ this module supervises the step itself:
   without charging ``--max_restarts``.
 
 Fault sites: the loop is instrumented with ``train.step`` / ``train.ckpt``
-/ ``train.data`` fault points, so a seeded
+/ ``train.data`` / ``train.bitflip`` fault points, so a seeded
 :class:`~paddle_tpu.distributed.resilience.FaultPlan` can stall steps,
 crash saves, or poison batches (``drop`` at ``train.data`` is translated
-into ``step.inject_anomaly()`` — a NaN-poisoned loss). ``tools/
-chaos_soak.py`` drives a full kill/stall/NaN soak through these sites.
+into ``step.inject_anomaly()`` — a NaN-poisoned loss; ``bitflip`` at
+``train.bitflip`` flips one bit in one replica's physical tensor copies
+via ``distributed.integrity.apply_bitflip`` — silent corruption only the
+cross-replica fingerprint vote can see). ``tools/chaos_soak.py`` drives a
+full kill/stall/NaN soak through these sites; ``tools/sdc_drill.py``
+drives the silent-data-corruption escalation ladder.
+
+Silent-data-corruption defense (``integrity_check_interval`` set): the
+step emits lazy per-replica fingerprints, an
+:class:`~paddle_tpu.distributed.integrity.IntegrityMonitor` votes on them
+batched with the watchdog flush, and the supervisor escalates
+suspect -> deterministic replay (existing rollback machinery; transient
+faults are discarded with the replayed steps) -> conviction -> durable
+quarantine record + :class:`~paddle_tpu.distributed.integrity.
+HostEvictionRequested` so the launcher restarts on surviving capacity
+through the elastic-mesh reshard path. Defaults off — the step programs
+are bit-identical to a build without the feature.
 """
 from __future__ import annotations
 
@@ -48,15 +63,18 @@ import warnings
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Set, Tuple
 
+from ..distributed.integrity import HostEvictionRequested  # noqa: F401
 from ..distributed.resilience import (  # noqa: F401  (EXIT_* re-exported)
-    Deadline, EXIT_HANG, EXIT_PREEMPTED, InjectedFault, fault_point)
+    Deadline, EXIT_EVICTED, EXIT_HANG, EXIT_PREEMPTED, InjectedBitflip,
+    InjectedFault, fault_point)
 from ..observability import flight as _flight
 from ..observability import tracing as _tracing
 
 __all__ = [
     "RecoveryPolicy", "TrainingSupervisor", "NumericsWatchdog",
     "HangWatchdog", "PreemptionHandler", "TrainingPreempted",
-    "RollbackRequested", "EXIT_PREEMPTED", "EXIT_HANG",
+    "RollbackRequested", "HostEvictionRequested",
+    "EXIT_PREEMPTED", "EXIT_HANG", "EXIT_EVICTED",
 ]
 
 
@@ -105,6 +123,17 @@ class RecoveryPolicy:
     - ``grace_seconds``: preemption grace budget (``resilience.Deadline``).
     - ``async_save``: overlap checkpoint IO with training (sync saves make
       kill-based tests deterministic).
+    - ``integrity_check_interval``: silent-data-corruption defense —
+      cross-replica fingerprint vote every N checked steps (``None`` =
+      off, the default: step programs stay bit-identical to a build
+      without the feature).
+    - ``integrity_vote_axis``: mesh axis along which state must be
+      bit-identical across replicas (leaves sharded over it — ZeRO
+      shards — are excluded with coverage accounting).
+    - ``integrity_forgive_after``: clean flushes after a replay before
+      the armed suspect is forgiven as a transient fault.
+    - ``integrity_ledger``: write/verify the per-save fingerprint record
+      (``integrity.json``) next to ``metadata.json``.
     """
 
     checkpoint_dir: str
@@ -119,6 +148,10 @@ class RecoveryPolicy:
     hang_action: str = "warn"
     preemption: bool = True
     grace_seconds: float = 30.0
+    integrity_check_interval: Optional[int] = None
+    integrity_vote_axis: str = "dp"
+    integrity_forgive_after: int = 2
+    integrity_ledger: bool = True
 
 
 class NumericsWatchdog:
@@ -362,6 +395,22 @@ class TrainingSupervisor:
                      if policy.step_timeout else None)
         self.preempt = (PreemptionHandler(policy.grace_seconds)
                         if policy.preemption else None)
+        self.integrity = None
+        if policy.integrity_check_interval:
+            enable = getattr(step, "enable_integrity", None)
+            if enable is None:
+                warnings.warn(
+                    "integrity_check_interval is set but this step type "
+                    "has no enable_integrity() (per-replica fingerprints "
+                    "need a device mesh); silent-data-corruption checks "
+                    "are disabled for this run", RuntimeWarning)
+            else:
+                from ..distributed.integrity import IntegrityMonitor
+
+                enable(policy.integrity_vote_axis)
+                self.integrity = IntegrityMonitor(
+                    policy.integrity_check_interval,
+                    forgive_after=policy.integrity_forgive_after)
         # cursor_fn supplies the CURRENT input-pipeline position (the NEXT
         # batch) whenever a checkpoint is cut mid-run
         self.cursor_fn = cursor_fn
@@ -435,6 +484,7 @@ class TrainingSupervisor:
         from ..distributed.checkpoint import (_STEP_DIR,
                                               CheckpointCorruptError,
                                               latest_checkpoint, load_state)
+        from ..distributed.integrity import ledger_problem, verify_ledger
         from ..io.cursor import DataCursor
 
         tried = []
@@ -443,8 +493,28 @@ class TrainingSupervisor:
                                      on_invalid=tried.append)
             if path is None:
                 return None
+            # a checkpoint whose integrity ledger says the replicas had
+            # already diverged at save time is poisoned regardless of its
+            # crcs — reject it (with the suspect rank named) before
+            # reading a byte of state
+            prob = ledger_problem(path)
+            if prob is not None:
+                warnings.warn(
+                    f"checkpoint rejected by integrity ledger: {prob}; "
+                    f"falling back to the next newest complete checkpoint",
+                    RuntimeWarning)
+                tried.append(path)
+                continue
             try:
-                flat = load_state(path, shardings=self._shardings())
+                # "proactive": every recorded shard is crc-verified up
+                # front, not just the slices this topology's devices ask
+                # for — supervisor restores must not trust lazy reads
+                flat = load_state(path, shardings=self._shardings(),
+                                  verify="proactive")
+                if self.integrity is not None:
+                    prob = verify_ledger(path, flat)
+                    if prob is not None:
+                        raise CheckpointCorruptError(prob)
                 # only a load that SUCCEEDED counts as a reshard — skipped
                 # candidates must not bump the counter or log a resize
                 self._report_reshard(path)
@@ -500,6 +570,11 @@ class TrainingSupervisor:
 
     def save_now(self, cursor=None) -> None:
         """Cut a checkpoint at the current step, recording the cursor."""
+        if self.integrity is not None:
+            # never cut a checkpoint over unverified state: drain the
+            # fingerprint window first — a divergence raises (replay/
+            # convict) BEFORE any poisoned bytes reach disk
+            self._flush_watchdog()
         if self.hang is not None:
             self.hang.pause()   # a slow (sync) save is not a hung step
         fault_point("train.ckpt")
@@ -508,7 +583,15 @@ class TrainingSupervisor:
             self.cursor_fn() if self.cursor_fn is not None else None)
         if cursor is not None:
             state["data_cursor"] = cursor.as_state()
-        self.checkpoint.save(int(self.step._count), state)
+        extra_files = None
+        if self.integrity is not None and self.policy.integrity_ledger:
+            from ..distributed.integrity import (LEDGER_FILE,
+                                                 build_ledger_bytes)
+
+            extra_files = {LEDGER_FILE: build_ledger_bytes(
+                state, int(self.step._count), self.integrity)}
+        self.checkpoint.save(int(self.step._count), state,
+                             extra_files=extra_files)
 
     def maybe_save(self, cursor=None) -> bool:
         if not self.checkpoint._due(int(self.step._count)):
@@ -542,6 +625,20 @@ class TrainingSupervisor:
             f"train-{os.getpid():x}-s{int(self.step._count)}")
         fault_point("train.step")
         try:
+            fault_point("train.bitflip")
+        except InjectedBitflip as f:
+            # silent corruption: one bit in ONE replica's physical copies
+            # of a parameter — the logical value is untouched and the
+            # numerics watchdog stays blind; only the fingerprint vote
+            # (integrity_check_interval) can catch it
+            from ..distributed.integrity import apply_bitflip
+
+            apply_bitflip(self.step, f)
+        except InjectedFault:
+            # a non-bitflip kind at this site (sweep matrix coverage):
+            # degrade to the NaN poison seam like train.data
+            self.step.inject_anomaly()
+        try:
             fault_point("train.data")
         except InjectedFault:
             self.step.inject_anomaly()
@@ -556,7 +653,12 @@ class TrainingSupervisor:
         if self.hang is not None:
             self.hang.beat()
         self.watchdog.observe(epoch, batch_index, loss, ok, found)
-        if self.watchdog.due:
+        if self.integrity is not None and ok is not None:
+            fp = self.step.take_fingerprint()
+            if fp is not None:
+                self.integrity.observe(int(self.step._count), fp)
+        if self.watchdog.due or (self.integrity is not None
+                                 and self.integrity.due):
             self._flush_watchdog()
         if self.maybe_save(cursor) and self.hang is not None:
             # a (possibly synchronous) checkpoint save is not a hung step
@@ -590,6 +692,11 @@ class TrainingSupervisor:
                                  "loss": loss})
         if self.watchdog.should_rollback:
             self._rollback()
+        if self.integrity is not None:
+            with RecordEvent("integrity_sync"):
+                verdict = self.integrity.flush()
+            if verdict is not None:
+                self._handle_integrity(verdict)
 
     def _rollback(self) -> None:
         from .. import profiler
@@ -624,6 +731,10 @@ class TrainingSupervisor:
             cursor = self.restore()
         self.watchdog.consecutive = 0
         self.watchdog.first_bad = None
+        if self.integrity is not None:
+            # fingerprints of steps this rollback replays would re-report
+            # pre-restore divergence — forget them
+            self.integrity.drop_pending()
         self._skip |= skip
         print(f"[supervisor] rollback #{self.rollbacks}: replaying from "
               f"{'checkpoint' if cursor is not None else 'current position'}"
@@ -633,6 +744,87 @@ class TrainingSupervisor:
             self.on_rollback({"rollbacks": self.rollbacks,
                               "cursor": cursor, "skip": sorted(skip)})
         raise RollbackRequested(cursor, skip)
+
+    # --------------------------------------------- the escalation ladder
+    def _handle_integrity(self, verdict: dict) -> None:
+        """suspect -> deterministic replay -> convict -> quarantine+evict.
+
+        ``verdict`` comes from :meth:`IntegrityMonitor.flush`. A first
+        divergence arms the suspect and replays deterministically from
+        the last consistent checkpoint (a transient flip will not recur
+        — the poisoned steps are simply discarded with the rollback); a
+        suspect that diverges AGAIN after its replay is convicted and the
+        host is evicted through the elastic machinery."""
+        rank, step_no = verdict.get("rank"), verdict["step"]
+        warnings.warn(
+            f"integrity: cross-replica fingerprint divergence at step "
+            f"{step_no} (suspect rank: {rank}); escalating to "
+            f"{verdict['action']}", RuntimeWarning)
+        _tracing.record_event("train:integrity_mismatch", step=step_no,
+                              rank=rank)
+        _flight.note("integrity_mismatch", corr=_tracing.current(),
+                     step=step_no, rank=rank, action=verdict["action"])
+        if verdict["action"] == "convict" and rank is not None:
+            self._convict(verdict)
+        else:
+            self._integrity_replay(verdict)
+
+    def _integrity_replay(self, verdict: dict) -> None:
+        from .. import profiler
+        from ..observability.registry import default_registry
+        from ..profiler import RecordEvent
+
+        default_registry().inc("integrity.replay")
+        profiler.bump_counter("train.integrity_replay")
+        if self.hang is not None:
+            self.hang.pause()
+        self.rollbacks += 1
+        profiler.bump_counter("train.rollback")
+        if self.rollbacks > self.policy.max_rollbacks:
+            raise FloatingPointError(
+                f"integrity: {self.rollbacks} rollbacks exceeded "
+                f"max_rollbacks={self.policy.max_rollbacks}; replicas "
+                f"keep diverging without an attributable culprit")
+        with RecordEvent("integrity_replay"):
+            self.checkpoint.wait()
+            cursor = self.restore()
+        # the replay is bit-deterministic: the per-step RNG is
+        # fold_in(base_key, count) and the restored cursor replays the
+        # same batches — a transient flip cannot recur, a sticky one
+        # diverges again and the armed suspect is convicted next flush.
+        # (With no checkpoint yet, restore() leaves state in place: the
+        # corruption persists and the sticky path convicts — by design.)
+        print(f"[supervisor] integrity replay #{self.rollbacks}: suspect "
+              f"rank {verdict.get('rank')} diverged at step "
+              f"{verdict['step']}; replaying from "
+              f"{'checkpoint' if cursor is not None else 'current position'}",
+              flush=True)
+        if self.on_rollback is not None:
+            self.on_rollback({"rollbacks": self.rollbacks, "cursor": cursor,
+                              "skip": [], "integrity": dict(verdict)})
+        raise RollbackRequested(cursor, set())
+
+    def _convict(self, verdict: dict) -> None:
+        from .. import profiler
+        from ..distributed.integrity import record_conviction
+        from ..observability.registry import default_registry
+
+        rank, step_no = int(verdict["rank"]), int(verdict["step"])
+        default_registry().inc("integrity.evicted")
+        profiler.bump_counter("train.integrity_evicted")
+        record = {"rank": rank, "step": step_no,
+                  "fingerprints": verdict.get("fingerprints"),
+                  "time": time.time(), "pid": os.getpid()}
+        # durable BEFORE the dump/raise: the record is what the next
+        # incarnation reads to boot on surviving capacity
+        path = record_conviction(self.checkpoint.root, record)
+        _flight.dump("integrity_conviction", corr=_tracing.current(),
+                     extra=record)
+        print(f"[supervisor] integrity conviction: rank {rank} diverged "
+              f"again after a deterministic replay (sticky fault); "
+              f"quarantine recorded at {path} — evicting via elastic "
+              f"restart", flush=True)
+        raise HostEvictionRequested(rank, step_no, path)
 
     def _handle_preemption(self, cursor=None) -> None:
         from .. import profiler

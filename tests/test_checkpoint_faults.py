@@ -77,6 +77,71 @@ def test_flipped_bytes_detected_and_skipped(tmp_path):
     load_state(d2, verify=False)
 
 
+def test_proactive_verify_rejects_corrupt_shard_up_front(tmp_path):
+    # supervisor restores use verify="proactive": EVERY recorded shard is
+    # crc-checked before a byte of state is constructed — not just the
+    # slices this topology's devices happen to read lazily
+    root = str(tmp_path)
+    _two_checkpoints(root)
+    d2 = os.path.join(root, "step_2")
+    victim = os.path.join(d2, _shard_files(d2)[0])
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size - 2)
+        chunk = f.read(2)
+        f.seek(size - 2)
+        f.write(bytes(b ^ 0x01 for b in chunk))   # one-bit rot, same length
+    with pytest.raises(CheckpointCorruptError, match="crc32"):
+        load_state(d2, verify="proactive")
+    # the message names the poisoned shard file for the operator
+    try:
+        load_state(d2, verify="proactive")
+    except CheckpointCorruptError as e:
+        assert os.path.basename(victim) in str(e)
+    # a clean sibling loads identically under both verify modes
+    d1 = os.path.join(root, "step_1")
+    lazy, proactive = load_state(d1), load_state(d1, verify="proactive")
+    np.testing.assert_array_equal(lazy["w"], proactive["w"])
+    np.testing.assert_array_equal(lazy["b"], proactive["b"])
+
+
+def test_supervisor_restore_falls_back_past_corrupt_shard(tmp_path):
+    # the end-to-end regression: a FakeStep supervisor restore must skip
+    # the bit-rotted newest checkpoint and land on the older valid one
+    from paddle_tpu.framework.supervisor import (RecoveryPolicy,
+                                                 TrainingSupervisor)
+
+    class Step:
+        _count = 0
+
+        def state_dict(self):
+            return {"w": np.full(4, float(self._count), np.float32),
+                    "count": np.asarray(self._count)}
+
+        def set_state_dict(self, state):
+            self._count = int(np.asarray(state["count"]))
+
+    root = str(tmp_path / "ckpt")
+    step = Step()
+    sup = TrainingSupervisor(step, RecoveryPolicy(
+        checkpoint_dir=root, save_interval_steps=1, keep_max=4,
+        async_save=False, preemption=False))
+    sup.save_now()
+    step._count = 1
+    sup.save_now()
+    d1 = os.path.join(root, "step_1")
+    victim = os.path.join(d1, _shard_files(d1)[0])
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size - 1)
+        last = f.read(1)
+        f.seek(size - 1)
+        f.write(bytes([last[0] ^ 0x01]))
+    step._count = 99
+    sup.restore()
+    assert step._count == 0                      # fell back to step_0
+
+
 def test_missing_metadata_detected_and_skipped(tmp_path):
     root = str(tmp_path)
     _two_checkpoints(root)

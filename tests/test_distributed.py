@@ -339,6 +339,7 @@ def test_moe_alltoall_ep2_parity(mesh8):
     set_mesh(None)
 
 
+@pytest.mark.slow  # heaviest tier-1 test (~14s); ep2 parity coverage stays fast
 def test_moe_alltoall_ep8_trains(mesh8):
     """Large-E regime on the full virtual mesh: ep=8, E=16 — forward,
     grads, and capacity-drop path all exercised."""

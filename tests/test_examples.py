@@ -12,11 +12,17 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CASES = [
-    ("gpt_pretrain.py", ["loss", "tokens/s", "saved"]),
-    ("hybrid_parallel.py", ["loss", "PartitionSpec"]),
-    ("ps_ctr_train.py", ["table rows 500"]),
-    ("graph_deepwalk.py", ["cosine same-clique"]),
-    ("export_serving.py", ["matches the eager model", "decode engine: "]),
+    # Heaviest demo (~13s): tier-1 time budget pushed it behind `slow`.
+    pytest.param("gpt_pretrain.py", ["loss", "tokens/s", "saved"],
+                 marks=pytest.mark.slow, id="gpt_pretrain"),
+    pytest.param("hybrid_parallel.py", ["loss", "PartitionSpec"],
+                 id="hybrid_parallel"),
+    pytest.param("ps_ctr_train.py", ["table rows 500"], id="ps_ctr_train"),
+    pytest.param("graph_deepwalk.py", ["cosine same-clique"],
+                 id="graph_deepwalk"),
+    pytest.param("export_serving.py",
+                 ["matches the eager model", "decode engine: "],
+                 id="export_serving"),
 ]
 
 _outputs = {}
@@ -35,8 +41,7 @@ def _run_once(script: str) -> str:
     return _outputs[script]
 
 
-@pytest.mark.parametrize("script,expect", CASES,
-                         ids=[c[0].removesuffix(".py") for c in CASES])
+@pytest.mark.parametrize("script,expect", CASES)
 def test_example_runs(script, expect):
     out = _run_once(script)
     for needle in expect:

@@ -1,0 +1,396 @@
+"""Silent-data-corruption defense: fingerprints, the cross-replica vote,
+the escalation ladder, and the checkpoint integrity ledger.
+
+Everything here is stub-based and single-device — numpy fingerprints
+drive the monitor, a scripted FakeStep drives the supervisor ladder —
+so the module stays far under the tier-1 time budget. The real
+multi-replica vote (shard_map over a dp4 x mp2 mesh, physical-copy
+corruption, eviction + reduced-topology resume) lives in
+``tools/sdc_drill.py``, gated as ``robustness_gate.py --sdc``.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed import integrity
+from paddle_tpu.distributed.integrity import (
+    LEDGER_FILE, HostEvictionRequested, IntegrityMonitor, build_ledger,
+    build_ledger_bytes, combine_folds, coverage_split, flip_bit,
+    fold_leaf, host_fold_leaf, ledger_problem, load_quarantine,
+    minority_ranks, read_ledger, record_conviction, verify_ledger)
+from paddle_tpu.distributed.resilience import (
+    EXIT_EVICTED, FaultPlan, InjectedBitflip)
+
+
+# ===================================================== fold primitives
+@pytest.mark.parametrize("arr", [
+    np.linspace(-3, 3, 24, dtype=np.float32).reshape(4, 6),
+    np.arange(-5, 7, dtype=np.int32),
+    np.array([True, False, True]),
+    np.linspace(-1, 1, 10, dtype=np.float16),
+    np.arange(6, dtype=np.int64).reshape(2, 3),
+])
+def test_host_fold_matches_device_fold(arr):
+    # the ledger is written by the HOST fold and verified against leaves
+    # fingerprinted by the DEVICE fold — they must agree to the bit
+    assert host_fold_leaf(arr) == int(fold_leaf(jnp.asarray(arr)))
+
+
+def test_fold_sees_a_single_bit():
+    a = np.linspace(-2, 2, 32, dtype=np.float32)
+    b = a.copy()
+    b.view(np.uint32)[17] ^= np.uint32(1)   # lowest mantissa bit
+    assert host_fold_leaf(a) != host_fold_leaf(b)
+    assert int(fold_leaf(jnp.asarray(a))) != int(fold_leaf(jnp.asarray(b)))
+
+
+def test_fold_is_position_weighted():
+    # a plain modular sum would miss two swapped elements
+    a = np.array([1.0, 2.0, 3.0], np.float32)
+    b = np.array([2.0, 1.0, 3.0], np.float32)
+    assert host_fold_leaf(a) != host_fold_leaf(b)
+
+
+def test_combine_folds_key_sensitive_and_order_free():
+    folds = {"w": 123, "b": 456}
+    assert combine_folds(folds) == combine_folds(
+        dict(reversed(list(folds.items()))))   # canonical key order
+    assert combine_folds({"w": 123, "b": 456}) != combine_folds(
+        {"w": 456, "b": 123})                  # fold-to-key binding
+
+
+# ================================================= vote + coverage math
+def test_minority_ranks_names_the_deviant():
+    fps = np.array([[7, 9], [7, 9], [7, 9], [7, 9]], np.uint32)
+    assert minority_ranks(fps) == []
+    fps[2, 0] ^= 1
+    assert minority_ranks(fps) == [2]
+
+
+def test_minority_ranks_no_majority_blames_everyone():
+    # a 2v2 split has no quorum: every rank is suspect, and the monitor
+    # escalates with rank=None (replay, never a conviction)
+    fps = np.array([[1], [1], [2], [2]], np.uint32)
+    assert minority_ranks(fps) == [0, 1, 2, 3]
+
+
+def test_minority_ranks_any_column_counts():
+    fps = np.array([[5, 5], [5, 5], [5, 6]], np.uint32)
+    assert minority_ranks(fps) == [2]
+
+
+def test_coverage_split_excludes_sharded_leaves():
+    specs = {"w": P(None, "mp"), "b": P(), "z": P("dp"), "n": None}
+    covered, uncovered = coverage_split(specs, "dp")
+    # a leaf sharded over the vote axis has no cross-replica redundancy:
+    # every replica holds a DIFFERENT slice, so equality says nothing
+    assert set(covered) == {"w", "b", "n"}
+    assert set(uncovered) == {"z"}
+
+
+# ==================================================== monitor ladder
+def _fp(*rows):
+    return np.asarray(rows, np.uint32)
+
+
+def test_monitor_clean_window_is_silent():
+    mon = IntegrityMonitor(check_interval=2)
+    assert not mon.due
+    mon.observe(1, _fp([3, 4], [3, 4]))
+    mon.observe(2, _fp([5, 6], [5, 6]))
+    assert mon.due
+    assert mon.flush() is None
+    assert mon.stats()["mismatches"] == 0 and mon.stats()["pending"] == 0
+
+
+def test_monitor_replay_then_convict_same_rank():
+    mon = IntegrityMonitor(check_interval=1)
+    v = mon.flush()
+    assert v is None                      # nothing pending
+    mon.observe(5, _fp([3, 4], [3, 4], [9, 4]))
+    v = mon.flush()
+    assert v == {"action": "replay", "rank": 2, "step": 5,
+                 "fingerprints": [[3, 4], [3, 4], [9, 4]]}
+    assert mon.stats()["replays"] == 1
+    # the SAME rank diverging again after the deterministic replay is a
+    # sticky fault: escalate to conviction
+    mon.observe(6, _fp([3, 4], [3, 4], [8, 4]))
+    v = mon.flush()
+    assert v["action"] == "convict" and v["rank"] == 2
+    assert mon.stats()["convictions"] == 1
+
+
+def test_monitor_forgives_a_transient_after_clean_flushes():
+    mon = IntegrityMonitor(check_interval=1, forgive_after=2)
+    mon.observe(5, _fp([3], [9], [3]))
+    assert mon.flush()["action"] == "replay"
+    assert mon.armed == (1, 5)
+    for step in (6, 7):
+        mon.observe(step, _fp([4], [4], [4]))
+        assert mon.flush() is None
+    assert mon.armed is None and mon.stats()["suspect"] is None
+    # a LATER flip is a fresh transient, not a conviction
+    mon.observe(8, _fp([5], [6], [5]))
+    assert mon.flush()["action"] == "replay"
+
+
+def test_monitor_different_rank_is_a_new_replay_not_a_conviction():
+    mon = IntegrityMonitor(check_interval=1)
+    mon.observe(1, _fp([9], [3], [3]))
+    assert mon.flush()["rank"] == 0
+    mon.observe(2, _fp([3], [9], [3]))
+    v = mon.flush()
+    assert v["action"] == "replay" and v["rank"] == 1
+    assert mon.stats()["convictions"] == 0
+
+
+def test_monitor_drop_pending_forgets_rolled_back_steps():
+    mon = IntegrityMonitor(check_interval=4)
+    mon.observe(1, _fp([1], [2]))
+    mon.drop_pending()
+    assert mon.flush() is None and mon.stats()["mismatches"] == 0
+
+
+# ======================================================== injection
+def test_flip_bit_changes_exactly_one_bit_deterministically():
+    import random
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    base = jnp.asarray(np.linspace(-1, 1, 12, dtype=np.float32))
+    arr1, info1 = flip_bit(base, mesh, "dp", 0, rng=random.Random(7))
+    arr2, info2 = flip_bit(base, mesh, "dp", 0, rng=random.Random(7))
+    assert info1 == info2                       # seeded draw is replayable
+    a, b = np.asarray(base), np.asarray(arr1)
+    diff = a.view(np.uint32) ^ b.view(np.uint32)
+    assert np.count_nonzero(diff) == 1
+    assert bin(int(diff.reshape(-1)[info1["element"]])).count("1") == 1
+    assert info1["bit"] < 23                    # f32 default: mantissa only
+    assert np.all(np.isfinite(b))               # numerics watchdog stays blind
+
+
+def test_bitflip_rule_roundtrip_and_injection():
+    plan = FaultPlan([{"site": "train.bitflip", "kind": "bitflip",
+                       "times": 1, "tensor": "*weight*", "rank": 2,
+                       "bit": 5}], seed=99)
+    again = FaultPlan.from_json(plan.to_json())
+    r = again.rules[0]
+    assert (r.kind, r.tensor, r.rank, r.bit) == ("bitflip", "*weight*", 2, 5)
+    with pytest.raises(InjectedBitflip) as ei:
+        again.check("train.bitflip")
+    assert ei.value.tensor == "*weight*" and ei.value.rank == 2
+    assert ei.value.bit == 5
+    again.check("train.bitflip")                # times=1: spent
+    assert EXIT_EVICTED == 46
+
+
+def test_apply_bitflip_without_mesh_degrades_to_anomaly():
+    class Bare:
+        def __init__(self):
+            self.poisoned = 0
+
+        def inject_anomaly(self):
+            self.poisoned += 1
+
+    step = Bare()
+    fault = InjectedBitflip("x", tensor="*", rank=0)
+    integrity.apply_bitflip(step, fault)
+    assert step.poisoned == 1
+
+
+# ============================================= ledger + quarantine
+def test_ledger_roundtrip_and_leaf_verification(tmp_path):
+    state = {"w": np.arange(6, dtype=np.float32),
+             "opt": {"m": np.ones(3, np.float32)}, "count": 7}
+    rec = build_ledger(state, step=7)
+    d = str(tmp_path)
+    with open(os.path.join(d, LEDGER_FILE), "wb") as f:
+        f.write(build_ledger_bytes(state, step=7))
+    assert read_ledger(d)["fingerprint"] == rec["fingerprint"]
+    assert ledger_problem(d) is None
+    flat = {"w": state["w"], "opt/m": state["opt"]["m"], "count": 7}
+    assert verify_ledger(d, flat) is None
+    flat["opt/m"] = np.full(3, 2.0, np.float32)   # bit rot after the crc
+    prob = verify_ledger(d, flat)
+    assert prob is not None and "opt/m" in prob
+
+
+def test_divergent_ledger_is_rejected_with_rank_named(tmp_path):
+    mon = IntegrityMonitor(check_interval=1)
+    mon.observe(3, _fp([1, 2], [1, 2], [9, 2]))
+    assert mon.flush()["rank"] == 2
+    with open(os.path.join(str(tmp_path), LEDGER_FILE), "wb") as f:
+        f.write(build_ledger_bytes({"w": np.ones(2, np.float32)}, 3, mon))
+    prob = ledger_problem(str(tmp_path))
+    assert prob is not None and "rank 2" in prob
+
+
+def test_missing_ledger_is_not_a_problem(tmp_path):
+    # pre-PR-20 checkpoints have no ledger; they must keep restoring
+    assert read_ledger(str(tmp_path)) is None
+    assert ledger_problem(str(tmp_path)) is None
+
+
+def test_quarantine_record_is_durable_and_appends(tmp_path):
+    root = str(tmp_path)
+    p = record_conviction(root, {"rank": 2, "step": 40})
+    record_conviction(root, {"rank": 5, "step": 90})
+    q = load_quarantine(root)
+    assert [r["rank"] for r in q["convicted"]] == [2, 5]
+    assert not glob.glob(p + ".tmp-*")     # staged write left no temp file
+
+
+def test_quarantine_staging_cleans_up_on_failure(tmp_path):
+    class Boom:
+        """json.dump walks into this and explodes mid-write."""
+
+        def __iter__(self):
+            raise RuntimeError("disk on fire")
+
+    path = str(tmp_path / "q.json")
+    with pytest.raises(TypeError):
+        integrity._write_json_durable(path, {"convicted": Boom()})
+    assert not os.path.exists(path)
+    assert not glob.glob(path + ".tmp-*")  # R9: no leak on the error path
+
+
+# ================================================= supervisor wiring
+class FakeStep:
+    """Scripted step: hands the supervisor a queue of fingerprints and a
+    restorable numpy state — no mesh, no jit."""
+
+    def __init__(self, fps):
+        self._fps = list(fps)
+        self._count = 0
+        self.enabled_axis = None
+        self.w = np.ones(4, np.float32)
+
+    def enable_integrity(self, vote_axis="dp"):
+        self.enabled_axis = vote_axis
+
+    def take_fingerprint(self):
+        return self._fps.pop(0) if self._fps else None
+
+    def state_dict(self):
+        return {"w": self.w, "count": np.asarray(self._count)}
+
+    def set_state_dict(self, state):
+        self.w = np.asarray(state["w"])
+        self._count = int(np.asarray(state["count"]))
+
+
+def _supervisor(tmp_path, fps, **kw):
+    from paddle_tpu.framework.supervisor import (RecoveryPolicy,
+                                                 TrainingSupervisor)
+
+    policy = RecoveryPolicy(
+        checkpoint_dir=str(tmp_path / "ckpt"), save_interval_steps=100,
+        keep_max=3, async_save=False, preemption=False,
+        integrity_check_interval=1, **kw)
+    step = FakeStep(fps)
+    return TrainingSupervisor(step, policy), step
+
+
+def test_supervisor_enables_integrity_from_policy(tmp_path):
+    sup, step = _supervisor(tmp_path, [], integrity_vote_axis="sdp")
+    assert step.enabled_axis == "sdp" and sup.integrity is not None
+
+
+def test_supervisor_warns_when_step_cannot_fingerprint(tmp_path):
+    from paddle_tpu.framework.supervisor import (RecoveryPolicy,
+                                                 TrainingSupervisor)
+
+    class NoIntegrity:
+        _count = 0
+
+    with pytest.warns(RuntimeWarning, match="enable_integrity"):
+        sup = TrainingSupervisor(
+            NoIntegrity(), RecoveryPolicy(
+                checkpoint_dir=str(tmp_path / "c"),
+                integrity_check_interval=2, preemption=False))
+    assert sup.integrity is None
+
+
+def test_supervisor_ladder_replay_then_evict(tmp_path):
+    from paddle_tpu.framework.supervisor import RollbackRequested
+    from paddle_tpu.observability.registry import default_registry
+
+    clean = _fp([3], [3], [3])
+    bad = _fp([3], [9], [3])
+    sup, step = _supervisor(tmp_path, [clean, bad, bad])
+    seen = []
+    sup.on_rollback = lambda info: seen.append(info.get("integrity"))
+    base_replays = default_registry().snapshot()["counters"].get(
+        "integrity.replay", 0)
+    with sup:
+        sup.save_now()                         # the replay's restore point
+        step.w[:] = 5.0                        # post-checkpoint progress
+        step._count = 1
+        sup.after_batch(0, 0, 0.5, True, False)     # clean -> no verdict
+        step._count = 2
+        with pytest.raises(RollbackRequested):      # flip detected: replay
+            sup.after_batch(0, 1, 0.5, True, False)
+        assert np.all(step.w == 1.0)           # state rewound bit-exactly
+        assert step._count == 0
+        step._count = 1
+        with pytest.raises(HostEvictionRequested) as ei:  # sticky: convict
+            sup.after_batch(0, 0, 0.5, True, False)
+    assert ei.value.rank == 1 and os.path.exists(ei.value.record_path)
+    q = load_quarantine(sup.checkpoint.root)
+    assert q["convicted"][0]["rank"] == 1
+    assert seen and seen[0]["action"] == "replay" and seen[0]["rank"] == 1
+    snap = default_registry().snapshot()["counters"]
+    assert snap.get("integrity.replay", 0) == base_replays + 1
+    assert snap.get("integrity.evicted", 0) >= 1
+    assert snap.get("integrity.mismatch", 0) >= 2
+
+
+def test_supervisor_save_writes_ledger_and_restore_rejects_divergent(
+        tmp_path):
+    sup, step = _supervisor(tmp_path, [])
+    with sup:
+        sup.save_now()
+        path = os.path.join(sup.checkpoint.root, "step_0")
+        assert read_ledger(path)["divergent"] is False
+        # a later save whose window had already diverged: poison the
+        # ledger the way a divergent monitor would have
+        step._count = 1
+        sup.save_now()
+        p2 = os.path.join(sup.checkpoint.root, "step_1")
+        rec = read_ledger(p2)
+        rec["divergent"], rec["suspect"] = True, 3
+        with open(os.path.join(p2, LEDGER_FILE), "w") as f:
+            json.dump(rec, f)
+        with pytest.warns(RuntimeWarning, match="rank 3"):
+            sup.restore()
+        assert step._count == 0                # fell back to step_0
+
+
+# ------------------------------------------------------------ the full proof
+@pytest.mark.slow
+def test_sdc_drill_quick_passes():
+    """The real multi-replica ladder on a dp4 x mp2 simulated mesh: a
+    seeded flip on rank 2's physical copies detected by the fingerprint
+    vote within one check interval, transient replayed + forgiven (loss
+    bit-identical to fault-free), sticky convicted + quarantined +
+    EXIT_EVICTED, then a reduced-topology resume on the surviving 6
+    devices. Integrity-ON clean run asserted BIT-identical to the
+    integrity-OFF reference (5 subprocesses, ~15-30 s)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "sdc_drill.py"),
+         "--quick"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=600)
+    assert p.returncode == 0, p.stdout[-3000:]
+    assert "[sdc_drill] PASS" in p.stdout

@@ -2001,3 +2001,133 @@ def test_repo_lifecycle_graph_names_engine_pin_sites(capsys):
     assert any(a["protocol"] == "staged-file"
                and a["file"] == "paddle_tpu/observability/flight.py"
                for a in acq)
+
+
+# ===================================== PR 20: integrity-readback shapes
+def test_r1_fingerprint_flush_without_reason_is_flagged(tmp_path):
+    # the integrity monitor's window drain: a device_get is a host sync
+    # wherever it lives — without a reasoned suppression it must surface
+    fs = lint(tmp_path, """
+        import threading
+        import jax
+
+        class Monitor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+
+            def flush(self):
+                with self._lock:
+                    todo, self._pending = self._pending, []
+                fetched = jax.device_get([fp for _, fp in todo])
+                return fetched
+    """)
+    assert any(f.symbol == "Monitor.flush" and "device_get" in f.message
+               for f in rules_at(fs, "R1"))
+
+
+def test_r1_batched_fingerprint_flush_suppression_holds(tmp_path):
+    # the shipped shape (integrity.IntegrityMonitor.flush): ONE batched
+    # readback per check window, drained outside the lock, with the
+    # reasoned suppression — R1 silenced, R5/R7 genuinely clean
+    fs = lint(tmp_path, """
+        import threading
+        import jax
+
+        class Monitor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+                self.mismatches = 0
+
+            def observe(self, step, fp):
+                with self._lock:
+                    self._pending.append((step, fp))
+
+            def flush(self):
+                with self._lock:
+                    todo, self._pending = self._pending, []
+                # tpu-lint: disable=R1(one batched readback per check window, by design)
+                fetched = jax.device_get([fp for _, fp in todo])
+                with self._lock:
+                    self.mismatches += len(fetched)
+                return fetched
+    """)
+    assert rules_at(fs, "R1") == []
+    assert rules_at(fs, "R5") == []
+    assert rules_at(fs, "R7") == []
+
+
+def test_r7_fingerprint_readback_under_lock_is_flagged(tmp_path):
+    # the pre-fix hazard the shipped monitor avoids: device_get while
+    # holding the bookkeeping lock — a stuck device wedges every
+    # stats()/observe() caller behind the flush
+    fs = lint(tmp_path, """
+        import threading
+        import jax
+
+        class Monitor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+
+            def stats(self):
+                with self._lock:
+                    return len(self._pending)
+
+            def flush(self):
+                with self._lock:
+                    # tpu-lint: disable=R1(window drain)
+                    return jax.device_get(self._pending)
+    """)
+    assert any(f.symbol == "Monitor.flush"
+               for f in rules_at(fs, "R7"))
+
+
+def test_r9_quarantine_staged_write_is_clean(tmp_path):
+    # integrity._write_json_durable: stage to a tmp sibling, publish with
+    # os.replace, and remove the tmp on ANY failure — no exception path
+    # may leak a half-written record next to the checkpoints
+    fs = lint(tmp_path, """
+        import json
+        import os
+
+        def write_durable(path, obj):
+            tmp = f"{path}.tmp-pt{os.getpid()}"
+            try:
+                f = open(tmp, "w")
+                try:
+                    json.dump(obj, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                finally:
+                    f.close()
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+    """)
+    assert rules_at(fs, "R9") == []
+
+
+def test_r9_quarantine_staging_leak_is_flagged(tmp_path):
+    # a staged record that takes a NORMAL early return without publishing
+    # is a silent lost write (raise paths are exempt by design — that is
+    # the crash-safety the orphan sweep covers)
+    fs = lint(tmp_path, """
+        import json
+        import os
+
+        def write_leaky(path, obj):
+            tmp = f"{path}.tmp-pt{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+            if not obj:
+                return None
+            os.replace(tmp, path)
+    """)
+    assert any(f.symbol == "write_leaky" and "staged .tmp file" in f.message
+               for f in rules_at(fs, "R9"))

@@ -8,15 +8,24 @@ Scenarios (each runs in a fresh subprocess so ``crash`` faults can kill it):
 - ``rpc``  — single-world ``init_rpc`` + ``rpc_sync`` + bounded shutdown
 - ``ckpt`` — two checkpoint saves + verified restore from the newest VALID
   checkpoint (faults may fail a save; they must never corrupt the root)
+- ``sdc``  — a supervised dp4 train loop with the cross-replica integrity
+  vote on (4 simulated CPU devices; the only row whose kinds include
+  ``bitflip``)
 
 Expected outcomes by kind:
 
 - ``drop``/``delay``/``slow`` — the scenario retries/absorbs the fault
   and exits 0 (``slow`` is the gray-failure kind: seeded-random latency
   at the site; for ``ckpt``, a failed save is fine as long as restore
-  stays valid);
+  stays valid; for ``sdc``, a non-bitflip kind at ``train.bitflip``
+  degrades to the NaN-poison seam and the numerics watchdog rolls it
+  back);
 - ``crash`` — the process dies with ``CRASH_EXIT``, and a clean re-run
-  against the same state recovers (resume-after-crash).
+  against the same state recovers (resume-after-crash);
+- ``bitflip`` (``sdc`` row only) — one seeded flip on rank 1's physical
+  copies after the second checkpoint: the fingerprint vote must detect
+  it (NaN watchdog stays blind), deterministically replay, and finish
+  clean — the child asserts ``replays >= 1`` and zero convictions.
 
 Deterministic: seeded plans, counted faults, bounded deadlines. Exit code
 is non-zero iff any cell fails, so CI can gate on it. Usage::
@@ -27,6 +36,7 @@ is non-zero iff any cell fails, so CI can gate on it. Usage::
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -88,7 +98,67 @@ def scenario_ckpt() -> None:
     assert state["step"] in (1, 2)
 
 
-SCENARIOS = {"kv": scenario_kv, "rpc": scenario_rpc, "ckpt": scenario_ckpt}
+def scenario_sdc() -> None:
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import elastic_mesh
+    from paddle_tpu.distributed.shard import DistributedTrainStep
+    from paddle_tpu.framework.supervisor import (RecoveryPolicy,
+                                                 RollbackRequested,
+                                                 TrainingSupervisor)
+    from paddle_tpu.optimizer import AdamW
+
+    assert len(jax.devices()) >= 4, "sdc row needs 4 simulated devices"
+    root = os.environ["SWEEP_CKPT_ROOT"]
+    mesh = elastic_mesh.reshaped_mesh(os.path.join(root, "ckpt"),
+                                      default_axes={"dp": -1})
+    pt.seed(1234)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+    step = DistributedTrainStep(
+        model, AdamW(learning_rate=1e-2),
+        loss_fn=lambda out, b: F.mse_loss(out, b[1]), mesh=mesh)
+    policy = RecoveryPolicy(
+        checkpoint_dir=os.path.join(root, "ckpt"), save_interval_steps=2,
+        keep_max=4, async_save=False, preemption=False, check_interval=2,
+        integrity_check_interval=2)
+    sup = TrainingSupervisor(step, policy)
+    rng = np.random.default_rng(7)
+    w_true = rng.standard_normal((8, 8)).astype(np.float32)
+
+    def batch_at(i: int):
+        r = np.random.default_rng(100003 + i)
+        x = r.standard_normal((8, 8)).astype(np.float32)
+        return x, x @ w_true
+
+    total = 10
+    with sup:
+        sup.restore()
+        i = int(step._count)
+        while i < total:
+            sup.before_batch()
+            try:
+                loss, ok, found = step.watchdog_call(batch_at(i))
+                sup.after_batch(0, i, loss, ok, found)
+            except RollbackRequested:
+                i = int(step._count)
+                continue
+            i += 1
+        sup.finish_epoch()
+    assert int(step._count) == total
+    plan = json.loads(os.environ.get("PT_FAULT_PLAN", "{}"))
+    if any(r.get("kind") == "bitflip" for r in plan.get("rules", [])):
+        st = sup.integrity.stats()
+        assert st["replays"] >= 1, f"flip never detected: {st}"
+        assert st["convictions"] == 0, f"transient flip convicted: {st}"
+
+
+SCENARIOS = {"kv": scenario_kv, "rpc": scenario_rpc, "ckpt": scenario_ckpt,
+             "sdc": scenario_sdc}
 
 MATRIX = [
     ("kv", "kv.put"),
@@ -96,13 +166,25 @@ MATRIX = [
     ("rpc", "rpc.connect.*"),
     ("ckpt", "ckpt.shard_write"),
     ("ckpt", "ckpt.publish"),
+    ("sdc", "train.bitflip"),
 ]
 KINDS = ("drop", "delay", "slow", "crash")
 
 
+def _kinds_for(scenario: str):
+    # only the supervised train row has an owner for the bitflip kind
+    # (integrity.apply_bitflip behind the train.bitflip site)
+    return KINDS + ("bitflip",) if scenario == "sdc" else KINDS
+
+
 def _make_plan(site: str, kind: str) -> FaultPlan:
     # ckpt rules skip the first save (1 shard write + 1 publish) so the
-    # fault lands on the SECOND checkpoint and fallback is observable
+    # fault lands on the SECOND checkpoint and fallback is observable;
+    # the bitflip lands after the second checkpoint so the deterministic
+    # replay has a consistent restore point to discard the step from
+    if kind == "bitflip":
+        return FaultPlan([{"site": site, "kind": kind, "times": 1,
+                           "after": 4, "rank": 1}], seed=1234)
     after = 1 if site.startswith("ckpt") else 0
     return FaultPlan([{"site": site, "kind": kind,
                        "times": 1 if kind == "crash" else 2,
@@ -120,6 +202,10 @@ def _run_child(scenario: str, env: dict) -> subprocess.CompletedProcess:
 
 def run_cell(scenario: str, site: str, kind: str):
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    if scenario == "sdc":  # the integrity vote needs dp replicas
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=4"
+                            ).strip()
     env["PT_FAULT_PLAN"] = _make_plan(site, kind).to_json()
     with tempfile.TemporaryDirectory(prefix="fault_sweep_") as workdir:
         env["SWEEP_CKPT_ROOT"] = workdir
@@ -148,16 +234,17 @@ def main() -> int:
         return 0
 
     rows, failed = [], 0
+    total_cells = sum(len(_kinds_for(s)) for s, _ in MATRIX)
     for scenario, site in MATRIX:
-        for kind in KINDS:
+        for kind in _kinds_for(scenario):
             t0 = time.monotonic()
             ok, detail = run_cell(scenario, site, kind)
             rows.append((scenario, site, kind,
                          "PASS" if ok else "FAIL",
                          f"{time.monotonic() - t0:5.1f}s  {detail}"))
             failed += 0 if ok else 1
-            print(f"[{len(rows)}/{len(MATRIX) * len(KINDS)}] "
-                  f"{scenario:5s} {site:18s} {kind:6s} "
+            print(f"[{len(rows)}/{total_cells}] "
+                  f"{scenario:5s} {site:18s} {kind:7s} "
                   f"{'PASS' if ok else 'FAIL'}", flush=True)
 
     print()

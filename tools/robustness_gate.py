@@ -111,6 +111,21 @@ story. Runs, in order:
    compile cache must keep cutting cold TTFT by the stored floor, and
    migration overhead must stay under its ceiling.
 
+10. with ``--sdc``, the silent-data-corruption drill:
+   ``tools/sdc_drill.py --quick`` — a seeded one-bit flip on vote-axis
+   rank 2's physical copies (logical value untouched, numerics watchdog
+   blind) must be caught by the cross-replica fingerprint vote within
+   one check interval with the right culprit named; the transient case
+   must end at a deterministic replay (final loss bit-identical to
+   fault-free), the sticky case must escalate to a conviction — durable
+   quarantine record, flight dump, ``EXIT_EVICTED`` — and the next
+   incarnation must resume on the surviving reduced topology via the
+   elastic reshard path with loss parity. The integrity-ON clean run
+   must be BIT-identical to the integrity-OFF reference (defaults off
+   means defaults off). A scoped tpu_lint of the integrity/supervisor
+   files rides along so the R1 (one batched fingerprint readback) and
+   R9 (durable quarantine staging) lines hold under ``--skip-lint``.
+
 Exit code is non-zero iff any stage fails. ``--skip-sweep`` /
 ``--skip-soak`` run a single stage (e.g. pre-merge quick signal vs the
 nightly full matrix)::
@@ -126,6 +141,7 @@ nightly full matrix)::
     python tools/robustness_gate.py --overlap      # + step-schedule gate
     python tools/robustness_gate.py --decode       # + decode-speed gate
     python tools/robustness_gate.py --disagg       # + prefill/decode split
+    python tools/robustness_gate.py --sdc          # + bit-flip defense
     python tools/robustness_gate.py --skip-lint    # runtime stages only
 """
 from __future__ import annotations
@@ -480,6 +496,12 @@ def main() -> int:
                          "KV vs plain engine, against the "
                          ".decode_baseline.json floor + scoped tpu_lint "
                          "of the speculative/quantization files)")
+    ap.add_argument("--sdc", action="store_true",
+                    help="also run the silent-data-corruption drill "
+                         "(sdc_drill --quick: fingerprint-vote detection "
+                         "of a seeded bit flip, replay-vs-convict ladder, "
+                         "quarantine + eviction + reduced-topology resume "
+                         "+ scoped tpu_lint of the integrity files)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="skip the tpu_lint static-analysis stage")
     ap.add_argument("--full-lint", action="store_true",
@@ -548,6 +570,24 @@ def main() -> int:
         results["disagg"] = _run_disagg_gate()
     if args.decode:
         results["decode"] = _run_decode_gate()
+    if args.sdc:
+        results["sdc"] = _run(
+            "sdc", [sys.executable, os.path.join(TOOLS, "sdc_drill.py"),
+                    "--quick"])
+        if results["sdc"]:
+            # scoped self-application: the fingerprint readback (R1
+            # suppressed at exactly one reasoned sync point), the
+            # monitor's lock discipline (R5/R7) and the quarantine
+            # staging write (R9) must carry zero unbaselined findings
+            results["sdc_lint"] = _run(
+                "sdc_lint",
+                [sys.executable, os.path.join(TOOLS, "tpu_lint.py"),
+                 "--baseline",
+                 os.path.join(REPO, ".tpu_lint_baseline.json"),
+                 os.path.join(REPO, "paddle_tpu/distributed/integrity.py"),
+                 os.path.join(REPO, "paddle_tpu/distributed/shard.py"),
+                 os.path.join(REPO, "paddle_tpu/framework/supervisor.py"),
+                 os.path.join(REPO, "tools/sdc_drill.py")])
     if not args.skip_sweep:
         results["fault_sweep"] = _run(
             "fault_sweep", [sys.executable,

@@ -1,0 +1,413 @@
+#!/usr/bin/env python
+"""Silent-data-corruption drill: the integrity escalation ladder, end to end.
+
+Five child runs on a simulated dp4 x mp2 CPU mesh (same MLP + data
+trajectory as ``chaos_soak.py --elastic``; every batch is a pure function
+of the global step, so runs are bit-comparable):
+
+1. **base** — integrity OFF (``integrity_check_interval=None``): the
+   defaults-off reference. Per-step losses are recorded as exact float32
+   bit patterns.
+2. **clean** — integrity ON, no faults: the fingerprint vote must stay
+   silent (zero mismatches) and every per-step loss must be BIT-IDENTICAL
+   to the base run — the in-program fingerprints are observation-only and
+   the feature defaults off, so enabling it must not perturb the math,
+   and disabling it leaves the step program byte-identical to a build
+   without the feature (asserted: the base child never compiles a
+   fingerprint specialization).
+3. **transient** — a seeded one-shot ``bitflip`` on vote-axis rank 2
+   (``times=1``: the cosmic-ray model). The vote must name rank 2 within
+   one check interval, the ladder must stop at deterministic replay (no
+   conviction), and the final loss must land within 1% of fault-free
+   (the replay is bit-deterministic, so it is in fact bit-identical).
+4. **sticky** — the same flip with ``times=None`` (a chip that keeps
+   lying): divergence recurs after the replay, the armed suspect is
+   convicted, a quarantine record lands durably next to the checkpoints,
+   a flight dump carries the fingerprints, and the child exits
+   ``EXIT_EVICTED``.
+5. **resume** — the post-eviction incarnation on the surviving 6 devices
+   (rank 2's pair evicted): ``elastic_mesh.reshaped_mesh`` absorbs the
+   shrink (dp4 -> dp3), the ledger-verified restore resumes from the
+   last consistent checkpoint, and training completes with loss parity.
+
+Gated as ``robustness_gate.py --sdc``; ``--quick`` stays under ~30s.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.distributed.resilience import (  # noqa: E402
+    EXIT_EVICTED, FaultPlan)
+
+DIM = 16
+BATCH = 12   # global; divides every drill topology (dp4, dp3, dp2)
+SAVE_INTERVAL = 4
+
+
+# ------------------------------------------------------------------ children
+def run_child(args) -> int:
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import elastic_mesh
+    from paddle_tpu.distributed.shard import DistributedTrainStep
+    from paddle_tpu.framework.supervisor import (HostEvictionRequested,
+                                                 RecoveryPolicy,
+                                                 RollbackRequested,
+                                                 TrainingSupervisor)
+    from paddle_tpu.distributed.parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+    from paddle_tpu.distributed.integrity import host_fold_leaf
+    from paddle_tpu.observability import flight
+    from paddle_tpu.optimizer import AdamW
+
+    assert len(jax.devices()) == args.devices, \
+        f"expected {args.devices} simulated devices, got {len(jax.devices())}"
+    flight.configure(dump_dir=os.path.join(args.workdir, "flight"))
+    root = os.path.join(args.workdir, "ckpt")
+    mesh = elastic_mesh.reshaped_mesh(root, default_axes={"dp": -1, "mp": 2})
+
+    elastic_mesh.rescale_batch(BATCH, dict(mesh.shape))  # divisibility check
+    pt.seed(args.seed)
+    model = nn.Sequential(
+        ColumnParallelLinear(DIM, 4 * DIM, gather_output=False),
+        nn.ReLU(),
+        RowParallelLinear(4 * DIM, DIM, input_is_parallel=True))
+    step = DistributedTrainStep(
+        model, AdamW(learning_rate=1e-2),
+        loss_fn=lambda out, b: F.mse_loss(out, b[1]), mesh=mesh)
+
+    rng = np.random.default_rng(args.seed)
+    w_true = rng.standard_normal((DIM, DIM)).astype(np.float32)
+
+    def batch_at(i: int):
+        r = np.random.default_rng(args.seed * 100003 + i)
+        x = r.standard_normal((BATCH, DIM)).astype(np.float32)
+        return x, x @ w_true
+
+    integrity_on = args.mode != "base"
+    policy = RecoveryPolicy(
+        checkpoint_dir=root, save_interval_steps=SAVE_INTERVAL, keep_max=4,
+        async_save=False, preemption=False, check_interval=args.interval,
+        integrity_check_interval=args.interval if integrity_on else None)
+    sup = TrainingSupervisor(step, policy)
+    if not integrity_on:
+        assert step._integrity is None and sup.integrity is None
+
+    losses = {}          # global step -> lazy loss (fetched once at the end)
+    detections = []      # escalation verdicts, via the on_rollback hook
+    evicted = None
+
+    def on_rollback(info):
+        if info.get("integrity"):
+            v = dict(info["integrity"])
+            v.pop("fingerprints", None)
+            detections.append(v)
+    sup.on_rollback = on_rollback
+
+    with sup:
+        sup.restore()
+        start = int(step._count)
+        print(f"[sdc-child:{args.mode}] devices={args.devices} "
+              f"mesh={dict(mesh.shape)} start_step={start}", flush=True)
+        i = start
+        try:
+            while i < args.total_steps:
+                sup.before_batch()
+                try:
+                    loss, ok, found = step.watchdog_call(batch_at(i))
+                    sup.after_batch(0, i, loss, ok, found)
+                except RollbackRequested:
+                    # batches are a pure function of the global step:
+                    # resume replaying at the restored count
+                    i = int(step._count)
+                    continue
+                losses[i] = loss
+                i += 1
+            sup.finish_epoch()
+        except HostEvictionRequested as ev:
+            evicted = {"rank": ev.rank, "step": ev.step,
+                       "record_path": ev.record_path}
+            print(f"[sdc-child:{args.mode}] evicted: {ev}", flush=True)
+
+    if not integrity_on:
+        # defaults-off means defaults off: the run must never have built
+        # a fingerprint specialization nor produced a fingerprint
+        assert step._fp_compiled is None and step._last_fp is None
+
+    # tpu-lint: disable=R1(one batched readback of the whole run's losses, after training — not on the step path)
+    fetched = jax.device_get([losses[k] for k in sorted(losses)])
+    losses_hex = {str(k): np.float32(v).tobytes().hex()
+                  for k, v in zip(sorted(losses), fetched)}
+    tail = [float(np.float32(v)) for v in fetched[-4:]]
+    stats = sup.integrity.stats() if sup.integrity is not None else None
+    result = {
+        "mode": args.mode,
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "start_step": start,
+        "end_step": int(step._count),
+        "final_eval_loss": float(np.mean(tail)) if tail else float("nan"),
+        "losses_hex": losses_hex,
+        "integrity": stats,
+        "detections": detections,
+        "evicted": evicted,
+        "param_fold": {k: host_fold_leaf(np.asarray(v))
+                       for k, v in sorted(step.params.items())},
+    }
+    out = os.path.join(args.workdir, f"result_{args.mode}.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out + ".tmp", out)
+    print(json.dumps({k: result[k] for k in
+                      ("mode", "final_eval_loss", "end_step", "integrity",
+                       "detections", "evicted")}), flush=True)
+    if args.mode == "sticky":
+        # the harness contract: a conviction ends the incarnation with
+        # EXIT_EVICTED so the launcher reschedules on surviving capacity
+        return EXIT_EVICTED if evicted is not None else 1
+    return 0
+
+
+# ------------------------------------------------------------------- harness
+def _flip_rule(args, times):
+    return {"site": "train.bitflip", "kind": "bitflip", "times": times,
+            "after": args.flip_after, "tensor": "*weight*", "rank": 2}
+
+
+def _spawn(workdir: str, args, mode: str, devices: int,
+           plan: FaultPlan | None):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={devices}"
+                        ).strip()
+    if plan is not None:
+        env["PT_FAULT_PLAN"] = plan.to_json()
+    else:
+        env.pop("PT_FAULT_PLAN", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--mode", mode, "--workdir", workdir, "--seed", str(args.seed),
+           "--devices", str(devices), "--total-steps",
+           str(args.total_steps), "--interval", str(args.interval),
+           "--flip-after", str(args.flip_after)]
+    return subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True, timeout=600)
+
+
+def _result(workdir: str, mode: str):
+    path = os.path.join(workdir, f"result_{mode}.json")
+    return json.load(open(path)) if os.path.exists(path) else None
+
+
+def _rel(a: float, b: float) -> float:
+    return abs(a - b) / max(abs(b), 1e-12)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--tol", type=float, default=0.01,
+                    help="relative final-loss tolerance vs fault-free")
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--child", action="store_true", help="internal")
+    ap.add_argument("--mode", default="base", help="internal")
+    ap.add_argument("--workdir", default=None, help="internal")
+    ap.add_argument("--devices", type=int, default=8, help="internal")
+    ap.add_argument("--total-steps", type=int, default=None)
+    ap.add_argument("--interval", type=int, default=2,
+                    help="integrity/watchdog check interval (steps)")
+    ap.add_argument("--flip-after", type=int, default=6,
+                    help="matching calls before the bitflip rule fires")
+    args = ap.parse_args()
+    if args.total_steps is None:
+        args.total_steps = 16 if args.quick else 32
+    if args.child:
+        return run_child(args)
+
+    failures = []
+    summary = {}
+    with tempfile.TemporaryDirectory(prefix="sdc_drill_") as root:
+        dirs = {m: os.path.join(root, m)
+                for m in ("base", "clean", "transient", "sticky")}
+        for d in dirs.values():
+            os.makedirs(d)
+
+        print("[sdc_drill] base run (integrity OFF — the defaults-off "
+              "reference)...", flush=True)
+        p = _spawn(dirs["base"], args, "base", 8, plan=None)
+        base = _result(dirs["base"], "base")
+        if p.returncode != 0 or base is None:
+            print(p.stdout[-2000:])
+            print("[sdc_drill] FAIL: base run failed")
+            return 1
+
+        print("[sdc_drill] clean run (integrity ON, no faults)...",
+              flush=True)
+        p = _spawn(dirs["clean"], args, "clean", 8, plan=None)
+        clean = _result(dirs["clean"], "clean")
+        if p.returncode != 0 or clean is None:
+            failures.append(f"clean: rc={p.returncode}: {p.stdout[-800:]}")
+        else:
+            # observation-only: enabling the fingerprint programs must not
+            # perturb a single bit of the training math
+            if clean["losses_hex"] != base["losses_hex"]:
+                diff = [k for k in base["losses_hex"]
+                        if clean["losses_hex"].get(k)
+                        != base["losses_hex"][k]]
+                failures.append(
+                    f"clean: losses NOT bit-identical to integrity-off "
+                    f"base at steps {diff[:5]}")
+            if clean["param_fold"] != base["param_fold"]:
+                failures.append("clean: final params not bit-identical "
+                                "to integrity-off base")
+            if clean["integrity"]["mismatches"] != 0:
+                failures.append(
+                    f"clean: {clean['integrity']['mismatches']} false "
+                    f"fingerprint mismatches on a fault-free run")
+
+        print(f"[sdc_drill] transient flip (rank 2, once, after "
+              f"{args.flip_after} steps)...", flush=True)
+        p = _spawn(dirs["transient"], args, "transient", 8,
+                   plan=FaultPlan([_flip_rule(args, times=1)],
+                                  seed=args.seed))
+        tr = _result(dirs["transient"], "transient")
+        if p.returncode != 0 or tr is None:
+            failures.append(f"transient: rc={p.returncode}: "
+                            f"{p.stdout[-1200:]}")
+        else:
+            det = tr["detections"]
+            if not det:
+                failures.append("transient: bitflip never detected")
+            else:
+                # the flip lands before fp-step flip_after+1; the vote
+                # must name it within one check interval of that step
+                flip_step = args.flip_after + 1
+                if det[0].get("rank") != 2:
+                    failures.append(f"transient: wrong culprit "
+                                    f"{det[0].get('rank')} (expected 2)")
+                if not (flip_step <= det[0]["step"]
+                        <= flip_step + args.interval):
+                    failures.append(
+                        f"transient: detected at step {det[0]['step']}, "
+                        f"outside one check interval of the flip at "
+                        f"{flip_step}")
+            st = tr["integrity"] or {}
+            if st.get("replays", 0) < 1:
+                failures.append("transient: no deterministic replay ran")
+            if st.get("convictions", 0) != 0:
+                failures.append("transient: transient fault was CONVICTED "
+                                "(should have been forgiven)")
+            rel = _rel(tr["final_eval_loss"], base["final_eval_loss"])
+            if not math.isfinite(rel) or rel > args.tol:
+                failures.append(
+                    f"transient: final loss {tr['final_eval_loss']} vs "
+                    f"fault-free {base['final_eval_loss']} "
+                    f"(rel {rel:.4f} > tol {args.tol})")
+            summary["transient_rel"] = rel
+            summary["transient_bitwise"] = (tr["losses_hex"].get(
+                str(args.total_steps - 1)) == base["losses_hex"].get(
+                str(args.total_steps - 1)))
+
+        print("[sdc_drill] sticky flip (rank 2, every step -> "
+              "conviction)...", flush=True)
+        p = _spawn(dirs["sticky"], args, "sticky", 8,
+                   plan=FaultPlan([_flip_rule(args, times=None)],
+                                  seed=args.seed))
+        stk = _result(dirs["sticky"], "sticky")
+        if p.returncode != EXIT_EVICTED:
+            failures.append(f"sticky: expected EXIT_EVICTED "
+                            f"{EXIT_EVICTED}, got {p.returncode}: "
+                            f"{p.stdout[-1200:]}")
+        if stk is None:
+            failures.append("sticky: no result file")
+        else:
+            ev = stk.get("evicted") or {}
+            if ev.get("rank") != 2:
+                failures.append(f"sticky: convicted rank {ev.get('rank')} "
+                                f"(expected 2)")
+            qpath = os.path.join(dirs["sticky"], "ckpt", "quarantine.json")
+            if not os.path.exists(qpath):
+                failures.append("sticky: no durable quarantine.json")
+            else:
+                q = json.load(open(qpath))
+                ranks = [r.get("rank") for r in q.get("convicted", [])]
+                if ranks != [2]:
+                    failures.append(f"sticky: quarantine names {ranks}, "
+                                    f"expected [2]")
+            fdir = os.path.join(dirs["sticky"], "flight")
+            dumps = ([f for f in os.listdir(fdir) if "conviction" in f]
+                     if os.path.isdir(fdir) else [])
+            if not dumps:
+                failures.append("sticky: no integrity_conviction flight "
+                                "dump")
+
+        print("[sdc_drill] post-eviction resume (6 surviving devices, "
+              "dp4 -> dp3)...", flush=True)
+        p = _spawn(dirs["sticky"], args, "resume", 6, plan=None)
+        rs = _result(dirs["sticky"], "resume")
+        if p.returncode != 0 or rs is None:
+            failures.append(f"resume: rc={p.returncode}: "
+                            f"{p.stdout[-1200:]}")
+        else:
+            if "elastic reshard" not in p.stdout:
+                failures.append("resume: no 'elastic reshard' logged — "
+                                "the shrunk incarnation did not "
+                                "reshard-restore")
+            if rs["mesh"].get("dp") != 3 or rs["mesh"].get("mp") != 2:
+                failures.append(f"resume: mesh {rs['mesh']}, expected "
+                                f"dp3 x mp2")
+            if rs["end_step"] != args.total_steps:
+                failures.append(f"resume: stopped at step "
+                                f"{rs['end_step']}/{args.total_steps}")
+            if not (0 < rs["start_step"] < args.total_steps):
+                failures.append(f"resume: no cross-topology progress "
+                                f"(start_step={rs['start_step']})")
+            rel = _rel(rs["final_eval_loss"], base["final_eval_loss"])
+            if not math.isfinite(rel) or rel > args.tol:
+                failures.append(
+                    f"resume: final loss {rs['final_eval_loss']} vs "
+                    f"fault-free {base['final_eval_loss']} "
+                    f"(rel {rel:.4f} > tol {args.tol})")
+            summary["resume_rel"] = rel
+
+        summary.update({
+            "base_loss": base["final_eval_loss"],
+            "detections": (tr or {}).get("detections"),
+            "sticky_evicted": (stk or {}).get("evicted"),
+            "failures": failures,
+        })
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(summary, f, indent=1)
+
+    if failures:
+        print("[sdc_drill] FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"[sdc_drill] PASS: integrity-on bit-identical to integrity-off; "
+          f"transient flip detected (rank 2, within one interval), "
+          f"replayed + forgiven (rel "
+          f"{summary.get('transient_rel', 0):.2e}, bitwise="
+          f"{summary.get('transient_bitwise')}); sticky flip convicted + "
+          f"quarantined + evicted; resumed on 6 devices (rel "
+          f"{summary.get('resume_rel', 0):.2e})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
